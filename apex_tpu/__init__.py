@@ -1,0 +1,30 @@
+"""apex_tpu — a TPU-native re-imagining of NVIDIA Apex.
+
+Everything Apex offers for CUDA/PyTorch (mixed precision, fused optimizers,
+fused normalization, data/tensor/pipeline parallelism) rebuilt TPU-first on
+JAX/XLA/Pallas: functional transforms, ``jax.sharding.Mesh`` + ``shard_map``
+for parallelism, Pallas kernels for the hot ops, and XLA collectives
+(psum / all_gather / ppermute / reduce_scatter) over the ICI mesh instead of
+NCCL.
+
+Reference capability surface: /root/reference (NVIDIA Apex); see SURVEY.md §2
+for the component-by-component mapping.
+"""
+
+from apex_tpu import amp
+from apex_tpu import optimizers
+from apex_tpu import normalization
+from apex_tpu import parallel
+from apex_tpu import multi_tensor_apply
+from apex_tpu import transformer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "optimizers",
+    "normalization",
+    "parallel",
+    "multi_tensor_apply",
+    "transformer",
+]

@@ -1,0 +1,28 @@
+"""Automatic mixed precision (TPU re-design of ``apex.amp``).
+
+Ref: apex/amp/__init__.py. See frontend.py for the O0-O3 → TPU mapping.
+"""
+
+from apex_tpu.amp.frontend import (
+    Policy,
+    Properties,
+    initialize,
+    state_dict,
+    load_state_dict,
+)
+from apex_tpu.amp.handle import AmpHandle
+from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
+from apex_tpu.amp import lists
+
+__all__ = [
+    "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
+    "AmpHandle", "LossScaler", "LossScaleState", "scaled_update", "lists",
+]
+
+
+def scale_loss(loss, optimizers=None):
+    """Module-level ``amp.scale_loss`` parity (ref apex/amp/handle.py:40)."""
+    from apex_tpu.amp._amp_state import _amp_state
+    if _amp_state.handle is None:
+        raise RuntimeError("amp.initialize must be called before amp.scale_loss")
+    return _amp_state.handle.scale_loss(loss, optimizers)

@@ -1,0 +1,26 @@
+"""Process-level amp registry (ref apex/amp/_amp_state.py).
+
+Holds the active :class:`~apex_tpu.amp.handle.AmpHandle` so module-level
+``amp.state_dict()`` / ``amp.load_state_dict()`` work like the reference.
+"""
+
+from __future__ import annotations
+
+
+class AmpState:
+    def __init__(self):
+        self.handle = None
+        self.opt_properties = None
+        self.verbosity = 1
+
+
+_amp_state = AmpState()
+
+
+def maybe_print(s: str, verbose: bool = False) -> None:
+    if _amp_state.verbosity > (0 if verbose else 1) or (verbose and _amp_state.verbosity > 0):
+        print(s)
+
+
+def warn_or_err(msg: str) -> None:
+    raise RuntimeError("\n".join(["", msg]))

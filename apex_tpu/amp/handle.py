@@ -1,0 +1,145 @@
+"""AmpHandle + ``scale_loss`` — TPU re-design of ``apex.amp.handle``.
+
+Ref: apex/amp/handle.py. The reference's ``with amp.scale_loss(loss, opt)``
+multiplies the loss, then unscales grads and maybe skips ``opt.step()`` on
+exit. JAX gradients are functional, so the handle exposes both:
+
+- the **functional protocol** (use inside jit):
+  ``scaled = handle.scale_loss(loss, sstate)`` →
+  ``grads = jax.grad(...)`` →
+  ``updates, opt_state, sstate, overflow = handle.scaled_update(tx, grads, ...)``
+- a **stateful convenience** mirroring apex: a ``with handle.scale_loss(loss)
+  as scaled:`` context (host-level loop only) whose scaler state lives on the
+  handle, plus FusedOptimizer integration via :meth:`attach`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import Policy, Properties
+from apex_tpu.amp.scaler import LossScaler, scaled_update as _scaled_update
+
+
+class AmpHandle:
+    def __init__(self, props: Properties, min_loss_scale=None,
+                 max_loss_scale=2.0 ** 24, half_dtype=jnp.bfloat16):
+        self.props = props
+        compute = half_dtype if props.opt_level in ("O1", "O2", "O3") else jnp.float32
+        param = props.cast_model_type or jnp.float32
+        self.policy = Policy(
+            param_dtype=param,
+            compute_dtype=compute if props.enabled else jnp.float32,
+            output_dtype=jnp.float32,
+            keep_batchnorm_fp32=bool(props.keep_batchnorm_fp32)
+            if props.keep_batchnorm_fp32 is not None else True,
+        )
+        self.scaler = LossScaler(
+            loss_scale=props.loss_scale if props.enabled else 1.0,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+            enabled=props.enabled and props.loss_scale != 1.0,
+        )
+        self.scaler_state = self.scaler.init()
+        self._optimizers = []
+
+    # ---- functional protocol ----------------------------------------------
+
+    def scale(self, loss, scaler_state=None):
+        return self.scaler.scale_loss(
+            loss, scaler_state if scaler_state is not None else self.scaler_state)
+
+    def scaled_update(self, tx, grads, opt_state, params, scaler_state):
+        return _scaled_update(tx, self.scaler, grads, opt_state, params, scaler_state)
+
+    # ---- stateful convenience (host-level loops) --------------------------
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        """``with handle.scale_loss(loss) as scaled_loss:`` (ref handle.py:40).
+
+        Yields the scaled loss; the matching unscale+skip runs inside the
+        attached optimizer's ``step`` (see :meth:`attach`).
+        """
+        yield self.scale(loss)
+
+    def attach(self, optimizers):
+        """Patch FusedOptimizer.step to unscale, skip-on-overflow, advance the
+        dynamic scale, and (O2) keep fp32 master weights — the
+        ``_process_optimizer`` analog (ref apex/amp/_process_optimizer.py).
+
+        The whole amp step is jitted ONCE per optimizer with the scaler state
+        as a traced argument, so repeated ``step`` calls hit the compilation
+        cache and the loss scale evolves on device.
+        """
+        if not isinstance(optimizers, (list, tuple)):
+            optimizers = [optimizers]
+        for opt in optimizers:
+            if any(o is opt for o in self._optimizers):
+                continue
+            self._optimizers.append(opt)
+            scaler = self.scaler
+            tx = opt.tx
+            use_master = bool(self.props.master_weights)
+            if use_master:
+                # fp32 master copy; the model params stay in their (half) dtype
+                # and are re-materialized from the master each step
+                # (ref _process_optimizer.py master param setup).
+                opt.master_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(jnp.float32), opt.params)
+                # moments must match the master tree's dtype/shape
+                opt.state = tx.init(opt.master_params)
+
+            import optax as _optax
+
+            def amp_step(grads, state, params, master, scaler_state):
+                unscaled, overflow = scaler.unscale(grads, scaler_state)
+                opt_params = master if use_master else params
+                g32 = (jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), unscaled)
+                    if use_master else unscaled)
+
+                def do(_):
+                    updates, new_state = tx.update(g32, state, opt_params)
+                    return _optax.apply_updates(opt_params, updates), new_state
+
+                new_opt_params, new_state = jax.lax.cond(
+                    overflow, lambda _: (opt_params, state), do, None)
+                if use_master:
+                    new_params = jax.tree_util.tree_map(
+                        lambda m, p: m.astype(p.dtype), new_opt_params, params)
+                    new_master = new_opt_params
+                else:
+                    new_params, new_master = new_opt_params, master
+                new_sstate = scaler.update(scaler_state, overflow)
+                return new_params, new_master, new_state, new_sstate, overflow
+
+            jitted = jax.jit(amp_step)
+            handle = self
+
+            def step(grads=None, closure=None, _opt=opt, _jitted=jitted):
+                loss = closure() if closure is not None else None
+                if grads is None:
+                    raise ValueError("pass grads to step()")
+                (_opt.params, master, _opt.state,
+                 handle.scaler_state, _) = _jitted(
+                    grads, _opt.state, _opt.params,
+                    getattr(_opt, "master_params", _opt.params),
+                    handle.scaler_state)
+                if use_master:
+                    _opt.master_params = master
+                return loss if loss is not None else _opt.params
+
+            opt.step = step
+
+    # ---- checkpointing -----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return self.scaler.state_dict(self.scaler_state)
+
+    def load_state_dict(self, d: dict) -> None:
+        self.scaler_state = self.scaler.load_state_dict(d)

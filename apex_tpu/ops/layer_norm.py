@@ -1,0 +1,309 @@
+"""Pallas TPU kernels for fused LayerNorm / RMSNorm.
+
+TPU re-design of the reference CUDA kernels
+(ref csrc/layer_norm_cuda_kernel.cu via apex/normalization/fused_layer_norm.py).
+
+Design: one single-pass kernel per row-block computes the statistics and the
+normalized output in VMEM (fp32 math regardless of storage dtype — same
+policy as the CUDA kernel's float accumulators). The backward runs as pure
+XLA (it is a couple of row reductions that XLA fuses into one pass; saved
+activations are just (mu, rstd), which is the memory-efficient choice).
+
+On non-TPU backends (tests run on a CPU mesh) the forward falls back to an
+equivalent jnp implementation — same math, same vjp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_ROWS = 256
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _ln_fwd_kernel(eps, affine, x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(jnp.square(xc), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    if affine:
+        y = xhat * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    else:
+        y = xhat
+    y_ref[:] = y.astype(y_ref.dtype)
+    mu_ref[:] = mu
+    rstd_ref[:] = rstd
+
+
+def _rms_fwd_kernel(eps, affine, x_ref, w_ref, y_ref, rstd_ref):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = x * rstd
+    if affine:
+        y = xhat * w_ref[:].astype(jnp.float32)
+    else:
+        y = xhat
+    y_ref[:] = y.astype(y_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _row_block(n_rows: int) -> int:
+    for cand in (_BLOCK_ROWS, 128, 64, 32, 16, 8):
+        if n_rows % cand == 0:
+            return cand
+    return 0  # no clean split — caller pads
+
+
+def _pad_rows(x2, block):
+    n = x2.shape[0]
+    pad = (-n) % block
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return x2, n
+
+
+def _ln_fwd_pallas(x2, w, b, eps):
+    affine = w is not None
+    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    x2p, n = _pad_rows(x2, block)
+    rows, h = x2p.shape
+    grid = (rows // block,)
+    row_spec = pl.BlockSpec((block, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    in_specs = [row_spec] + ([vec_spec, vec_spec] if affine else [])
+    args = (x2p,) + ((w.reshape(1, h), b.reshape(1, h)) if affine else ())
+    kernel = functools.partial(_ln_fwd_kernel, eps, affine)
+    if not affine:
+        kernel = functools.partial(
+            lambda eps_, x_ref, y_ref, mu_ref, rstd_ref: _ln_fwd_kernel(
+                eps_, False, x_ref, None, None, y_ref, mu_ref, rstd_ref), eps)
+    y, mu, rstd = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[row_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(*args)
+    return y[:n], mu[:n], rstd[:n]
+
+
+def _rms_fwd_pallas(x2, w, eps):
+    affine = w is not None
+    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    x2p, n = _pad_rows(x2, block)
+    rows, h = x2p.shape
+    grid = (rows // block,)
+    row_spec = pl.BlockSpec((block, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    in_specs = [row_spec] + ([vec_spec] if affine else [])
+    args = (x2p,) + ((w.reshape(1, h),) if affine else ())
+    if affine:
+        kernel = functools.partial(_rms_fwd_kernel, eps, True)
+    else:
+        kernel = functools.partial(
+            lambda eps_, x_ref, y_ref, rstd_ref: _rms_fwd_kernel(
+                eps_, False, x_ref, None, y_ref, rstd_ref), eps)
+    y, rstd = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[row_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+    )(*args)
+    return y[:n], rstd[:n]
+
+
+# ------------------------------------------------------- fallbacks (jnp)
+
+
+def _ln_fwd_jnp(x2, w, b, eps):
+    x = x2.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(jnp.square(xc), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd
+    if w is not None:
+        y = y * w.astype(jnp.float32).reshape(1, -1) + b.astype(jnp.float32).reshape(1, -1)
+    return y.astype(x2.dtype), mu, rstd
+
+
+def _rms_fwd_jnp(x2, w, eps):
+    x = x2.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y = x * rstd
+    if w is not None:
+        y = y * w.astype(jnp.float32).reshape(1, -1)
+    return y.astype(x2.dtype), rstd
+
+
+# ------------------------------------------------ custom_vjp entry points
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layer_norm_affine(x2, w, b, eps):
+    fwd = _ln_fwd_pallas if _use_pallas() else _ln_fwd_jnp
+    return fwd(x2, w, b, eps)[0]
+
+
+def _layer_norm_affine_fwd(x2, w, b, eps):
+    fwd = _ln_fwd_pallas if _use_pallas() else _ln_fwd_jnp
+    y, mu, rstd = fwd(x2, w, b, eps)
+    return y, (x2, w, mu, rstd)
+
+
+def _layer_norm_affine_bwd(eps, res, dy):
+    x2, w, mu, rstd = res
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = (x - mu) * rstd
+    gw = g * w.astype(jnp.float32).reshape(1, -1)
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - m1 - xhat * m2)).astype(x2.dtype)
+    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
+    db = jnp.sum(g, axis=0).astype(w.dtype)
+    return dx, dw, db
+
+
+_layer_norm_affine.defvjp(_layer_norm_affine_fwd, _layer_norm_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _layer_norm_plain(x2, eps):
+    fwd = _ln_fwd_pallas if _use_pallas() else _ln_fwd_jnp
+    return fwd(x2, None, None, eps)[0]
+
+
+def _layer_norm_plain_fwd(x2, eps):
+    fwd = _ln_fwd_pallas if _use_pallas() else _ln_fwd_jnp
+    y, mu, rstd = fwd(x2, None, None, eps)
+    return y, (x2, mu, rstd)
+
+
+def _layer_norm_plain_bwd(eps, res, dy):
+    x2, mu, rstd = res
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = (x - mu) * rstd
+    m1 = jnp.mean(g, axis=-1, keepdims=True)
+    m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (g - m1 - xhat * m2)).astype(x2.dtype)
+    return (dx,)
+
+
+_layer_norm_plain.defvjp(_layer_norm_plain_fwd, _layer_norm_plain_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_affine(x2, w, eps):
+    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    return fwd(x2, w, eps)[0]
+
+
+def _rms_norm_affine_fwd(x2, w, eps):
+    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    y, rstd = fwd(x2, w, eps)
+    return y, (x2, w, rstd)
+
+
+def _rms_norm_affine_bwd(eps, res, dy):
+    x2, w, rstd = res
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = x * rstd
+    gw = g * w.astype(jnp.float32).reshape(1, -1)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - xhat * m2)).astype(x2.dtype)
+    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
+    return dx, dw
+
+
+_rms_norm_affine.defvjp(_rms_norm_affine_fwd, _rms_norm_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _rms_norm_plain(x2, eps):
+    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    return fwd(x2, None, eps)[0]
+
+
+def _rms_norm_plain_fwd(x2, eps):
+    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    y, rstd = fwd(x2, None, eps)
+    return y, (x2, rstd)
+
+
+def _rms_norm_plain_bwd(eps, res, dy):
+    x2, rstd = res
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = x * rstd
+    m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (g - xhat * m2)).astype(x2.dtype)
+    return (dx,)
+
+
+_rms_norm_plain.defvjp(_rms_norm_plain_fwd, _rms_norm_plain_bwd)
+
+
+# ------------------------------------------------------------- public API
+
+
+def _to_2d(x, normalized_shape):
+    import numpy as np
+    h = int(np.prod(normalized_shape))
+    lead = x.shape[: x.ndim - len(normalized_shape)]
+    if tuple(x.shape[x.ndim - len(normalized_shape):]) != tuple(normalized_shape):
+        raise ValueError(
+            f"input trailing dims {x.shape} do not match normalized_shape "
+            f"{normalized_shape}")
+    return x.reshape(-1, h), lead
+
+
+def layer_norm(x, weight: Optional[jax.Array], bias: Optional[jax.Array],
+               normalized_shape, eps: float = 1e-5):
+    """Fused LayerNorm over trailing ``normalized_shape`` dims."""
+    normalized_shape = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    x2, lead = _to_2d(x, normalized_shape)
+    if weight is not None:
+        y = _layer_norm_affine(x2, weight.reshape(-1), bias.reshape(-1), eps)
+    else:
+        y = _layer_norm_plain(x2, eps)
+    return y.reshape(*lead, *normalized_shape)
+
+
+def rms_norm(x, weight: Optional[jax.Array], normalized_shape, eps: float = 1e-5):
+    """Fused RMSNorm over trailing ``normalized_shape`` dims."""
+    normalized_shape = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    x2, lead = _to_2d(x, normalized_shape)
+    if weight is not None:
+        y = _rms_norm_affine(x2, weight.reshape(-1), eps)
+    else:
+        y = _rms_norm_plain(x2, eps)
+    return y.reshape(*lead, *normalized_shape)

@@ -1,0 +1,71 @@
+"""Stateful optimizer shim over functional (optax-style) transforms.
+
+The reference optimizers subclass ``torch.optim.Optimizer`` (mutable state,
+``.step()``). TPU-native training is functional — the transform's ``update``
+runs inside the user's jitted train step. ``FusedOptimizer`` wraps a
+transform with an apex-flavoured stateful API for drop-in familiarity and for
+the eager-ish scripting path; serious training should use the transform
+directly (``tx.init`` / ``tx.update``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import optax
+
+
+class FusedOptimizer:
+    """Apex-style stateful wrapper: holds params + opt state, ``step(grads)``.
+
+    Unlike torch there are no ``.grad`` attributes: gradients are passed to
+    ``step`` explicitly (a pytree matching params). ``zero_grad`` exists for
+    API parity and is a no-op (ref e.g. apex/optimizers/fused_adam.py:85
+    ``zero_grad``).
+    """
+
+    def __init__(self, params, tx: optax.GradientTransformation, defaults: dict,
+                 tx_factory: Optional[Callable] = None):
+        self.defaults = dict(defaults)
+        self.tx = tx
+        # rebuild hook: tx_factory(**overrides) -> GradientTransformation with
+        # the same hyperparams except the overrides (used by e.g. LARC to zero
+        # the inner weight decay, ref apex/parallel/LARC.py step()).
+        self._tx_factory = tx_factory
+        self.params = params
+        self.state = tx.init(params)
+        self._jit_step = jax.jit(self._functional_step)
+
+    def _functional_step(self, grads, state, params):
+        updates, new_state = self.tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state
+
+    def step(self, grads=None, closure: Optional[Callable] = None):
+        """Apply one fused update. Returns the new params (also stored on self)."""
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError(
+                "apex_tpu optimizers are functional: pass grads to step() "
+                "(there is no .grad attribute to read on TPU)."
+            )
+        self.params, self.state = self._jit_step(grads, self.state, self.params)
+        return loss if loss is not None else self.params
+
+    def zero_grad(self, set_to_none: bool = True):  # noqa: ARG002 - parity no-op
+        return None
+
+    def state_dict(self) -> dict:
+        return {"state": self.state, "defaults": self.defaults}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        new_state = state_dict["state"]
+        have = jax.tree_util.tree_structure(self.state)
+        got = jax.tree_util.tree_structure(new_state)
+        if have != got:
+            raise ValueError(
+                f"loaded optimizer state structure {got} does not match "
+                f"current optimizer structure {have}")
+        self.state = new_state
+        self.defaults.update(state_dict.get("defaults", {}))

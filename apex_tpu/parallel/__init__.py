@@ -1,0 +1,22 @@
+"""Distributed data parallelism (TPU re-design of ``apex.parallel``).
+
+Ref: apex/parallel/__init__.py.
+"""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    sync_gradients,
+    sync_gradients_flat,
+    average_reduced,
+)
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
+from apex_tpu.parallel.larc import LARC, larc
+from apex_tpu.parallel import multiproc
+
+__all__ = [
+    "DistributedDataParallel", "Reducer",
+    "sync_gradients", "sync_gradients_flat", "average_reduced",
+    "SyncBatchNorm", "convert_syncbn_model",
+    "LARC", "larc", "multiproc",
+]

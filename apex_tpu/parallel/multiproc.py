@@ -1,0 +1,56 @@
+"""Multi-host launcher — TPU re-design of ``apex.parallel.multiproc``.
+
+Ref: apex/parallel/multiproc.py (spawns one process per GPU with
+WORLD_SIZE/RANK env vars). On TPU pods each host runs one process that owns
+its local chips; bootstrap goes through ``jax.distributed.initialize`` which
+reads the TPU metadata (or explicit coordinator args) instead of
+torch.distributed env vars.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Initialize the multi-host runtime (NCCL init_process_group analog)."""
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()
+
+
+def main():
+    """CLI parity shim: ``python -m apex_tpu.parallel.multiproc script.py ...``
+
+    On GPU the reference forks one worker per device. On TPU the runtime
+    already runs one process per host, so this simply initializes the
+    distributed runtime and execs the target script in-process.
+    """
+    argv = sys.argv[1:]
+    if not argv:
+        print("usage: python -m apex_tpu.parallel.multiproc <script> [args...]")
+        return 1
+    initialize_distributed(
+        coordinator_address=os.environ.get("COORDINATOR_ADDRESS"),
+        num_processes=(int(os.environ["NUM_PROCESSES"])
+                       if "NUM_PROCESSES" in os.environ else None),
+        process_id=(int(os.environ["PROCESS_ID"])
+                    if "PROCESS_ID" in os.environ else None),
+    )
+    script = argv[0]
+    sys.argv = argv
+    with open(script) as f:
+        code = compile(f.read(), script, "exec")
+    exec(code, {"__name__": "__main__", "__file__": script})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

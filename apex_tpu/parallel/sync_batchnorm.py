@@ -1,0 +1,111 @@
+"""SyncBatchNorm — TPU re-design of ``apex.parallel.sync_batchnorm``.
+
+Ref: apex/parallel/{sync_batchnorm,optimized_sync_batchnorm}.py +
+csrc/{syncbn.cpp,welford.cu}.
+
+The reference's optimized path fuses a per-GPU Welford reduction with an
+NCCL allreduce of (mean, var, count). The TPU formulation reduces local
+(sum, sum-of-squares, count) with a single fused ``psum`` over the data
+axis inside the jitted step — numerically the same pooled statistics, one
+collective, no separate kernel needed. Running stats use the unbiased
+variance exactly as the reference does (sync_batchnorm.py:87).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica BatchNorm over ``axis_name`` (default ``data``).
+
+    Mirrors ``apex.parallel.SyncBatchNorm(num_features, eps, momentum,
+    affine, track_running_stats, process_group, channel_last)`` — the
+    process group is a mesh axis name here. Drop-in for ``flax.linen
+    .BatchNorm`` with ``use_running_average`` semantics.
+
+    Channel axis: flax convention is NHWC, so ``channel_last`` defaults to
+    True (channels = last dim). Pass ``channel_last=False`` for torch-style
+    NCHW parity with the reference's default.
+    """
+
+    num_features: Optional[int] = None
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    process_group: Optional[str] = None  # mesh axis name
+    channel_last: bool = True
+    axis_name: Optional[str] = "data"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        axis_name = self.process_group or self.axis_name
+        ch_axis = (x.ndim - 1) if (self.channel_last or x.ndim == 2) else 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        c = x.shape[ch_axis]
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            x32 = x.astype(jnp.float32)
+            local_sum = jnp.sum(x32, axis=reduce_axes)
+            local_sqsum = jnp.sum(jnp.square(x32), axis=reduce_axes)
+            local_count = jnp.asarray(
+                x.size / c, jnp.float32)
+            try:
+                total_sum = jax.lax.psum(local_sum, axis_name)
+                total_sqsum = jax.lax.psum(local_sqsum, axis_name)
+                total_count = jax.lax.psum(local_count, axis_name)
+            except NameError:
+                # outside pmap/shard_map: plain (single-replica) batch norm
+                total_sum, total_sqsum, total_count = (
+                    local_sum, local_sqsum, local_count)
+            mean = total_sum / total_count
+            var = total_sqsum / total_count - jnp.square(mean)
+            if self.track_running_stats and not self.is_initializing():
+                unbiased = var * total_count / jnp.maximum(total_count - 1.0, 1.0)
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+
+        shape = [1] * x.ndim
+        shape[ch_axis] = c
+        y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + self.eps)
+        if self.affine:
+            weight = self.param("scale", nn.initializers.ones, (c,), self.dtype)
+            bias = self.param("bias", nn.initializers.zeros, (c,), self.dtype)
+            y = y * weight.astype(jnp.float32).reshape(shape) + \
+                bias.astype(jnp.float32).reshape(shape)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=False):
+    """Best-effort analog of ``apex.parallel.convert_syncbn_model``
+    (ref apex/parallel/__init__.py:create convert function).
+
+    flax modules are immutable dataclasses, so generic recursive surgery is
+    not possible; a ``flax.linen.BatchNorm`` instance is converted directly,
+    and model classes in ``apex_tpu.models`` accept a ``norm_cls`` argument
+    for the same effect at construction time.
+    """
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            eps=module.epsilon, momentum=1.0 - module.momentum,
+            process_group=process_group, channel_last=channel_last)
+    if isinstance(module, SyncBatchNorm):
+        return module
+    raise NotImplementedError(
+        "convert_syncbn_model can convert flax BatchNorm instances; for whole "
+        "models, construct them with norm_cls=apex_tpu.parallel.SyncBatchNorm "
+        "(see apex_tpu.models).")

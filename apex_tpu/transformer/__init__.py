@@ -1,0 +1,1 @@
+"""apex_tpu.transformer (being built — see SURVEY.md §2)."""

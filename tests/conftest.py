@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; all sharding/collective tests run
+against XLA's host-platform device partitioning (SURVEY.md §4).
+
+Note: the container's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon already in the environment, so setting env vars here is
+too late for the platform choice — it must go through jax.config. XLA_FLAGS
+is still read at (lazy) backend initialization, which has not happened yet
+when conftest runs.
+"""
+
+import os
+import sys
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,111 @@
+"""SyncBatchNorm tests (mirrors ref tests/distributed/synced_batchnorm/
+test_batchnorm1d_multigpu_sync.py intent: stats over the global batch)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def ref_bn(x, eps=1e-5):
+    mu = x.mean(0)
+    var = x.var(0)
+    return (x - mu) / np.sqrt(var + eps)
+
+
+def test_syncbn_matches_global_batch_stats():
+    mesh = mesh8()
+    bn = SyncBatchNorm()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 6))
+    variables = bn.init(jax.random.PRNGKey(1), x[:2])
+
+    @jax.jit
+    def run(x):
+        def f(x):
+            y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+            return y
+        return shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(x)
+
+    y = run(x)
+    np.testing.assert_allclose(np.asarray(y), ref_bn(np.asarray(x)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_syncbn_running_stats_accumulate_globally():
+    mesh = mesh8()
+    bn = SyncBatchNorm(momentum=1.0)  # running stats = current batch stats
+    x = jax.random.normal(jax.random.PRNGKey(2), (16, 4)) * 3.0 + 1.5
+    variables = bn.init(jax.random.PRNGKey(1), x[:2])
+
+    @jax.jit
+    def run(x):
+        def f(x):
+            y, updated = bn.apply(variables, x, mutable=["batch_stats"])
+            return y, updated["batch_stats"]["mean"], updated["batch_stats"]["var"]
+        return shard_map(f, mesh=mesh, in_specs=P("data"),
+                         out_specs=(P("data"), P(), P()))(x)
+
+    _, mean, var = run(x)
+    xn = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(mean), xn.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var), xn.var(0, ddof=1), rtol=1e-3, atol=1e-3)
+
+
+def test_syncbn_eval_uses_running_stats():
+    bn = SyncBatchNorm()
+    x = jnp.ones((4, 3))
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    y = bn.apply(variables, x * 5.0, use_running_average=True)
+    # running stats are (0, 1) at init -> output = input (affine is identity)
+    np.testing.assert_allclose(np.asarray(y), 5.0 * np.ones((4, 3)), rtol=1e-5)
+
+
+def test_syncbn_single_process_fallback():
+    """Outside shard_map the psum falls back to local stats."""
+    bn = SyncBatchNorm()
+    x = jax.random.normal(jax.random.PRNGKey(3), (32, 5))
+    variables = bn.init(jax.random.PRNGKey(1), x)
+    y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y), ref_bn(np.asarray(x)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_syncbn_nchw_channel_axis():
+    bn = SyncBatchNorm(channel_last=False)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 3, 4, 4))  # NCHW
+    variables = bn.init(jax.random.PRNGKey(1), x)
+    y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+    xn = np.asarray(x)
+    mu = xn.mean(axis=(0, 2, 3), keepdims=True)
+    var = xn.var(axis=(0, 2, 3), keepdims=True)
+    np.testing.assert_allclose(np.asarray(y), (xn - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_convert_from_flax_batchnorm():
+    converted = convert_syncbn_model(nn.BatchNorm(momentum=0.9, epsilon=1e-3))
+    assert isinstance(converted, SyncBatchNorm)
+    assert converted.eps == 1e-3
+    with pytest.raises(NotImplementedError):
+        convert_syncbn_model(nn.Dense(3))
+
+
+def test_syncbn_nhwc_default_matches_flax_batchnorm():
+    """Default channel axis must match flax.linen.BatchNorm (NHWC, last dim)."""
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 4, 4, 3))
+    ours = SyncBatchNorm()
+    ref = nn.BatchNorm(use_running_average=False)
+    yo, _ = ours.apply(ours.init(jax.random.PRNGKey(0), x), x,
+                       mutable=["batch_stats"])
+    yr, _ = ref.apply(ref.init(jax.random.PRNGKey(0), x), x,
+                      mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yo), np.asarray(yr), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,244 @@
+"""amp opt-level / scaler / checkpoint tests (mirrors ref tests/L0/run_amp/
+{test_basic_casts,test_checkpointing}.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import LossScaler
+from apex_tpu.optimizers import FusedAdam, fused_adam
+
+
+def params_tree():
+    return {
+        "Dense_0": {"kernel": jnp.ones((8, 8), jnp.float32), "bias": jnp.zeros((8,))},
+        "BatchNorm_0": {"scale": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+    }
+
+
+class TestOptLevels:
+    def test_o0_leaves_fp32(self):
+        handle = amp.initialize(opt_level="O0")
+        assert handle.policy.compute_dtype == jnp.float32
+        assert not handle.scaler.dynamic
+
+    def test_o1_fp32_params_bf16_compute(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O1")
+        assert p["Dense_0"]["kernel"].dtype == jnp.float32
+        assert handle.policy.compute_dtype == jnp.bfloat16
+        assert handle.scaler.dynamic
+
+    def test_o2_casts_params_keeps_norms_fp32(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O2")
+        assert p["Dense_0"]["kernel"].dtype == jnp.bfloat16
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert handle.props.master_weights
+
+    def test_o3_pure_half(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O3")
+        assert p["Dense_0"]["kernel"].dtype == jnp.bfloat16
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+        assert not handle.scaler.dynamic
+
+    def test_bad_opt_level(self):
+        with pytest.raises(ValueError):
+            amp.initialize(opt_level="O4")
+
+    def test_fp16_override(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O3",
+                                   half_dtype=jnp.float16)
+        assert p["Dense_0"]["kernel"].dtype == jnp.float16
+
+    def test_keep_batchnorm_string_override(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O3",
+                                   keep_batchnorm_fp32="True")
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+
+
+class TestDisabled:
+    def test_enabled_false_is_noop(self):
+        p, handle = amp.initialize(params_tree(), opt_level="O2", enabled=False)
+        assert p["Dense_0"]["kernel"].dtype == jnp.float32
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert not handle.scaler.enabled
+
+
+class TestNoFloorByDefault:
+    def test_dynamic_scale_can_drop_below_one(self):
+        s = amp.LossScaler(loss_scale="dynamic", init_scale=2.0)
+        st = s.init()
+        ovf = jnp.ones([], jnp.bool_)
+        for _ in range(3):
+            st = s.update(st, ovf)
+        assert float(st.loss_scale) == 0.25  # no implicit 1.0 floor (ref default)
+
+
+class TestLossScaler:
+    def test_static_scale(self):
+        s = LossScaler(loss_scale=128.0)
+        st = s.init()
+        assert float(s.scale_loss(jnp.asarray(2.0), st)) == 256.0
+        g, overflow = s.unscale({"p": jnp.asarray([128.0])}, st)
+        np.testing.assert_allclose(np.asarray(g["p"]), [1.0])
+        assert not bool(overflow)
+
+    def test_dynamic_halves_on_overflow(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=2.0 ** 10)
+        st = s.init()
+        _, overflow = s.unscale({"p": jnp.asarray([jnp.inf])}, st)
+        assert bool(overflow)
+        st2 = s.update(st, overflow)
+        assert float(st2.loss_scale) == 2.0 ** 9
+        assert int(st2.overflows) == 1
+
+    def test_dynamic_grows_after_window(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=4.0, scale_window=3)
+        st = s.init()
+        no_ovf = jnp.zeros([], jnp.bool_)
+        for _ in range(3):
+            st = s.update(st, no_ovf)
+        assert float(st.loss_scale) == 8.0
+        assert int(st.unskipped) == 0
+
+    def test_min_scale_clamp(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=2.0, min_loss_scale=1.0)
+        st = s.init()
+        ovf = jnp.ones([], jnp.bool_)
+        st = s.update(st, ovf)
+        st = s.update(st, ovf)
+        assert float(st.loss_scale) == 1.0
+
+    def test_disabled_compiles_to_nothing(self):
+        s = LossScaler(enabled=False)
+        st = s.init()
+        loss = jnp.asarray(3.0)
+        assert float(s.scale_loss(loss, st)) == 3.0
+        g, overflow = s.unscale({"p": jnp.asarray([jnp.inf])}, st)
+        assert not bool(overflow)  # disabled scaler never reports
+
+
+class TestScaledUpdate:
+    def test_overflow_skips_optimizer(self):
+        params = {"p": jnp.ones((4,))}
+        tx = fused_adam(lr=0.1)
+        opt_state = tx.init(params)
+        s = LossScaler(loss_scale="dynamic", init_scale=8.0)
+        sstate = s.init()
+        bad_grads = {"p": jnp.asarray([jnp.inf, 1.0, 1.0, 1.0])}
+        from apex_tpu.amp.scaler import scaled_update
+        updates, new_opt_state, new_sstate, overflow = scaled_update(
+            tx, s, bad_grads, opt_state, params, sstate)
+        assert bool(overflow)
+        np.testing.assert_array_equal(np.asarray(updates["p"]), np.zeros(4))
+        assert int(new_opt_state.count) == int(opt_state.count)  # state frozen
+        assert float(new_sstate.loss_scale) == 4.0
+
+    def test_clean_step_advances(self):
+        params = {"p": jnp.ones((4,))}
+        tx = fused_adam(lr=0.1)
+        opt_state = tx.init(params)
+        s = LossScaler(loss_scale="dynamic", init_scale=8.0)
+        sstate = s.init()
+        grads = {"p": jnp.full((4,), 8.0)}  # unscales to 1.0
+        from apex_tpu.amp.scaler import scaled_update
+        updates, new_opt_state, new_sstate, overflow = scaled_update(
+            tx, s, grads, opt_state, params, sstate)
+        assert not bool(overflow)
+        assert int(new_opt_state.count) == 1
+        assert not np.allclose(np.asarray(updates["p"]), 0.0)
+
+    def test_full_amp_train_step_jits(self):
+        """End-to-end jitted amp train step: scale → grad → unscale → cond-step."""
+        handle = amp.initialize(opt_level="O2")
+        params = {"w": jnp.ones((4, 4), jnp.float32)}
+        tx = fused_adam(lr=0.01)
+        opt_state = tx.init(params)
+        sstate = handle.scaler.init()
+
+        @jax.jit
+        def train_step(params, opt_state, sstate, x):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"]) ** 2)
+            loss, grads = jax.value_and_grad(
+                lambda p: handle.scaler.scale_loss(loss_fn(p), sstate))(params)
+            updates, opt_state, sstate2, overflow = handle.scaled_update(
+                tx, grads, opt_state, params, sstate)
+            return optax.apply_updates(params, updates), opt_state, sstate2, loss
+
+        x = jnp.ones((2, 4))
+        p1, opt_state, sstate, loss = train_step(params, opt_state, sstate, x)
+        p2, opt_state, sstate, loss = train_step(p1, opt_state, sstate, x)
+        assert int(opt_state.count) == 2
+        assert not np.allclose(np.asarray(p2["w"]), np.asarray(params["w"]))
+
+
+class TestCheckpointing:
+    def test_state_dict_roundtrip(self):
+        handle = amp.initialize(opt_level="O2")
+        ovf = jnp.ones([], jnp.bool_)
+        handle.scaler_state = handle.scaler.update(handle.scaler_state, ovf)
+        sd = amp.state_dict()
+        assert sd["loss_scale"] == 2.0 ** 15
+        handle2 = amp.initialize(opt_level="O2")
+        amp.load_state_dict(sd)
+        assert float(handle2.scaler_state.loss_scale) == 2.0 ** 15
+        assert int(handle2.scaler_state.overflows) == 1
+
+
+class TestStatefulIntegration:
+    def test_o2_master_weights_train_bf16_model(self):
+        params = {"Dense_0": {"kernel": jnp.ones((4, 4), jnp.float32)}}
+        opt = FusedAdam(params, lr=0.1)
+        cast, opt2, handle = amp.initialize(params, opt, opt_level="O2")
+        # stateful O2: optimizer holds bf16 model params + fp32 masters
+        opt.params = cast
+        opt.master_params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), cast)
+        assert opt.params["Dense_0"]["kernel"].dtype == jnp.bfloat16
+        scale = float(handle.scaler_state.loss_scale)
+        g = {"Dense_0": {"kernel": jnp.full((4, 4), 0.5 * scale, jnp.bfloat16)}}
+        for _ in range(3):
+            opt.step(g)
+        assert opt.params["Dense_0"]["kernel"].dtype == jnp.bfloat16
+        assert opt.master_params["Dense_0"]["kernel"].dtype == jnp.float32
+        assert float(opt.params["Dense_0"]["kernel"][0, 0]) < 1.0
+        # master tracks params
+        np.testing.assert_allclose(
+            np.asarray(opt.master_params["Dense_0"]["kernel"].astype(jnp.bfloat16),
+                       np.float32),
+            np.asarray(opt.params["Dense_0"]["kernel"], np.float32))
+
+    def test_attach_skips_on_overflow(self):
+        params = {"p": jnp.ones((4,))}
+        opt = FusedAdam(params, lr=0.1)
+        cast, opt2, handle = amp.initialize(params, opt, opt_level="O2")
+        before = np.asarray(opt.params["p"])
+        opt.step({"p": jnp.asarray([jnp.inf, 1.0, 1.0, 1.0])})
+        np.testing.assert_array_equal(np.asarray(opt.params["p"]), before)
+        assert float(handle.scaler_state.loss_scale) == 2.0 ** 15
+        opt.step({"p": jnp.full((4,), handle.scaler_state.loss_scale)})
+        assert not np.allclose(np.asarray(opt.params["p"]), before)
+
+
+def test_scaled_update_mixed_grad_param_dtypes():
+    """fp32 grads over bf16 params must not crash the cond branches."""
+    import optax as _optax
+    from apex_tpu.amp.scaler import scaled_update, LossScaler
+    params = {"p": jnp.ones((4,), jnp.bfloat16)}
+    tx = _optax.sgd(0.1)
+    s = LossScaler(loss_scale=2.0)
+    updates, _, _, overflow = scaled_update(
+        tx, s, {"p": jnp.full((4,), 2.0, jnp.float32)}, tx.init(params),
+        params, s.init())
+    assert not bool(overflow)
+
+
+def test_disabled_amp_leaves_optimizer_untouched():
+    params = {"p": jnp.ones((4,))}
+    opt = FusedAdam(params, lr=0.1)
+    _, opt2, handle = amp.initialize(params, opt, opt_level="O2", enabled=False)
+    assert "step" not in opt.__dict__  # attach() would set an instance attr
+    assert not hasattr(opt, "master_params")

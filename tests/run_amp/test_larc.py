@@ -1,0 +1,64 @@
+"""LARC tests (mirrors ref tests/L0/run_amp/test_larc.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu.optimizers import FusedSGD, fused_sgd
+from apex_tpu.parallel import LARC, larc
+
+
+def test_larc_transform_scales_small_grad_params():
+    params = {"p": jnp.ones((4, 4))}           # norm 4
+    grads = {"p": jnp.full((4, 4), 1000.0)}    # huge grads -> clip kicks in
+    tx = larc(fused_sgd(lr=0.1), lr=0.1, trust_coefficient=0.001)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    # adaptive_lr = 0.001 * 4 / 4000 = 1e-6 ; clip -> min(1e-6/0.1, 1) = 1e-5
+    expected = -0.1 * 1e-5 * 1000.0
+    np.testing.assert_allclose(np.asarray(updates["p"]),
+                               np.full((4, 4), expected), rtol=1e-4)
+
+
+def test_larc_noop_when_adaptive_lr_large():
+    params = {"p": jnp.full((4, 4), 100.0)}
+    grads = {"p": jnp.full((4, 4), 0.001)}
+    tx = larc(fused_sgd(lr=0.1), lr=0.1, trust_coefficient=10.0)
+    base = fused_sgd(lr=0.1)
+    u1, _ = tx.update(grads, tx.init(params), params)
+    u2, _ = base.update(grads, base.init(params), params)
+    np.testing.assert_allclose(np.asarray(u1["p"]), np.asarray(u2["p"]), rtol=1e-5)
+
+
+def test_larc_class_wrapper():
+    params = {"p": jnp.ones((3, 3))}
+    opt = LARC(FusedSGD(params, lr=0.1, momentum=0.9))
+    g = {"p": jnp.full((3, 3), 0.5)}
+    new_params = opt.step(g)
+    assert not np.allclose(np.asarray(new_params["p"]), 1.0)
+    sd = opt.state_dict()
+    opt.load_state_dict(sd)
+    opt.step(g)
+
+
+def test_larc_zero_param_norm_passthrough():
+    params = {"p": jnp.zeros((3, 3))}
+    grads = {"p": jnp.ones((3, 3))}
+    tx = larc(fused_sgd(lr=0.1), lr=0.1)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(updates["p"]),
+                               np.full((3, 3), -0.1), rtol=1e-6)
+
+
+def test_larc_class_no_double_weight_decay():
+    """Inner optimizer's weight decay must be zeroed (larc wrapper owns it)."""
+    params = {"p": jnp.full((4, 4), 100.0)}
+    g = {"p": jnp.full((4, 4), 0.001)}
+    opt = LARC(FusedSGD(params, lr=0.1, weight_decay=0.01),
+               trust_coefficient=10.0)
+    new_params = opt.step(g)
+    # adaptive_lr large -> clipped to 1; delta = -lr*(g + wd*p) applied once
+    expected = 100.0 - 0.1 * (0.001 + 0.01 * 100.0)
+    np.testing.assert_allclose(np.asarray(new_params["p"]),
+                               np.full((4, 4), expected), rtol=1e-5)

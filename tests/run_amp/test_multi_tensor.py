@@ -1,0 +1,92 @@
+"""multi_tensor op tests (mirrors ref tests/L0/run_amp/test_multi_tensor_{scale,axpby,l2norm}.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_applier,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_l2norm_scale,
+)
+
+
+def tensors(dtype=jnp.float32):
+    rs = np.random.RandomState(0)
+    return [jnp.asarray(rs.randn(*s).astype(np.float32), dtype=dtype)
+            for s in [(4, 5), (3,), (2, 2, 2)]]
+
+
+class TestScale:
+    def test_basic(self):
+        ts = tensors()
+        out, overflow = multi_tensor_scale(ts, 2.5)
+        assert not bool(overflow)
+        for o, t in zip(out, ts):
+            np.testing.assert_allclose(np.asarray(o), 2.5 * np.asarray(t), rtol=1e-6)
+            assert o.shape == t.shape
+
+    def test_overflow_detection(self):
+        ts = tensors() + [jnp.asarray([np.inf, 1.0])]
+        _, overflow = multi_tensor_scale(ts, 1.0)
+        assert bool(overflow)
+
+    def test_nan_detection(self):
+        ts = [jnp.asarray([np.nan])]
+        _, overflow = multi_tensor_scale(ts, 1.0)
+        assert bool(overflow)
+
+    def test_out_dtype(self):
+        ts = tensors()
+        out, _ = multi_tensor_scale(ts, 1.0, out_dtype=jnp.bfloat16)
+        assert all(o.dtype == jnp.bfloat16 for o in out)
+
+    def test_applier_shim(self):
+        ts = tensors()
+        out, overflow = multi_tensor_applier(multi_tensor_scale, None, [ts], 3.0)
+        np.testing.assert_allclose(np.asarray(out[0]), 3.0 * np.asarray(ts[0]), rtol=1e-6)
+
+    def test_applier_apex_inout_convention(self):
+        # apex passes [src, dst] for scale and [x, y, out] for axpby; the
+        # trailing output lists must be accepted and ignored
+        src, dst = tensors(), tensors()
+        out, overflow = multi_tensor_applier(multi_tensor_scale, None, [src, dst], 2.0)
+        np.testing.assert_allclose(np.asarray(out[1]), 2.0 * np.asarray(src[1]), rtol=1e-6)
+        xs, ys, outs = tensors(), tensors(), tensors()
+        out, overflow = multi_tensor_applier(
+            multi_tensor_axpby, None, [xs, ys, outs], 1.0, 2.0)
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.asarray(xs[0]) + 2.0 * np.asarray(ys[0]), rtol=1e-6)
+
+
+class TestAxpby:
+    def test_basic(self):
+        xs, ys = tensors(), tensors()
+        out, overflow = multi_tensor_axpby(xs, ys, a=2.0, b=-1.0)
+        assert not bool(overflow)
+        for o, x, y in zip(out, xs, ys):
+            np.testing.assert_allclose(
+                np.asarray(o), 2.0 * np.asarray(x) - np.asarray(y), rtol=1e-6)
+
+
+class TestL2Norm:
+    def test_global(self):
+        ts = tensors()
+        norm, per = multi_tensor_l2norm(ts)
+        expected = np.sqrt(sum((np.asarray(t) ** 2).sum() for t in ts))
+        np.testing.assert_allclose(float(norm), expected, rtol=1e-6)
+        assert per is None
+
+    def test_per_tensor(self):
+        ts = tensors()
+        norm, per = multi_tensor_l2norm(ts, per_tensor=True)
+        for p, t in zip(np.asarray(per), ts):
+            np.testing.assert_allclose(p, np.linalg.norm(np.asarray(t).ravel()), rtol=1e-6)
+
+    def test_l2norm_scale(self):
+        ts = tensors()
+        out, norm, per, overflow = multi_tensor_l2norm_scale(ts, 0.5, per_tensor=True)
+        expected = 0.5 * np.sqrt(sum((np.asarray(t) ** 2).sum() for t in ts))
+        np.testing.assert_allclose(float(norm), expected, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out[0]), 0.5 * np.asarray(ts[0]), rtol=1e-6)

@@ -1,0 +1,141 @@
+"""Fused LN/RMSNorm parity tests (mirrors ref tests/L0/run_fused_layer_norm/test_fused_layer_norm.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm, FusedRMSNorm, MixedFusedRMSNorm,
+    fused_layer_norm, fused_layer_norm_affine,
+    fused_rms_norm, fused_rms_norm_affine,
+)
+
+
+def ref_layer_norm(x, w, b, eps):
+    x32 = np.asarray(x, np.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) / np.sqrt(var + eps)
+    if w is not None:
+        y = y * np.asarray(w, np.float32) + np.asarray(b, np.float32)
+    return y
+
+
+def ref_rms_norm(x, w, eps):
+    x32 = np.asarray(x, np.float32)
+    ms = (x32 ** 2).mean(-1, keepdims=True)
+    y = x32 / np.sqrt(ms + eps)
+    if w is not None:
+        y = y * np.asarray(w, np.float32)
+    return y
+
+
+@pytest.mark.parametrize("shape", [(4, 16), (2, 3, 32), (7, 160)])
+def test_layer_norm_affine_forward(shape):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+    h = shape[-1]
+    w = jnp.asarray(rs.randn(h).astype(np.float32))
+    b = jnp.asarray(rs.randn(h).astype(np.float32))
+    y = fused_layer_norm_affine(x, w, b, h, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref_layer_norm(x, w, b, 1e-5),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_no_affine():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(5, 24).astype(np.float32))
+    y = fused_layer_norm(x, 24)
+    np.testing.assert_allclose(np.asarray(y), ref_layer_norm(x, None, None, 1e-6),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_forward():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(6, 48).astype(np.float32))
+    w = jnp.asarray(rs.randn(48).astype(np.float32))
+    y = fused_rms_norm_affine(x, w, 48, eps=1e-6)
+    np.testing.assert_allclose(np.asarray(y), ref_rms_norm(x, w, 1e-6),
+                               rtol=1e-5, atol=1e-5)
+    y2 = fused_rms_norm(x, 48, eps=1e-6)
+    np.testing.assert_allclose(np.asarray(y2), ref_rms_norm(x, None, 1e-6),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_grads_match_autodiff():
+    """custom_vjp backward vs jax autodiff of the plain formula."""
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(8, 32).astype(np.float32))
+    w = jnp.asarray(rs.randn(32).astype(np.float32))
+    b = jnp.asarray(rs.randn(32).astype(np.float32))
+
+    def ours(x, w, b):
+        return jnp.sum(jnp.sin(fused_layer_norm_affine(x, w, b, 32, eps=1e-5)))
+
+    def plain(x, w, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+        y = (x - mu) / jnp.sqrt(var + 1e-5) * w + b
+        return jnp.sum(jnp.sin(y))
+
+    g1 = jax.grad(ours, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(plain, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_grads_match_autodiff():
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(8, 32).astype(np.float32))
+    w = jnp.asarray(rs.randn(32).astype(np.float32))
+
+    def ours(x, w):
+        return jnp.sum(jnp.cos(fused_rms_norm_affine(x, w, 32, eps=1e-6)))
+
+    def plain(x, w):
+        ms = jnp.mean(x ** 2, -1, keepdims=True)
+        return jnp.sum(jnp.cos(x / jnp.sqrt(ms + 1e-6) * w))
+
+    g1 = jax.grad(ours, argnums=(0, 1))(x, w)
+    g2 = jax.grad(plain, argnums=(0, 1))(x, w)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_input_fp32_stats():
+    """Mixed dtype: bf16 activations, fp32 affine params (MixedFused*)."""
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(4, 64).astype(np.float32), dtype=jnp.bfloat16)
+    w = jnp.ones((64,), jnp.float32)
+    y = fused_rms_norm_affine(x, w, 64)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), ref_rms_norm(np.asarray(x, np.float32), w, 1e-5),
+        rtol=0.05, atol=0.05)
+
+
+def test_multidim_normalized_shape():
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(3, 4, 8).astype(np.float32))
+    w = jnp.ones((4, 8), jnp.float32)
+    b = jnp.zeros((4, 8), jnp.float32)
+    y = fused_layer_norm_affine(x, w, b, (4, 8), eps=1e-5)
+    flat = np.asarray(x).reshape(3, 32)
+    expect = ref_layer_norm(flat, None, None, 1e-5).reshape(3, 4, 8)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_flax_modules():
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(4, 16).astype(np.float32))
+    for mod in (FusedLayerNorm(16), FusedRMSNorm(16), MixedFusedRMSNorm(16)):
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        assert y.shape == x.shape
+
+    mod = FusedLayerNorm(16, elementwise_affine=False)
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y), ref_layer_norm(x, None, None, 1e-5),
+                               rtol=1e-5, atol=1e-5)
