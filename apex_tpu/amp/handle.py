@@ -96,7 +96,11 @@ class AmpHandle:
 
             import optax as _optax
 
-            def amp_step(grads, state, params, master, scaler_state):
+            # NB: bind per-optimizer values as defaults — jit traces lazily at
+            # the first step() call, which can happen after this loop has
+            # moved on to the next optimizer.
+            def amp_step(grads, state, params, master, scaler_state,
+                         tx=tx, use_master=use_master, scaler=scaler):
                 unscaled, overflow = scaler.unscale(grads, scaler_state)
                 opt_params = master if use_master else params
                 g32 = (jax.tree_util.tree_map(
@@ -121,7 +125,8 @@ class AmpHandle:
             jitted = jax.jit(amp_step)
             handle = self
 
-            def step(grads=None, closure=None, _opt=opt, _jitted=jitted):
+            def step(grads=None, closure=None, _opt=opt, _jitted=jitted,
+                     _use_master=use_master):
                 loss = closure() if closure is not None else None
                 if grads is None:
                     raise ValueError("pass grads to step()")
@@ -130,7 +135,7 @@ class AmpHandle:
                     grads, _opt.state, _opt.params,
                     getattr(_opt, "master_params", _opt.params),
                     handle.scaler_state)
-                if use_master:
+                if _use_master:
                     _opt.master_params = master
                 return loss if loss is not None else _opt.params
 

@@ -17,21 +17,26 @@ import optax
 
 class LARCState(NamedTuple):
     inner: optax.OptState
+    count: jnp.ndarray = jnp.zeros((), jnp.int32)
 
 
-def larc(inner_tx: optax.GradientTransformation, lr: float,
+def larc(inner_tx: optax.GradientTransformation, lr,
          trust_coefficient: float = 0.02, clip: bool = True, eps: float = 1e-8,
          weight_decay: float = 0.0) -> optax.GradientTransformation:
     """Wrap ``inner_tx`` with LARC gradient rescaling (ref LARC.py:75 step).
 
-    ``lr`` is the inner optimizer's learning rate, needed for the clipping
-    form ``min(adaptive_lr / lr, 1)``.
+    ``lr`` is the inner optimizer's learning rate — a float or an optax
+    schedule (evaluated at the wrapper's own step count) — needed for the
+    clipping form ``min(adaptive_lr / lr, 1)``.
     """
 
     def init(params):
-        return LARCState(inner=inner_tx.init(params))
+        return LARCState(inner=inner_tx.init(params),
+                         count=jnp.zeros((), jnp.int32))
 
     def update(grads, state, params=None):
+        lr_now = lr(state.count) if callable(lr) else lr
+
         def rescale(g, p):
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
@@ -40,7 +45,7 @@ def larc(inner_tx: optax.GradientTransformation, lr: float,
             adaptive_lr = trust_coefficient * p_norm / (
                 g_norm + p_norm * weight_decay + eps)
             if clip:
-                adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+                adaptive_lr = jnp.minimum(adaptive_lr / lr_now, 1.0)
             scale = jnp.where((p_norm > 0) & (g_norm > 0), adaptive_lr, 1.0)
             if weight_decay:
                 g32 = g32 + weight_decay * p32
@@ -51,7 +56,7 @@ def larc(inner_tx: optax.GradientTransformation, lr: float,
         scaled = treedef.unflatten(
             [rescale(g, p) for g, p in zip(g_leaves, p_leaves)])
         updates, inner = inner_tx.update(scaled, state.inner, params)
-        return updates, LARCState(inner=inner)
+        return updates, LARCState(inner=inner, count=state.count + 1)
 
     return optax.GradientTransformation(init, update)
 
@@ -101,6 +106,10 @@ class LARC:
             grads, self._state, self.optim.params)
         self.optim.state = self._state.inner
         return loss if loss is not None else self.optim.params
+
+    @property
+    def defaults(self):
+        return self.optim.defaults
 
     def zero_grad(self):
         return None

@@ -1,1 +1,31 @@
-"""apex_tpu.transformer (being built — see SURVEY.md §2)."""
+"""Megatron-style model parallelism, TPU-native
+(ref apex/transformer/__init__.py).
+
+Axes ride a global ``jax.sharding.Mesh`` ('pp','dp','cp','tp'); see
+``parallel_state`` for the group/rank API, ``tensor_parallel`` for TP
+layers/collectives, ``pipeline_parallel`` for collective 1F1B schedules,
+and ``context_parallel`` for ring-attention sequence parallelism.
+"""
+
+from apex_tpu.transformer import enums
+from apex_tpu.transformer import functional
+from apex_tpu.transformer import microbatches
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import utils
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+from apex_tpu.transformer.log_util import set_logging_level
+
+__all__ = [
+    "enums",
+    "functional",
+    "microbatches",
+    "parallel_state",
+    "tensor_parallel",
+    "utils",
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+    "set_logging_level",
+]

@@ -242,3 +242,25 @@ def test_disabled_amp_leaves_optimizer_untouched():
     _, opt2, handle = amp.initialize(params, opt, opt_level="O2", enabled=False)
     assert "step" not in opt.__dict__  # attach() would set an instance attr
     assert not hasattr(opt, "master_params")
+
+
+def test_attach_multiple_optimizers_keeps_each_tx():
+    """Two optimizers attached in one call must not share the last tx
+    (review fix: late-bound loop closure)."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+    p1 = {"w": jnp.ones((4,))}
+    p2 = {"w": jnp.ones((4,))}
+    opt1 = FusedAdam(p1, lr=0.1)
+    opt2 = FusedSGD(p2, lr=0.1, momentum=0.0)
+    amp.initialize(None, [opt1, opt2], opt_level="O0",
+                   loss_scale=1.0, verbosity=0)
+    g = {"w": jnp.full((4,), 0.5)}
+    opt1.step(g)
+    opt2.step(g)
+    # plain SGD: w -= lr*g exactly; Adam: w -= ~lr*sign step (≈0.1 each)
+    np.testing.assert_allclose(np.asarray(opt2.params["w"]),
+                               np.ones(4) - 0.05, rtol=1e-6)
+    assert not np.allclose(np.asarray(opt1.params["w"]),
+                           np.asarray(opt2.params["w"]))

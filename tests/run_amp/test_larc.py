@@ -62,3 +62,20 @@ def test_larc_class_no_double_weight_decay():
     expected = 100.0 - 0.1 * (0.001 + 0.01 * 100.0)
     np.testing.assert_allclose(np.asarray(new_params["p"]),
                                np.full((4, 4), expected), rtol=1e-5)
+
+
+def test_larc_accepts_lr_schedule():
+    """Schedule (callable) lr must work in the clip term (review fix)."""
+    import optax
+    from apex_tpu.parallel.larc import larc
+
+    sched = optax.cosine_decay_schedule(0.1, 100)
+    tx = larc(optax.sgd(sched), lr=sched)
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    grads = {"w": jnp.full((4,), 0.5)}
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    assert int(state.count) == 3
+    assert np.isfinite(np.asarray(params["w"])).all()

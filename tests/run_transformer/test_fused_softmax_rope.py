@@ -1,0 +1,159 @@
+"""Fused softmax + RoPE parity vs plain jnp (mirrors ref
+tests/L0/run_transformer/test_fused_softmax.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import (
+    FusedScaleMaskSoftmax,
+    apply_rotary_qk,
+    fused_apply_rotary_pos_emb,
+    rotary_freqs,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+def ref_softmax(x, mask, scale):
+    x = x.astype(jnp.float32) * scale
+    if mask is not None:
+        x = jnp.where(mask, -10000.0, x)
+    return jax.nn.softmax(x, axis=-1)
+
+
+def test_scaled_masked_softmax_parity():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 8, 8))
+    got = scaled_masked_softmax(x, mask, 0.5)
+    ref = ref_softmax(x, jnp.broadcast_to(mask, x.shape), 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_scaled_softmax_no_mask():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8))
+    got = scaled_masked_softmax(x, None, 2.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_softmax(x, None, 2.0)), rtol=1e-5
+    )
+
+
+def test_causal_softmax_parity():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 6, 6))
+    got = scaled_upper_triang_masked_softmax(x, None, 1.0)
+    tri = jnp.triu(jnp.ones((6, 6), bool), k=1)
+    ref = ref_softmax(x, jnp.broadcast_to(tri, x.shape), 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+    # each causal row sums to 1 and masks the future
+    np.testing.assert_allclose(np.asarray(got.sum(-1)), 1.0, rtol=1e-5)
+    assert np.asarray(got)[0, 0, 1:].max() == 0.0
+
+
+def test_fused_scale_mask_softmax_module_causal():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 8),
+                          dtype=jnp.bfloat16)
+    m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal, scale=0.25)
+    got = m(x)
+    tri = jnp.triu(jnp.ones((8, 8), bool), k=1)
+    ref = ref_softmax(x, jnp.broadcast_to(tri, x.shape), 0.25)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), atol=1e-2
+    )
+
+
+def test_fused_scale_mask_softmax_rejects_conflicting_flags():
+    with pytest.raises(ValueError):
+        FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+    with pytest.raises(ValueError):
+        FusedScaleMaskSoftmax(softmax_in_fp32=False, scale=2.0)
+
+
+def test_rope_norm_preserved_and_zero_pos_identity():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 3, 8))
+    qr, kr = apply_rotary_qk(q, k)
+    # rotation preserves pairwise norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 has angle 0 -> identity
+    np.testing.assert_allclose(
+        np.asarray(qr)[:, 0], np.asarray(q)[:, 0], atol=1e-6
+    )
+    # relative-position property: <q_i k_j> depends only on i-j
+    a = np.einsum("hd,hd->h", np.asarray(qr)[0, 2, :], np.asarray(kr)[0, 4, :])
+    q2, k2 = apply_rotary_qk(q, k, positions=jnp.tile(jnp.arange(1, 6), (2, 1)))
+    b = np.einsum("hd,hd->h", np.asarray(q2)[0, 2, :], np.asarray(k2)[0, 4, :])
+    np.testing.assert_allclose(a, b, rtol=1e-4)
+
+
+def test_partial_rotary():
+    t = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 2, 8))
+    freqs = rotary_freqs(4, 4)[None, :, None, :]
+    out = fused_apply_rotary_pos_emb(t, freqs)
+    # pass-through half untouched
+    np.testing.assert_array_equal(np.asarray(out)[..., 4:],
+                                  np.asarray(t)[..., 4:])
+
+
+def test_softmax_custom_vjp_grads_match_autodiff():
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 6, 6))
+
+    def f_fused(x):
+        return jnp.sum(scaled_upper_triang_masked_softmax(x, None, 0.7) ** 2)
+
+    def f_ref(x):
+        tri = jnp.triu(jnp.ones((6, 6), bool), k=1)
+        return jnp.sum(
+            jax.nn.softmax(jnp.where(tri, -10000.0, x * 0.7), -1) ** 2
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f_fused)(x)), np.asarray(jax.grad(f_ref)(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    mask = jax.random.bernoulli(jax.random.PRNGKey(4), 0.3, (4, 6, 6))
+
+    def g_fused(x):
+        return jnp.sum(scaled_masked_softmax(x, mask, 1.3) ** 3)
+
+    def g_ref(x):
+        return jnp.sum(
+            jax.nn.softmax(jnp.where(mask, -10000.0, x * 1.3), -1) ** 3
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(g_fused)(x)), np.asarray(jax.grad(g_ref)(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_causal_module_combines_padding_mask():
+    """Causal module + padding mask must keep BOTH masks (review fix)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 6, 6))
+    pad = jnp.zeros((1, 1, 6, 6), bool).at[..., 4:].set(True)
+    m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal)
+    got = np.asarray(m(x, pad))
+    # future position (0,2) masked even though pad allows it
+    assert got[0, 0, 0, 2] == 0.0
+    # padded position (5,5) masked even though causal allows it
+    assert got[0, 0, 5, 5] == 0.0
+
+
+def test_rope_positions_traceable_under_jit():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 2, 8))
+    pos = jnp.tile(jnp.arange(4), (2, 1))
+
+    qr, kr = jax.jit(lambda q, k, p: apply_rotary_qk(q, k, positions=p))(
+        q, k, pos
+    )
+    qr2, kr2 = apply_rotary_qk(q, k)
+    np.testing.assert_allclose(np.asarray(qr), np.asarray(qr2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(kr), np.asarray(kr2), rtol=1e-5)

@@ -1,0 +1,126 @@
+"""Collective region fwd/bwd semantics (mirrors ref
+tests/L0/run_transformer/test_mapping.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import mappings
+
+
+@pytest.fixture(autouse=True)
+def mesh():
+    ps.destroy_model_parallel()
+    m = ps.initialize_model_parallel(4, 1)  # tp=4, dp=2
+    yield m
+    ps.destroy_model_parallel()
+
+
+TP = 4
+
+
+def run_tp(fn, x, in_spec, out_spec, mesh):
+    return jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    )(x)
+
+
+def test_scatter_then_gather_round_trip(mesh):
+    x = jnp.arange(2 * 8, dtype=jnp.float32).reshape(2, 8)
+
+    def fn(x):
+        s = mappings.scatter_to_tensor_model_parallel_region(x)
+        assert s.shape == (2, 8 // TP)
+        return mappings.gather_from_tensor_model_parallel_region(s)
+
+    out = run_tp(fn, x, P(), P(None, "tp"), mesh)
+    # out_specs concatenates per-rank outputs; every rank held the full
+    # gathered tensor, so slice the first tp chunk back out.
+    np.testing.assert_array_equal(np.asarray(out)[:, :8], np.asarray(x))
+
+
+def test_reduce_from_sums_over_ranks(mesh):
+    x = jnp.ones((2, 4))
+
+    def fn(x):
+        x = mappings.copy_to_tensor_model_parallel_region(x)
+        return mappings.reduce_from_tensor_model_parallel_region(x)
+
+    out = run_tp(fn, x, P(), P(), mesh)
+    np.testing.assert_array_equal(np.asarray(out), TP * np.ones((2, 4)))
+
+
+def test_copy_to_region_grad_is_psum(mesh):
+    """bwd of copy = allreduce: per-rank cotangents (rank+1) sum to 10."""
+    x = jnp.ones((3,))
+
+    def loss(x):
+        def fn(x):
+            y = mappings.copy_to_tensor_model_parallel_region(x)
+            r = jax.lax.axis_index("tp").astype(jnp.float32)
+            return jax.lax.psum(jnp.sum(y) * (r + 1.0), "tp")
+
+        return shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P())(x)
+
+    g = jax.jit(jax.grad(loss))(x)
+    # sum over tp ranks (1+2+3+4) = 10.
+    np.testing.assert_allclose(np.asarray(g), 10.0 * np.ones(3), rtol=1e-6)
+
+
+def test_gather_grad_is_reduce_scatter(mesh):
+    """bwd of all-gather must *sum* contributions (psum_scatter), the
+    generally-correct transpose (see mappings.py module docstring)."""
+    x = jnp.ones((8,))
+
+    def loss(x):
+        def fn(xs):
+            g = mappings.gather_from_tensor_model_parallel_region(xs)
+            r = jax.lax.axis_index("tp").astype(jnp.float32)
+            return jax.lax.psum(jnp.sum(g) * (r + 1.0), "tp")
+
+        return shard_map(fn, mesh=mesh, in_specs=(P("tp"),), out_specs=P())(x)
+
+    g = jax.jit(jax.grad(loss))(x)
+    np.testing.assert_allclose(np.asarray(g), 10.0 * np.ones(8), rtol=1e-6)
+
+
+def test_sequence_parallel_round_trip(mesh):
+    x = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+
+    def fn(x):
+        s = mappings.scatter_to_sequence_parallel_region(x)
+        assert s.shape == (2, 2)
+        return mappings.gather_from_sequence_parallel_region(s)
+
+    out = run_tp(fn, x, P(), P("tp"), mesh)
+    np.testing.assert_array_equal(np.asarray(out)[:8], np.asarray(x))
+
+
+def test_reduce_scatter_sequence(mesh):
+    x = jnp.ones((8, 2))
+
+    def fn(x):
+        x = mappings.copy_to_tensor_model_parallel_region(x)
+        out = mappings.reduce_scatter_to_sequence_parallel_region(x)
+        assert out.shape == (2, 2)
+        return out
+
+    out = run_tp(fn, x, P(), P("tp"), mesh)
+    np.testing.assert_array_equal(np.asarray(out), TP * np.ones((8, 2)))
+
+
+def test_identity_without_axis():
+    ps.destroy_model_parallel()
+    x = jnp.ones((4, 4))
+    np.testing.assert_array_equal(
+        np.asarray(mappings.gather_from_tensor_model_parallel_region(x)),
+        np.asarray(x),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(mappings.copy_to_tensor_model_parallel_region(x)),
+        np.asarray(x),
+    )

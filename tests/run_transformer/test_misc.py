@@ -1,0 +1,162 @@
+"""microbatches / random tracker / memory / data / utils (mirrors ref
+tests/L0/run_transformer/{test_microbatches,test_random,test_data}.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer import microbatches as mb
+from apex_tpu.transformer import utils as tu
+from apex_tpu.transformer.tensor_parallel import (
+    MemoryBuffer,
+    RNGStatesTracker,
+    broadcast_data,
+    checkpoint,
+    get_rng_tracker,
+    model_parallel_rng_seed,
+)
+from apex_tpu.transformer.tensor_parallel import memory as tp_memory
+
+
+def test_divide_and_ensure():
+    assert tu.divide(12, 4) == 3
+    with pytest.raises(ValueError):
+        tu.divide(12, 5)
+
+
+def test_constant_microbatches():
+    calc = mb.build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=None, global_batch_size=32,
+        micro_batch_size=2, data_parallel_size=4,
+    )
+    assert calc.get() == 4
+    assert calc.get_current_global_batch_size() == 32
+    calc.update(100, True)  # no-op
+    assert calc.get() == 4
+
+
+def test_rampup_microbatches():
+    calc = mb.build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=[8, 8, 1000], global_batch_size=32,
+        micro_batch_size=2, data_parallel_size=2,
+    )
+    assert calc.get_current_global_batch_size() == 8
+    assert calc.get() == 2
+    calc.update(500, True)  # 500/(1000/3) -> 1 increment
+    assert calc.get_current_global_batch_size() == 16
+    calc.update(2000, True)
+    assert calc.get_current_global_batch_size() == 32
+    assert calc.get() == 8
+
+
+def test_rng_tracker_fork_advances_and_restores():
+    tr = RNGStatesTracker()
+    tr.add("default", 0)
+    with tr.fork("default") as k1:
+        pass
+    with tr.fork("default") as k2:
+        pass
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    with pytest.raises(ValueError):
+        tr.add("default", 1)
+    with pytest.raises(ValueError):
+        tr.add("other", 0)  # duplicate seed
+    with pytest.raises(KeyError):
+        with tr.fork("missing"):
+            pass
+    states = tr.get_states()
+    tr2 = RNGStatesTracker()
+    tr2.set_states(states)
+    with tr.fork("default") as a, tr2.fork("default") as b:
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_model_parallel_rng_seed_streams_differ():
+    model_parallel_rng_seed(123)
+    tr = get_rng_tracker()
+    with tr.fork("default") as a, tr.fork("model-parallel-rng") as b:
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_matches_plain():
+    def f(x):
+        return jnp.sum(jnp.tanh(x) ** 2)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8,))
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(lambda x: checkpoint(f, x))(x)),
+        np.asarray(jax.grad(f)(x)),
+        rtol=1e-6,
+    )
+
+
+def test_memory_buffer_pack_unpack():
+    tp_memory.reset_mem_buffs()
+    buf = tp_memory.allocate_mem_buff("b", 64, jnp.float32, track_usage=True)
+    assert tp_memory.get_mem_buff("b") is buf
+    s0, e0 = buf.add((2, 4))
+    s1, e1 = buf.add((8,))
+    assert (s0, e0, s1, e1) == (0, 8, 8, 16)
+    buf.put(jnp.arange(8.0).reshape(2, 4), s0)
+    np.testing.assert_array_equal(
+        np.asarray(buf.get((2, 4), s0)), np.arange(8.0).reshape(2, 4)
+    )
+    with pytest.raises(MemoryError):
+        buf.add((100,))
+    assert buf.is_in_use()
+    buf.reset()
+    assert not buf.is_in_use()
+    tp_memory.reset_mem_buffs()
+
+
+def test_ring_mem_buffer():
+    tp_memory.reset_mem_buffs()
+    ring = tp_memory.RingMemBuffer("r", 2, 16, jnp.float32, False)
+    b0 = ring.get_next_buffer()
+    b1 = ring.get_next_buffer()
+    assert b0 is not b1
+    b0.add((4,))
+    with pytest.raises(RuntimeError):
+        ring.get_next_buffer()  # b0 still in use
+    tp_memory.reset_mem_buffs()
+
+
+def test_broadcast_data_casts_and_checks():
+    data = {
+        "text": jnp.arange(6, dtype=jnp.int32).reshape(2, 3),
+        "mask": jnp.ones((2, 3), dtype=jnp.int32),
+        "ignored": jnp.ones((1,), dtype=jnp.float32),
+    }
+    out = broadcast_data(["text", "mask"], data, jnp.int32)
+    assert out["text"].shape == (2, 3)
+    with pytest.raises(ValueError):
+        broadcast_data(["ignored"], data, jnp.int32)
+
+
+def test_split_1d_chunks_shard_map():
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def fn(x):
+        chunk = tu.split_tensor_into_1d_equal_chunks(x)
+        return tu.gather_split_1d_tensor(chunk)
+
+    out = jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(P(),), out_specs=P("tp"))
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out)[:16], np.arange(16.0))
+
+
+def test_rampup_equal_start_and_global_batch():
+    """start == global must not divide by zero (review fix)."""
+    calc = mb.build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=[16, 8, 1000], global_batch_size=16,
+        micro_batch_size=2, data_parallel_size=2,
+    )
+    assert calc.get_current_global_batch_size() == 16
+    calc.update(10, True)
+    assert calc.get() == 4

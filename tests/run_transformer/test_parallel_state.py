@@ -1,0 +1,98 @@
+"""parallel_state over a virtual 8-device mesh (mirrors ref
+tests/L0/run_transformer/test_parallel_state.py intent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    ps.destroy_model_parallel()
+    yield
+    ps.destroy_model_parallel()
+
+
+def test_initialize_and_world_sizes():
+    assert ps.is_unitialized()
+    mesh = ps.initialize_model_parallel(2, 2)  # tp=2, pp=2 -> dp=2
+    assert ps.model_parallel_is_initialized()
+    assert mesh.shape == {"pp": 2, "dp": 2, "cp": 1, "tp": 2}
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert ps.get_tensor_model_parallel_group() == "tp"
+    assert ps.get_pipeline_model_parallel_group() == "pp"
+    assert ps.get_data_parallel_group() == "dp"
+    assert set(ps.get_model_parallel_group()) == {"pp", "tp"}
+
+
+def test_indivisible_world_raises():
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(3, 1)
+
+
+def test_rank_getters_outside_trace_default_zero_and_overrides():
+    ps.initialize_model_parallel(4, 2)
+    assert ps.get_tensor_model_parallel_rank() == 0
+    ps.set_tensor_model_parallel_rank(3)
+    assert ps.get_tensor_model_parallel_rank() == 3
+    ps.set_pipeline_model_parallel_rank(1)
+    assert ps.is_pipeline_last_stage()
+    assert not ps.is_pipeline_first_stage()
+
+
+def test_rank_getters_inside_shard_map_are_axis_indices():
+    mesh = ps.initialize_model_parallel(2, 2)
+
+    def f():
+        tp = ps.get_tensor_model_parallel_rank()
+        pp = ps.get_pipeline_model_parallel_rank()
+        dp = ps.get_data_parallel_rank()
+        return (tp * 4 + pp * 2 + dp)[None]
+
+    out = jax.jit(
+        shard_map(
+            f, mesh=mesh, in_specs=(), out_specs=P(("pp", "dp", "cp", "tp"))
+        )
+    )()
+    # Every device must see a distinct (tp,pp,dp) combination.
+    assert len(set(np.asarray(out).tolist())) == 8
+
+
+def test_virtual_pipeline_bookkeeping():
+    ps.initialize_model_parallel(
+        1, 2, virtual_pipeline_model_parallel_size_=2
+    )
+    assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 0
+    ps.set_pipeline_model_parallel_rank(0)
+    # virtual rank 0 of stage 0 is "first", virtual rank 1 is not.
+    assert ps.is_pipeline_first_stage()
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    assert not ps.is_pipeline_first_stage()
+    assert ps.is_pipeline_first_stage(ignore_virtual=True)
+
+
+def test_split_rank_predicates():
+    ps.initialize_model_parallel(
+        1, 4, pipeline_model_parallel_split_rank_=2
+    )
+    assert ps.is_pipeline_stage_before_split(1)
+    assert not ps.is_pipeline_stage_before_split(2)
+    assert ps.is_pipeline_stage_after_split(2)
+    assert not ps.is_pipeline_stage_after_split(1)
+
+
+def test_pipeline_neighbour_ranks():
+    ps.initialize_model_parallel(2, 2)  # stride dp*cp*tp = 4
+    ps.set_flat_rank(1)
+    assert ps.get_pipeline_model_parallel_first_rank() == 1
+    assert ps.get_pipeline_model_parallel_last_rank() == 5
+    assert ps.get_pipeline_model_parallel_next_rank() == 5
+    assert ps.get_pipeline_model_parallel_prev_rank() == 5
