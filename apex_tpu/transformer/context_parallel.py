@@ -1,0 +1,179 @@
+"""Context (sequence) parallelism — first-class long-context support.
+
+No reference-file analog (the CUDA reference scales sequence length with
+megatron context parallelism + flash attention at the framework level; see
+SURVEY.md §2 #53): sequences are sharded over the 'cp' mesh axis and
+attention runs as **ring attention** — each step computes one K/V block's
+contribution with an online-softmax accumulator (flash-attention algebra in
+fp32) and ``ppermute``s the K/V block around the ring, so peak memory is
+O(s_local²/P) and the ICI transfer overlaps the block matmul. Backward is
+autodiff through the scan: the transposed ppermutes run the ring in reverse.
+
+Alternative: :func:`ulysses_attention` (DeepSpeed-Ulysses-style) swaps
+sequence↔head sharding with two ``all_to_all``s and runs plain attention
+locally — cheaper at moderate sequence lengths when heads ≥ cp.
+
+All functions run inside ``shard_map`` with 'cp' bound; layouts are
+``[batch, seq_local, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+_NEG_INF = -1e30
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else parallel_state.CONTEXT_AXIS
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    remat: bool = True,
+):
+    """Exact attention over a cp-sharded sequence.
+
+    q/k/v: [b, s_local, h, d] — this rank's sequence shard. Returns the
+    attention output for the local queries, identical (up to fp roundoff) to
+    full attention over the gathered sequence.
+    """
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q32 = q.astype(jnp.float32) * scale
+    row_pos = rank * s_local + jnp.arange(s_local)  # global query positions
+
+    def block(carry_kv, src_rank):
+        """One K/V block's contribution given its originating rank."""
+        k_blk, v_blk = carry_kv
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            col_pos = src_rank * s_local + jnp.arange(s_local)
+            allowed = col_pos[None, :] <= row_pos[:, None]  # [q, k]
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        return s
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        src = (rank - i) % n
+        s = block((k_blk, v_blk), src)  # [b, h, q, k]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # fully-masked rows have s == m_new == _NEG_INF; exp(0)=1 would leak
+        # weight onto masked keys, so zero them explicitly
+        p = jnp.where(
+            s <= _NEG_INF * 0.5, 0.0, jnp.exp(s - m_new[..., None])
+        )
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate K/V around the ring (rank r's block moves to r+1)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, m_new, l, o), None
+
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    step_fn = jax.checkpoint(step) if remat else step
+    # accumulators become device-varying inside the loop; start them that way
+    m0 = _to_varying(jnp.full((b, h, s_local), _NEG_INF, jnp.float32), axis)
+    l0 = _to_varying(jnp.zeros((b, h, s_local), jnp.float32), axis)
+    o0 = _to_varying(jnp.zeros((b, h, s_local, d), jnp.float32), axis)
+    (_, _, m, l, o), _ = jax.lax.scan(
+        step_fn, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]  # [b, h, q, d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    attn_fn: Optional[Callable] = None,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """All-to-all sequence parallelism: trade seq sharding for head sharding,
+    attend locally over the FULL sequence, swap back.
+
+    Requires heads % cp == 0. ``attn_fn(q, k, v)`` (full-sequence layouts)
+    defaults to plain softmax attention with the usual 1/√d scale.
+    """
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+
+    def seq_to_heads(x):
+        # [b, s_local, h, d] -> [b, s_full, h/n, d]
+        x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                               tiled=True)
+        return x
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    if attn_fn is None:
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+
+        def attn_fn(q, k, v):
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+            ) * sc
+            if causal:
+                sq, sk = s.shape[-2], s.shape[-1]
+                rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                s = jnp.where((cols > rows)[None, None], _NEG_INF, s)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+            return o.astype(q.dtype)
+
+    of = attn_fn(qf, kf, vf)
+    return heads_to_seq(of)
+
+
+def split_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
+    """Take this rank's sequence chunk (host-side sharding helper for use
+    inside shard_map when the input arrives replicated)."""
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    chunk = x.shape[seq_dim] // n
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        x = pcast(x, axis, to="varying")
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_dim)
+
+
+def gather_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
+    """Inverse of :func:`split_sequence`."""
+    return jax.lax.all_gather(x, _axis(axis_name), axis=seq_dim, tiled=True)
+
+
+def context_parallel_positions(s_local: int, axis_name: Optional[str] = None):
+    """Global position ids for this rank's shard (feed to RoPE)."""
+    axis = _axis(axis_name)
+    rank = jax.lax.axis_index(axis)
+    return rank * s_local + jnp.arange(s_local)

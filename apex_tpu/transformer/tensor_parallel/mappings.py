@@ -48,7 +48,13 @@ def _axis_bound(axis: str) -> bool:
 
 
 def _to_varying(x, axis: str):
-    """Mark a replicated value as device-varying (transpose: psum)."""
+    """Mark a replicated value as device-varying (transpose: psum).
+    Idempotent: values already varying over ``axis`` pass through."""
+    try:
+        if axis in jax.typeof(x).vma:
+            return x
+    except (AttributeError, TypeError):
+        pass
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, axis, to="varying")

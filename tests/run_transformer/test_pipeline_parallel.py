@@ -1,0 +1,252 @@
+"""Pipeline schedules: parity vs sequential (no-pipelining) execution
+(mirrors ref tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+    get_forward_backward_func,
+    get_params_for_weight_decay_optimization,
+    p2p,
+    pipelined_forward,
+)
+
+PP = 4
+DIM = 6
+MB = 3  # microbatch size
+M = 4  # number of microbatches
+
+
+@pytest.fixture(autouse=True)
+def mesh():
+    ps.destroy_model_parallel()
+    m = ps.initialize_model_parallel(1, PP)  # pp=4, dp=2
+    yield m
+    ps.destroy_model_parallel()
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def seq_apply(stacked, x, n_stages):
+    for i in range(n_stages):
+        x = stage_fn(jax.tree_util.tree_map(lambda p: p[i], stacked), x)
+    return x
+
+
+def make_params(key, n_stages):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (n_stages, DIM, DIM)) / np.sqrt(DIM),
+        "b": 0.01 * jax.random.normal(kb, (n_stages, DIM)),
+    }
+
+
+def loss_fn(out_mb, tgt_mb):
+    return jnp.mean((out_mb - tgt_mb) ** 2)
+
+
+def test_pipelined_forward_matches_sequential(mesh):
+    params = make_params(jax.random.PRNGKey(0), PP)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+
+    def fn(params, x):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        outs = pipelined_forward(stage_fn, local, x)
+        # only the last stage's buffer is meaningful; select it
+        r = jax.lax.axis_index("pp")
+        outs = jnp.where(r == jax.lax.axis_size("pp") - 1, outs, 0.0)
+        return jax.lax.psum(outs, "pp")
+
+    got = jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("pp"), P()),
+            out_specs=P(),
+        )
+    )(params, x)
+    ref = seq_apply(params, x, PP)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fwd_bwd_pipelining_matches_dense(mesh):
+    params = make_params(jax.random.PRNGKey(0), PP)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (M, MB, DIM))
+
+    def dense_loss(params):
+        out = seq_apply(params, x, PP)
+        return jnp.mean(
+            jnp.stack([loss_fn(out[m], tgt[m]) for m in range(M)])
+        )
+
+    ref_loss = dense_loss(params)
+    ref_grads = jax.grad(dense_loss)(params)
+
+    def fn(params):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, local, x, tgt
+        )
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("pp"),),
+            out_specs=(P(), P("pp")),
+        )
+    )(params)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(ref_grads[k]), rtol=1e-4,
+            atol=1e-6,
+        )
+
+
+def test_fwd_bwd_forward_only(mesh):
+    params = make_params(jax.random.PRNGKey(0), PP)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+    tgt = jnp.zeros((M, MB, DIM))
+
+    def fn(params):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, local, x, tgt, forward_only=True
+        )
+        assert grads is None
+        return loss
+
+    loss = jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(P("pp"),), out_specs=P())
+    )(params)
+    assert np.isfinite(float(loss))
+
+
+def test_interleaved_matches_dense_2x_chunks(mesh):
+    """V=2 chunks × P=4 devices = 8 virtual stages."""
+    V = 2
+    total = V * PP
+    params = make_params(jax.random.PRNGKey(0), total)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (M, MB, DIM))
+
+    # device r holds chunks (r, r+P): reorder the stacked stage dim into
+    # [P, V, ...] so in_specs P('pp') hands each device its V chunks.
+    def to_device_layout(p):
+        # stage s = r + v*P  ->  [v, r] -> transpose to [r, v]
+        return p.reshape((V, PP) + p.shape[1:]).swapaxes(0, 1)
+
+    dev_params = jax.tree_util.tree_map(to_device_layout, params)
+
+    def dense_loss(params):
+        out = seq_apply(params, x, total)
+        return jnp.mean(
+            jnp.stack([loss_fn(out[m], tgt[m]) for m in range(M)])
+        )
+
+    ref_loss = dense_loss(params)
+    ref_grads = jax.grad(dense_loss)(params)
+
+    def fn(dev_params):
+        local = jax.tree_util.tree_map(lambda p: p[0], dev_params)  # [V,...]
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, local, x, tgt
+        )
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(
+        shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("pp"),),
+            out_specs=(P(), P("pp")),
+        )
+    )(dev_params)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5)
+    got_w = np.asarray(grads["w"]).swapaxes(0, 1).reshape(total, DIM, DIM)
+    np.testing.assert_allclose(got_w, np.asarray(ref_grads["w"]), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_no_pipelining_grad_accumulation():
+    ps.destroy_model_parallel()
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM))}
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+
+    def mb_loss(p, mb):
+        return jnp.mean((mb @ p["w"]) ** 2)
+
+    def full_loss(p):
+        return jnp.mean(
+            jnp.stack([mb_loss(p, x[m]) for m in range(M)])
+        )
+
+    loss, grads = jax.jit(
+        lambda p: forward_backward_no_pipelining(mb_loss, p, x)
+    )(params)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(full_loss(params)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]), np.asarray(jax.grad(full_loss)(params)["w"]),
+        rtol=1e-5,
+    )
+    loss_fwd, none_grads = forward_backward_no_pipelining(
+        mb_loss, params, x, forward_only=True
+    )
+    assert none_grads is None
+    np.testing.assert_allclose(np.asarray(loss_fwd), np.asarray(loss),
+                               rtol=1e-6)
+
+
+def test_get_forward_backward_func(mesh):
+    assert (
+        get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+    )
+    assert (
+        get_forward_backward_func(None, 4)
+        is forward_backward_pipelining_without_interleaving
+    )
+    with pytest.warns(Warning):
+        f = get_forward_backward_func(2, 4)
+    assert f is forward_backward_pipelining_with_interleaving
+
+
+def test_p2p_shift_and_embedding_allreduce(mesh):
+    def fn():
+        r = jax.lax.axis_index("pp").astype(jnp.float32)
+        got_fwd = p2p.send_forward_recv_forward(r[None])
+        got_bwd = p2p.send_backward_recv_backward(r[None])
+        emb = p2p.embedding_allreduce((r + 1.0)[None])
+        return got_fwd, got_bwd, emb
+
+    fwd, bwd, emb = jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(),
+                  out_specs=(P("pp"), P("pp"), P("pp")))
+    )()
+    # stage r receives r-1 from upstream (stage 0 receives 0-fill)
+    np.testing.assert_array_equal(np.asarray(fwd).ravel(), [0, 0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(bwd).ravel(), [1, 2, 3, 0])
+    # first+last (ranks 0,3): 1+4=5; middle ranks untouched
+    np.testing.assert_array_equal(np.asarray(emb).ravel(), [5, 2, 3, 5])
+
+
+def test_weight_decay_mask():
+    params = {"dense": {"kernel": jnp.ones((3, 3)), "bias": jnp.ones(3)},
+              "ln": {"scale": jnp.ones(3)}}
+    mask = get_params_for_weight_decay_optimization(params)
+    assert mask["dense"]["kernel"] is True or mask["dense"]["kernel"] == True  # noqa: E712
+    assert not mask["dense"]["bias"]
+    assert not mask["ln"]["scale"]
