@@ -1,0 +1,42 @@
+"""Shared model-zoo scaffolding: init helpers and the BatchNorm switch."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+def fan_in_normal(key, *shape, fan_in=None, dtype=jnp.float32):
+    """N(0, 1/fan_in) init (fan_in defaults to the second-to-last dim)."""
+    scale = (fan_in if fan_in is not None else shape[-2]) ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+class BatchNorm(nn.Module):
+    """Plain flax BatchNorm or cross-replica :class:`SyncBatchNorm`.
+
+    ``momentum`` uses the flax convention (fraction of the running stat
+    KEPT each step); SyncBatchNorm follows the torch convention (fraction
+    REPLACED, ref apex/parallel/sync_batchnorm.py), so it gets ``1 - m`` —
+    the same inversion ``convert_syncbn_model`` applies.
+    """
+
+    sync: bool = False
+    axis_name: Optional[str] = "data"
+    momentum: float = 0.9
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        if self.sync:
+            return SyncBatchNorm(momentum=1.0 - self.momentum, eps=self.eps,
+                                 axis_name=self.axis_name)(
+                x, use_running_average=not train)
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=self.momentum, epsilon=self.eps,
+                            dtype=x.dtype)(x)

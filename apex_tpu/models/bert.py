@@ -1,0 +1,193 @@
+"""BERT family — bidirectional encoder matching the reference's BERT-base
+FusedLAMB + FusedLayerNorm benchmark config (ref BASELINE; primitives from
+apex/normalization/fused_layer_norm.py and apex.optimizers.FusedLAMB).
+
+Functional conventions match :mod:`apex_tpu.models.llama`; attention is
+bidirectional with an optional padding mask through
+``scaled_masked_softmax`` (ref apex/transformer/functional/fused_softmax.py:94).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models._common import fan_in_normal
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528  # 30522 padded for tp/tile divisibility
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    num_types: int = 2
+    ln_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def bert_base(**over) -> BertConfig:
+    return BertConfig(**over)
+
+
+def tiny(**over) -> BertConfig:
+    kw = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=64, dtype=jnp.float32)
+    kw.update(over)
+    return BertConfig(**kw)
+
+
+def init_params(key, cfg: BertConfig):
+    h, L = cfg.hidden_size, cfg.num_layers
+    dt = cfg.dtype
+    ks = jax.random.split(key, 8)
+
+    def norm(k, *shape, fan_in=None):
+        return fan_in_normal(k, *shape, fan_in=fan_in, dtype=dt)
+
+    return {
+        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
+        "pos_embed": norm(ks[1], cfg.max_seq_len, h, fan_in=h),
+        "type_embed": norm(ks[2], cfg.num_types, h, fan_in=h),
+        "emb_ln_w": jnp.ones((h,), dt), "emb_ln_b": jnp.zeros((h,), dt),
+        "layers": {
+            "wqkv": norm(ks[3], L, h, 3, h, fan_in=h),
+            "bqkv": jnp.zeros((L, 3, h), dt),
+            "wo": norm(ks[4], L, h, h), "bo": jnp.zeros((L, h), dt),
+            "ln1_w": jnp.ones((L, h), dt), "ln1_b": jnp.zeros((L, h), dt),
+            "wfc": norm(ks[5], L, h, 4 * h), "bfc": jnp.zeros((L, 4 * h), dt),
+            "wproj": norm(ks[6], L, 4 * h, h), "bproj": jnp.zeros((L, h), dt),
+            "ln2_w": jnp.ones((L, h), dt), "ln2_b": jnp.zeros((L, h), dt),
+        },
+        "mlm_dense": norm(ks[7], h, h),
+        "mlm_bias": jnp.zeros((h,), dt),
+        "mlm_ln_w": jnp.ones((h,), dt), "mlm_ln_b": jnp.zeros((h,), dt),
+    }
+
+
+def param_specs(cfg: BertConfig, tp_axis: str = "tp"):
+    """tp PartitionSpec pytree matching :func:`init_params`."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    return {
+        "embed": P(t, None), "pos_embed": P(), "type_embed": P(),
+        "emb_ln_w": P(), "emb_ln_b": P(),
+        "layers": {
+            "wqkv": P(None, None, None, t), "bqkv": P(None, None, t),
+            "wo": P(None, t, None), "bo": P(),
+            "ln1_w": P(), "ln1_b": P(),
+            "wfc": P(None, None, t), "bfc": P(None, t),
+            "wproj": P(None, t, None), "bproj": P(),
+            "ln2_w": P(), "ln2_b": P(),
+        },
+        "mlm_dense": P(), "mlm_bias": P(),
+        "mlm_ln_w": P(), "mlm_ln_b": P(),
+    }
+
+
+def _ln(x, w, b, eps):
+    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps=eps)
+
+
+def _attention(x, lp, cfg: BertConfig, pad_mask, tp_axis):
+    b, s, h = x.shape
+    d = cfg.head_dim
+    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
+    n = cfg.num_heads // tp
+
+    w = lp["wqkv"].reshape(h, -1)   # local [h, 3·h/tp]: q|k|v blocks
+    qkv = column_parallel_linear(x, w, lp["bqkv"].reshape(-1),
+                                 gather_output=False, axis_name=tp_axis)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, n, d)
+    v = v.reshape(b, s, n, d)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    # mask: True = masked-out key (ref scaled_masked_softmax semantics)
+    mask = None if pad_mask is None else pad_mask[:, None, None, :]
+    probs = scaled_masked_softmax(scores, mask, d ** -0.5).astype(v.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, n * d)
+    return row_parallel_linear(o, lp["wo"], lp["bo"], input_is_parallel=True,
+                               axis_name=tp_axis)
+
+
+def _mlp(x, lp, tp_axis):
+    y = column_parallel_linear(x, lp["wfc"], lp["bfc"], gather_output=False,
+                               axis_name=tp_axis)
+    y = jax.nn.gelu(y, approximate=False)
+    return row_parallel_linear(y, lp["wproj"], lp["bproj"],
+                               input_is_parallel=True, axis_name=tp_axis)
+
+
+def encoder_layer(x, lp, cfg: BertConfig, pad_mask,
+                  tp_axis: Optional[str] = "tp"):
+    """Post-norm block (original BERT residual order)."""
+    x = _ln(x + _attention(x, lp, cfg, pad_mask, tp_axis),
+            lp["ln1_w"], lp["ln1_b"], cfg.ln_eps)
+    x = _ln(x + _mlp(x, lp, tp_axis), lp["ln2_w"], lp["ln2_b"], cfg.ln_eps)
+    return x
+
+
+def forward(params, tokens, cfg: BertConfig, type_ids=None, pad_mask=None,
+            tp_axis: Optional[str] = "tp", remat: bool = True):
+    """tokens [b, s] → hidden states [b, s, h]."""
+    b, s = tokens.shape
+    x = vocab_parallel_embedding(tokens, params["embed"], axis_name=tp_axis)
+    x = x + params["pos_embed"][None, :s]
+    if type_ids is None:
+        x = x + params["type_embed"][0]
+    else:
+        x = x + jnp.take(params["type_embed"], type_ids, axis=0)
+    x = _ln(x.astype(cfg.dtype), params["emb_ln_w"], params["emb_ln_b"],
+            cfg.ln_eps)
+
+    def body(h, lp):
+        return encoder_layer(h, lp, cfg, pad_mask, tp_axis), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def mlm_logits(params, hidden, cfg: BertConfig,
+               tp_axis: Optional[str] = "tp"):
+    """Masked-LM head: dense+gelu+LN, tied decoder → [b, s, v_local]."""
+    x = jnp.matmul(hidden, params["mlm_dense"].astype(hidden.dtype))
+    x = jax.nn.gelu(x + params["mlm_bias"], approximate=False)
+    x = _ln(x, params["mlm_ln_w"], params["mlm_ln_b"], cfg.ln_eps)
+    return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: BertConfig, tp_axis: Optional[str] = "tp",
+            remat: bool = True):
+    """MLM loss; ``batch = (tokens, targets, loss_mask)`` — loss_mask selects
+    the masked positions (targets elsewhere are ignored)."""
+    tokens, targets, loss_mask = batch
+    hidden = forward(params, tokens, cfg, tp_axis=tp_axis, remat=remat)
+    logits = mlm_logits(params, hidden, cfg, tp_axis)
+    losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.sum(losses * loss_mask) / denom

@@ -1,0 +1,177 @@
+"""GPT-2 family — Megatron-style TP transformer with learned positions.
+
+Corresponds to the reference's GPT-2 345M benchmark config (Apex transformer
+primitives assembled Megatron-LM-style: fused softmax + LayerNorm + TP linear
+layers — ref apex/transformer/tensor_parallel/layers.py,
+apex/transformer/functional/fused_softmax.py). Same functional conventions
+as :mod:`apex_tpu.models.llama`: stacked [L, ...] layer params under
+``lax.scan``, collectives no-op when the tp axis is unbound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models._common import fan_in_normal
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.functional.fused_softmax import (
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 padded to a tp/128-friendly multiple
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 1024
+    ln_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt2_345m(**over) -> GPT2Config:
+    return GPT2Config(**over)
+
+
+def tiny(**over) -> GPT2Config:
+    kw = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=64, dtype=jnp.float32)
+    kw.update(over)
+    return GPT2Config(**kw)
+
+
+def init_params(key, cfg: GPT2Config):
+    h, L = cfg.hidden_size, cfg.num_layers
+    dt = cfg.dtype
+    ks = jax.random.split(key, 8)
+
+    def norm(k, *shape, fan_in=None):
+        return fan_in_normal(k, *shape, fan_in=fan_in, dtype=dt)
+
+    return {
+        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
+        "pos_embed": norm(ks[1], cfg.max_seq_len, h, fan_in=h),
+        "layers": {
+            "ln1_w": jnp.ones((L, h), dt), "ln1_b": jnp.zeros((L, h), dt),
+            # packed qkv, [L, h, 3, h] so P(..., 'tp') on the LAST dim
+            # shards each of q/k/v by heads (Megatron packing, ref
+            # tensor_parallel/layers.py ColumnParallelLinear qkv use)
+            "wqkv": norm(ks[2], L, h, 3, h, fan_in=h),
+            "bqkv": jnp.zeros((L, 3, h), dt),
+            "wo": norm(ks[3], L, h, h), "bo": jnp.zeros((L, h), dt),
+            "ln2_w": jnp.ones((L, h), dt), "ln2_b": jnp.zeros((L, h), dt),
+            "wfc": norm(ks[4], L, h, 4 * h), "bfc": jnp.zeros((L, 4 * h), dt),
+            "wproj": norm(ks[5], L, 4 * h, h), "bproj": jnp.zeros((L, h), dt),
+        },
+        "lnf_w": jnp.ones((h,), dt), "lnf_b": jnp.zeros((h,), dt),
+    }
+
+
+def param_specs(cfg: GPT2Config, tp_axis: str = "tp"):
+    """tp PartitionSpec pytree matching :func:`init_params`."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    return {
+        "embed": P(t, None), "pos_embed": P(),
+        "layers": {
+            "ln1_w": P(), "ln1_b": P(),
+            "wqkv": P(None, None, None, t), "bqkv": P(None, None, t),
+            "wo": P(None, t, None), "bo": P(),
+            "ln2_w": P(), "ln2_b": P(),
+            "wfc": P(None, None, t), "bfc": P(None, t),
+            "wproj": P(None, t, None), "bproj": P(),
+        },
+        "lnf_w": P(), "lnf_b": P(),
+    }
+
+
+def _ln(x, w, b, eps):
+    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps=eps)
+
+
+def _attention(x, lp, cfg: GPT2Config, tp_axis):
+    b, s, h = x.shape
+    d = cfg.head_dim
+    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
+    n = cfg.num_heads // tp
+
+    # Megatron packs qkv into one column-parallel gemm; sharding the LAST
+    # dim of [h, 3, h] gives each rank its heads of all of q, k and v, so
+    # the flattened local kernel is q|k|v blocks and thirds-split is exact.
+    w = lp["wqkv"].reshape(h, -1)   # local [h, 3·h/tp]: q|k|v blocks
+    qkv = column_parallel_linear(x, w, lp["bqkv"].reshape(-1),
+                                 gather_output=False, axis_name=tp_axis)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, n, d)
+    v = v.reshape(b, s, n, d)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    probs = scaled_upper_triang_masked_softmax(
+        scores.reshape(b * n, s, s), None, d ** -0.5
+    ).reshape(b, n, s, s).astype(v.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, n * d)
+    return row_parallel_linear(o, lp["wo"], lp["bo"], input_is_parallel=True,
+                               axis_name=tp_axis)
+
+
+def _mlp(x, lp, tp_axis):
+    y = column_parallel_linear(x, lp["wfc"], lp["bfc"], gather_output=False,
+                               axis_name=tp_axis)
+    y = jax.nn.gelu(y, approximate=True)
+    return row_parallel_linear(y, lp["wproj"], lp["bproj"],
+                               input_is_parallel=True, axis_name=tp_axis)
+
+
+def decoder_layer(x, lp, cfg: GPT2Config, tp_axis: Optional[str] = "tp"):
+    x = x + _attention(_ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_eps), lp, cfg,
+                       tp_axis)
+    x = x + _mlp(_ln(x, lp["ln2_w"], lp["ln2_b"], cfg.ln_eps), lp, tp_axis)
+    return x
+
+
+def forward(params, tokens, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
+            remat: bool = True):
+    """tokens [b, s] → vocab-sharded logits [b, s, v_local] (tied head)."""
+    b, s = tokens.shape
+    x = vocab_parallel_embedding(tokens, params["embed"], axis_name=tp_axis)
+    x = (x + params["pos_embed"][None, :s]).astype(cfg.dtype)
+
+    def body(h, lp):
+        return decoder_layer(h, lp, cfg, tp_axis), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_eps)
+    # tied embedding head → vocab-sharded logits (embed rows are the shard)
+    return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
+            remat: bool = True):
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, tp_axis, remat)
+    return jnp.mean(
+        vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    )

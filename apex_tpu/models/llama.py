@@ -1,0 +1,325 @@
+"""Llama model family (flagship) — TP/SP/CP/PP-composable functional model.
+
+Role in the framework: the reference (NVIDIA Apex) ships no model zoo, but
+its headline benchmarks run Megatron-style transformers built from its
+primitives (ColumnParallelLinear/RowParallelLinear, FusedRMSNorm, fused
+softmax/RoPE — ref apex/transformer/tensor_parallel/layers.py,
+apex/normalization/fused_layer_norm.py, apex/transformer/functional/).
+This module is the TPU-native assembly of those same primitives into the
+Llama-3 architecture (RMSNorm pre-norm, SwiGLU, GQA, RoPE).
+
+Design: pure-functional param pytrees with stacked per-layer weights
+([L, ...] leading dim, consumed by ``lax.scan``) so the whole depth compiles
+as one rolled loop (fast compile, remat-friendly). Every collective degrades
+to a no-op when its mesh axis is unbound, so the SAME code runs single-chip,
+under tp-only shard_map, and as one pipeline stage:
+
+- tp:   column/row-parallel projections, vocab-parallel embedding + CE
+- sp:   ``sequence_parallel=True`` switches tp collectives to
+        reduce_scatter/all_gather over the sequence dim
+- cp:   ring attention over the 'cp' axis; RoPE uses global positions
+- pp:   :func:`stage_fn` applies a contiguous slice of layers — feed it to
+        ``pipeline_parallel.schedules``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models._common import fan_in_normal
+
+from apex_tpu.normalization.fused_layer_norm import fused_rms_norm_affine
+from apex_tpu.transformer.context_parallel import (
+    context_parallel_positions,
+    ring_attention,
+)
+from apex_tpu.transformer.functional.fused_softmax import (
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.functional.rope import apply_rotary_qk
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    _axis_bound,
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama3_8b(**over) -> LlamaConfig:
+    return LlamaConfig(**over)
+
+
+def tiny(**over) -> LlamaConfig:
+    """Test-scale config (tp/cp-divisible heads)."""
+    kw = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128, dtype=jnp.float32,
+    )
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+def init_params(key, cfg: LlamaConfig):
+    """Full (unsharded) parameter pytree; layer weights stacked on dim 0.
+
+    Shard for tp with ``P(None, 'tp')`` on column kernels (wq/wk/wv/wg/wu),
+    ``P(None, 'tp', None)`` on row kernels' input dim (wo/wd), ``P('tp',)``
+    on the embedding's vocab dim and the lm head's output dim.
+    """
+    h, i, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    nq, nkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    dt = cfg.dtype
+
+    ks = jax.random.split(key, 10)
+
+    def norm(k, *shape, fan_in=None):
+        return fan_in_normal(k, *shape, fan_in=fan_in, dtype=dt)
+
+    params = {
+        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dt),
+            "wq": norm(ks[1], L, h, nq * d),
+            "wk": norm(ks[2], L, h, nkv * d),
+            "wv": norm(ks[3], L, h, nkv * d),
+            "wo": norm(ks[4], L, nq * d, h),
+            "mlp_norm": jnp.ones((L, h), dt),
+            "wg": norm(ks[5], L, h, i),
+            "wu": norm(ks[6], L, h, i),
+            "wd": norm(ks[7], L, i, h),
+        },
+        "final_norm": jnp.ones((h,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(ks[8], h, cfg.vocab_size, fan_in=h)
+    return params
+
+
+def _rmsnorm(x, w, eps):
+    return fused_rms_norm_affine(x, w, (x.shape[-1],), eps=eps)
+
+
+def _attention(x, lp, cfg: LlamaConfig, positions, tp_axis, cp_axis,
+               sequence_parallel):
+    """GQA attention on [b, s_local, h]; q/k/v heads tp-sharded, sequence
+    cp-sharded (ring attention when 'cp' is bound)."""
+    b = x.shape[0]
+    d = cfg.head_dim
+    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
+    if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads}")
+    nq, nkv = cfg.num_heads // tp, cfg.num_kv_heads // tp
+
+    # x arrives sequence-FULL (decoder_layer gathers once in sp mode), so
+    # the qkv projections never re-gather.
+    q = column_parallel_linear(x, lp["wq"], gather_output=False,
+                               axis_name=tp_axis)
+    k = column_parallel_linear(x, lp["wk"], gather_output=False,
+                               axis_name=tp_axis)
+    v = column_parallel_linear(x, lp["wv"], gather_output=False,
+                               axis_name=tp_axis)
+    s_full = q.shape[1]
+    q = q.reshape(b, s_full, nq, d)
+    k = k.reshape(b, s_full, nkv, d)
+    v = v.reshape(b, s_full, nkv, d)
+
+    q, k = apply_rotary_qk(q, k, positions=positions, base=cfg.rope_theta)
+
+    rep = nq // nkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if _axis_bound(cp_axis):
+        o = ring_attention(q, k, v, axis_name=cp_axis, causal=True)
+    else:
+        scale = d ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        probs = scaled_upper_triang_masked_softmax(
+            scores.reshape(b * nq, s_full, s_full), None, scale
+        ).reshape(b, nq, s_full, s_full).astype(v.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    o = o.reshape(b, s_full, nq * d)
+    return row_parallel_linear(o, lp["wo"], input_is_parallel=True,
+                               sequence_parallel_enabled=sequence_parallel,
+                               axis_name=tp_axis, seq_dim=1)
+
+
+def _mlp(x, lp, tp_axis, sequence_parallel):
+    # x arrives sequence-full (see decoder_layer); no per-gemm gather.
+    g = column_parallel_linear(x, lp["wg"], gather_output=False,
+                               axis_name=tp_axis)
+    u = column_parallel_linear(x, lp["wu"], gather_output=False,
+                               axis_name=tp_axis)
+    return row_parallel_linear(jax.nn.silu(g) * u, lp["wd"],
+                               input_is_parallel=True,
+                               sequence_parallel_enabled=sequence_parallel,
+                               axis_name=tp_axis, seq_dim=1)
+
+
+def decoder_layer(x, lp, cfg: LlamaConfig, positions,
+                  tp_axis: Optional[str] = "tp",
+                  cp_axis: Optional[str] = "cp",
+                  sequence_parallel: bool = False):
+    """One pre-norm block on a single layer's (unstacked) params ``lp``.
+
+    In sp mode the residual stream (and the norms) stay sequence-sharded;
+    each half-block all-gathers the normed input ONCE for its column gemms
+    and reduce-scatters the row-gemm output (Megatron sequence-parallel
+    comm pattern: 2 gathers + 2 scatters per layer, not one per gemm).
+    """
+
+    def to_full(h):
+        if sequence_parallel:
+            return gather_from_sequence_parallel_region(h, tp_axis, seq_dim=1)
+        return h
+
+    h = to_full(_rmsnorm(x, lp["attn_norm"], cfg.rms_eps))
+    x = x + _attention(h, lp, cfg, positions, tp_axis, cp_axis,
+                       sequence_parallel)
+    h = to_full(_rmsnorm(x, lp["mlp_norm"], cfg.rms_eps))
+    x = x + _mlp(h, lp, tp_axis, sequence_parallel)
+    return x
+
+
+def _positions(b, s_local, cp_axis):
+    if _axis_bound(cp_axis):
+        pos = context_parallel_positions(s_local, cp_axis)
+    else:
+        pos = jnp.arange(s_local)
+    return jnp.broadcast_to(pos[None, :], (b, s_local))
+
+
+def run_layers(x, stacked, cfg: LlamaConfig, positions,
+               tp_axis="tp", cp_axis="cp", sequence_parallel=False,
+               remat: bool = True):
+    """Scan a stacked [L, ...] layer pytree over the residual stream."""
+
+    def body(h, lp):
+        return decoder_layer(h, lp, cfg, positions, tp_axis, cp_axis,
+                             sequence_parallel), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, stacked)
+    return x
+
+
+def embed(params, tokens, cfg: LlamaConfig, tp_axis="tp",
+          sequence_parallel=False):
+    x = vocab_parallel_embedding(tokens, params["embed"], axis_name=tp_axis)
+    x = x.astype(cfg.dtype)
+    if sequence_parallel:
+        x = scatter_to_sequence_parallel_region(x, tp_axis, seq_dim=1)
+    return x
+
+
+def lm_head(params, x, cfg: LlamaConfig, tp_axis="tp",
+            sequence_parallel=False):
+    """Final norm + vocab-sharded logits [b, s, vocab/tp] (fp32)."""
+    if sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    # vocab-sharded output: plain local gemm, no gather (CE is vocab-parallel)
+    return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
+
+
+def forward(params, tokens, cfg: LlamaConfig,
+            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
+            sequence_parallel: bool = False, remat: bool = True):
+    """tokens [b, s_local] → vocab-sharded logits [b, s_local, v_local]."""
+    b, s = tokens.shape
+    positions = _positions(b, s, cp_axis)
+    x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
+    x = run_layers(x, params["layers"], cfg, positions, tp_axis, cp_axis,
+                   sequence_parallel, remat)
+    return lm_head(params, x, cfg, tp_axis, sequence_parallel)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig,
+            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
+            sequence_parallel: bool = False, remat: bool = True):
+    """Next-token CE; ``batch = (tokens, targets)`` both [b, s_local]."""
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, tp_axis, cp_axis,
+                     sequence_parallel, remat)
+    losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    return jnp.mean(losses)
+
+
+def param_specs(cfg: LlamaConfig, tp_axis: str = "tp"):
+    """PartitionSpec pytree matching :func:`init_params` (tp sharding):
+    column kernels split the output dim, row kernels the input dim, the
+    embedding/head split the vocab dim, norms replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    specs = {
+        "embed": P(t, None),
+        "layers": {
+            "attn_norm": P(), "mlp_norm": P(),
+            "wq": P(None, None, t), "wk": P(None, None, t),
+            "wv": P(None, None, t), "wo": P(None, t, None),
+            "wg": P(None, None, t), "wu": P(None, None, t),
+            "wd": P(None, t, None),
+        },
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, t)
+    return specs
+
+
+# ------------------------------------------------------------- pipeline view
+
+
+def stage_fn(stage_params, x, cfg: LlamaConfig, positions,
+             tp_axis="tp", cp_axis=None, sequence_parallel=False):
+    """Apply one pipeline stage's stacked layer slice to the residual
+    stream — plug into ``pipeline_parallel.schedules`` (embedding/head live
+    outside via :func:`embed`/:func:`lm_head` on the first/last stage)."""
+    return run_layers(x, stage_params, cfg, positions, tp_axis, cp_axis,
+                      sequence_parallel, remat=False)
+
+
+def split_stages(params, n_stages: int):
+    """Reshape stacked [L, ...] layers into [n_stages, L/n_stages, ...] for
+    ``shard_map`` with ``in_specs=P('pp', ...)``."""
+    def r(x):
+        return x.reshape(n_stages, x.shape[0] // n_stages, *x.shape[1:])
+
+    return jax.tree_util.tree_map(r, params["layers"])

@@ -155,21 +155,20 @@ def ulysses_attention(
 
 
 def split_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
-    """Take this rank's sequence chunk (host-side sharding helper for use
-    inside shard_map when the input arrives replicated)."""
-    axis = _axis(axis_name)
-    n = jax.lax.axis_size(axis)
-    rank = jax.lax.axis_index(axis)
-    chunk = x.shape[seq_dim] // n
-    pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        x = pcast(x, axis, to="varying")
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_dim)
+    """Take this rank's sequence chunk (delegates to the tensor_parallel
+    mapping; the cp default axis and [b, s, ...] seq_dim=1 differ)."""
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    return mappings.scatter_to_sequence_parallel_region(
+        x, _axis(axis_name), seq_dim=seq_dim)
 
 
 def gather_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
     """Inverse of :func:`split_sequence`."""
-    return jax.lax.all_gather(x, _axis(axis_name), axis=seq_dim, tiled=True)
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    return mappings.gather_from_sequence_parallel_region(
+        x, _axis(axis_name), seq_dim=seq_dim)
 
 
 def context_parallel_positions(s_local: int, axis_name: Optional[str] = None):

@@ -227,6 +227,7 @@ def linear_with_grad_accumulation_and_async_allreduce(
     async_grad_allreduce: bool = True,
     sequence_parallel_enabled: bool = False,
     axis_name: Optional[str] = None,
+    seq_dim: int = 0,
 ):
     """Local gemm whose input-grad allreduce overlaps wgrad (ref layers.py:308).
 
@@ -240,7 +241,8 @@ def linear_with_grad_accumulation_and_async_allreduce(
     del gradient_accumulation_fusion, async_grad_allreduce
     axis = axis_name if axis_name is not None else TP
     if sequence_parallel_enabled:
-        x = mappings.gather_from_sequence_parallel_region(input, axis)
+        x = mappings.gather_from_sequence_parallel_region(input, axis,
+                                                          seq_dim=seq_dim)
     else:
         x = mappings.copy_to_tensor_model_parallel_region(input, axis)
     y = jnp.matmul(x, weight)
@@ -256,12 +258,13 @@ def column_parallel_linear(
     gather_output: bool = True,
     sequence_parallel_enabled: bool = False,
     axis_name: Optional[str] = None,
+    seq_dim: int = 0,
 ):
     """Per-shard column-parallel linear: kernel is ``(in, out/tp)``."""
     axis = axis_name if axis_name is not None else TP
     y = linear_with_grad_accumulation_and_async_allreduce(
         x, kernel, bias, sequence_parallel_enabled=sequence_parallel_enabled,
-        axis_name=axis,
+        axis_name=axis, seq_dim=seq_dim,
     )
     if gather_output:
         y = mappings.gather_from_tensor_model_parallel_region(y, axis)
@@ -275,6 +278,7 @@ def row_parallel_linear(
     input_is_parallel: bool = True,
     sequence_parallel_enabled: bool = False,
     axis_name: Optional[str] = None,
+    seq_dim: int = 0,
 ):
     """Per-shard row-parallel linear: kernel is ``(in/tp, out)``; the partial
     products are psum'd (or reduce-scattered in sequence-parallel mode)."""
@@ -283,7 +287,8 @@ def row_parallel_linear(
         x = mappings.scatter_to_tensor_model_parallel_region(x, axis)
     y = jnp.matmul(x, kernel)
     if sequence_parallel_enabled:
-        y = mappings.reduce_scatter_to_sequence_parallel_region(y, axis)
+        y = mappings.reduce_scatter_to_sequence_parallel_region(y, axis,
+                                                                seq_dim=seq_dim)
     else:
         y = mappings.reduce_from_tensor_model_parallel_region(y, axis)
     if bias is not None:

@@ -102,28 +102,32 @@ def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
 # sequence_parallel_enabled on the layers.)
 
 
-def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
-    """Split the *sequence* (leading) dim across tp ranks."""
+def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None,
+                                        seq_dim: int = 0):
+    """Split the *sequence* dim across tp ranks (Megatron layout puts it
+    leading; our [b, s, h] model families pass ``seq_dim=1``)."""
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
-    chunk = x.shape[0] // n
+    chunk = x.shape[seq_dim] // n
     x = _to_varying(x, axis)
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=0)
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_dim)
 
 
-def gather_from_sequence_parallel_region(x, axis_name: Optional[str] = None):
+def gather_from_sequence_parallel_region(x, axis_name: Optional[str] = None,
+                                         seq_dim: int = 0):
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    return jax.lax.all_gather(x, axis, axis=seq_dim, tiled=True)
 
 
-def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None):
+def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None,
+                                               seq_dim: int = 0):
     """psum_scatter over the sequence dim (row-parallel output in SP mode)."""
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=seq_dim, tiled=True)
