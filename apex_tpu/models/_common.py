@@ -40,3 +40,59 @@ class BatchNorm(nn.Module):
         return nn.BatchNorm(use_running_average=not train,
                             momentum=self.momentum, epsilon=self.eps,
                             dtype=x.dtype)(x)
+
+
+# --------------------------------------------------- shared transformer bits
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+
+def layer_norm(x, w, b, eps):
+    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps=eps)
+
+
+def tp_size(tp_axis) -> int:
+    import jax.lax
+
+    return jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
+
+
+def packed_qkv_attention(x, lp, num_heads, head_dim, softmax_fn, tp_axis):
+    """Megatron packed-qkv attention shared by the gpt2/bert families.
+
+    ``lp`` carries wqkv [h, 3, h] / bqkv [3, h] / wo / bo; sharding the LAST
+    dim of wqkv with P(..., 'tp') gives each rank its heads of all of q, k
+    and v, so the flattened local kernel is q|k|v blocks and a thirds-split
+    of the local gemm output is exact. ``softmax_fn(scores, scale) -> probs``
+    injects the mask flavour (causal for gpt2, padding for bert).
+    """
+    b, s, h = x.shape
+    n = num_heads // tp_size(tp_axis)
+    d = head_dim
+
+    w = lp["wqkv"].reshape(h, -1)   # local [h, 3·h/tp]: q|k|v blocks
+    qkv = column_parallel_linear(x, w, lp["bqkv"].reshape(-1),
+                                 gather_output=False, axis_name=tp_axis)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, n, d)
+    v = v.reshape(b, s, n, d)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    probs = softmax_fn(scores, d ** -0.5).astype(v.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, n * d)
+    return row_parallel_linear(o, lp["wo"], lp["bo"], input_is_parallel=True,
+                               axis_name=tp_axis)
+
+
+def packed_mlp(x, lp, act_fn, tp_axis):
+    """fc -> act -> proj with column/row tensor parallelism."""
+    y = column_parallel_linear(x, lp["wfc"], lp["bfc"], gather_output=False,
+                               axis_name=tp_axis)
+    return row_parallel_linear(act_fn(y), lp["wproj"], lp["bproj"],
+                               input_is_parallel=True, axis_name=tp_axis)

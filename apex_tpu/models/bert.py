@@ -15,19 +15,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models._common import fan_in_normal
+from apex_tpu.models._common import (
+    fan_in_normal,
+    layer_norm,
+    packed_mlp,
+    packed_qkv_attention,
+)
 
-from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
 from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.tensor_parallel.layers import (
-    column_parallel_linear,
-    row_parallel_linear,
     vocab_parallel_embedding,
 )
-from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,39 +107,22 @@ def param_specs(cfg: BertConfig, tp_axis: str = "tp"):
     }
 
 
-def _ln(x, w, b, eps):
-    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps=eps)
+_ln = layer_norm
 
 
 def _attention(x, lp, cfg: BertConfig, pad_mask, tp_axis):
-    b, s, h = x.shape
-    d = cfg.head_dim
-    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
-    n = cfg.num_heads // tp
+    def padding_softmax(scores, scale):
+        # mask: True = masked-out key (ref scaled_masked_softmax semantics)
+        mask = None if pad_mask is None else pad_mask[:, None, None, :]
+        return scaled_masked_softmax(scores, mask, scale)
 
-    w = lp["wqkv"].reshape(h, -1)   # local [h, 3·h/tp]: q|k|v blocks
-    qkv = column_parallel_linear(x, w, lp["bqkv"].reshape(-1),
-                                 gather_output=False, axis_name=tp_axis)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, n, d)
-    k = k.reshape(b, s, n, d)
-    v = v.reshape(b, s, n, d)
-
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-    # mask: True = masked-out key (ref scaled_masked_softmax semantics)
-    mask = None if pad_mask is None else pad_mask[:, None, None, :]
-    probs = scaled_masked_softmax(scores, mask, d ** -0.5).astype(v.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, n * d)
-    return row_parallel_linear(o, lp["wo"], lp["bo"], input_is_parallel=True,
-                               axis_name=tp_axis)
+    return packed_qkv_attention(x, lp, cfg.num_heads, cfg.head_dim,
+                                padding_softmax, tp_axis)
 
 
 def _mlp(x, lp, tp_axis):
-    y = column_parallel_linear(x, lp["wfc"], lp["bfc"], gather_output=False,
-                               axis_name=tp_axis)
-    y = jax.nn.gelu(y, approximate=False)
-    return row_parallel_linear(y, lp["wproj"], lp["bproj"],
-                               input_is_parallel=True, axis_name=tp_axis)
+    return packed_mlp(x, lp, lambda y: jax.nn.gelu(y, approximate=False),
+                      tp_axis)
 
 
 def encoder_layer(x, lp, cfg: BertConfig, pad_mask,
@@ -181,12 +165,14 @@ def mlm_logits(params, hidden, cfg: BertConfig,
     return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
 
 
-def loss_fn(params, batch, cfg: BertConfig, tp_axis: Optional[str] = "tp",
-            remat: bool = True):
+def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
+            tp_axis: Optional[str] = "tp", remat: bool = True):
     """MLM loss; ``batch = (tokens, targets, loss_mask)`` — loss_mask selects
-    the masked positions (targets elsewhere are ignored)."""
+    the masked positions (targets elsewhere are ignored). ``pad_mask``
+    (True = padding) masks attention; the loss_mask only masks the CE sum."""
     tokens, targets, loss_mask = batch
-    hidden = forward(params, tokens, cfg, tp_axis=tp_axis, remat=remat)
+    hidden = forward(params, tokens, cfg, type_ids=type_ids,
+                     pad_mask=pad_mask, tp_axis=tp_axis, remat=remat)
     logits = mlm_logits(params, hidden, cfg, tp_axis)
     losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
     denom = jnp.maximum(jnp.sum(loss_mask), 1.0)

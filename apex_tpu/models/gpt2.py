@@ -16,9 +16,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models._common import fan_in_normal
+from apex_tpu.models._common import (
+    fan_in_normal,
+    layer_norm,
+    packed_mlp,
+    packed_qkv_attention,
+)
 
-from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
 from apex_tpu.transformer.functional.fused_softmax import (
     scaled_upper_triang_masked_softmax,
 )
@@ -26,11 +30,8 @@ from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.tensor_parallel.layers import (
-    column_parallel_linear,
-    row_parallel_linear,
     vocab_parallel_embedding,
 )
-from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,42 +106,24 @@ def param_specs(cfg: GPT2Config, tp_axis: str = "tp"):
     }
 
 
-def _ln(x, w, b, eps):
-    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps=eps)
+_ln = layer_norm
+
+
+def _causal_softmax(scores, scale):
+    b, n, s, sk = scores.shape
+    return scaled_upper_triang_masked_softmax(
+        scores.reshape(b * n, s, sk), None, scale
+    ).reshape(b, n, s, sk)
 
 
 def _attention(x, lp, cfg: GPT2Config, tp_axis):
-    b, s, h = x.shape
-    d = cfg.head_dim
-    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
-    n = cfg.num_heads // tp
-
-    # Megatron packs qkv into one column-parallel gemm; sharding the LAST
-    # dim of [h, 3, h] gives each rank its heads of all of q, k and v, so
-    # the flattened local kernel is q|k|v blocks and thirds-split is exact.
-    w = lp["wqkv"].reshape(h, -1)   # local [h, 3·h/tp]: q|k|v blocks
-    qkv = column_parallel_linear(x, w, lp["bqkv"].reshape(-1),
-                                 gather_output=False, axis_name=tp_axis)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, n, d)
-    k = k.reshape(b, s, n, d)
-    v = v.reshape(b, s, n, d)
-
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-    probs = scaled_upper_triang_masked_softmax(
-        scores.reshape(b * n, s, s), None, d ** -0.5
-    ).reshape(b, n, s, s).astype(v.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, n * d)
-    return row_parallel_linear(o, lp["wo"], lp["bo"], input_is_parallel=True,
-                               axis_name=tp_axis)
+    return packed_qkv_attention(x, lp, cfg.num_heads, cfg.head_dim,
+                                _causal_softmax, tp_axis)
 
 
 def _mlp(x, lp, tp_axis):
-    y = column_parallel_linear(x, lp["wfc"], lp["bfc"], gather_output=False,
-                               axis_name=tp_axis)
-    y = jax.nn.gelu(y, approximate=True)
-    return row_parallel_linear(y, lp["wproj"], lp["bproj"],
-                               input_is_parallel=True, axis_name=tp_axis)
+    return packed_mlp(x, lp, lambda y: jax.nn.gelu(y, approximate=True),
+                      tp_axis)
 
 
 def decoder_layer(x, lp, cfg: GPT2Config, tp_axis: Optional[str] = "tp"):

@@ -157,14 +157,14 @@ def _attention(x, lp, cfg: LlamaConfig, positions, tp_axis, cp_axis,
 
     q, k = apply_rotary_qk(q, k, positions=positions, base=cfg.rope_theta)
 
-    rep = nq // nkv
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
     if _axis_bound(cp_axis):
+        # ring_attention is GQA-aware: k/v circulate at nkv heads
         o = ring_attention(q, k, v, axis_name=cp_axis, causal=True)
     else:
+        rep = nq // nkv
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         scale = d ** -0.5
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
         probs = scaled_upper_triang_masked_softmax(

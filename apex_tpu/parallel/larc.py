@@ -17,7 +17,7 @@ import optax
 
 class LARCState(NamedTuple):
     inner: optax.OptState
-    count: jnp.ndarray = jnp.zeros((), jnp.int32)
+    count: jnp.ndarray
 
 
 def larc(inner_tx: optax.GradientTransformation, lr,
@@ -83,7 +83,8 @@ class LARC:
             inner_tx = optimizer._tx_factory(weight_decay=0.0)
         self._tx = larc(inner_tx, lr=lr, trust_coefficient=trust_coefficient,
                         clip=clip, eps=eps, weight_decay=wd)
-        self._state = LARCState(inner=optimizer.state)
+        self._state = LARCState(inner=optimizer.state,
+                                count=jnp.zeros((), jnp.int32))
         self._jit_step = jax.jit(self._functional_step)
 
     def _functional_step(self, grads, state, params):
@@ -119,4 +120,5 @@ class LARC:
 
     def load_state_dict(self, d):
         self.optim.load_state_dict(d)
-        self._state = LARCState(inner=self.optim.state)
+        self._state = LARCState(inner=self.optim.state,
+                                count=jnp.zeros((), jnp.int32))

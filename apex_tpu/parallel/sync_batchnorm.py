@@ -96,8 +96,8 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
 
     flax modules are immutable dataclasses, so generic recursive surgery is
     not possible; a ``flax.linen.BatchNorm`` instance is converted directly,
-    and model classes in ``apex_tpu.models`` accept a ``norm_cls`` argument
-    for the same effect at construction time.
+    and model classes in ``apex_tpu.models`` accept a ``sync_bn=True``
+    argument for the same effect at construction time.
     """
     if isinstance(module, nn.BatchNorm):
         return SyncBatchNorm(
@@ -106,6 +106,6 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
     if isinstance(module, SyncBatchNorm):
         return module
     raise NotImplementedError(
-        "convert_syncbn_model can convert flax BatchNorm instances; for whole "
-        "models, construct them with norm_cls=apex_tpu.parallel.SyncBatchNorm "
-        "(see apex_tpu.models).")
+        "convert_syncbn_model can convert flax BatchNorm instances; for "
+        "whole models, construct them with sync_bn=True "
+        "(see apex_tpu.models.resnet / dcgan).")

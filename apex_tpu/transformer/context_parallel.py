@@ -53,15 +53,26 @@ def ring_attention(
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     b, s_local, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv  # GQA: k/v ride the ring at h_kv heads, never repeated
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
     q32 = q.astype(jnp.float32) * scale
+    if rep > 1:
+        q32 = q32.reshape(b, s_local, h_kv, rep, d)
     row_pos = rank * s_local + jnp.arange(s_local)  # global query positions
 
     def block(carry_kv, src_rank):
         """One K/V block's contribution given its originating rank."""
         k_blk, v_blk = carry_kv
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        k32 = k_blk.astype(jnp.float32)
+        if rep > 1:
+            s = jnp.einsum("bqgrd,bkgd->bgrqk", q32, k32)
+            s = s.reshape(b, h, s_local, -1)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
         if causal:
             col_pos = src_rank * s_local + jnp.arange(s_local)
             allowed = col_pos[None, :] <= row_pos[:, None]  # [q, k]
@@ -80,9 +91,15 @@ def ring_attention(
         )
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
-        )
+        v32 = v_blk.astype(jnp.float32)
+        if rep > 1:
+            pv = jnp.einsum(
+                "bgrqk,bkgd->bgrqd",
+                p.reshape(b, h_kv, rep, s_local, -1), v32
+            ).reshape(b, h, s_local, d)
+        else:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, v32)
+        o = o * alpha[..., None] + pv
         # rotate K/V around the ring (rank r's block moves to r+1)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis, perm)
