@@ -17,6 +17,13 @@ from apex_tpu import normalization
 from apex_tpu import parallel
 from apex_tpu import multi_tensor_apply
 from apex_tpu import transformer
+from apex_tpu import fp16_utils
+from apex_tpu import fused_dense
+from apex_tpu import mlp
+from apex_tpu import models
+from apex_tpu import pyprof
+from apex_tpu import reparameterization
+from apex_tpu import rnn
 
 __version__ = "0.1.0"
 
@@ -27,4 +34,11 @@ __all__ = [
     "parallel",
     "multi_tensor_apply",
     "transformer",
+    "fp16_utils",
+    "fused_dense",
+    "mlp",
+    "models",
+    "pyprof",
+    "reparameterization",
+    "rnn",
 ]

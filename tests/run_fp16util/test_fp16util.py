@@ -1,0 +1,98 @@
+"""fp16_utils tests (mirrors ref tests/L0/run_fp16util/test_fp16util.py:
+master/model param round trips) plus FP16_Optimizer behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import fp16_utils
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    clip_grad_norm,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+    tofp16,
+)
+from apex_tpu.optimizers import FusedSGD
+
+
+def _params():
+    return {
+        "dense": {"w": jnp.ones((4, 4), jnp.bfloat16) * 0.5,
+                  "b": jnp.zeros((4,), jnp.bfloat16)},
+        "bn": {"scale": jnp.ones((4,), jnp.float32)},
+    }
+
+
+class TestFp16Util:
+    def test_tofp16_and_bn_exemption(self):
+        p = {"dense": {"w": jnp.ones((4, 4))}, "bn": {"scale": jnp.ones(4)}}
+        h = network_to_half(p)
+        assert h["dense"]["w"].dtype == jnp.bfloat16
+        assert h["bn"]["scale"].dtype == jnp.float32
+        assert tofp16(p)["bn"]["scale"].dtype == jnp.bfloat16
+
+    def test_prep_and_roundtrip(self):
+        p = _params()
+        model, master = prep_param_lists(p)
+        assert jax.tree_util.tree_leaves(master)[0].dtype == jnp.float32
+        # master update flows back at model dtype
+        master2 = jax.tree_util.tree_map(lambda m: m + 1.0, master)
+        model2 = master_params_to_model_params(model, master2)
+        assert model2["dense"]["w"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(model2["dense"]["w"], np.float32), 1.5)
+
+    def test_flat_master_roundtrip(self):
+        p = _params()
+        model, flat = prep_param_lists(p, flat_master=True)
+        assert flat.ndim == 1 and flat.dtype == jnp.float32
+        model2 = master_params_to_model_params(model, flat * 2,
+                                               flat_master=True)
+        np.testing.assert_allclose(
+            np.asarray(model2["dense"]["w"], np.float32), 1.0)
+        grads = jax.tree_util.tree_map(jnp.ones_like, p)
+        gflat = model_grads_to_master_grads(grads, flat_master=True)
+        assert gflat.shape == flat.shape
+
+    def test_clip_grad_norm(self):
+        g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+        clipped, total = clip_grad_norm(g, max_norm=1.0)
+        np.testing.assert_allclose(float(total), np.sqrt(3 * 16 + 4 * 9),
+                                   rtol=1e-5)
+        norm2 = jnp.sqrt(sum(jnp.sum(x ** 2)
+                             for x in jax.tree_util.tree_leaves(clipped)))
+        np.testing.assert_allclose(float(norm2), 1.0, rtol=1e-4)
+
+    def test_to_python_float(self):
+        assert to_python_float(jnp.asarray([[3.5]])) == 3.5
+
+
+class TestFP16Optimizer:
+    def test_step_and_overflow_skip(self):
+        p = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedSGD(p, lr=0.5), dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 4.0})
+        scale0 = opt.loss_scale
+        # normal step: grads are pre-scaled by the loss scale
+        grads = {"w": jnp.full((4,), 1.0 * scale0, jnp.bfloat16)}
+        model = opt.step(grads)
+        np.testing.assert_allclose(np.asarray(model["w"], np.float32), 0.5)
+        assert not opt.overflow
+        # overflow step: params unchanged, scale halves
+        bad = {"w": jnp.array([jnp.inf, 1, 1, 1], jnp.bfloat16)}
+        model2 = opt.step(bad)
+        assert opt.overflow
+        assert opt.loss_scale == scale0 / 2
+        np.testing.assert_allclose(np.asarray(model2["w"], np.float32), 0.5)
+
+    def test_state_dict_roundtrip(self):
+        p = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedSGD(p, lr=0.1), static_loss_scale=128.0)
+        sd = opt.state_dict()
+        opt2 = FP16_Optimizer(FusedSGD(p, lr=0.1), static_loss_scale=1.0)
+        opt2.load_state_dict(sd)
+        assert opt2.loss_scale == 128.0
