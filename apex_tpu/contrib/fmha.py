@@ -1,0 +1,43 @@
+"""Fused multi-head attention (ref apex/contrib/fmha/fmha.py FMHAFun +
+csrc/fmha cutlass kernels) — backed by the Pallas TPU flash attention
+kernel in :mod:`apex_tpu.ops.flash_attention`.
+
+The reference consumes varlen packed sequences (qkv [total, 3, h, d] +
+cu_seqlens). TPU-first design uses fixed-shape batches (dynamic shapes
+defeat XLA); varlen batches are expressed with a padding mask or by packing
+to a common length upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+
+def fmha(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """[b, s, h, d] fused attention (flash; no s×s HBM materialization)."""
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+def fmha_packed_qkv(qkv, causal: bool = False,
+                    scale: Optional[float] = None):
+    """qkv [b, s, 3, h, d] (the reference's packed layout, batched)."""
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+class FMHAFun:
+    """ref fmha.py FMHAFun.apply shape."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens=None, seqlens=None, p_dropout=0.0,
+              max_s=None, is_training=True, zero_tensors=False):
+        del cu_seqlens, seqlens, max_s, is_training, zero_tensors
+        if p_dropout:
+            raise NotImplementedError(
+                "attention dropout: apply dropout to the output projection "
+                "(TPU kernels keep the softmax deterministic)")
+        return fmha_packed_qkv(qkv)

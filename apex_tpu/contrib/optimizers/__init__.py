@@ -1,0 +1,8 @@
+"""apex.contrib.optimizers parity (ref apex/contrib/optimizers/)."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    distributed_fused_adam,
+)
+
+__all__ = ["DistributedFusedAdam", "distributed_fused_adam"]

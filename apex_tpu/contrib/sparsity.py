@@ -1,0 +1,117 @@
+"""ASP — automatic 2:4 structured sparsity (ref apex/contrib/sparsity/
+{asp.py,sparse_masklib.py}).
+
+The reference computes N:M masks with CUDA permutation-search kernels and
+hooks the optimizer to re-apply masks after each step. TPU design: the mask
+computation is a vectorized jnp program (magnitude-based m4n2_1d — the
+reference's default --whitelist pattern), masks live in the param pytree,
+and masking is a pure function applied inside the jitted train step (and
+wrapped around any optax transform via :func:`masked_update`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def mn_1d_mask(w, m: int = 4, n: int = 2):
+    """Keep the ``n`` largest-magnitude of every ``m`` consecutive weights
+    along the last dim (ref sparse_masklib.py:49 m4n2_1d / mn_1d_best).
+
+    Works on any shape with last dim divisible by m; returns a 0/1 mask of
+    w's shape and dtype bool.
+    """
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by m={m}")
+    groups = w.reshape(*w.shape[:-1], w.shape[-1] // m, m)
+    mag = jnp.abs(groups)
+    # keep exactly n per group by magnitude rank (deterministic ties)
+    order = jnp.argsort(jnp.argsort(-mag, axis=-1), axis=-1)  # rank, 0=largest
+    keep = order < n
+    return keep.reshape(w.shape)
+
+
+def create_mask(w, pattern: str = "m4n2_1d"):
+    """ref sparse_masklib.py create_mask entry."""
+    if pattern == "m4n2_1d":
+        return mn_1d_mask(w, 4, 2)
+    if pattern == "m4n2_2d_best":
+        # 2d pattern: apply 1d along both dims greedily (the reference's
+        # exhaustive 2d search is a CUDA kernel; 1d x transpose-1d is the
+        # documented greedy fallback, ref sparse_masklib.py:67)
+        m_rows = mn_1d_mask(w, 4, 2)
+        m_cols = jnp.swapaxes(
+            mn_1d_mask(jnp.swapaxes(w, -1, -2), 4, 2), -1, -2)
+        return m_rows & m_cols
+    raise ValueError(f"unknown pattern {pattern}")
+
+
+def apply_masks(params, masks):
+    """w * mask over the tree (the reference's in-place hook, functional)."""
+    return jax.tree_util.tree_map(
+        lambda p, m: p * m.astype(p.dtype) if m is not None else p,
+        params, masks, is_leaf=lambda x: x is None)
+
+
+def masked_update(tx: optax.GradientTransformation, masks):
+    """Wrap an optax transform so updates AND params stay masked — the
+    analog of ASP hooking optimizer.step (ref asp.py:init_optimizer_for_pruning)."""
+
+    def init(params):
+        return tx.init(apply_masks(params, masks))
+
+    def update(grads, state, params=None):
+        grads = apply_masks(grads, masks)
+        updates, state = tx.update(grads, state, params)
+        updates = apply_masks(updates, masks)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
+class ASP:
+    """ref asp.py ASP static class; functional equivalents.
+
+    Usage:
+        masks = ASP.compute_sparse_masks(params)       # once, post-warmup
+        params = ASP.apply(params, masks)
+        tx = ASP.init_optimizer_for_pruning(tx, masks) # masked updates
+    """
+
+    @staticmethod
+    def _eligible(path: str, leaf) -> bool:
+        # ref asp.py whitelist: linear/conv weights, ndim>=2, dims % 4 == 0
+        return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+                and leaf.shape[-1] % 4 == 0)
+
+    @staticmethod
+    def compute_sparse_masks(params, pattern: str = "m4n2_1d",
+                             eligible: Optional[Callable] = None):
+        elig = eligible or ASP._eligible
+
+        def mk(path, leaf):
+            name = jax.tree_util.keystr(path)
+            if elig(name, leaf):
+                return create_mask(leaf, pattern)
+            return None
+
+        return jax.tree_util.tree_map_with_path(mk, params)
+
+    @staticmethod
+    def apply(params, masks):
+        return apply_masks(params, masks)
+
+    @staticmethod
+    def init_optimizer_for_pruning(tx, masks):
+        return masked_update(tx, masks)
+
+    @staticmethod
+    def init_model_for_pruning(params, mask_calculator: str = "m4n2_1d",
+                               **kw):
+        """Returns (params, masks) — functional twist on ref asp.py:61."""
+        masks = ASP.compute_sparse_masks(params, mask_calculator)
+        return apply_masks(params, masks), masks

@@ -1,0 +1,158 @@
+"""RNN-T transducer joint + loss (ref apex/contrib/transducer/
+{transducer.py} TransducerJoint / TransducerLoss, csrc transducer kernels).
+
+TPU-first design notes:
+- The joint is the broadcast add f[:, :, None] + g[:, None, :] with optional
+  relu/dropout — one XLA fusion (the reference's "packed" path exists to
+  skip padding on GPU; fixed shapes + masking is the TPU-friendly layout).
+- The loss's alpha recursion is reformulated so the inner (label) dimension
+  runs as a ``lax.associative_scan`` in the log semiring: each time-frame
+  row is a first-order linear recurrence
+      alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+                              alpha[t, u-1] + emit[t, u-1])
+  whose scan element is the affine map X -> E*X + A, composed associatively
+  as (log_m, log_a) pairs. The outer time loop is a ``lax.scan``. That
+  turns the classic O(T·U) sequential lattice into O(T) steps of O(log U)
+  depth — the TPU answer to the reference's warp-parallel CUDA DP.
+- Gradients fall out of AD through the scans (exact), so there is no
+  hand-written backward kernel to keep in sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------- joint
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, pack_output: bool = False,
+                     relu: bool = False, dropout: float = 0.0,
+                     dropout_rng=None):
+    """h[b, t, u, :] = f[b, t, :] + g[b, u, :] (ref TransducerJoint.forward).
+
+    ``pack_output`` is accepted for API parity and ignored: TPU kernels
+    want fixed shapes; padding is masked in the loss instead.
+    """
+    del f_len, g_len, pack_output
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout), 0.0)
+    return h
+
+
+class TransducerJoint:
+    """ref transducer.py:10 TransducerJoint."""
+
+    def __init__(self, pack_output=False, relu=False, dropout=False,
+                 dropout_prob=0.0, probe=None):
+        del probe
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout_prob = dropout_prob if dropout else 0.0
+
+    def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
+                 packed_batch=0, dropout_rng=None):
+        del batch_offset, packed_batch
+        return transducer_joint(f, g, f_len, g_len, self.pack_output,
+                                self.relu, self.dropout_prob, dropout_rng)
+
+
+# -------------------------------------------------------------------- loss
+
+
+def _row_recurrence(prev_term, emit_row):
+    """Solve alpha_row[u] = logaddexp(prev_term[u], alpha_row[u-1] +
+    emit_row[u-1]) for all u via associative_scan in the log semiring.
+
+    Element = affine map X -> M*X + A with (log_m, log_a); composition
+    (applied left-to-right) is (lm1+lm2, logaddexp(la1 + lm2, la2)).
+    """
+    u1 = prev_term.shape[-1]
+    # shift emit right: multiplier entering position u is emit[u-1]
+    log_m = jnp.concatenate(
+        [jnp.full(emit_row.shape[:-1] + (1,), _NEG_INF), emit_row[..., :-1]],
+        axis=-1)
+    log_a = prev_term
+
+    def combine(x, y):
+        lm1, la1 = x
+        lm2, la2 = y
+        return lm1 + lm2, jnp.logaddexp(la1 + lm2, la2)
+
+    _, alpha = jax.lax.associative_scan(combine, (log_m, log_a), axis=-1)
+    return alpha
+
+
+def transducer_loss(logits, targets, f_len, y_len, blank_idx: int = 0,
+                    packed_input: bool = False):
+    """Negative log-likelihood per batch element (ref TransducerLoss).
+
+    logits: [B, T, U+1, V] joint outputs; targets [B, U] label ids;
+    f_len [B] valid time frames; y_len [B] valid labels.
+    """
+    if packed_input:
+        raise NotImplementedError(
+            "packed input is a GPU memory optimization; pass padded "
+            "[B, T, U+1, V] logits (mask via f_len/y_len)")
+    B, T, U1, V = logits.shape
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    blank = lp[..., blank_idx]                       # [B, T, U+1]
+    emit = jnp.take_along_axis(
+        lp[:, :, :-1, :], targets[:, None, :, None], axis=-1)[..., 0]
+    # emit[b, t, u] = lp[t, u, targets[u]]; pad back to U+1 with -inf
+    emit = jnp.concatenate(
+        [emit, jnp.full((B, T, 1), _NEG_INF)], axis=2)   # [B, T, U+1]
+    # labels beyond y_len can never be emitted
+    u_pos = jnp.arange(U1)[None, :]
+    emit = jnp.where(u_pos[None] < y_len[:, None, None], emit, _NEG_INF)
+
+    alpha0 = jnp.full((B, U1), _NEG_INF).at[:, 0].set(0.0)
+    alpha0 = _row_recurrence(
+        alpha0.at[:, 1:].set(_NEG_INF).at[:, 0].set(0.0), emit[:, 0])
+
+    def step(alpha_prev, inputs):
+        blank_prev, emit_row = inputs  # blank at t-1, emit at t
+        prev_term = alpha_prev + blank_prev
+        alpha = _row_recurrence(prev_term, emit_row)
+        return alpha, alpha
+
+    blanks_t = jnp.moveaxis(blank[:, :-1], 1, 0)    # [T-1, B, U+1]
+    emits_t = jnp.moveaxis(emit[:, 1:], 1, 0)
+    _, alphas = jax.lax.scan(step, alpha0, (blanks_t, emits_t))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, U+1]
+    alphas = jnp.moveaxis(alphas, 0, 1)             # [B, T, U+1]
+
+    # ll = alpha[f_len-1, y_len] + blank[f_len-1, y_len]
+    t_idx = jnp.clip(f_len - 1, 0, T - 1)
+    a_final = jnp.take_along_axis(
+        alphas, t_idx[:, None, None].repeat(U1, axis=2), axis=1)[:, 0]
+    b_final = jnp.take_along_axis(
+        blank, t_idx[:, None, None].repeat(U1, axis=2), axis=1)[:, 0]
+    ll = jnp.take_along_axis(a_final + b_final, y_len[:, None], axis=1)[:, 0]
+    return -ll
+
+
+class TransducerLoss:
+    """ref transducer.py TransducerLoss (Function.apply shape)."""
+
+    def __init__(self, fuse_softmax_backward=True, opt=1,
+                 packed_input=False):
+        del fuse_softmax_backward, opt
+        self.packed_input = packed_input
+
+    def __call__(self, x, label, f_len, y_len, blank_idx=0,
+                 batch_offset=None, max_f_len=None, debug_list=None):
+        del batch_offset, max_f_len, debug_list
+        return transducer_loss(x, label, f_len, y_len, blank_idx,
+                               self.packed_input)

@@ -1,0 +1,189 @@
+"""Pallas TPU flash attention (the kernel behind ``apex_tpu.contrib.fmha``;
+ref apex/contrib/fmha/fmha.py + csrc/fmha cutlass kernels).
+
+Design (TPU-first, not a CUDA port):
+- grid = (batch*heads, q_blocks, k_blocks), k innermost so the online
+  softmax state (m, l, acc) lives in VMEM scratch across the k sweep.
+- one q tile is [BLOCK_Q, d] in VMEM; each step streams one [BLOCK_K, d]
+  k/v tile through the MXU (q @ k^T then p @ v), fp32 accumulation.
+- causal masking is positional (iota compare) — no mask tensor ever
+  materializes in HBM (the reference's kernels read a cu_seqlens array;
+  fixed-shape batched input is the TPU-friendly layout).
+
+Backward runs the standard recompute-based VJP expressed in jnp (XLA fuses
+it well at these sizes); the Pallas forward is the memory win: no [sq, sk]
+attention matrix is ever written to HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
+                q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    run = True
+    if causal:
+        # whole block above the diagonal ⇒ nothing to do
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        if causal:
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        # mask key padding (sk not multiple of block_k)
+        if sk % block_k:
+            s = jnp.where(k_pos < sk, s, _NEG_INF)
+
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        # rows with nothing allowed yet: keep p exact zero
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:, 0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_sc[:] /
+                    jnp.maximum(l_sc[:, 0], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def _pick_block(s, target):
+    b = min(target, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+    """q [bh, sq, d], k/v [bh_kv, sk, d] → o [bh, sq, d].
+
+    GQA: when bh_kv < bh, ``rep = bh // bh_kv`` query heads read the SAME
+    k/v block via the BlockSpec index map — no repeated copy in HBM.
+    Layout requirement: q heads grouped kv-major (head g*rep+r shares kv
+    head g), which :func:`flash_attention` arranges.
+    """
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    rep = bh // bh_kv
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
+
+    kernel = functools.partial(_fwd_kernel, causal, scale, bq, bk, sq, sk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, causal, scale):
+    """jnp reference — also the VJP path (rematerialized). GQA-aware:
+    q [bh, sq, d] with k/v [bh_kv, sk, d]; grouped einsum, no kv copy."""
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    rep = bh // bh_kv
+    qg = q.reshape(bh_kv, rep, sq, d).astype(jnp.float32)
+    s = jnp.einsum("grqd,gkd->grqk", qg, k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("grqk,gkd->grqd", p, v.astype(jnp.float32))
+    return o.reshape(bh, sq, d).astype(q.dtype)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    if _use_pallas():
+        return _flash_fwd_pallas(q, k, v, causal, scale, 512, 512)
+    return _reference_attention(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference_attention(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Fused attention on [b, s, h, d] (heads may differ for k/v — GQA).
+
+    Returns [b, sq, h, d]; fp32 softmax internally, output in q's dtype.
+    """
+    b, sq, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / d ** 0.5
+
+    # heads-major flatten; q head g*rep+r shares kv head g (standard GQA
+    # head order), matching the kernel's b//rep kv indexing
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
+    o = _flash(qt, kt, vt, causal, float(scale))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

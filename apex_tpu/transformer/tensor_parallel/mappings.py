@@ -61,6 +61,20 @@ def _to_varying(x, axis: str):
     return jax.lax.pvary(x, (axis,))
 
 
+def _to_invariant(x, axis: str):
+    """Make a numerically-replicated value vma-invariant over ``axis``
+    (e.g. an all_gather output, identical on every rank). jax has no claim
+    primitive, so this divides by the axis size and psums — psum is the
+    variant→invariant collective. XLA folds the scale into the reduce."""
+    try:
+        if axis not in jax.typeof(x).vma:
+            return x
+    except (AttributeError, TypeError):
+        return x
+    n = jax.lax.axis_size(axis)
+    return jax.lax.psum(x / n, axis)
+
+
 def copy_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
     """Identity forward; gradients allreduce over tp (ref mappings.py:148)."""
     axis = _axis(axis_name)

@@ -1,0 +1,348 @@
+"""Contrib module tests (mirrors ref apex/contrib/test/* strategy: parity
+vs plain implementations on small shapes)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.conv_bias_relu import ConvBias, ConvBiasMaskReLU, ConvBiasReLU
+from apex_tpu.contrib.fmha import fmha, fmha_packed_qkv
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.layer_norm import FastLayerNorm, fast_layer_norm
+from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+from apex_tpu.contrib.peer_memory import halo_exchange_1d
+from apex_tpu.contrib.sparsity import ASP, create_mask, mn_1d_mask
+from apex_tpu.contrib.optimizers import distributed_fused_adam
+from apex_tpu.contrib.transducer import TransducerJoint, transducer_loss
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.optimizers import fused_adam
+
+
+class TestXentropy:
+    def test_matches_plain_ce(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 32))
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8,), 1, 32)
+        got = softmax_cross_entropy_loss(logits, labels)
+        want = -jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                    labels[:, None], 1)[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_smoothing_and_padding(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+        labels = jnp.array([0, 3, 5, 7])  # first = padding_idx
+        loss = softmax_cross_entropy_loss(logits, labels, smoothing=0.1)
+        assert float(loss[0]) == 0.0
+        v = logits.shape[-1]
+        lp = jax.nn.log_softmax(logits)
+        want = -(0.9 * jnp.take_along_axis(lp, labels[:, None], 1)[:, 0]
+                 + 0.1 * jnp.mean(lp, axis=-1))
+        np.testing.assert_allclose(np.asarray(loss[1:]), np.asarray(want[1:]),
+                                   rtol=1e-5)
+
+    def test_grad_matches_autodiff(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+        labels = jnp.array([2, 3, 0, 7])
+
+        def fused(lg):
+            return jnp.sum(softmax_cross_entropy_loss(lg, labels,
+                                                      smoothing=0.2))
+
+        def plain(lg):
+            lp = jax.nn.log_softmax(lg)
+            nll = -jnp.take_along_axis(lp, labels[:, None], 1)[:, 0]
+            sm = -jnp.mean(lp, axis=-1)
+            per = 0.8 * nll + 0.2 * sm
+            return jnp.sum(jnp.where(labels == 0, 0.0, per))
+
+        np.testing.assert_allclose(np.asarray(jax.grad(fused)(logits)),
+                                   np.asarray(jax.grad(plain)(logits)),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestClipFocal:
+    def test_clip_grad_norm(self):
+        g = {"a": jnp.full((4,), 3.0), "b": jnp.full((9,), 4.0)}
+        clipped, norm = clip_grad_norm_(g, 5.0)
+        np.testing.assert_allclose(float(norm), np.sqrt(4 * 9 + 9 * 16),
+                                   rtol=1e-5)
+        total = jnp.sqrt(sum(jnp.sum(x ** 2) for x in
+                             jax.tree_util.tree_leaves(clipped)))
+        np.testing.assert_allclose(float(total), 5.0, rtol=1e-4)
+
+    def test_focal_loss_reduces_to_weighted_ce_at_gamma0(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (6, 10))
+        targets = jnp.array([0, 1, 2, -1, 4, 5])
+        lf = focal_loss(logits, targets, jnp.asarray(5.0), 10, alpha=0.25,
+                        gamma=0.0)
+        onehot = jax.nn.one_hot(jnp.maximum(targets, 0), 10)
+        onehot = jnp.where((targets >= 0)[:, None], onehot, 0.0)
+        a = 0.25 * onehot + 0.75 * (1 - onehot)
+        bce = a * (jnp.maximum(logits, 0) - logits * onehot
+                   + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        np.testing.assert_allclose(float(lf), float(jnp.sum(bce) / 5.0),
+                                   rtol=1e-5)
+
+    def test_focal_gamma_downweights_easy(self):
+        logits = jnp.array([[8.0, -8.0]])  # confidently correct for class 0
+        t = jnp.array([0])
+        easy = focal_loss(logits, t, jnp.asarray(1.0), 2, 0.5, 2.0)
+        hard = focal_loss(-logits, t, jnp.asarray(1.0), 2, 0.5, 2.0)
+        assert float(easy) < float(hard) / 100
+
+
+class TestLayerNormConv:
+    def test_fast_layer_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        g, b = jnp.ones(64) * 1.5, jnp.full((64,), 0.25)
+        got = fast_layer_norm(x, g, b)
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        want = (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+        ln = FastLayerNorm(64)
+        v = ln.init(jax.random.PRNGKey(1), x)
+        np.testing.assert_allclose(np.asarray(ln.apply(v, x)),
+                                   np.asarray(fast_layer_norm(
+                                       x, jnp.ones(64), jnp.zeros(64))),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv_bias_relu(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 5)) * 0.2
+        b = jnp.linspace(-1, 1, 5)
+        y = ConvBiasReLU(x, w, b, padding=1, stride=1)
+        assert y.shape == (2, 8, 8, 5)
+        assert float(jnp.min(y)) >= 0.0
+        y2 = ConvBias(x, w, b, padding=1, stride=2)
+        assert y2.shape == (2, 4, 4, 5)
+        mask = jnp.zeros((2, 8, 8, 5)).at[:, :4].set(1.0)
+        y3 = ConvBiasMaskReLU(x, w, b, mask, padding=1, stride=1)
+        np.testing.assert_allclose(np.asarray(y3[:, 4:]), 0.0)
+
+    def test_groupbn_fuse_relu_and_addrelu(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+        bn = BatchNorm2d_NHWC(8, fuse_relu=True, bn_group=1)
+        v = bn.init(jax.random.PRNGKey(1), x)
+        y = bn.apply(v, x, mutable=["batch_stats"])[0]
+        assert float(jnp.min(y)) >= 0.0
+        z = jnp.ones_like(x)
+        bn2 = BatchNorm2d_NHWC(8)
+        v2 = bn2.init(jax.random.PRNGKey(1), x)
+        y2 = bn2.apply(v2, x, z, mutable=["batch_stats"])[0]
+        assert float(jnp.min(y2)) >= 0.0  # add+relu path
+
+
+class TestAttention:
+    def test_fmha_matches_softmax_attention(self):
+        b, s, h, d = 2, 64, 4, 32
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+        got = fmha(q, k, v, causal=True)
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        s_ = jnp.where(mask, s_, -1e30)
+        want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s_), v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fmha_gqa_matches_repeat(self):
+        b, s, h, hkv, d = 2, 32, 8, 2, 16
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d))
+        got = fmha(q, k, v, causal=True)
+        kr = jnp.repeat(k, h // hkv, axis=2)
+        vr = jnp.repeat(v, h // hkv, axis=2)
+        want = fmha(q, kr, vr, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_self_mha_key_padding_excludes_keys(self):
+        """Changing a PADDED key must not change any output; semantics match
+        a manual pre-softmax key mask."""
+        s, b, h = 8, 2, 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        mask = jnp.zeros((b, s), bool).at[:, 6:].set(True)
+        m = SelfMultiheadAttn(hidden_dim=h, heads=2)
+        v = m.init(jax.random.PRNGKey(1), x)
+        y1 = m.apply(v, x, key_padding_mask=mask)
+        x2 = x.at[7].add(100.0)  # perturb a padded position's input...         # (its QUERY row changes, but other rows must not)
+        y2 = m.apply(v, x2, key_padding_mask=mask)
+        np.testing.assert_allclose(np.asarray(y1[:6]), np.asarray(y2[:6]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fmha_packed(self):
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 3, 4, 8))
+        out = fmha_packed_qkv(qkv)
+        assert out.shape == (2, 16, 4, 8)
+
+    def test_self_mha_shapes_and_norm_add(self):
+        s, b, h = 12, 2, 32
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        for norm_add in (False, True):
+            m = SelfMultiheadAttn(hidden_dim=h, heads=4,
+                                  include_norm_add=norm_add)
+            v = m.init(jax.random.PRNGKey(1), x)
+            y = m.apply(v, x)
+            assert y.shape == (s, b, h)
+
+    def test_encdec_mha(self):
+        q = jax.random.normal(jax.random.PRNGKey(0), (6, 2, 16))
+        kv = jax.random.normal(jax.random.PRNGKey(1), (9, 2, 16))
+        m = EncdecMultiheadAttn(hidden_dim=16, heads=2)
+        v = m.init(jax.random.PRNGKey(2), q, kv)
+        y = m.apply(v, q, kv)
+        assert y.shape == (6, 2, 16)
+
+
+class TestSparsity:
+    def test_mn_1d_mask_density_and_selection(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+        m = mn_1d_mask(w, 4, 2)
+        assert float(jnp.mean(m.astype(jnp.float32))) == 0.5
+        groups = jnp.abs(w).reshape(16, 8, 4)
+        kept = jnp.abs(w * m).reshape(16, 8, 4)
+        # the kept magnitudes are the top-2 of each group
+        np.testing.assert_allclose(
+            np.asarray(jnp.sort(kept, -1)[..., 2:]),
+            np.asarray(jnp.sort(groups, -1)[..., 2:]), rtol=1e-6)
+
+    def test_asp_masked_training_preserves_sparsity(self):
+        params = {"dense": {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                                   (8, 16))}}
+        params, masks = ASP.init_model_for_pruning(params)
+        tx = ASP.init_optimizer_for_pruning(fused_adam(lr=0.1), masks)
+        state = tx.init(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+
+        def loss(p):
+            return jnp.mean((x @ p["dense"]["w"] - 1.0) ** 2)
+
+        import optax
+        for _ in range(3):
+            g = jax.grad(loss)(params)
+            u, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, u)
+        w = params["dense"]["w"]
+        density = float(jnp.mean((w != 0).astype(jnp.float32)))
+        assert density <= 0.5 + 1e-6
+
+    def test_2d_pattern(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+        m = create_mask(w, "m4n2_2d_best")
+        assert float(jnp.mean(m.astype(jnp.float32))) <= 0.5
+
+
+class TestDistributedFusedAdam:
+    def test_matches_plain_adam(self):
+        """ZeRO-sharded update == replicated fused adam update."""
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (37,)),
+                  "b": jnp.ones((5,))}
+        grads = {"w": jnp.full((37,), 0.5), "b": jnp.full((5,), -0.25)}
+
+        tx = distributed_fused_adam(lr=1e-2, axis_name="dp")
+
+        def run(params, grads):
+            state = tx.init(params)
+            updates, _ = tx.update(grads, state, params)
+            return updates
+
+        got = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=P())(params, grads)
+
+        ref_tx = fused_adam(lr=1e-2)
+        st = ref_tx.init(params)
+        want, _ = ref_tx.update(grads, st, params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(want[k]), rtol=1e-5,
+                                       atol=1e-6)
+
+
+class TestTransducer:
+    def test_joint(self):
+        f = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 8))
+        g = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))
+        h = TransducerJoint()(f, g)
+        assert h.shape == (2, 5, 3, 8)
+        np.testing.assert_allclose(np.asarray(h[0, 2, 1]),
+                                   np.asarray(f[0, 2] + g[0, 1]), rtol=1e-6)
+        hr = TransducerJoint(relu=True)(f, g)
+        assert float(jnp.min(hr)) >= 0.0
+
+    def test_loss_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 6, 4, 8
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        targets = rng.randint(1, V, (B, U))
+        f_len = np.array([6, 5, 4])
+        y_len = np.array([4, 3, 2])
+        got = np.asarray(transducer_loss(
+            jnp.asarray(logits), jnp.asarray(targets), jnp.asarray(f_len),
+            jnp.asarray(y_len)))
+
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+
+        def brute(lp, tg, T, U):
+            NEG = -1e30
+            alpha = np.full((T, U + 1), NEG)
+            alpha[0, 0] = 0.0
+            for t in range(T):
+                for u in range(U + 1):
+                    c = []
+                    if t > 0:
+                        c.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+                    if u > 0:
+                        c.append(alpha[t, u - 1] + lp[t, u - 1, tg[u - 1]])
+                    if c:
+                        m = max(c)
+                        if m > NEG / 2:
+                            alpha[t, u] = m + np.log(
+                                sum(np.exp(x - m) for x in c))
+            return -(alpha[T - 1, U] + lp[T - 1, U, 0])
+
+        want = np.array([brute(lp[b], targets[b], f_len[b], y_len[b])
+                         for b in range(B)])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_loss_grad_finite(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 4, 6))
+        targets = jnp.array([[1, 2, 3], [2, 3, 1]])
+        g = jax.grad(lambda lg: jnp.sum(transducer_loss(
+            lg, targets, jnp.array([5, 4]), jnp.array([3, 2]))))(logits)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestHaloExchange:
+    def test_halo_rows_move_to_neighbours(self):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("spatial",))
+        hh = 1
+        # global map [N=1, H=16, W=2, C=3], H sharded 4 ways (4 rows/rank)
+        x = jnp.arange(16 * 2 * 3, dtype=jnp.float32).reshape(1, 16, 2, 3)
+
+        def run(x_local):
+            pad = [(0, 0)] * x_local.ndim
+            pad[1] = (hh, hh)
+            y = jnp.pad(x_local, pad)
+            y = halo_exchange_1d(y, hh, "spatial", h_dim=1)
+            return y[None]  # stack per-rank padded slabs on a new axis
+
+        got = shard_map(run, mesh=mesh, in_specs=P(None, "spatial"),
+                        out_specs=P("spatial"))(x)
+        got = np.asarray(got)          # [4, 1, 6, 2, 3]
+        slabs = np.asarray(x).reshape(4, 4, 2, 3)
+        # rank r's top margin row == rank r-1's last row; bottom == r+1's first
+        for r in range(1, 4):
+            np.testing.assert_allclose(got[r, 0, 0], slabs[r - 1, -1])
+        for r in range(0, 3):
+            np.testing.assert_allclose(got[r, 0, -1], slabs[r + 1, 0])
