@@ -1,0 +1,99 @@
+"""Checkpoint/resume (SURVEY.md §5): orbax-backed save/restore of
+params + optimizer state + amp/loss-scaler state + RNG.
+
+The reference has no checkpoint layer of its own (torch.save in examples,
+plus ``amp.state_dict()`` — ref apex/amp/frontend.py state_dict); here the
+whole training state round-trips through one API, sharding-aware via orbax
+(restores land on the same Mesh/PartitionSpec layout they were saved from).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
+                    overwrite: bool = True):
+    """Save a pytree (params / opt state / amp state / rng — anything).
+
+    ``step`` appends a step subdirectory (``path/step_000010``).
+    """
+    ocp = _ocp()
+    if step is not None:
+        path = os.path.join(path, f"step_{step:08d}")
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=overwrite)
+    return path
+
+
+def restore_checkpoint(path: str, target: Optional[Any] = None,
+                       step: Optional[int] = None):
+    """Restore; ``target`` (a matching pytree of arrays/ShapeDtypeStructs)
+    pins structure, dtypes and shardings."""
+    ocp = _ocp()
+    if step is not None:
+        path = os.path.join(path, f"step_{step:08d}")
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, item=target)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest ``step_*`` subdirectory, or None."""
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for d in os.listdir(path):
+        if d.startswith("step_"):
+            try:
+                steps.append(int(d[5:]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+
+class CheckpointManager:
+    """Thin rotation/bookkeeping wrapper (orbax CheckpointManager analog
+    with the apex-era torch.save ergonomics)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def save(self, step: int, state: Any):
+        p = save_checkpoint(self.directory, state, step=step)
+        self._gc()
+        return p
+
+    def restore(self, target: Optional[Any] = None,
+                step: Optional[int] = None):
+        step = step if step is not None else latest_step(self.directory)
+        if step is None:
+            return None
+        return restore_checkpoint(self.directory, target, step=step)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.directory)
+
+    def _gc(self):
+        import shutil
+
+        steps = sorted(
+            int(d[5:]) for d in os.listdir(self.directory)
+            if d.startswith("step_"))
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s:08d}"),
+                          ignore_errors=True)

@@ -1,0 +1,236 @@
+// apex_tpu C++ host runtime (TPU re-design of the reference's host-side
+// native layer: csrc/flatten_unflatten.cpp + apex/parallel/distributed.py
+// bucket logic). The TPU compute path is XLA/Pallas; this library owns the
+// host work that sits AROUND the device: gradient-bucket planning, flat
+// buffer packing for host-side checkpoint/comm staging, and a threaded
+// prefetch ring for input pipelines.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <numeric>
+#include <thread>
+#include <vector>
+#include <functional>
+
+extern "C" {
+
+// ---------------------------------------------------------------- buckets
+//
+// Greedy size-capped bucketing in reverse registration order — gradients
+// become ready roughly last-parameter-first during backprop, so DDP fills
+// buckets in reverse (ref apex/parallel/distributed.py bucket assignment).
+// sizes: bytes per tensor. out_bucket: bucket id per tensor.
+// Returns the number of buckets.
+int64_t apex_plan_buckets(const int64_t* sizes, int64_t n,
+                          int64_t bucket_bytes, int64_t* out_bucket) {
+  if (n <= 0) return 0;
+  int64_t bucket = 0;
+  int64_t used = 0;
+  for (int64_t i = n - 1; i >= 0; --i) {
+    if (used > 0 && used + sizes[i] > bucket_bytes) {
+      ++bucket;
+      used = 0;
+    }
+    out_bucket[i] = bucket;
+    used += sizes[i];
+  }
+  return bucket + 1;
+}
+
+// Offsets of each tensor inside its flat bucket buffer.
+// out_offset[i] = byte offset of tensor i within bucket out_bucket[i].
+void apex_bucket_offsets(const int64_t* sizes, const int64_t* bucket_ids,
+                         int64_t n, int64_t n_buckets, int64_t* out_offset,
+                         int64_t* out_bucket_size) {
+  std::vector<int64_t> used(n_buckets, 0);
+  // offsets follow ascending index order within a bucket
+  for (int64_t i = 0; i < n; ++i) {
+    out_offset[i] = used[bucket_ids[i]];
+    used[bucket_ids[i]] += sizes[i];
+  }
+  for (int64_t b = 0; b < n_buckets; ++b) out_bucket_size[b] = used[b];
+}
+
+// ------------------------------------------------------------ flat pack/
+// unpack (ref csrc/flatten_unflatten.cpp, which defers to torch's
+// flatten_dense_tensors). Multithreaded memcpy gather/scatter.
+
+struct CopyJob {
+  const uint8_t* src;
+  uint8_t* dst;
+  int64_t bytes;
+};
+
+static void run_jobs(std::vector<CopyJob>& jobs, int threads) {
+  if (threads <= 1 || jobs.size() <= 1) {
+    for (auto& j : jobs) std::memcpy(j.dst, j.src, j.bytes);
+    return;
+  }
+  std::atomic<size_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= jobs.size()) return;
+      std::memcpy(jobs[i].dst, jobs[i].src, jobs[i].bytes);
+    }
+  };
+  std::vector<std::thread> pool;
+  int nt = std::min<int>(threads, (int)jobs.size());
+  pool.reserve(nt);
+  for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+// Gather n tensors (srcs[i], sizes[i] bytes) into flat at given offsets.
+void apex_flatten(const void** srcs, const int64_t* sizes,
+                  const int64_t* offsets, int64_t n, void* flat,
+                  int threads) {
+  std::vector<CopyJob> jobs(n);
+  for (int64_t i = 0; i < n; ++i)
+    jobs[i] = {(const uint8_t*)srcs[i], (uint8_t*)flat + offsets[i],
+               sizes[i]};
+  run_jobs(jobs, threads);
+}
+
+// Scatter flat back out to n tensors.
+void apex_unflatten(const void* flat, const int64_t* sizes,
+                    const int64_t* offsets, int64_t n, void** dsts,
+                    int threads) {
+  std::vector<CopyJob> jobs(n);
+  for (int64_t i = 0; i < n; ++i)
+    jobs[i] = {(const uint8_t*)flat + offsets[i], (uint8_t*)dsts[i],
+               sizes[i]};
+  run_jobs(jobs, threads);
+}
+
+// ------------------------------------------------------- prefetch ring
+//
+// Threaded producer/consumer ring of fixed-size byte buffers. Producers
+// call a user callback (Python via ctypes CFUNCTYPE, or any C fn) that
+// fills a buffer for batch index i; consumers pop in order. This is the
+// host input pipeline the reference leaves to torch DataLoader workers.
+
+typedef int32_t (*apex_fill_fn)(int64_t batch_idx, void* buffer,
+                                int64_t buffer_bytes, void* ctx);
+
+struct PrefetchRing {
+  std::vector<std::vector<uint8_t>> slots;
+  std::vector<int64_t> slot_batch;     // which batch each slot holds
+  std::vector<int32_t> slot_status;    // 0 empty, 1 filling, 2 ready, -1 err
+  std::deque<int64_t> fill_queue;      // batch indices to produce
+  int64_t next_consume = 0;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_work;
+  std::vector<std::thread> workers;
+  bool stop = false;
+  apex_fill_fn fill = nullptr;
+  void* ctx = nullptr;
+  int64_t buffer_bytes = 0;
+};
+
+void* apex_prefetch_create(int64_t n_slots, int64_t buffer_bytes,
+                           int64_t total_batches, int n_workers,
+                           apex_fill_fn fill, void* ctx) {
+  auto* r = new PrefetchRing();
+  r->slots.assign(n_slots, std::vector<uint8_t>(buffer_bytes));
+  r->slot_batch.assign(n_slots, -1);
+  r->slot_status.assign(n_slots, 0);
+  for (int64_t b = 0; b < total_batches; ++b) r->fill_queue.push_back(b);
+  r->fill = fill;
+  r->ctx = ctx;
+  r->buffer_bytes = buffer_bytes;
+  auto worker = [r]() {
+    for (;;) {
+      int64_t batch = -1;
+      int64_t slot = -1;
+      {
+        std::unique_lock<std::mutex> lk(r->mu);
+        r->cv_work.wait(lk, [r] {
+          if (r->stop) return true;
+          if (r->fill_queue.empty()) return false;
+          // a slot is claimable if empty AND the batch at the queue head
+          // is within n_slots of the consume cursor (bounded prefetch)
+          int64_t b = r->fill_queue.front();
+          if (b >= r->next_consume + (int64_t)r->slots.size()) return false;
+          for (size_t s = 0; s < r->slots.size(); ++s)
+            if (r->slot_status[s] == 0) return true;
+          return false;
+        });
+        if (r->stop) return;
+        batch = r->fill_queue.front();
+        if (batch >= r->next_consume + (int64_t)r->slots.size()) continue;
+        for (size_t s = 0; s < r->slots.size(); ++s)
+          if (r->slot_status[s] == 0) { slot = (int64_t)s; break; }
+        if (slot < 0) continue;
+        r->fill_queue.pop_front();
+        r->slot_status[slot] = 1;
+        r->slot_batch[slot] = batch;
+      }
+      int32_t rc = r->fill(batch, r->slots[slot].data(), r->buffer_bytes,
+                           r->ctx);
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        r->slot_status[slot] = rc == 0 ? 2 : -1;
+      }
+      r->cv_ready.notify_all();
+      r->cv_work.notify_all();
+    }
+  };
+  for (int t = 0; t < n_workers; ++t) r->workers.emplace_back(worker);
+  return r;
+}
+
+// Block until the next in-order batch is ready; copy it to out. Returns the
+// batch index, or -1 on fill error, -2 if exhausted.
+int64_t apex_prefetch_next(void* ring, void* out, int64_t out_bytes) {
+  auto* r = (PrefetchRing*)ring;
+  std::unique_lock<std::mutex> lk(r->mu);
+  int64_t want = r->next_consume;
+  int64_t slot = -1;
+  for (;;) {
+    bool pending = false;
+    for (size_t s = 0; s < r->slots.size(); ++s) {
+      if (r->slot_batch[s] == want) {
+        if (r->slot_status[s] == 2) { slot = (int64_t)s; break; }
+        if (r->slot_status[s] == -1) return -1;
+        pending = true;
+      }
+    }
+    if (slot >= 0) break;
+    if (!pending) {
+      bool queued = false;
+      for (int64_t b : r->fill_queue) if (b == want) { queued = true; break; }
+      if (!queued) return -2;  // nothing will ever produce it
+    }
+    r->cv_ready.wait(lk);
+  }
+  int64_t n = std::min(out_bytes, r->buffer_bytes);
+  std::memcpy(out, r->slots[slot].data(), n);
+  r->slot_status[slot] = 0;
+  r->slot_batch[slot] = -1;
+  r->next_consume = want + 1;
+  lk.unlock();
+  r->cv_work.notify_all();
+  return want;
+}
+
+void apex_prefetch_destroy(void* ring) {
+  auto* r = (PrefetchRing*)ring;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+  }
+  r->cv_work.notify_all();
+  r->cv_ready.notify_all();
+  for (auto& t : r->workers) t.join();
+  delete r;
+}
+
+}  // extern "C"
