@@ -13,10 +13,24 @@ from apex_tpu.amp.frontend import (
 from apex_tpu.amp.handle import AmpHandle
 from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
 from apex_tpu.amp import lists
+from apex_tpu.amp.amp import (
+    amp_call,
+    casting,
+    current_policy,
+    float_function,
+    half_function,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
 
 __all__ = [
     "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
     "AmpHandle", "LossScaler", "LossScaleState", "scaled_update", "lists",
+    "amp_call", "casting", "current_policy", "half_function",
+    "float_function", "promote_function", "register_half_function",
+    "register_float_function", "register_promote_function",
 ]
 
 

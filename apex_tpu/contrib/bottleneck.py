@@ -29,6 +29,11 @@ class SpatialBottleneck(nn.Module):
     The 3x3 conv needs one halo row from each neighbour; the exchange rides
     ICI via ppermute, then the conv runs on the padded slab and the halo
     rows are dropped again.
+
+    Downsampling always uses the v1 placement (stride on the first 1x1 —
+    the reference's spatial path forces ``stride_1x1`` too), so for parity
+    with a non-sharded model build its blocks with
+    ``Bottleneck(stride_1x1=True)``.
     """
 
     features: int
@@ -46,13 +51,18 @@ class SpatialBottleneck(nn.Module):
         bn = lambda: BatchNorm(sync=self.sync_bn, axis_name=self.bn_axis)  # noqa: E731
 
         residual = x
-        y = nn.relu(bn()(conv(self.features, (1, 1))(x), train))
+        # Downsampling stride lives on the first 1x1 (the reference's
+        # spatial path forces stride_1x1, bottleneck.py SpatialBottleneck):
+        # a strided per-shard 3x3 would break the residual-add shape and the
+        # global stride phase across H-shards.
+        y = nn.relu(bn()(conv(self.features, (1, 1), self.strides)(x),
+                         train))
         # 3x3 on the H-sharded slab: pad a 1-row halo, exchange, conv VALID
         pad = [(0, 0)] * y.ndim
         pad[1] = (1, 1)
         y_h = jnp.pad(y, pad)
         y_h = halo_exchange_1d(y_h, 1, self.axis_name, h_dim=1)
-        y = nn.Conv(self.features, (3, 3), strides=self.strides,
+        y = nn.Conv(self.features, (3, 3), strides=(1, 1),
                     use_bias=False, padding=((0, 0), (1, 1)),
                     dtype=x.dtype)(y_h)
         y = nn.relu(bn()(y, train))

@@ -62,6 +62,12 @@ def _bwd(res, g):
 
 softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
 
+# O1 boundary cast: cross-entropy is range-sensitive → forced fp32 under an
+# active O1 policy (lists.py FP32_OPS; ref functional_overrides FP32_FUNCS)
+from apex_tpu.amp.amp import float_function as _float_function  # noqa: E402
+
+softmax_cross_entropy_loss = _float_function(softmax_cross_entropy_loss)
+
 
 class SoftmaxCrossEntropyLoss:
     """Class-shaped entry (the reference exposes the autograd.Function

@@ -97,7 +97,10 @@ def all_reduce(x, op: ReduceOp = ReduceOp.SUM, group: Group = "dp"):
     if op == ReduceOp.MIN:
         return jax.lax.pmin(x, group)
     if op == ReduceOp.PRODUCT:
-        return jnp.exp(jax.lax.psum(jnp.log(x), group))
+        # exact and sign-correct for any dtype (exp(psum(log)) would NaN on
+        # negatives); PRODUCT is never bandwidth-critical, so the gather is
+        # fine
+        return jnp.prod(jax.lax.all_gather(x, group, axis=0), axis=0)
     raise ValueError(op)
 
 

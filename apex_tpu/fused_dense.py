@@ -57,6 +57,13 @@ def _fdgd_bwd(res, g):
 
 fused_dense_gelu_dense_function.defvjp(_fdgd_fwd, _fdgd_bwd)
 
+# O1 boundary casts: gemm(+gelu) chains are MXU work → compute dtype
+from apex_tpu.amp.amp import half_function as _half_function  # noqa: E402
+
+fused_dense_function = _half_function(fused_dense_function)
+dense_no_bias_function = _half_function(dense_no_bias_function)
+fused_dense_gelu_dense_function = _half_function(fused_dense_gelu_dense_function)
+
 
 class FusedDense:
     """apex-shaped module (ref fused_dense.py:66 FusedDense). Weights are

@@ -74,6 +74,13 @@ def _mlp_bwd(bias, activation, res, g):
 
 mlp_function.defvjp(_mlp_fwd, _mlp_bwd)
 
+# O1 boundary cast: the matmul chain is MXU work → compute dtype
+# (consumes amp/lists.py via amp_call's classification; ref apex registers
+# mlp through amp.half_function the same way)
+from apex_tpu.amp.amp import half_function as _half_function  # noqa: E402
+
+mlp_function = _half_function(mlp_function)
+
 
 class MLP:
     """apex-shaped MLP container (ref mlp.py:26).

@@ -22,20 +22,32 @@ from apex_tpu.models._common import BatchNorm
 
 class Bottleneck(nn.Module):
     """1x1 → 3x3 → 1x1 bottleneck (the reference's contrib/bottleneck fused
-    block is the CUDA fusion of exactly this; XLA fuses it on TPU)."""
+    block is the CUDA fusion of exactly this; XLA fuses it on TPU).
+
+    ``stride_1x1`` moves the downsampling stride from the 3x3 (ResNet
+    v1.5, the default here) onto the first 1x1 (v1 — ref
+    contrib/bottleneck/bottleneck.py ``stride_1x1``). The spatially-sharded
+    :class:`apex_tpu.contrib.bottleneck.SpatialBottleneck` always uses the
+    v1 placement (a strided per-shard 3x3 would break the halo phase), so
+    build the plain block with ``stride_1x1=True`` when parity with the
+    spatial variant matters.
+    """
     features: int
     strides: Tuple[int, int] = (1, 1)
     sync_bn: bool = False
     axis_name: Optional[str] = "data"
+    stride_1x1: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         bn = partial(BatchNorm, sync=self.sync_bn, axis_name=self.axis_name)
         conv = partial(nn.Conv, use_bias=False, dtype=x.dtype)
         residual = x
-        y = conv(self.features, (1, 1))(x)
+        s1 = self.strides if self.stride_1x1 else (1, 1)
+        s3 = (1, 1) if self.stride_1x1 else self.strides
+        y = conv(self.features, (1, 1), strides=s1)(x)
         y = nn.relu(bn()(y, train))
-        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = conv(self.features, (3, 3), strides=s3)(y)
         y = nn.relu(bn()(y, train))
         y = conv(self.features * 4, (1, 1))(y)
         y = bn()(y, train)

@@ -10,9 +10,13 @@ Design (TPU-first, not a CUDA port):
   materializes in HBM (the reference's kernels read a cu_seqlens array;
   fixed-shape batched input is the TPU-friendly layout).
 
-Backward runs the standard recompute-based VJP expressed in jnp (XLA fuses
-it well at these sizes); the Pallas forward is the memory win: no [sq, sk]
-attention matrix is ever written to HBM.
+Backward (FlashAttention-2 style, TPU-blocked): the forward additionally
+writes the per-row logsumexp; the backward recomputes p-blocks from (q, k,
+lse) in VMEM — dq accumulates over a k sweep, dk/dv accumulate over a q
+sweep (and, for GQA, over the query heads sharing each kv head) — so
+training, like inference, never materializes an [sq, sk] matrix in HBM
+(ref apex/contrib/fmha csrc dgrad kernels). Non-TPU backends fall back to
+the jnp reference VJP.
 """
 
 from __future__ import annotations
@@ -25,11 +29,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops import pallas_config
+
 _NEG_INF = -1e30
 
 
 def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
-                q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc):
+                q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -77,9 +83,10 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        o_ref[0] = (acc_sc[:] /
-                    jnp.maximum(l_sc[:, 0], 1e-30)[:, None]
-                    ).astype(o_ref.dtype)
+        l = jnp.maximum(l_sc[:, 0], 1e-30)
+        o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+        # exact per-row logsumexp — the backward's p-block recompute key
+        lse_ref[0] = (m_sc[:, 0] + jnp.log(l)).astype(jnp.float32)
 
 
 def _pick_block(s, target):
@@ -90,8 +97,9 @@ def _pick_block(s, target):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k"))
-def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
+                                             "block_k", "interpret"))
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                      interpret=False):
     """q [bh, sq, d], k/v [bh_kv, sk, d] → o [bh, sq, d].
 
     GQA: when bh_kv < bh, ``rep = bh // bh_kv`` query heads read the SAME
@@ -107,7 +115,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
 
     kernel = functools.partial(_fwd_kernel, causal, scale, bq, bk, sq, sk)
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -115,14 +123,22 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
+    return o, lse
 
 
 def _reference_attention(q, k, v, causal, scale):
@@ -142,23 +158,191 @@ def _reference_attention(q, k, v, causal, scale):
     return o.reshape(bh, sq, d).astype(q.dtype)
 
 
+# ------------------------------------------------------------ backward
+# FlashAttention-2-style blocked backward: p-blocks are recomputed in VMEM
+# from (q, k, lse); dq accumulates over the k sweep, dk/dv over the q sweep
+# (innermost, so scratch accumulation per kv block is contiguous) and, for
+# GQA, over the `rep` query heads sharing each kv head. No [sq, sk] array
+# ever exists in HBM (ref csrc/fmha dgrad kernels).
+
+
+def _bwd_dq_kernel(causal, scale, bq, bk,
+                   q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                   dq_ref, acc_sc):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    run = True
+    if causal:
+        run = (ki * bk) <= (qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0][:, None])
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - dl_ref[0][:, None]) * scale
+        acc_sc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc):
+    ki = pl.program_id(1)
+    r = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when((r == 0) & (qi == 0))
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        run = (qi * bq + bq - 1) >= (ki * bk)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0][:, None])
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        dv_sc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0][:, None]) * scale
+        dk_sc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+
+    @pl.when((r == rep - 1) & (qi == nq - 1))
+    def _finish():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                      interpret=False):
+    bh, sq, d = q.shape
+    bh_kv, sk, _ = k.shape
+    rep = bh // bh_kv
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    nq, nk = sq // bq, sk // bk
+
+    # D_i = rowsum(dO * O): elementwise, O(s·d) — fine as fused XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal, scale, bq, bk, rep, nq),
+        grid=(bh_kv, nk, rep, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
+            pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
+            pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    return pallas_config.use_pallas()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     if _use_pallas():
-        return _flash_fwd_pallas(q, k, v, causal, scale, 512, 512)
+        return _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+                                 pallas_config.interpret())[0]
     return _reference_attention(q, k, v, causal, scale)
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash(q, k, v, causal, scale), (q, k, v)
+    if _use_pallas():
+        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+                                   pallas_config.interpret())
+        return o, (q, k, v, o, lse)
+    return _reference_attention(q, k, v, causal, scale), (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if lse is not None:
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, 256, 256,
+                                 pallas_config.interpret())
     _, vjp = jax.vjp(
         lambda q, k, v: _reference_attention(q, k, v, causal, scale), q, k, v)
     return vjp(g)

@@ -23,11 +23,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops import pallas_config
+
 _BLOCK_ROWS = 256
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    return pallas_config.use_pallas()
 
 
 # ---------------------------------------------------------------- kernels
@@ -103,6 +105,7 @@ def _ln_fwd_pallas(x2, w, b, eps):
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
+        interpret=pallas_config.interpret(),
     )(*args)
     return y[:n], mu[:n], rstd[:n]
 
@@ -133,6 +136,7 @@ def _rms_fwd_pallas(x2, w, eps):
             jax.ShapeDtypeStruct((rows, h), x2.dtype),
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
+        interpret=pallas_config.interpret(),
     )(*args)
     return y[:n], rstd[:n]
 

@@ -65,8 +65,11 @@ def fused_lamb(
             by_dtype.setdefault(jnp.dtype(l.dtype).name, []).append(l)
         norms = [multi_tensor_l2norm(ls)[0] for ls in by_dtype.values()]
         gnorm = jnp.sqrt(sum(jnp.square(n) for n in norms))
+        # max_grad_norm <= 0 disables clipping (ref fused_lamb.py: the norm
+        # kernel only runs when defaults['max_grad_norm'] > 0)
         clip_coeff = jnp.where(
-            gnorm > max_grad_norm, max_grad_norm / jnp.maximum(gnorm, 1e-30), 1.0
+            (max_grad_norm > 0.0) & (gnorm > max_grad_norm),
+            max_grad_norm / jnp.maximum(gnorm, 1e-30), 1.0
         )
 
         def leaf(g, p, m, v):

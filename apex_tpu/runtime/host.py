@@ -27,13 +27,29 @@ _FILL_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p,
 def _load():
     global _LIB
     if _LIB is not None:
-        return _LIB
+        return _LIB or None  # False = cached failure -> numpy fallback
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     so = os.path.join(here, "csrc", "libapex_tpu_host.so")
     if not os.path.exists(so):
+        # the binary is not version-controlled (platform-specific); build it
+        # on first use when a toolchain is around, else numpy fallback
+        import subprocess
+        try:
+            subprocess.run(["make", "-C", os.path.dirname(so)],
+                           capture_output=True, timeout=120, check=True)
+        except Exception:
+            _LIB = False  # cache the failure: no make re-spawn per call
+            return None
+    if not os.path.exists(so):
+        _LIB = False
         return None
-    lib = ctypes.CDLL(so)
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        # .so present but not loadable on this OS/arch — use numpy fallback
+        _LIB = False
+        return None
     lib.apex_plan_buckets.restype = ctypes.c_int64
     lib.apex_plan_buckets.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,

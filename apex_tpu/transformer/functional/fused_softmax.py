@@ -26,13 +26,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops import pallas_config
 from apex_tpu.transformer.enums import AttnMaskType
 
 _MASK_FILL = -10000.0
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    return pallas_config.use_pallas()
 
 
 # ------------------------------------------------------------- jnp reference
@@ -104,6 +105,7 @@ def _pallas_causal(x, scale):
         grid=(b, sq // rows),
         in_specs=[pl.BlockSpec(blk, idx)],
         out_specs=pl.BlockSpec(blk, idx),
+        interpret=pallas_config.interpret(),
     )(x)
 
 
@@ -122,6 +124,7 @@ def _pallas_masked(x, mask, scale):
         grid=(x3.shape[0], sq // rows),
         in_specs=[pl.BlockSpec(blk, idx), pl.BlockSpec(blk, idx)],
         out_specs=pl.BlockSpec(blk, idx),
+        interpret=pallas_config.interpret(),
     )(x3, mask3)
     return out.reshape(lead + (sq, sk))
 

@@ -1,4 +1,5 @@
-"""Headline benchmark: FusedAdam step time vs "eager" per-tensor Adam.
+"""Headline benchmark: FusedAdam step time vs "eager" per-tensor Adam,
+plus model-level step benches (Llama train step MFU, ResNet-50 images/s).
 
 The reference's primary perf claim (BASELINE.json north star) is fused
 multi-tensor optimizer steps >=3x an eager per-tensor Adam loop (one kernel
@@ -7,28 +8,55 @@ On TPU the analog of the eager loop is one jit call PER TENSOR (dispatch
 bound, like torch eager); apex_tpu's fused_adam updates the whole tree in
 ONE jitted program.
 
+Robustness (round-2): the TPU backend behind the tunnel can fail or hang at
+init, which in round 1 meant zero perf evidence. This file is therefore a
+*launcher* that runs the actual benchmark in a subprocess with bounded
+retries + backoff, falling back to CPU (relative fused-vs-eager ratio is
+still meaningful there) and finally to an error JSON line that still parses.
+
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 vs_baseline > 1.0 means beating the reference's 3x target.
 """
 
 import gc
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from apex_tpu.optimizers import fused_adam
-
 TARGET_SPEEDUP = 3.0  # reference north star: fused >= 3x eager
 
+# bf16 peak FLOP/s per chip by device generation (public figures).
+_PEAK_FLOPS = (
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def make_params(key):
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+# worker side (actual benchmarks; runs in a subprocess)
+# ---------------------------------------------------------------------------
+
+def make_params(key, n_layers=24):
     """A GPT-2-345M-shaped tree: ~150 tensors, ~350M params total."""
+    import jax
+    import jax.numpy as jnp
     sizes = []
-    for _ in range(24):  # 24 layers x 6 tensors
+    for _ in range(n_layers):  # n_layers x 6 tensors
         sizes += [(1024, 3072), (3072,), (1024, 1024), (1024, 4096),
                   (4096, 1024), (1024,)]
     sizes += [(50304, 1024), (1024, 1024)]
@@ -40,6 +68,7 @@ def make_params(key):
 
 
 def time_fn(fn, *args, iters=20, warmup=3):
+    import jax
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)
@@ -52,6 +81,7 @@ def time_fn(fn, *args, iters=20, warmup=3):
 
 def time_chained(step, grads, state, params, iters=100):
     """Output-feeds-input timing: true serial device time per step."""
+    import jax
     p, s = step(grads, state, params)
     jax.block_until_ready(p)
     t0 = time.perf_counter()
@@ -61,15 +91,25 @@ def time_chained(step, grads, state, params, iters=100):
     return (time.perf_counter() - t0) / iters
 
 
-def main():
+def bench_fused_adam(cpu_mode):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.optimizers import fused_adam
+
+    n_layers = 6 if cpu_mode else 24
+    chained_iters = 5 if cpu_mode else 100
+    eager_iters = 2 if cpu_mode else 10
+
     key = jax.random.PRNGKey(0)
-    params = make_params(key)
-    grads = jax.tree_util.tree_map(
-        lambda p: jnp.full_like(p, 1e-3), params)
+    params = make_params(key, n_layers=n_layers)
+    grads = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 1e-3), params)
 
     # fused: whole tree in ONE jitted update over per-dtype flat buffers
-    # (the multi_tensor_apply design, SURVEY.md §2 #10)
-    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=True)
+    # (the multi_tensor_apply design, SURVEY.md §2 #10). On CPU the flat
+    # concatenation costs more than it saves (no dispatch overhead to win
+    # back), so the fallback benches the tree-fused single-jit path, which
+    # is the same one-dispatch structure.
+    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=not cpu_mode)
     state = tx.init(params)
 
     @jax.jit
@@ -77,7 +117,8 @@ def main():
         updates, state = tx.update(grads, state, params)
         return jax.tree_util.tree_map(jnp.add, params, updates), state
 
-    fused_t = time_chained(fused_step, grads, state, params, iters=100)
+    fused_t = time_chained(fused_step, grads, state, params,
+                           iters=chained_iters)
     del state
     gc.collect()
     print(f"fused: {fused_t * 1e3:.3f} ms/step", file=sys.stderr)
@@ -85,7 +126,6 @@ def main():
     # eager analog: one jitted dispatch per tensor (the reference's
     # unfused torch.optim.Adam loop shape)
     per_tensor_tx = fused_adam(lr=1e-3, weight_decay=0.01)
-
     single_states = {k: per_tensor_tx.init({"x": v})
                      for k, v in params.items()}
 
@@ -100,17 +140,221 @@ def main():
             out[k] = one_tensor(grads[k], single_states[k], p)
         return out
 
-    eager_t = time_fn(eager_step, iters=10)
+    eager_t = time_fn(eager_step, iters=eager_iters, warmup=1)
     print(f"eager: {eager_t * 1e3:.3f} ms/step", file=sys.stderr)
+    return eager_t / fused_t, fused_t
 
-    speedup = eager_t / fused_t
+
+def bench_llama(extras):
+    """Single-chip Llama train step (fwd+bwd+FusedAdam), ms/step + MFU."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+        dtype=jnp.bfloat16)
+    B, S = 4, 2048
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    tx = fused_adam(lr=1e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, batch, cfg, tp_axis=None, cp_axis=None)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    batch = (tokens, targets)
+    p, s, loss = train_step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        p, s, loss = train_step(p, s, batch)
+    jax.block_until_ready(loss)
+    step_t = (time.perf_counter() - t0) / iters
+
+    # fwd+bwd FLOPs/token ~ 6N + 12*L*h*S (PaLM appendix accounting)
+    flops = B * S * (6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    extras["llama_0p9b_step_ms"] = round(step_t * 1e3, 2)
+    extras["llama_tokens_per_sec"] = round(B * S / step_t)
+    extras["llama_tflops_per_sec"] = round(flops / step_t / 1e12, 1)
+    if peak:
+        extras["llama_mfu"] = round(flops / step_t / peak, 3)
+    extras["device_kind"] = kind
+    print(f"llama: {step_t*1e3:.1f} ms/step  "
+          f"{flops/step_t/1e12:.1f} TF/s on {kind}", file=sys.stderr)
+
+
+def bench_resnet(extras):
+    """ResNet-50 bf16 train step (fwd+bwd+momentum SGD), images/s."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from apex_tpu.models import resnet
+
+    model = resnet.resnet50(sync_bn=False, axis_name=None)
+    B = 64
+    x = jnp.ones((B, 224, 224, 3), jnp.bfloat16)
+    labels = jnp.zeros((B,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, labels):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+            return loss, mut["batch_stats"]
+
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), bs, opt_state, loss
+
+    p, bs, s, loss = train_step(params, batch_stats, opt_state, x, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        p, bs, s, loss = train_step(p, bs, s, x, labels)
+    jax.block_until_ready(loss)
+    step_t = (time.perf_counter() - t0) / iters
+    extras["resnet50_step_ms"] = round(step_t * 1e3, 2)
+    extras["resnet50_images_per_sec"] = round(B / step_t)
+    print(f"resnet50: {step_t*1e3:.1f} ms/step  {B/step_t:.0f} im/s",
+          file=sys.stderr)
+
+
+def worker():
+    cpu_mode = os.environ.get("BENCH_FORCE_CPU") == "1"
+
+    # TPU backend init over the tunnel can hang indefinitely (round-1
+    # failure mode); fail fast so the launcher's retry loop gets a chance.
+    import threading
+    ready = threading.Event()
+
+    def watchdog():
+        if not ready.wait(180):
+            print("backend init watchdog fired (180s); aborting attempt",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    import jax
+    if cpu_mode:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    ready.set()
+    if not cpu_mode and platform != "tpu":
+        # JAX fell back to CPU silently: bail out fast so the launcher's
+        # CPU fallback runs the correctly-sized workload instead of the
+        # full TPU workload timing out here
+        print(f"expected tpu, got {platform}; aborting attempt",
+              file=sys.stderr)
+        sys.exit(3)
+    print(f"platform: {platform} x{jax.device_count()} "
+          f"({jax.devices()[0].device_kind})", file=sys.stderr)
+
+    speedup, fused_ms = bench_fused_adam(cpu_mode)
+    extras = {"platform": platform,
+              "fused_adam_step_ms": round(fused_ms * 1e3, 3)}
+    if not cpu_mode:
+        # model-level benches are secondary evidence: never let them kill
+        # the headline number
+        for fn in (bench_llama, bench_resnet):
+            try:
+                fn(extras)
+            except Exception as e:  # noqa: BLE001
+                print(f"{fn.__name__} failed: {e!r}", file=sys.stderr)
+                extras[fn.__name__ + "_error"] = repr(e)[:200]
+
     print(json.dumps({
         "metric": "fused_adam_speedup_vs_eager",
         "value": round(speedup, 2),
         "unit": "x",
         "vs_baseline": round(speedup / TARGET_SPEEDUP, 2),
+        **extras,
     }))
 
 
+# ---------------------------------------------------------------------------
+# launcher side
+# ---------------------------------------------------------------------------
+
+def _run_worker(env, timeout):
+    """Run one worker attempt; return the parsed JSON line or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        print(f"bench worker timed out after {timeout}s", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        print(f"bench worker rc={proc.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return line
+    print("bench worker produced no JSON line", file=sys.stderr)
+    return None
+
+
+def launcher():
+    env = dict(os.environ)
+    env.pop("BENCH_FORCE_CPU", None)
+    delays = [10, 30]
+    for attempt in range(len(delays) + 1):
+        line = _run_worker(env, timeout=900)
+        if line is not None:
+            print(line)
+            return 0
+        if attempt < len(delays):
+            print(f"retrying in {delays[attempt]}s...", file=sys.stderr)
+            time.sleep(delays[attempt])
+
+    print("TPU attempts exhausted; falling back to CPU", file=sys.stderr)
+    env["BENCH_FORCE_CPU"] = "1"
+    line = _run_worker(env, timeout=900)
+    if line is not None:
+        print(line)
+        return 0
+
+    print(json.dumps({
+        "metric": "fused_adam_speedup_vs_eager",
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+        "error": "TPU init failed after retries; CPU fallback also failed",
+    }))
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        sys.exit(launcher())

@@ -1,0 +1,52 @@
+"""Shared example plumbing: device-mesh forcing + synthetic data.
+
+Examples default to whatever devices exist; ``ensure_devices(n)`` forces an
+``n``-device virtual CPU platform when fewer real chips are available (the
+container's sitecustomize imports jax before env vars apply, so this goes
+through jax.config — same dance as tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def ensure_devices(n: int) -> None:
+    # Probing jax.devices() first would initialize (and possibly hang on)
+    # the default accelerator backend, so the examples force the virtual CPU
+    # platform up front. Set APEX_TPU_EXAMPLES_REAL=1 to run on whatever
+    # real devices exist instead.
+    if os.environ.get("APEX_TPU_EXAMPLES_REAL") == "1":
+        assert len(jax.devices()) >= n, (
+            f"need {n} devices, have {len(jax.devices())}")
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    for key, val in (("jax_platforms", "cpu"), ("jax_num_cpu_devices", n)):
+        try:
+            jax.config.update(key, val)
+        except Exception:
+            pass
+    if len(jax.devices()) < n or jax.devices()[0].platform != "cpu":
+        from jax.extend import backend as _backend
+
+        _backend.clear_backends()
+    assert len(jax.devices()) >= n, (
+        f"need {n} devices, have {len(jax.devices())}")
+
+
+def synthetic_images(key, batch: int, size: int, classes: int):
+    """One synthetic (images, labels) batch — stands in for the imagenet
+    loader (ref examples/imagenet/main_amp.py uses real ImageFolder; the
+    example trains on fixed random data so it runs anywhere)."""
+    import jax.numpy as jnp
+
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (batch, size, size, 3), jnp.float32)
+    y = jax.random.randint(ky, (batch,), 0, classes)
+    return x, y

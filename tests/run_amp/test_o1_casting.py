@@ -1,0 +1,136 @@
+"""O1 boundary casting: lists.py classification actually drives dtypes
+(VERDICT missing #4 — the amp/lists tables must have a working consumer).
+
+Ref behavioral model: apex/amp/amp.py half/float/promote functions +
+apex/tests/L0/run_amp test_basic_casts.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import apex_tpu.amp as amp
+from apex_tpu.amp._amp_state import _amp_state
+from apex_tpu.amp.amp import amp_call
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.fused_dense import fused_dense_function
+from apex_tpu.mlp import MLP
+
+
+@pytest.fixture
+def o1_policy():
+    return amp.Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                      output_dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_amp_state():
+    yield
+    _amp_state.handle = None
+    _amp_state.opt_properties = None
+
+
+def test_no_policy_is_identity():
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 8), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    assert amp.current_policy() is None
+    assert fused_dense_function(x, w, b).dtype == jnp.float32
+
+
+def test_compute_ops_run_bf16_under_o1(o1_policy):
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 8), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    with amp.casting(o1_policy):
+        out = fused_dense_function(x, w, b)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_mlp_runs_bf16_under_o1(o1_policy):
+    mlp = MLP([16, 16, 8])
+    x = jnp.ones((4, 16), jnp.float32)
+    assert mlp(x).dtype == jnp.float32
+    with amp.casting(o1_policy):
+        assert mlp(x).dtype == jnp.bfloat16
+
+
+def test_fp32_ops_stay_fp32_under_o1(o1_policy):
+    logits = jnp.ones((6, 32), jnp.bfloat16)
+    labels = jnp.zeros((6,), jnp.int32)
+    with amp.casting(o1_policy):
+        loss = softmax_cross_entropy_loss(logits, labels)
+    assert loss.dtype == jnp.float32
+
+
+def test_promote_widens(o1_policy):
+    a = jnp.ones((4,), jnp.bfloat16)
+    b = jnp.ones((4,), jnp.float32)
+    with amp.casting(o1_policy):
+        out = amp_call("add", jnp.add, a, b)
+        assert out.dtype == jnp.float32
+        # both-narrow stays narrow
+        out = amp_call("add", jnp.add, a, a)
+        assert out.dtype == jnp.bfloat16
+
+
+def test_integer_args_untouched(o1_policy):
+    x = jnp.ones((4, 8), jnp.float32)
+    idx = jnp.zeros((4,), jnp.int32)
+    with amp.casting(o1_policy):
+        out = amp_call("dense", lambda x, i: (x, i), x, idx)
+    assert out[0].dtype == jnp.bfloat16
+    assert out[1].dtype == jnp.int32
+
+
+def test_initialize_o1_activates_boundary_casting():
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    cast, handle = amp.initialize(params, opt_level="O1", verbosity=0)
+    # O1 keeps model weights fp32 (ref frontend.py O1 properties)...
+    assert cast["w"].dtype == jnp.float32
+    # ...but library ops now run in compute dtype
+    x = jnp.ones((4, 8), jnp.float32)
+    out = fused_dense_function(x, cast["w"], jnp.zeros((8,)))
+    assert out.dtype == jnp.bfloat16
+    # O1 casting also flows through jit + grad
+    g = jax.grad(lambda x: fused_dense_function(
+        x, cast["w"], jnp.zeros((8,))).astype(jnp.float32).sum())(x)
+    assert g.dtype == jnp.float32
+
+
+def test_initialize_o0_is_off():
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    cast, handle = amp.initialize(params, opt_level="O0", verbosity=0)
+    x = jnp.ones((4, 8), jnp.float32)
+    assert fused_dense_function(
+        x, cast["w"], jnp.zeros((8,))).dtype == jnp.float32
+
+
+def test_register_functions(o1_policy):
+    import types
+
+    mod = types.SimpleNamespace(f=lambda x: x, g=lambda x: x)
+    amp.register_half_function(mod, "f")
+    amp.register_float_function(mod, "g")
+    x32 = jnp.ones((4,), jnp.float32)
+    x16 = jnp.ones((4,), jnp.bfloat16)
+    with amp.casting(o1_policy):
+        assert mod.f(x32).dtype == jnp.bfloat16
+        assert mod.g(x16).dtype == jnp.float32
+    # registration is idempotent
+    amp.register_half_function(mod, "f")
+    assert mod.f(x32).dtype == jnp.float32  # no policy → identity
+
+
+def test_grad_through_o1_mlp(o1_policy):
+    """Autodiff composes with boundary casts: grads exist and are finite."""
+    mlp = MLP([8, 8, 4])
+    x = jnp.ones((2, 8), jnp.float32)
+
+    def loss(params, x):
+        return jnp.sum(mlp(x, params).astype(jnp.float32) ** 2)
+
+    with amp.casting(o1_policy):
+        grads = jax.grad(loss)(mlp.params, x)
+    for g in jax.tree_util.tree_leaves(grads):
+        assert bool(jnp.all(jnp.isfinite(g)))

@@ -1,0 +1,47 @@
+"""Every example must run to completion on the virtual CPU mesh
+(SURVEY §2 #51; ref ships examples/imagenet, examples/simple/distributed,
+examples/dcgan as its primary user-facing surface)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # examples must self-force the CPU mesh
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_simple_distributed():
+    out = _run("simple_distributed.py")
+    assert "DDP grad == global-batch grad: OK" in out
+    assert "converged: OK" in out
+
+
+@pytest.mark.slow
+def test_imagenet_resnet50():
+    out = _run("imagenet_resnet50.py", "--steps", "8")
+    assert "(decreased)" in out
+
+
+@pytest.mark.slow
+def test_llama_train():
+    out = _run("llama_train.py", "--steps", "4")
+    assert "(decreased)" in out
+
+
+@pytest.mark.slow
+def test_dcgan():
+    out = _run("dcgan.py", "--steps", "4")
+    assert "ran to completion: OK" in out

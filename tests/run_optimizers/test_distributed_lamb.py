@@ -1,0 +1,134 @@
+"""DistributedFusedLAMB (ZeRO LAMB) parity vs unsharded FusedLAMB on the
+dp mesh (VERDICT next-round #7; ref apex/contrib/optimizers/
+distributed_fused_lamb.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from apex_tpu.contrib.optimizers import distributed_fused_lamb
+from apex_tpu.optimizers import fused_lamb
+
+
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+def _params():
+    # deliberately awkward sizes: padding + tensors straddling shard
+    # boundaries exercise the segment-sum norm path
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 3)
+    return {
+        "w": jax.random.normal(ks[0], (37, 5)),
+        "b": jax.random.normal(ks[1], (11,)) * 0.1,
+        "v": jax.random.normal(ks[2], (3,)),
+    }
+
+
+def _grads():
+    k = jax.random.PRNGKey(1)
+    ks = jax.random.split(k, 3)
+    return {
+        "w": jax.random.normal(ks[0], (37, 5)) * 0.3,
+        "b": jax.random.normal(ks[1], (11,)),
+        "v": jax.random.normal(ks[2], (3,)) * 2.0,
+    }
+
+
+def test_matches_unsharded_lamb_one_step():
+    mesh = mesh8()
+    params, grads = _params(), _grads()
+    kw = dict(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    tx = distributed_fused_lamb(axis_name="dp", **kw)
+
+    def run(params, grads):
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+
+    got = shard_map(run, mesh=mesh, in_specs=(P(), P()),
+                    out_specs=P())(params, grads)
+
+    ref_tx = fused_lamb(**kw)
+    st = ref_tx.init(params)
+    want, _ = ref_tx.update(grads, st, params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_matches_unsharded_lamb_trajectory():
+    """Three steps with different grads: moments and bias correction stay
+    in sync with the unsharded optimizer."""
+    mesh = mesh8()
+    params = _params()
+    kw = dict(lr=5e-3, weight_decay=0.1, max_grad_norm=0.5,
+              use_nvlamb=True)
+    tx = distributed_fused_lamb(axis_name="dp", **kw)
+    ref_tx = fused_lamb(**kw)
+
+    def run(params, g1, g2, g3):
+        state = tx.init(params)
+        p = params
+        for g in (g1, g2, g3):
+            updates, state = tx.update(g, state, p)
+            p = jax.tree_util.tree_map(jnp.add, p, updates)
+        return p
+
+    gs = [jax.tree_util.tree_map(
+        lambda a, i=i: a * (0.5 + i), _grads()) for i in range(3)]
+    got = jax.jit(shard_map(run, mesh=mesh, in_specs=(P(),) * 4,
+                            out_specs=P()))(params, *gs)
+
+    st = ref_tx.init(params)
+    p = params
+    for g in gs:
+        updates, st = ref_tx.update(g, st, p)
+        p = jax.tree_util.tree_map(jnp.add, p, updates)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(p[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_state_is_sharded():
+    """ZeRO point: each rank's master/m/v shard is 1/8 of the padded flat
+    size."""
+    mesh = mesh8()
+    params = _params()
+    total = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    tx = distributed_fused_lamb(axis_name="dp")
+
+    def run(params):
+        state = tx.init(params)
+        return state.master_shard["float32"].size
+
+    out = shard_map(
+        lambda p: jnp.asarray(run(p)), mesh=mesh, in_specs=(P(),),
+        out_specs=P())(params)
+    padded = total + (-total) % 8
+    assert int(out) == padded // 8
+
+
+def test_contrib_optimizer_imports():
+    """Import-surface parity (ref apex/contrib/optimizers/*)."""
+    from apex_tpu.contrib.optimizers import (  # noqa: F401
+        FP16_Optimizer,
+        DistributedFusedAdam,
+        DistributedFusedLAMB,
+    )
+    from apex_tpu.contrib.optimizers.distributed_fused_adam_v2 import (  # noqa: F401
+        DistributedFusedAdamV2,
+    )
+    from apex_tpu.contrib.optimizers.distributed_fused_adam_v3 import (  # noqa: F401
+        DistributedFusedAdamV3,
+    )
+    from apex_tpu.contrib.optimizers.fused_adam import FusedAdam  # noqa: F401
+    from apex_tpu.contrib.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+    from apex_tpu.contrib.optimizers.fused_sgd import FusedSGD  # noqa: F401

@@ -1,0 +1,278 @@
+"""Interpret-mode execution of every Pallas kernel body (VERDICT weak #2).
+
+The CI mesh is CPU, so the compiled-Pallas path never runs here; these tests
+force ``pallas_config.force('interpret')`` so the actual kernel bodies
+(online-softmax flash attention, single-pass LN/RMS, causal/masked softmax)
+execute through the Pallas interpreter and are checked for parity against
+the jnp fallbacks (ref test model: tests/L0/run_fused_layer_norm in the
+reference).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.ops.flash_attention import (
+    _flash_fwd_pallas,
+    _reference_attention,
+    flash_attention,
+)
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+from apex_tpu.transformer.functional.fused_softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+def _tol(dtype):
+    return 2e-2 if dtype == jnp.bfloat16 else 2e-6
+
+
+# --------------------------------------------------------------- layer norm
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("rows", [48, 256, 300])  # 300 exercises row padding
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_interpret(dtype, rows, affine):
+    h = 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, h), dtype)
+    w = b = None
+    if affine:
+        w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (h,), dtype)
+        b = 0.1 * jax.random.normal(jax.random.PRNGKey(2), (h,), dtype)
+    ref = layer_norm(x, w, b, h)
+    with pallas_config.force("interpret"):
+        out = layer_norm(x, w, b, h)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=_tol(dtype), rtol=_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("affine", [True, False])
+def test_rms_norm_interpret(dtype, affine):
+    rows, h = 96, 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, h), dtype)
+    w = None
+    if affine:
+        w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (h,), dtype)
+    ref = rms_norm(x, w, h)
+    with pallas_config.force("interpret"):
+        out = rms_norm(x, w, h)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=_tol(dtype), rtol=_tol(dtype))
+
+
+def test_layer_norm_interpret_grads():
+    """The Pallas fwd saves (mu, rstd) for the shared bwd — check the full
+    custom_vjp chain matches autodiff through the jnp path."""
+    h = 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, h), jnp.float32)
+    w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (h,))
+    b = jnp.zeros((h,))
+
+    def f(x, w, b):
+        return jnp.sum(jnp.sin(layer_norm(x, w, b, h)))
+
+    ref = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+    with pallas_config.force("interpret"):
+        out = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=1e-5)
+
+
+# ---------------------------------------------------------- flash attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h_kv", [4, 2, 1])  # MHA, GQA, MQA
+def test_flash_attention_interpret(causal, h_kv):
+    b, s, h, d = 2, 64, 4, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h_kv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h_kv, d), jnp.float32)
+    ref = flash_attention(q, k, v, causal=causal)
+    with pallas_config.force("interpret"):
+        out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_interpret_multiblock(causal):
+    """Small blocks force a real k-sweep (online-softmax carry across k
+    blocks) and a multi-row q grid, plus GQA block indexing."""
+    bh, bh_kv, s, d = 4, 2, 128, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (bh, s, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (bh_kv, s, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (bh_kv, s, d), jnp.float32)
+    ref = _reference_attention(q, k, v, causal, 0.25)
+    out, lse = _flash_fwd_pallas(q, k, v, causal, 0.25, 32, 32,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # lse parity vs explicit logsumexp
+    s = 0.25 * np.einsum("bqd,bkd->bqk",
+                         np.asarray(q), np.asarray(k).repeat(2, 0))
+    if causal:
+        qpos = np.arange(s.shape[1])[:, None]
+        kpos = np.arange(s.shape[2])[None, :]
+        s = np.where(kpos <= qpos, s, -1e30)
+    ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+    np.testing.assert_allclose(np.asarray(lse), ref_lse, atol=1e-4)
+
+
+def test_flash_attention_interpret_ragged():
+    """sq != sk and sizes that don't hit the preferred block."""
+    bh, sq, sk, d = 2, 48, 80, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (bh, sq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (bh, sk, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (bh, sk, d), jnp.float32)
+    ref = _reference_attention(q, k, v, False, 0.125)
+    out, _ = _flash_fwd_pallas(q, k, v, False, 0.125, 32, 32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ------------------------------------------------- flash attention backward
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h_kv", [4, 2, 1])  # MHA, GQA, MQA
+def test_flash_attention_bwd_interpret(causal, h_kv):
+    """Pallas dq/dk/dv kernels vs autodiff through the jnp reference."""
+    b, s, h, d = 2, 64, 4, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h_kv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h_kv, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=causal)
+                               .astype(jnp.float32)))
+
+    ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    with pallas_config.force("interpret"):
+        out = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for name, o, r in zip("q k v".split(), out, ref):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_attention_bwd_interpret_multiblock():
+    """Small blocks: dq k-sweep and dk/dv q-sweep accumulate across a real
+    grid; GQA rep accumulation across shared query heads."""
+    from apex_tpu.ops.flash_attention import _flash_bwd_pallas
+
+    bh, bh_kv, s, d = 4, 2, 96, 16
+    ks = [jax.random.normal(jax.random.PRNGKey(i), (bh, s, d)) for i in
+          range(2)]
+    q, do = ks
+    k = jax.random.normal(jax.random.PRNGKey(2), (bh_kv, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(3), (bh_kv, s, d))
+
+    o, vjp = jax.vjp(
+        lambda q, k, v: _reference_attention(q, k, v, True, 0.25), q, k, v)
+    ref = vjp(do)
+    _, lse = _flash_fwd_pallas(q, k, v, True, 0.25, 32, 32, interpret=True)
+    out = _flash_bwd_pallas(q, k, v, o, lse, do, True, 0.25, 32, 32,
+                            interpret=True)
+    for name, got, want in zip("q k v".split(), out, ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, err_msg=f"d{name}")
+
+
+def test_flash_attention_bwd_no_full_matrix():
+    """The grad jaxpr must contain no [sq, sk] intermediate — the memory
+    claim the docstring makes (VERDICT weak #5)."""
+    bh, s, d = 2, 160, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (bh, s, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (bh, s, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (bh, s, d), jnp.float32)
+
+    from apex_tpu.ops.flash_attention import _flash
+
+    def loss(q, k, v):
+        return jnp.sum(_flash(q, k, v, True, 0.25))
+
+    with pallas_config.force("interpret"):
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    bad = []
+
+    def walk(jxp):
+        for eqn in jxp.eqns:
+            if "pallas" in eqn.primitive.name:
+                continue  # kernel-internal VMEM blocks are the point
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                if len(shape) >= 2 and shape[-2:] == (s, s):
+                    bad.append((eqn.primitive.name, shape))
+            for param in eqn.params.values():
+                if hasattr(param, "jaxpr"):
+                    walk(param.jaxpr)
+                elif hasattr(param, "eqns"):
+                    walk(param)
+
+    walk(jaxpr.jaxpr)
+    assert not bad, f"full [sq, sk] intermediates in grad jaxpr: {bad}"
+
+
+def test_flash_attention_interpret_bf16():
+    b, s, h, d = 1, 64, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.bfloat16)
+    ref = flash_attention(q, k, v, causal=True)
+    with pallas_config.force("interpret"):
+        out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+# ------------------------------------------------------------ fused softmax
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_causal_softmax_interpret(dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 48), dtype)
+    ref = scaled_upper_triang_masked_softmax(x, None, 0.5)
+    with pallas_config.force("interpret"):
+        out = scaled_upper_triang_masked_softmax(x, None, 0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=_tol(dtype))
+
+
+def test_causal_softmax_interpret_rect():
+    """sk > sq (cached/inference layout): triangle offset path."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 64), jnp.float32)
+    ref = scaled_upper_triang_masked_softmax(x, None, 1.3)
+    with pallas_config.force("interpret"):
+        out = scaled_upper_triang_masked_softmax(x, None, 1.3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_masked_softmax_interpret(dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 16, 48), dtype)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 16, 48))
+    ref = scaled_masked_softmax(x, mask, 0.7)
+    with pallas_config.force("interpret"):
+        out = scaled_masked_softmax(x, mask, 0.7)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=_tol(dtype))
+
+
+def test_softmax_interpret_grads():
+    """custom_vjp bwd consumes the Pallas fwd's saved y."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 24, 24), jnp.float32)
+
+    def f(x):
+        return jnp.sum(scaled_upper_triang_masked_softmax(x, None, 0.9) ** 2)
+
+    ref = jax.grad(f)(x)
+    with pallas_config.force("interpret"):
+        out = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
