@@ -4,11 +4,14 @@ Ref: apex/parallel/{sync_batchnorm,optimized_sync_batchnorm}.py +
 csrc/{syncbn.cpp,welford.cu}.
 
 The reference's optimized path fuses a per-GPU Welford reduction with an
-NCCL allreduce of (mean, var, count). The TPU formulation reduces local
-(sum, sum-of-squares, count) with a single fused ``psum`` over the data
-axis inside the jitted step — numerically the same pooled statistics, one
-collective, no separate kernel needed. Running stats use the unbiased
-variance exactly as the reference does (sync_batchnorm.py:87).
+NCCL allreduce of (mean, var, count) — ``welford.cu`` exists precisely
+because E[x²]−E[x]² cancels catastrophically for large-mean activations.
+The TPU formulation keeps that numerics guarantee: each replica computes
+its local (count, mean, M2 = Σ(x−mean)²), and the replicas merge with
+Chan's parallel update expressed over two ``psum``s —
+``M = Σnᵢmᵢ/N`` then ``M2 = Σ(M2ᵢ + nᵢ(mᵢ−M)²)`` — never forming a
+sum-of-squares. Running stats use the unbiased variance exactly as the
+reference does (sync_batchnorm.py:87).
 """
 
 from __future__ import annotations
@@ -55,31 +58,39 @@ class SyncBatchNorm(nn.Module):
         ra_var = self.variable("batch_stats", "var",
                                lambda: jnp.ones((c,), jnp.float32))
 
+        stat_shape = [1] * x.ndim
+        stat_shape[ch_axis] = c
+
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
             x32 = x.astype(jnp.float32)
-            local_sum = jnp.sum(x32, axis=reduce_axes)
-            local_sqsum = jnp.sum(jnp.square(x32), axis=reduce_axes)
-            local_count = jnp.asarray(
-                x.size / c, jnp.float32)
+            local_count = jnp.asarray(x.size / c, jnp.float32)
+            local_mean = jnp.mean(x32, axis=reduce_axes)
+            # Welford M2: centered sum of squares — no E[x²]−E[x]²
+            # cancellation (ref csrc/welford.cu)
+            local_m2 = jnp.sum(
+                jnp.square(x32 - local_mean.reshape(stat_shape)),
+                axis=reduce_axes)
             try:
-                total_sum = jax.lax.psum(local_sum, axis_name)
-                total_sqsum = jax.lax.psum(local_sqsum, axis_name)
                 total_count = jax.lax.psum(local_count, axis_name)
+                mean = jax.lax.psum(local_count * local_mean,
+                                    axis_name) / total_count
+                # Chan's parallel merge of per-replica (mean, M2, count)
+                m2 = jax.lax.psum(
+                    local_m2
+                    + local_count * jnp.square(local_mean - mean),
+                    axis_name)
             except NameError:
                 # outside pmap/shard_map: plain (single-replica) batch norm
-                total_sum, total_sqsum, total_count = (
-                    local_sum, local_sqsum, local_count)
-            mean = total_sum / total_count
-            var = total_sqsum / total_count - jnp.square(mean)
+                total_count, mean, m2 = local_count, local_mean, local_m2
+            var = m2 / total_count
             if self.track_running_stats and not self.is_initializing():
                 unbiased = var * total_count / jnp.maximum(total_count - 1.0, 1.0)
                 ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
                 ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
 
-        shape = [1] * x.ndim
-        shape[ch_axis] = c
+        shape = stat_shape
         y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
             var.reshape(shape) + self.eps)
         if self.affine:

@@ -1,0 +1,51 @@
+"""Gradient scaler with model-parallel inf check
+(ref apex/transformer/amp/grad_scaler.py GradScaler).
+
+The reference subclasses ``torch.cuda.amp.GradScaler`` and all-reduces
+``found_inf`` (MAX) over the model-parallel group before deciding to step
+or back off — a rank seeing a local overflow must make EVERY tp/pp rank
+skip, or the replicas diverge. The TPU form subclasses the in-graph
+:class:`apex_tpu.amp.LossScaler`: :meth:`unscale` ORs the overflow flag
+across the model-parallel mesh axes with ``pmax`` inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+
+
+class GradScaler(LossScaler):
+    """ref grad_scaler.py:21. ``model_parallel_axes`` are the mesh axes the
+    overflow decision must agree across (tp and pp by default); axes not
+    bound in the current shard_map are skipped, so the same scaler works
+    under any mesh subset."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True,
+                 model_parallel_axes: Sequence[str] = ("tp", "pp")):
+        super().__init__(
+            loss_scale="dynamic", init_scale=init_scale,
+            scale_factor=growth_factor, scale_window=growth_interval,
+            enabled=enabled)
+        if backoff_factor != 1.0 / growth_factor:
+            # LossScaler uses one symmetric factor (apex default semantics:
+            # backoff = 1/growth); asymmetric factors are not represented
+            self.backoff_factor = backoff_factor
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def unscale(self, grads, state):
+        unscaled, overflow = super().unscale(grads, state)
+        # sync the decision across model-parallel ranks (ref
+        # _maybe_opt_step's MAX allreduce over get_model_parallel_group())
+        flag = overflow.astype(jnp.int32)
+        for axis in self.model_parallel_axes:
+            try:
+                flag = jax.lax.pmax(flag, axis)
+            except NameError:
+                continue  # axis not bound here
+        return unscaled, flag > 0

@@ -74,8 +74,13 @@ def forward_backward_no_pipelining(
         grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
         return (loss_acc + loss, grad_acc), None
 
+    # accumulator avals must match the GRAD avals, not the param avals:
+    # with grad-accumulation fusion the wgrads are fp32 over bf16-computed
+    # layers, and the fp32 carry is where the fusion's accumulation lives
+    first_mb = jax.tree_util.tree_map(lambda a: a[0], microbatches)
+    grad_shapes = jax.eval_shape(lambda p, mb: vg(p, mb)[1], params, first_mb)
     zero_grads = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), grad_shapes
     )
     (loss_sum, grad_sum), _ = jax.lax.scan(body, (0.0, zero_grads), microbatches)
     scale = 1.0 / m_count if grad_scale is None else grad_scale / m_count
@@ -172,23 +177,25 @@ def forward_backward_pipelining_without_interleaving(
     return jax.value_and_grad(total_loss)(stage_params)
 
 
-def pipelined_forward_interleaved(
+def interleaved_num_steps(m_count: int, p: int, v: int) -> int:
+    """Scan length of the interleaved schedule: fill once, then stream all
+    V·M chunk-computations — vs ``v * (m_count + p - 1)`` for V chained
+    GPipe passes. The saving, ``(v-1)·(p-1)`` steps, is the interleaving
+    bubble reduction (ref fwd_bwd_pipelining_with_interleaving.py's point:
+    bubble ∝ (p-1)/v because each virtual stage is 1/v of the model)."""
+    return v * m_count + p - 1
+
+
+def pipelined_forward_chained(
     stage_fn: Callable,
     stage_params_chunks,
     inputs,
     axis_name: Optional[str] = None,
     remat: bool = True,
 ):
-    """Virtual-pipeline forward (ref fwd_bwd_pipelining_with_interleaving.py).
-
-    ``stage_params_chunks`` carries a leading virtual-chunk dim V: device r
-    owns chunks (r, r+P, ..., r+(V-1)·P) of a V·P-stage model, matching the
-    reference's model-chunk assignment. Chunks run as V chained collective
-    passes with a cyclic last→first ppermute between them. The *model
-    semantics* (V·P stages on P devices) match the reference; the schedule
-    is chained-GPipe rather than interleaved 1F1B — XLA still overlaps each
-    pass's collectives with compute.
-    """
+    """V chained GPipe passes with a cyclic last→first ppermute between
+    chunks — the fallback when M is not a multiple of P (the true
+    interleaved order needs whole microbatch groups of size P)."""
     axis = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
     v_size = jax.tree_util.tree_leaves(stage_params_chunks)[0].shape[0]
     outs = inputs
@@ -203,6 +210,81 @@ def pipelined_forward_interleaved(
     return outs
 
 
+def pipelined_forward_interleaved(
+    stage_fn: Callable,
+    stage_params_chunks,
+    inputs,
+    axis_name: Optional[str] = None,
+    remat: bool = True,
+):
+    """Interleaved virtual-pipeline forward
+    (ref fwd_bwd_pipelining_with_interleaving.py:26).
+
+    ``stage_params_chunks`` carries a leading virtual-chunk dim V: device r
+    owns virtual stages (r, r+P, ..., r+(V-1)·P) of a V·P-stage model —
+    the reference's model-chunk assignment.
+
+    Collective re-design of the interleaved 1F1B order: one ``lax.scan`` of
+    ``V·M + P − 1`` steps (vs ``V·(M + P − 1)`` for chained GPipe). Device
+    ``r`` at local step ``u = t − r`` runs unit ``(chunk c, microbatch m)``
+    with ``g = u // (V·P)``, ``c = (u // P) % V``, ``i = u % P``,
+    ``m = g·P + i`` — microbatches in groups of P, cycling chunks per group,
+    exactly Megatron's interleaved order. Under this ordering EVERY
+    dependency (same-chunk previous stage, and the last→first chunk
+    handoff) is "my ring-neighbour produced it one step ago", so stage
+    transfer is a single cyclic ppermute per step and the reference's
+    hand-scheduled warmup/steady/cooldown phases collapse into index
+    arithmetic. The backward (reverse ring, per-chunk wgrad scatter-add)
+    falls out of AD. Requires ``M % P == 0`` (whole microbatch groups —
+    the reference asserts the same); other sizes fall back to
+    :func:`pipelined_forward_chained`.
+    """
+    axis = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+    p = jax.lax.axis_size(axis)
+    m_count = inputs.shape[0]
+    if m_count % p:
+        return pipelined_forward_chained(
+            stage_fn, stage_params_chunks, inputs, axis, remat)
+    rank = jax.lax.axis_index(axis)
+    v = jax.tree_util.tree_leaves(stage_params_chunks)[0].shape[0]
+    units = v * m_count
+    steps = interleaved_num_steps(m_count, p, v)
+
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    inputs_v = _to_varying(inputs, axis)
+
+    def step(carry, t):
+        incoming, outputs = carry
+        u = t - rank
+        valid = (u >= 0) & (u < units)
+        uc = jnp.clip(u, 0, units - 1)
+        c = (uc // p) % v                       # which of my V chunks
+        m = (uc // (v * p)) * p + uc % p        # microbatch g·P + i
+        params_c = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            stage_params_chunks)
+        feed = jax.lax.dynamic_index_in_dim(inputs_v, m, 0, keepdims=False)
+        # virtual stage 0 = (device 0, chunk 0) reads external input
+        x = jnp.where((rank == 0) & (c == 0), feed, incoming)
+        y = body_fn(params_c, x)
+        # virtual stage V·P−1 = (device P−1, chunk V−1) emits the output
+        is_out = (rank == p - 1) & (c == v - 1) & valid
+        prev = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(is_out, y, prev), m, 0)
+        incoming = p2p._shift_cyclic(y, +1, axis)
+        return (incoming, outputs), None
+
+    one = jax.lax.dynamic_index_in_dim(inputs, 0, 0, keepdims=False)
+    init = (_to_varying(jnp.zeros_like(one), axis),
+            _to_varying(jnp.zeros_like(inputs), axis))
+    (_, outputs), _ = jax.lax.scan(step, init, jnp.arange(steps))
+    return outputs
+
+
 def _forward_backward_pipelining_with_interleaving(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -213,8 +295,9 @@ def _forward_backward_pipelining_with_interleaving(
     axis_name: Optional[str] = None,
     remat: bool = True,
 ):
-    """Interleaved-schedule entry (ref fwd_bwd_pipelining_with_interleaving.py:26
-    — experimental there too)."""
+    """Interleaved-schedule entry (ref fwd_bwd_pipelining_with_interleaving.py:26).
+    True interleaved order when ``M % P == 0``, chained-GPipe fallback
+    otherwise (see :func:`pipelined_forward_interleaved`)."""
     axis = axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
 
     def total_loss(chunks):
@@ -244,7 +327,8 @@ def get_forward_backward_func(
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
             warnings.warn(
-                "interleaved schedule runs as chained collective passes",
+                "interleaved collective schedule (chained fallback when "
+                "num_microbatches % pp != 0)",
                 ExperimentalWarning,
             )
             return _forward_backward_pipelining_with_interleaving

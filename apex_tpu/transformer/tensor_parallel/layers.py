@@ -219,6 +219,39 @@ class VocabParallelEmbedding(nn.Module):
 # ------------------------------------------------------------------
 
 
+@jax.custom_vjp
+def _matmul_fp32_wgrad(x, weight):
+    """bf16 gemm with fp32 weight gradients — the TPU form of the
+    reference's gradient-accumulation fusion (ref tensor_parallel/
+    layers.py:264-298 + csrc/megatron/fused_weight_gradient_dense*).
+
+    The CUDA kernel writes wgrad straight into an fp32 ``main_grad`` buffer
+    attached to the half-precision weight. Functionally that is: keep the
+    stored weight fp32 (the master), run the forward gemm in the
+    activation's (bf16) dtype on the MXU, and compute the weight cotangent
+    with fp32 MXU accumulation, returned AS fp32 — so microbatch
+    grad-accumulation loops carry fp32 main grads with no cast or extra
+    buffer per microbatch.
+    """
+    return jnp.matmul(x, weight.astype(x.dtype))
+
+
+def _matmul_fp32_wgrad_fwd(x, weight):
+    return jnp.matmul(x, weight.astype(x.dtype)), (x, weight)
+
+
+def _matmul_fp32_wgrad_bwd(res, g):
+    x, weight = res
+    dx = jnp.matmul(g, weight.astype(g.dtype).swapaxes(-1, -2))
+    # fp32 accumulation on the MXU; cotangent dtype = stored weight dtype
+    dw = jnp.einsum("...i,...o->io", x, g,
+                    preferred_element_type=jnp.float32)
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+_matmul_fp32_wgrad.defvjp(_matmul_fp32_wgrad_fwd, _matmul_fp32_wgrad_bwd)
+
+
 def linear_with_grad_accumulation_and_async_allreduce(
     input,
     weight,
@@ -233,19 +266,26 @@ def linear_with_grad_accumulation_and_async_allreduce(
 
     Under XLA the overlap is automatic: the dgrad ``psum`` generated by
     transposing :func:`mappings.copy_to_tensor_model_parallel_region` is
-    scheduled concurrently with the independent wgrad gemm. ``weight`` is the
-    local ``(in, out_local)`` shard. ``gradient_accumulation_fusion`` (the
-    reference's fp32 main-grad accumulation) is the caller's optimizer
-    concern in a functional framework and is accepted as a no-op flag.
+    scheduled concurrently with the independent wgrad gemm
+    (``async_grad_allreduce`` is therefore accepted as a no-op). ``weight``
+    is the local ``(in, out_local)`` shard.
+
+    ``gradient_accumulation_fusion`` engages :func:`_matmul_fp32_wgrad`:
+    store the weight fp32, run the forward gemm in the activation dtype,
+    and get fp32 weight grads with fp32 MXU accumulation — the reference's
+    fp32 main-grad wgrad fusion.
     """
-    del gradient_accumulation_fusion, async_grad_allreduce
+    del async_grad_allreduce
     axis = axis_name if axis_name is not None else TP
     if sequence_parallel_enabled:
         x = mappings.gather_from_sequence_parallel_region(input, axis,
                                                           seq_dim=seq_dim)
     else:
         x = mappings.copy_to_tensor_model_parallel_region(input, axis)
-    y = jnp.matmul(x, weight)
+    if gradient_accumulation_fusion:
+        y = _matmul_fp32_wgrad(x, weight)
+    else:
+        y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias
     return y

@@ -109,3 +109,34 @@ def test_syncbn_nhwc_default_matches_flax_batchnorm():
     yr, _ = ref.apply(ref.init(jax.random.PRNGKey(0), x), x,
                       mutable=["batch_stats"])
     np.testing.assert_allclose(np.asarray(yo), np.asarray(yr), rtol=1e-4, atol=1e-4)
+
+
+def test_welford_survives_large_mean():
+    """mean >> std: E[x²]−E[x]² cancels catastrophically in fp32 (the
+    reason ref csrc/welford.cu exists); the Welford/Chan formulation must
+    recover the tiny variance."""
+    mesh = mesh8()
+    bn = SyncBatchNorm(affine=False)
+    rng = np.random.RandomState(0)
+    # mean 1e4, std 1e-1: sum-of-squares in fp32 has absolute error ~1e1,
+    # dwarfing the true variance of 1e-2 (fp32 INPUT quantization at 1e4 is
+    # ~1.2e-3, so ~1% is the best any algorithm can do on these values)
+    x = (1e4 + 1e-1 * rng.randn(64, 4)).astype(np.float32)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+    @jax.jit
+    def run(x):
+        def f(x):
+            y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+            return y
+        return shard_map(f, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))(x)
+
+    y = np.asarray(run(jnp.asarray(x)))
+    # reference in float64
+    x64 = x.astype(np.float64)
+    want = (x64 - x64.mean(0)) / np.sqrt(x64.var(0) + 1e-5)
+    np.testing.assert_allclose(y, want, rtol=5e-2, atol=5e-2)
+    # the old sum-of-squares formulation fails this outright:
+    sq = (x.astype(np.float32) ** 2).mean(0) - x.astype(np.float32).mean(0) ** 2
+    assert not np.allclose(sq, x64.var(0), rtol=0.5)

@@ -276,3 +276,63 @@ def test_softmax_interpret_grads():
     with pallas_config.force("interpret"):
         out = jax.grad(f)(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# -------------------------------------------------- k-blocked long softmax
+
+
+def test_blocked_causal_softmax_matches(monkeypatch):
+    """sk beyond the whole-row VMEM limit takes the two-pass k-blocked
+    path (threshold lowered so interpret mode stays fast)."""
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 64)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 96, 96), jnp.float32)
+    ref = scaled_upper_triang_masked_softmax(x, None, 0.7)
+    with pallas_config.force("interpret"):
+        out = scaled_upper_triang_masked_softmax(x, None, 0.7)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_blocked_causal_softmax_rect(monkeypatch):
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 64)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 128), jnp.float32)
+    ref = scaled_upper_triang_masked_softmax(x, None, 1.1)
+    with pallas_config.force("interpret"):
+        out = scaled_upper_triang_masked_softmax(x, None, 1.1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_blocked_masked_softmax_matches(monkeypatch):
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 64)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 16, 96),
+                          jnp.bfloat16)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(3), 0.3, (2, 1, 16, 96))
+    ref = scaled_masked_softmax(x, mask, 0.5)
+    with pallas_config.force("interpret"):
+        out = scaled_masked_softmax(x, mask, 0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=2e-2)
+
+
+def test_blocked_softmax_grads(monkeypatch):
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 64)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 96, 96), jnp.float32)
+
+    def f(x):
+        return jnp.sum(scaled_upper_triang_masked_softmax(x, None, 0.9) ** 2)
+
+    ref = jax.grad(f)(x)
+    with pallas_config.force("interpret"):
+        out = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

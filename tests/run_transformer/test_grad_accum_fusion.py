@@ -1,0 +1,88 @@
+"""Gradient-accumulation fusion: fp32 main-grad wgrad in the TP linear
+(VERDICT next-round #8; ref tensor_parallel/layers.py:264-298 +
+csrc/megatron/fused_weight_gradient_dense*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    linear_with_grad_accumulation_and_async_allreduce as fused_linear,
+)
+
+
+def _setup():
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (8, 16), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (16, 8), jnp.float32) * 0.1
+    return x, w
+
+
+def test_wgrad_is_fp32_over_bf16_activations():
+    x, w = _setup()
+
+    def loss(w, x):
+        y = fused_linear(x, w, gradient_accumulation_fusion=True,
+                         axis_name=None)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    dw = jax.grad(loss)(w, x)
+    assert dw.dtype == jnp.float32
+    # dx stays in the activation dtype
+    dx = jax.grad(loss, argnums=1)(w, x)
+    assert dx.dtype == jnp.bfloat16
+
+
+def test_forward_matches_bf16_gemm():
+    x, w = _setup()
+    y = fused_linear(x, w, gradient_accumulation_fusion=True, axis_name=None)
+    want = jnp.matmul(x, w.astype(jnp.bfloat16))
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(want, np.float32))
+
+
+def test_wgrad_value_matches_fp32_einsum():
+    x, w = _setup()
+
+    def loss(w):
+        y = fused_linear(x, w, gradient_accumulation_fusion=True,
+                         axis_name=None)
+        return jnp.sum(y.astype(jnp.float32))
+
+    dw = jax.grad(loss)(w)
+    # cotangent of sum() is ones; dw = x^T @ ones accumulated in fp32
+    want = jnp.einsum("bi,bo->io", x.astype(jnp.float32),
+                      jnp.ones((8, 8), jnp.float32))
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)  # bf16 inputs
+
+
+def test_microbatch_accumulation_carries_fp32():
+    """The no-pipelining scan must accumulate the fused wgrads in an fp32
+    carry (the main-grad buffer semantics), even though the layer computes
+    in bf16."""
+    w = jax.random.normal(jax.random.PRNGKey(1), (16, 4), jnp.float32) * 0.1
+    mbs = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 16), jnp.bfloat16)
+
+    def mb_loss(w, mb):
+        y = fused_linear(mb, w, gradient_accumulation_fusion=True,
+                         axis_name=None)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    loss, grads = forward_backward_no_pipelining(mb_loss, w, mbs)
+    assert grads.dtype == jnp.float32
+    want = sum(jax.grad(mb_loss)(w, mbs[m]) for m in range(4)) / 4
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_off_keeps_promoted_dtype():
+    """Without fusion the gemm follows jnp promotion (fp32 weight wins)."""
+    x, w = _setup()
+    y = fused_linear(x, w, gradient_accumulation_fusion=False,
+                     axis_name=None)
+    assert y.dtype == jnp.float32

@@ -181,6 +181,103 @@ def test_interleaved_matches_dense_2x_chunks(mesh):
                                atol=1e-6)
 
 
+def test_interleaved_fallback_when_m_not_divisible(mesh):
+    """M=3 with P=4: chained fallback still matches dense."""
+    V = 2
+    total = V * PP
+    M_odd = 3
+    params = make_params(jax.random.PRNGKey(0), total)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M_odd, MB, DIM))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (M_odd, MB, DIM))
+
+    def to_device_layout(p):
+        return p.reshape((V, PP) + p.shape[1:]).swapaxes(0, 1)
+
+    dev_params = jax.tree_util.tree_map(to_device_layout, params)
+
+    def dense_loss(params):
+        out = seq_apply(params, x, total)
+        return jnp.mean(
+            jnp.stack([loss_fn(out[m], tgt[m]) for m in range(M_odd)]))
+
+    def fn(dev_params):
+        local = jax.tree_util.tree_map(lambda p: p[0], dev_params)
+        loss, _ = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, local, x, tgt)
+        return loss
+
+    loss = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("pp"),),
+                             out_specs=P()))(dev_params)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(dense_loss(params)), rtol=1e-5)
+
+
+def test_interleaved_bubble_smaller_than_chained(mesh):
+    """The schedule's scan must be V·M + P − 1 steps — strictly fewer than
+    chained GPipe's V·(M + P − 1) (VERDICT next-round #8: measurably
+    smaller bubble)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        interleaved_num_steps,
+        pipelined_forward_chained,
+        pipelined_forward_interleaved,
+    )
+
+    V = 2
+    total = V * PP
+    params = make_params(jax.random.PRNGKey(0), total)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M, MB, DIM))
+
+    def to_device_layout(p):
+        return p.reshape((V, PP) + p.shape[1:]).swapaxes(0, 1)
+
+    dev_params = jax.tree_util.tree_map(to_device_layout, params)
+
+    def scan_lengths(forward):
+        def fn(dev_params, x):
+            local = jax.tree_util.tree_map(lambda p: p[0], dev_params)
+            return forward(stage_fn, local, x, remat=False)
+
+        jaxpr = jax.make_jaxpr(
+            shard_map(fn, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P("pp")))(dev_params, x)
+        lengths = []
+
+        def walk(jxp):
+            for eqn in jxp.eqns:
+                if eqn.primitive.name == "scan":
+                    lengths.append(eqn.params["length"])
+                for param in eqn.params.values():
+                    if hasattr(param, "jaxpr"):
+                        walk(param.jaxpr)
+                    elif hasattr(param, "eqns"):
+                        walk(param)
+
+        walk(jaxpr.jaxpr)
+        return lengths
+
+    inter = scan_lengths(pipelined_forward_interleaved)
+    chain = scan_lengths(pipelined_forward_chained)
+    assert sum(inter) == interleaved_num_steps(M, PP, V) == V * M + PP - 1
+    assert sum(chain) == V * (M + PP - 1)
+    assert sum(inter) < sum(chain)
+
+    # and the two forwards agree on the last stage
+    def run(forward):
+        def fn(dev_params, x):
+            local = jax.tree_util.tree_map(lambda p: p[0], dev_params)
+            outs = forward(stage_fn, local, x)
+            r = jax.lax.axis_index("pp")
+            outs = jnp.where(r == jax.lax.axis_size("pp") - 1, outs, 0.0)
+            return jax.lax.psum(outs, "pp")
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("pp"), P()),
+                                 out_specs=P()))(dev_params, x)
+
+    np.testing.assert_allclose(
+        np.asarray(run(pipelined_forward_interleaved)),
+        np.asarray(run(pipelined_forward_chained)), rtol=1e-5, atol=1e-6)
+
+
 def test_no_pipelining_grad_accumulation():
     ps.destroy_model_parallel()
     params = {"w": jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM))}
