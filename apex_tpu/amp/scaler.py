@@ -39,11 +39,16 @@ class LossScaler:
 
     def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
                  scale_factor=2.0, scale_window=2000,
-                 min_loss_scale=None, max_loss_scale=2.0 ** 24, enabled=True):
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24, enabled=True,
+                 backoff_factor=None):
         self.dynamic = loss_scale == "dynamic"
         self._static_scale = 1.0 if self.dynamic else float(loss_scale)
         self.init_scale = init_scale if self.dynamic else self._static_scale
         self.scale_factor = scale_factor
+        # apex default: backoff is symmetric (1/growth); torch-GradScaler
+        # style asymmetric backoff is supported via an explicit factor
+        self.backoff_factor = (1.0 / scale_factor if backoff_factor is None
+                               else backoff_factor)
         self.scale_window = scale_window
         self.min_loss_scale = min_loss_scale
         self.max_loss_scale = max_loss_scale
@@ -85,7 +90,7 @@ class LossScaler:
         """Dynamic-scale automaton (ref apex/amp/scaler.py:update_scale)."""
         if not self.enabled or not self.dynamic:
             return state
-        halved = state.loss_scale / self.scale_factor
+        halved = state.loss_scale * self.backoff_factor
         if self.min_loss_scale is not None:  # ref default: no floor
             halved = jnp.maximum(halved, self.min_loss_scale)
         new_scale = jnp.where(

@@ -21,11 +21,32 @@ from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
 
 
-def _masked_attention(q, k, v, key_padding_mask, scale):
-    """[b, s, h, d] attention with torch-style key_padding_mask [b, sk]
-    (True = pad): padded KEYS are excluded from every query's softmax."""
+def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale):
+    """[b, s, h, d] attention with torch-style masks (ref
+    self_multihead_attn.py:144-156):
+
+    - ``key_padding_mask`` [b, sk], True = pad: padded KEYS are excluded
+      from every query's softmax.
+    - ``attn_mask`` [sq, sk], bool (True = masked) or additive float
+      (-inf = masked), applied to every batch/head.
+    """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-    mask = key_padding_mask[:, None, None, :]
+    b, _, sq, sk = scores.shape
+    mask = None  # built lazily: all-additive masks need no bool mask at all
+    if key_padding_mask is not None:
+        mask = jnp.broadcast_to(key_padding_mask[:, None, None, :],
+                                (b, 1, sq, sk))
+    if attn_mask is not None:
+        if jnp.issubdtype(attn_mask.dtype, jnp.integer):
+            # torch-style byte/int mask (nonzero = masked): treat as bool
+            # rather than silently ADDING it to the scores
+            attn_mask = attn_mask != 0
+        if attn_mask.dtype == jnp.bool_:
+            am = jnp.broadcast_to(attn_mask[None, None, :, :],
+                                  (b, 1, sq, sk))
+            mask = am if mask is None else mask | am
+        else:  # additive float mask: fold into the (scaled) scores
+            scores = scores + attn_mask[None, None, :, :] / scale
     probs = scaled_masked_softmax(scores, mask, scale).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -47,10 +68,6 @@ class SelfMultiheadAttn(nn.Module):
     @nn.compact
     def __call__(self, query, key_padding_mask=None, attn_mask=None,
                  is_training: bool = True, deterministic: Optional[bool] = None):
-        if attn_mask is not None:
-            raise NotImplementedError(
-                "attn_mask: use apex_tpu.contrib.fmha (causal) or "
-                "scaled_masked_softmax directly")
         s, b, h = query.shape
         d = h // self.heads
         x = query
@@ -69,10 +86,10 @@ class SelfMultiheadAttn(nn.Module):
         def heads_first(t):
             return t.transpose(1, 0, 2).reshape(b, s, self.heads, d)
 
-        if key_padding_mask is not None:
+        if key_padding_mask is not None or attn_mask is not None:
             o = _masked_attention(heads_first(q), heads_first(k),
                                   heads_first(v), key_padding_mask,
-                                  d ** -0.5)
+                                  attn_mask, d ** -0.5)
         else:
             o = flash_attention(heads_first(q), heads_first(k),
                                 heads_first(v), causal=False,

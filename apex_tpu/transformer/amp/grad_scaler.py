@@ -19,6 +19,25 @@ import jax.numpy as jnp
 from apex_tpu.amp.scaler import LossScaler
 
 
+def _axis_bound(axis: str) -> bool:
+    """True iff ``axis`` is a bound named axis in the current trace.
+
+    Probing the axis env directly (rather than catching pmax's unbound-axis
+    error) keeps genuine pmax failures loud — swallowing them would silently
+    drop the cross-rank overflow sync this class exists to guarantee.
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        return bool(get_axis_env().axis_exists(axis))
+    except Exception:  # private API moved: probe with a cheap axis_size
+        try:
+            jax.lax.axis_size(axis)
+            return True
+        except (NameError, AssertionError):
+            return False
+
+
 class GradScaler(LossScaler):
     """ref grad_scaler.py:21. ``model_parallel_axes`` are the mesh axes the
     overflow decision must agree across (tp and pp by default); axes not
@@ -31,21 +50,18 @@ class GradScaler(LossScaler):
         super().__init__(
             loss_scale="dynamic", init_scale=init_scale,
             scale_factor=growth_factor, scale_window=growth_interval,
-            enabled=enabled)
-        if backoff_factor != 1.0 / growth_factor:
-            # LossScaler uses one symmetric factor (apex default semantics:
-            # backoff = 1/growth); asymmetric factors are not represented
-            self.backoff_factor = backoff_factor
+            enabled=enabled, backoff_factor=backoff_factor)
         self.model_parallel_axes = tuple(model_parallel_axes)
 
     def unscale(self, grads, state):
         unscaled, overflow = super().unscale(grads, state)
+        if not self.enabled:  # disabled scaler compiles to nothing
+            return unscaled, overflow
         # sync the decision across model-parallel ranks (ref
         # _maybe_opt_step's MAX allreduce over get_model_parallel_group())
         flag = overflow.astype(jnp.int32)
         for axis in self.model_parallel_axes:
-            try:
-                flag = jax.lax.pmax(flag, axis)
-            except NameError:
-                continue  # axis not bound here
+            if not _axis_bound(axis):
+                continue
+            flag = jax.lax.pmax(flag, axis)
         return unscaled, flag > 0

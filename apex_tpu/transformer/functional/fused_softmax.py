@@ -61,14 +61,6 @@ _WHOLE_ROW_MAX_SK = 16384
 _BLOCKED_BK = 2048
 
 
-def _pick_block_rows(sq: int, sk: int) -> int:
-    target = max(8, _VMEM_ROW_BUDGET // (4 * sk))
-    block = min(sq, target)
-    while sq % block:  # largest divisor of sq not above the budget
-        block -= 1
-    return block
-
-
 def _largest_divisor(s: int, target: int) -> int:
     b = min(s, target)
     while s % b:
@@ -76,8 +68,19 @@ def _largest_divisor(s: int, target: int) -> int:
     return b
 
 
+def _pick_block_rows(sq: int, sk: int) -> int:
+    # largest divisor of sq whose fp32 row block fits the VMEM budget
+    return _largest_divisor(sq, max(8, _VMEM_ROW_BUDGET // (4 * sk)))
+
+
 def _pallas_ok(sq: int, sk: int) -> bool:
-    del sq, sk  # k-blocking removed the sk cap (VERDICT weak #9)
+    del sq  # k-blocking removed the sk cap (VERDICT weak #9)
+    if (sk > _WHOLE_ROW_MAX_SK
+            and _largest_divisor(sk, _BLOCKED_BK) < min(128, _BLOCKED_BK)):
+        # awkward sk (e.g. prime): the blocked kernel would degenerate to
+        # lane-dim blocks far below a TPU tile — jnp/XLA is faster there
+        # (min() keeps tests that shrink _BLOCKED_BK on the blocked path)
+        return False
     return _use_pallas()
 
 
@@ -145,7 +148,10 @@ def _stats_kernel(scale, bq, bk, off, causal, x_ref, mask_ref, m_ref, l_ref,
 
     @pl.when(ki == 0)
     def _init():
-        m_sc[:] = jnp.full_like(m_sc, _MASK_FILL)
+        # -inf, not _MASK_FILL: a row whose true max is below the fill
+        # value must still normalize (exp(-inf - m_new) == 0 is fine;
+        # seeding with the fill value would zero the sum and divide by 0).
+        m_sc[:] = jnp.full_like(m_sc, -jnp.inf)
         l_sc[:] = jnp.zeros_like(l_sc)
 
     xb = x_ref[0].astype(jnp.float32) * scale
@@ -155,8 +161,12 @@ def _stats_kernel(scale, bq, bk, off, causal, x_ref, mask_ref, m_ref, l_ref,
         xb = jnp.where(mask_ref[0], _MASK_FILL, xb)
     m_prev = m_sc[:, 0]
     m_new = jnp.maximum(m_prev, jnp.max(xb, axis=-1))
-    l_sc[:, 0] = (l_sc[:, 0] * jnp.exp(m_prev - m_new)
-                  + jnp.sum(jnp.exp(xb - m_new[:, None]), axis=-1))
+    # m_new can be -inf while every element seen so far is -inf (additive
+    # -inf masks reach this kernel); exp(-inf - -inf) = NaN, so shift by a
+    # finite stand-in — all exps are exactly 0 then and l stays 0.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    l_sc[:, 0] = (l_sc[:, 0] * jnp.exp(m_prev - m_safe)
+                  + jnp.sum(jnp.exp(xb - m_safe[:, None]), axis=-1))
     m_sc[:, 0] = m_new
 
     @pl.when(ki == nk - 1)

@@ -37,6 +37,8 @@ def main():
     p.add_argument("--layers-per-stage", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--no-sequence-parallel", action="store_true")
+    p.add_argument("--fixed-data", action="store_true",
+                   help="overfit one fixed batch (deterministic decrease)")
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
@@ -168,11 +170,16 @@ def main():
 
         key = jax.random.PRNGKey(1)
         first = None
+        fixed = None
         for it in range(args.steps):
-            key, sub = jax.random.split(key)
-            tokens = jax.random.randint(sub, (M, mb * dp, s), 0,
-                                        cfg.vocab_size)
-            targets = jnp.roll(tokens, -1, axis=-1)
+            if args.fixed_data and fixed is not None:
+                tokens, targets = fixed
+            else:
+                key, sub = jax.random.split(key)
+                tokens = jax.random.randint(sub, (M, mb * dp, s), 0,
+                                            cfg.vocab_size)
+                targets = jnp.roll(tokens, -1, axis=-1)
+                fixed = (tokens, targets)
             t0 = time.perf_counter()
             stage_params, io_params, opt_state, loss = step(
                 stage_params, io_params, opt_state, tokens, targets)

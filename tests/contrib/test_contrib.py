@@ -181,6 +181,74 @@ class TestAttention:
         np.testing.assert_allclose(np.asarray(y1[:6]), np.asarray(y2[:6]),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_self_mha_bool_attn_mask_matches_manual(self):
+        """Causal bool attn_mask (True = masked) must match manually-masked
+        softmax attention (ref self_multihead_attn.py:144 mask support)."""
+        s, b, h, heads = 8, 2, 16, 2
+        d = h // heads
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        causal = jnp.triu(jnp.ones((s, s), bool), k=1)
+        m = SelfMultiheadAttn(hidden_dim=h, heads=heads)
+        var = m.init(jax.random.PRNGKey(1), x)
+        got = m.apply(var, x, attn_mask=causal)
+
+        # manual reference: same params, explicit masked softmax
+        qkv = x @ var["params"]["qkv_proj"]["kernel"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def hf(t):
+            return t.transpose(1, 0, 2).reshape(b, s, heads, d)
+
+        q, k, v = hf(q), hf(k), hf(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * d ** -0.5
+        scores = jnp.where(causal[None, None], -jnp.inf, scores)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+        want = (o.reshape(b, s, h).transpose(1, 0, 2)
+                @ var["params"]["out_proj"]["kernel"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_self_mha_additive_attn_mask(self):
+        """A -inf additive float mask behaves like the bool mask."""
+        s, b, h = 8, 2, 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        causal_bool = jnp.triu(jnp.ones((s, s), bool), k=1)
+        causal_add = jnp.where(causal_bool, -jnp.inf, 0.0).astype(x.dtype)
+        m = SelfMultiheadAttn(hidden_dim=h, heads=2)
+        var = m.init(jax.random.PRNGKey(1), x)
+        y_bool = m.apply(var, x, attn_mask=causal_bool)
+        y_add = m.apply(var, x, attn_mask=causal_add)
+        np.testing.assert_allclose(np.asarray(y_bool), np.asarray(y_add),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_self_mha_int_attn_mask_treated_as_bool(self):
+        """torch-style byte masks (1 = masked) must behave like bool masks,
+        not be added to the scores."""
+        s, b, h = 8, 2, 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        causal_bool = jnp.triu(jnp.ones((s, s), bool), k=1)
+        causal_int = causal_bool.astype(jnp.uint8)
+        m = SelfMultiheadAttn(hidden_dim=h, heads=2)
+        var = m.init(jax.random.PRNGKey(1), x)
+        np.testing.assert_allclose(
+            np.asarray(m.apply(var, x, attn_mask=causal_int)),
+            np.asarray(m.apply(var, x, attn_mask=causal_bool)),
+            rtol=1e-6, atol=1e-7)
+
+    def test_self_mha_attn_mask_with_key_padding(self):
+        """attn_mask composes with key_padding_mask."""
+        s, b, h = 8, 2, 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
+        causal = jnp.triu(jnp.ones((s, s), bool), k=1)
+        pad = jnp.zeros((b, s), bool).at[:, 6:].set(True)
+        m = SelfMultiheadAttn(hidden_dim=h, heads=2)
+        var = m.init(jax.random.PRNGKey(1), x)
+        y1 = m.apply(var, x, key_padding_mask=pad, attn_mask=causal)
+        x2 = x.at[7].add(100.0)  # padded key perturbation is invisible
+        y2 = m.apply(var, x2, key_padding_mask=pad, attn_mask=causal)
+        np.testing.assert_allclose(np.asarray(y1[:6]), np.asarray(y2[:6]),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_fmha_packed(self):
         qkv = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 3, 4, 8))
         out = fmha_packed_qkv(qkv)

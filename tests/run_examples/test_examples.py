@@ -37,7 +37,7 @@ def test_imagenet_resnet50():
 
 @pytest.mark.slow
 def test_llama_train():
-    out = _run("llama_train.py", "--steps", "4")
+    out = _run("llama_train.py", "--steps", "4", "--fixed-data")
     assert "(decreased)" in out
 
 

@@ -336,3 +336,57 @@ def test_blocked_softmax_grads(monkeypatch):
     with pallas_config.force("interpret"):
         out = jax.grad(f)(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_blocked_softmax_very_negative_rows(monkeypatch):
+    """Rows whose true max is below the mask fill value (-10000) must still
+    normalize — regression for seeding the running max with _MASK_FILL
+    instead of -inf (which zeroed the denominator -> NaN)."""
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 32)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 16)
+    x = jnp.full((1, 8, 64), -30000.0, jnp.float32)
+    ref = scaled_masked_softmax(x, None, 1.0)  # uniform 1/64
+    with pallas_config.force("interpret"):
+        out = fs._pallas_blocked(x, None, 1.0, causal=False)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_blocked_softmax_awkward_sk_falls_back(monkeypatch):
+    """A long sk with no usable block divisor (prime) must not take the
+    blocked kernel (lane blocks of width 1); _pallas_ok rejects it and the
+    masked dispatch lands on the identical-math jnp path."""
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    assert not fs._pallas_ok(8, 16411)  # prime > _WHOLE_ROW_MAX_SK
+    # exercise the actual dispatch: thresholds lowered so sk=97 (prime) is
+    # "long"; the blocked kernel would need bk >= 128 (impossible) and a
+    # broken fallback would send a degenerate grid into pallas_call
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 64)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 4, 97))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (1, 1, 4, 97))
+    assert not fs._pallas_ok(4, 97)
+    with pallas_config.force("interpret"):
+        out = scaled_masked_softmax(x, mask, 1.0)
+    ref = scaled_masked_softmax(x, mask, 1.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_blocked_softmax_first_block_all_neg_inf(monkeypatch):
+    """A row whose FIRST k-block is entirely -inf (additive -inf masks fold
+    into scores) must recover once later blocks hold finite keys —
+    regression for exp(-inf - -inf) = NaN in the running stats."""
+    from apex_tpu.transformer.functional import fused_softmax as fs
+
+    monkeypatch.setattr(fs, "_WHOLE_ROW_MAX_SK", 32)
+    monkeypatch.setattr(fs, "_BLOCKED_BK", 16)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 8, 64), jnp.float32)
+    x = x.at[:, :, :16].set(-jnp.inf)  # first block fully masked
+    ref = jax.nn.softmax(x, axis=-1)
+    with pallas_config.force("interpret"):
+        out = fs._pallas_blocked(x, None, 1.0, causal=False)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)

@@ -1,0 +1,106 @@
+"""Batch sampler parity (ref apex/transformer/_data/_batchsampler.py via
+Megatron data_samplers; ref test: apex/transformer/testing usage)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+def test_sequential_partitions_ranks():
+    total, local_mb, dp = 64, 4, 2
+    seen = []
+    for rank in range(dp):
+        s = MegatronPretrainingSampler(
+            total_samples=total, consumed_samples=0,
+            local_minibatch_size=local_mb, data_parallel_rank=rank,
+            data_parallel_size=dp)
+        batches = list(s)
+        assert all(len(b) == local_mb for b in batches)
+        seen.append(np.concatenate(batches))
+    # both ranks together cover a disjoint prefix; no overlap
+    assert not set(seen[0]) & set(seen[1])
+
+
+def test_sequential_resume_from_consumed():
+    s = MegatronPretrainingSampler(
+        total_samples=32, consumed_samples=8, local_minibatch_size=4,
+        data_parallel_rank=0, data_parallel_size=1)
+    first = next(iter(s))
+    assert first == [8, 9, 10, 11]
+
+
+def test_sequential_drop_last():
+    kept = list(MegatronPretrainingSampler(
+        total_samples=10, consumed_samples=0, local_minibatch_size=4,
+        data_parallel_rank=0, data_parallel_size=1, drop_last=False))
+    dropped = list(MegatronPretrainingSampler(
+        total_samples=10, consumed_samples=0, local_minibatch_size=4,
+        data_parallel_rank=0, data_parallel_size=1, drop_last=True))
+    assert len(kept) == len(dropped) + 1
+    assert kept[-1] == [8, 9]
+
+
+def test_sequential_tail_split_across_ranks():
+    """drop_last=False tail is split near-evenly: no rank gets an empty
+    batch while another gets the whole remainder."""
+    tails = []
+    for rank in range(2):
+        batches = list(MegatronPretrainingSampler(
+            total_samples=10, consumed_samples=0, local_minibatch_size=4,
+            data_parallel_rank=rank, data_parallel_size=2, drop_last=False))
+        tails.append(batches[-1])
+    assert sorted(tails[0] + tails[1]) == [8, 9]
+    assert abs(len(tails[0]) - len(tails[1])) <= 1
+
+
+def test_random_deterministic_and_disjoint():
+    total, local_mb, dp = 64, 4, 2
+    per_rank = []
+    for rank in range(dp):
+        a = list(MegatronPretrainingRandomSampler(
+            total_samples=total, consumed_samples=0,
+            local_minibatch_size=local_mb, data_parallel_rank=rank,
+            data_parallel_size=dp))
+        b = list(MegatronPretrainingRandomSampler(
+            total_samples=total, consumed_samples=0,
+            local_minibatch_size=local_mb, data_parallel_rank=rank,
+            data_parallel_size=dp))
+        assert a == b  # same epoch -> same permutation
+        per_rank.append({i for batch in a for i in batch})
+    assert not per_rank[0] & per_rank[1]  # rank buckets are disjoint
+    assert all(i < total for s in per_rank for i in s)
+
+
+def test_random_resume_skips_consumed():
+    total, local_mb = 64, 4
+    full = list(MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=0, local_minibatch_size=local_mb,
+        data_parallel_rank=0, data_parallel_size=1))
+    resumed = list(MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=2 * local_mb,
+        local_minibatch_size=local_mb, data_parallel_rank=0,
+        data_parallel_size=1))
+    assert resumed == full[2:]  # resume = same permutation minus consumed
+
+
+def test_rampup_via_local_minibatch_setter():
+    s = MegatronPretrainingSampler(
+        total_samples=64, consumed_samples=0, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2)
+    it = iter(s)
+    assert len(next(it)) == 2
+    s.local_minibatch_size = 4  # batch-size rampup mid-epoch
+    assert s.local_minibatch_times_data_parallel_size == 8
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        MegatronPretrainingSampler(0, 0, 4, 0, 1)
+    with pytest.raises(ValueError):
+        MegatronPretrainingSampler(8, 8, 4, 0, 1)
+    with pytest.raises(ValueError):
+        MegatronPretrainingRandomSampler(8, 0, 4, 2, 2)
