@@ -28,13 +28,20 @@ def _load():
     global _LIB
     if _LIB is not None:
         return _LIB or None  # False = cached failure -> numpy fallback
-    here = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    so = os.path.join(here, "csrc", "libapex_tpu_host.so")
-    if not os.path.exists(so):
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # installed layout first (setup.py drops the lib inside the package),
+    # then the source checkout's csrc/
+    candidates = [
+        os.path.join(pkg_dir, "_lib", "libapex_tpu_host.so"),
+        os.path.join(os.path.dirname(pkg_dir), "csrc",
+                     "libapex_tpu_host.so"),
+    ]
+    so = next((c for c in candidates if os.path.exists(c)), None)
+    if so is None:
         # the binary is not version-controlled (platform-specific); build it
         # on first use when a toolchain is around, else numpy fallback
         import subprocess
+        so = candidates[-1]
         try:
             subprocess.run(["make", "-C", os.path.dirname(so)],
                            capture_output=True, timeout=120, check=True)

@@ -112,7 +112,11 @@ class MegatronPretrainingSampler(_Base):
             # split the short tail evenly (sizes differ by at most 1) instead
             # of the reference's fixed-offset slice, which hands every rank
             # past the remainder an empty list (ref _batchsampler.py:97-100);
-            # consumers must still expect a ragged final batch
+            # consumers must still expect a ragged final batch. A tail with
+            # fewer samples than ranks is dropped outright — some rank would
+            # otherwise get an empty batch, which no SPMD consumer survives.
+            if len(batch) < self.data_parallel_size:
+                return
             base, rem = divmod(len(batch), self.data_parallel_size)
             r = self.data_parallel_rank
             start = r * base + min(r, rem)

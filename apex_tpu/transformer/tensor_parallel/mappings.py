@@ -31,6 +31,11 @@ from apex_tpu.transformer import parallel_state
 
 
 def _axis(axis_name: Optional[str]) -> str:
+    """``None`` means the DEFAULT tp axis name, not "no parallelism" —
+    like the reference's ``group=None`` → default NCCL group. To run
+    tensor-parallel code unpartitioned on a mesh that has a bound 'tp'
+    axis, use a different axis name for that mesh dimension; when 'tp' is
+    simply unbound these regions are identity."""
     return (
         axis_name
         if axis_name is not None

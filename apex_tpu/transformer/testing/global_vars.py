@@ -1,0 +1,149 @@
+"""Global singletons for the test harness
+(ref apex/transformer/testing/global_vars.py).
+
+``set_global_variables`` parses args once and builds the num-microbatches
+calculator; ``get_args``/``get_num_microbatches``/``get_timers`` read the
+singletons with the reference's initialized/not-initialized assertions.
+Timers block on device work (``block_until_ready``) the way the
+reference's timers ``cuda.synchronize`` (ref global_vars.py:191).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+    return var
+
+
+def _ensure_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def get_args():
+    """Return arguments (ref global_vars.py:34)."""
+    return _ensure_initialized(_GLOBAL_ARGS, "args")
+
+
+def get_num_microbatches() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).update(consumed_samples, consistency_check)
+
+
+def get_timers():
+    return _ensure_initialized(_GLOBAL_TIMERS, "timers")
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args: bool = True,
+                         data_parallel_size: Optional[int] = None,
+                         args=None):
+    """Parse args and set every singleton (ref global_vars.py:87)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _ensure_not_initialized(_GLOBAL_ARGS, "args")
+    parsed = parse_args(extra_args_provider, args_defaults,
+                        ignore_unknown_args, args=args)
+    _GLOBAL_ARGS = parsed
+    dp = data_parallel_size if data_parallel_size is not None else 1
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank=0,
+        rampup_batch_size=parsed.rampup_batch_size,
+        global_batch_size=parsed.global_batch_size,
+        micro_batch_size=parsed.micro_batch_size,
+        data_parallel_size=dp,
+    )
+    _GLOBAL_TIMERS = Timers()
+    return parsed
+
+
+def destroy_global_vars():
+    """Reset for the next test (the reference leaks these across tests)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TIMERS = None
+
+
+class _Timer:
+    """ref global_vars.py:191 — start/stop/elapsed with device sync."""
+
+    def __init__(self, name):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = None
+
+    def start(self):
+        assert not self.started_, "timer has already been started"
+        (jax.device_put(0.0)).block_until_ready()  # drain pending work
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self):
+        assert self.started_, "timer is not started"
+        (jax.device_put(0.0)).block_until_ready()
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """ref global_vars.py:236 — named timer registry."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        strings = [
+            f"{name}: {self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer:.2f}"
+            for name in names if name in self.timers
+        ]
+        print("time (ms) | " + " | ".join(strings), flush=True)

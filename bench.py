@@ -19,6 +19,7 @@ Prints exactly one JSON line:
 vs_baseline > 1.0 means beating the reference's 3x target.
 """
 
+import functools
 import gc
 import json
 import os
@@ -51,15 +52,20 @@ def _peak_flops(device_kind: str):
 # worker side (actual benchmarks; runs in a subprocess)
 # ---------------------------------------------------------------------------
 
-def make_params(key, n_layers=24):
-    """A GPT-2-345M-shaped tree: ~150 tensors, ~350M params total."""
+def make_params(key, n_layers=24, hidden=1024, vocab=50304):
+    """A GPT-2-345M-shaped tree (~150 tensors, ~350M params at defaults).
+
+    CPU fallback shrinks ``hidden``/``vocab`` so the workload stays
+    dispatch-bound — the quantity this benchmark measures — instead of
+    being swamped by CPU elementwise compute.
+    """
     import jax
     import jax.numpy as jnp
+    h = hidden
     sizes = []
     for _ in range(n_layers):  # n_layers x 6 tensors
-        sizes += [(1024, 3072), (3072,), (1024, 1024), (1024, 4096),
-                  (4096, 1024), (1024,)]
-    sizes += [(50304, 1024), (1024, 1024)]
+        sizes += [(h, 3 * h), (3 * h,), (h, h), (h, 4 * h), (4 * h, h), (h,)]
+    sizes += [(vocab, h), (h, h)]
     params = {}
     for i, s in enumerate(sizes):
         key, k = jax.random.split(key)
@@ -91,40 +97,75 @@ def time_chained(step, grads, state, params, iters=100):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_fused_adam(cpu_mode):
+def bench_fused_adam(cpu_mode, extras):
     import jax
     import jax.numpy as jnp
     from apex_tpu.optimizers import fused_adam
+    from apex_tpu.optimizers._math import adam_step
 
-    n_layers = 6 if cpu_mode else 24
-    chained_iters = 5 if cpu_mode else 100
-    eager_iters = 2 if cpu_mode else 10
+    if cpu_mode:
+        # dispatch-bound sizing: CPU elementwise compute on a 350M tree
+        # would swamp the dispatch overhead this benchmark measures
+        shape_kw = dict(n_layers=24, hidden=64, vocab=5030)
+        chained_iters, eager_iters = 50, 3
+    else:
+        shape_kw = dict(n_layers=24)
+        chained_iters, eager_iters = 100, 3
 
     key = jax.random.PRNGKey(0)
-    params = make_params(key, n_layers=n_layers)
+    params = make_params(key, **shape_kw)
     grads = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 1e-3), params)
 
-    # fused: whole tree in ONE jitted update over per-dtype flat buffers
-    # (the multi_tensor_apply design, SURVEY.md §2 #10). On CPU the flat
-    # concatenation costs more than it saves (no dispatch overhead to win
-    # back), so the fallback benches the tree-fused single-jit path, which
-    # is the same one-dispatch structure.
-    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=not cpu_mode)
-    state = tx.init(params)
+    # fused: whole tree in ONE jitted update, opt state donated the way a
+    # real train step would. Two variants of the one-dispatch design:
+    # tree (per-leaf fused chains) and flat (per-dtype packed buffer — the
+    # multi_tensor_apply end state, SURVEY.md §2 #10). The headline takes
+    # the faster; both are reported.
+    def time_fused(flat):
+        tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=flat)
+        state = tx.init(params)
 
-    @jax.jit
-    def fused_step(grads, state, params):
-        updates, state = tx.update(grads, state, params)
-        return jax.tree_util.tree_map(jnp.add, params, updates), state
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fused_step(grads, state, params):
+            updates, state = tx.update(grads, state, params)
+            return jax.tree_util.tree_map(jnp.add, params, updates), state
 
-    fused_t = time_chained(fused_step, grads, state, params,
-                           iters=chained_iters)
-    del state
-    gc.collect()
-    print(f"fused: {fused_t * 1e3:.3f} ms/step", file=sys.stderr)
+        # donation consumes the argument buffers — hand each run its own
+        # copies so the eager baselines below still own live params
+        t = time_chained(
+            fused_step, grads, state,
+            jax.tree_util.tree_map(jnp.copy, params), iters=chained_iters)
+        gc.collect()
+        return t
 
-    # eager analog: one jitted dispatch per tensor (the reference's
-    # unfused torch.optim.Adam loop shape)
+    tree_t = time_fused(flat=False)
+    flat_t = time_fused(flat=True)
+    fused_t = min(tree_t, flat_t)
+    extras["tree_fused_step_ms"] = round(tree_t * 1e3, 3)
+    extras["flat_fused_step_ms"] = round(flat_t * 1e3, 3)
+    print(f"fused: tree {tree_t * 1e3:.3f} / flat {flat_t * 1e3:.3f} ms/step",
+          file=sys.stderr)
+
+    # eager analog of the reference's baseline (unfused torch.optim.Adam:
+    # one kernel per OP per tensor): op-by-op jax dispatch, no jit
+    mu = {k: jnp.zeros_like(p) for k, p in params.items()}
+    nu = {k: jnp.zeros_like(p) for k, p in params.items()}
+    kw = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01,
+              adam_w_mode=True, step=1.0, bias_correction=True)
+
+    def eager_step():
+        out = {}
+        with jax.disable_jit():
+            for k, p in params.items():
+                d, m, v = adam_step(grads[k], p, mu[k], nu[k], **kw)
+                out[k] = (p + d, m, v)
+        return out
+
+    eager_t = time_fn(eager_step, iters=eager_iters, warmup=1)
+    print(f"eager (op-by-op): {eager_t * 1e3:.3f} ms/step", file=sys.stderr)
+
+    # secondary, stricter baseline: one jitted dispatch per tensor (each
+    # tensor's op chain fused, launches not amortized)
     per_tensor_tx = fused_adam(lr=1e-3, weight_decay=0.01)
     single_states = {k: per_tensor_tx.init({"x": v})
                      for k, v in params.items()}
@@ -134,14 +175,15 @@ def bench_fused_adam(cpu_mode):
         u, s = per_tensor_tx.update({"x": g}, s, {"x": p})
         return p + u["x"], s
 
-    def eager_step():
-        out = {}
-        for k, p in params.items():
-            out[k] = one_tensor(grads[k], single_states[k], p)
-        return out
+    def per_tensor_step():
+        return {k: one_tensor(grads[k], single_states[k], p)
+                for k, p in params.items()}
 
-    eager_t = time_fn(eager_step, iters=eager_iters, warmup=1)
-    print(f"eager: {eager_t * 1e3:.3f} ms/step", file=sys.stderr)
+    pt_t = time_fn(per_tensor_step, iters=eager_iters, warmup=1)
+    print(f"per-tensor-jit: {pt_t * 1e3:.3f} ms/step", file=sys.stderr)
+    extras["eager_step_ms"] = round(eager_t * 1e3, 3)
+    extras["per_tensor_jit_step_ms"] = round(pt_t * 1e3, 3)
+    extras["speedup_vs_per_tensor_jit"] = round(pt_t / fused_t, 2)
     return eager_t / fused_t, fused_t
 
 
@@ -273,9 +315,9 @@ def worker():
     print(f"platform: {platform} x{jax.device_count()} "
           f"({jax.devices()[0].device_kind})", file=sys.stderr)
 
-    speedup, fused_ms = bench_fused_adam(cpu_mode)
-    extras = {"platform": platform,
-              "fused_adam_step_ms": round(fused_ms * 1e3, 3)}
+    extras = {"platform": platform}
+    speedup, fused_ms = bench_fused_adam(cpu_mode, extras)
+    extras["fused_adam_step_ms"] = round(fused_ms * 1e3, 3)
     if not cpu_mode:
         # model-level benches are secondary evidence: never let them kill
         # the headline number

@@ -1,0 +1,47 @@
+"""Build script (ref setup.py: CUDA extension build; here the native piece
+is the C++ host runtime, built as a plain shared library and loaded via
+ctypes — no Python ABI dependency).
+
+The library is optional: if no C++ toolchain is available the package
+installs anyway and `apex_tpu.runtime.host` uses its numpy fallbacks.
+
+    pip install .            # builds csrc/host_runtime.cpp if g++ exists
+    APEX_TPU_SKIP_NATIVE=1 pip install .   # pure-Python install
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildWithHostLib(build_py):
+    """Compile the ctypes host library and ship it as package data."""
+
+    def run(self):
+        super().run()
+        if os.environ.get("APEX_TPU_SKIP_NATIVE") == "1":
+            return
+        src = os.path.join(THIS_DIR, "csrc", "host_runtime.cpp")
+        cxx = os.environ.get("CXX", "g++")
+        if not (os.path.exists(src) and shutil.which(cxx)):
+            print("apex_tpu: no C++ toolchain/source; using numpy fallbacks")
+            return
+        out_dir = os.path.join(self.build_lib, "apex_tpu", "_lib")
+        os.makedirs(out_dir, exist_ok=True)
+        out = os.path.join(out_dir, "libapex_tpu_host.so")
+        cmd = [cxx, "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+               "-Wall", "-o", out, src]
+        try:
+            subprocess.run(cmd, check=True, timeout=300)
+            print(f"apex_tpu: built host runtime -> {out}")
+        except Exception as exc:  # noqa: BLE001 - install must not fail
+            print(f"apex_tpu: host runtime build failed ({exc}); "
+                  "using numpy fallbacks")
+
+
+setup(cmdclass={"build_py": BuildWithHostLib})

@@ -57,6 +57,17 @@ def test_sequential_tail_split_across_ranks():
     assert abs(len(tails[0]) - len(tails[1])) <= 1
 
 
+def test_sequential_tiny_tail_dropped():
+    """A tail smaller than the rank count is dropped on every rank (no rank
+    may ever receive an empty batch)."""
+    for rank in range(2):
+        batches = list(MegatronPretrainingSampler(
+            total_samples=9, consumed_samples=0, local_minibatch_size=4,
+            data_parallel_rank=rank, data_parallel_size=2, drop_last=False))
+        assert batches == [[rank * 4 + i for i in range(4)]]
+        assert all(len(b) > 0 for b in batches)
+
+
 def test_random_deterministic_and_disjoint():
     total, local_mb, dp = 64, 4, 2
     per_rank = []
