@@ -34,8 +34,11 @@ class BatchNorm2d_NHWC(nn.Module):
     @nn.compact
     def __call__(self, x, z=None, train: bool = True):
         if self.bn_group > 1:
+            # groups of bn_group consecutive ranks share statistics (ref
+            # batch_norm.py bn_group peer groups)
             y = SyncBatchNorm(momentum=1.0 - self.momentum, eps=self.eps,
-                              axis_name=self.axis_name)(
+                              axis_name=self.axis_name,
+                              group_size=self.bn_group)(
                 x, use_running_average=not train)
         else:
             y = nn.BatchNorm(use_running_average=not train,

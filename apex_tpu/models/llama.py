@@ -37,9 +37,7 @@ from apex_tpu.transformer.context_parallel import (
     context_parallel_positions,
     ring_attention,
 )
-from apex_tpu.transformer.functional.fused_softmax import (
-    scaled_upper_triang_masked_softmax,
-)
+from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer.functional.rope import apply_rotary_qk
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
@@ -161,16 +159,9 @@ def _attention(x, lp, cfg: LlamaConfig, positions, tp_axis, cp_axis,
         # ring_attention is GQA-aware: k/v circulate at nkv heads
         o = ring_attention(q, k, v, axis_name=cp_axis, causal=True)
     else:
-        rep = nq // nkv
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        scale = d ** -0.5
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        probs = scaled_upper_triang_masked_softmax(
-            scores.reshape(b * nq, s_full, s_full), None, scale
-        ).reshape(b, nq, s_full, s_full).astype(v.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        # GQA-aware flash attention: online softmax, no [s, s] matrix in
+        # HBM fwd or bwd (jnp fallback off-TPU is the same math)
+        o = flash_attention(q, k, v, causal=True, scale=d ** -0.5)
 
     o = o.reshape(b, s_full, nq * d)
     return row_parallel_linear(o, lp["wo"], input_is_parallel=True,

@@ -36,28 +36,92 @@ class FusedOptimizer:
         self.params = params
         self.state = tx.init(params)
         self._jit_step = jax.jit(self._functional_step)
+        # torch-style param groups: group 0 aliases (params, state) above;
+        # groups added later carry their own transform + state
+        self.param_groups = [{"params": params, **self.defaults}]
+        self._extra_groups = []
 
     def _functional_step(self, grads, state, params):
         updates, new_state = self.tx.update(grads, state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_state
 
+    def add_param_group(self, group: dict) -> None:
+        """Add a parameter group with its own hyperparameters (ref
+        torch.optim.Optimizer.add_param_group; tested by the reference's
+        L0/run_amp/test_add_param_group.py).
+
+        ``group`` is ``{"params": pytree, **hyperparam_overrides}``; unknown
+        hyperparameters are rejected. With extra groups present, ``step``
+        takes a sequence of grad pytrees, one per group in order.
+        """
+        if not isinstance(group, dict) or "params" not in group:
+            raise ValueError("param group must be a dict with a 'params' key")
+        overrides = {k: v for k, v in group.items() if k != "params"}
+        unknown = set(overrides) - set(self.defaults)
+        if unknown:
+            raise ValueError(f"unknown hyperparameters for this optimizer: "
+                             f"{sorted(unknown)}")
+        if overrides and self._tx_factory is None:
+            raise ValueError(
+                "this optimizer does not support per-group overrides")
+        tx = self._tx_factory(**overrides) if overrides else self.tx
+        gparams = group["params"]
+        self._extra_groups.append({
+            "params": gparams, "state": tx.init(gparams), "tx": tx,
+            "jit_step": jax.jit(
+                lambda g, s, p, _tx=tx: self._group_step(_tx, g, s, p)),
+        })
+        self.param_groups.append({**self.defaults, **group})
+
+    @staticmethod
+    def _group_step(tx, grads, state, params):
+        updates, new_state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
     def step(self, grads=None, closure: Optional[Callable] = None):
-        """Apply one fused update. Returns the new params (also stored on self)."""
+        """Apply one fused update. Returns the new params (also stored on
+        self). With extra param groups, ``grads`` is a sequence of pytrees
+        (one per group) and the returned params are a list in group order."""
         loss = closure() if closure is not None else None
         if grads is None:
             raise ValueError(
                 "apex_tpu optimizers are functional: pass grads to step() "
                 "(there is no .grad attribute to read on TPU)."
             )
-        self.params, self.state = self._jit_step(grads, self.state, self.params)
-        return loss if loss is not None else self.params
+        if not self._extra_groups:
+            self.params, self.state = self._jit_step(
+                grads, self.state, self.params)
+            self.param_groups[0]["params"] = self.params
+            return loss if loss is not None else self.params
+        if not isinstance(grads, (list, tuple)):
+            raise ValueError(
+                f"optimizer has {1 + len(self._extra_groups)} param groups: "
+                "pass a list of grad trees, one per group")
+        grads = list(grads)
+        if len(grads) != 1 + len(self._extra_groups):
+            raise ValueError(
+                f"expected {1 + len(self._extra_groups)} grad trees "
+                f"(one per param group), got {len(grads)}")
+        self.params, self.state = self._jit_step(
+            grads[0], self.state, self.params)
+        for g, grp in zip(grads[1:], self._extra_groups):
+            grp["params"], grp["state"] = grp["jit_step"](
+                g, grp["state"], grp["params"])
+        all_params = [self.params] + [g["params"] for g in self._extra_groups]
+        self.param_groups[0]["params"] = self.params
+        for pg, grp in zip(self.param_groups[1:], self._extra_groups):
+            pg["params"] = grp["params"]
+        return loss if loss is not None else all_params
 
     def zero_grad(self, set_to_none: bool = True):  # noqa: ARG002 - parity no-op
         return None
 
     def state_dict(self) -> dict:
-        return {"state": self.state, "defaults": self.defaults}
+        d = {"state": self.state, "defaults": self.defaults}
+        if self._extra_groups:
+            d["group_states"] = [g["state"] for g in self._extra_groups]
+        return d
 
     def load_state_dict(self, state_dict: dict) -> None:
         new_state = state_dict["state"]
@@ -68,4 +132,11 @@ class FusedOptimizer:
                 f"loaded optimizer state structure {got} does not match "
                 f"current optimizer structure {have}")
         self.state = new_state
+        group_states = state_dict.get("group_states", [])
+        if len(group_states) != len(self._extra_groups):
+            raise ValueError(
+                f"loaded state has {len(group_states)} extra param groups, "
+                f"optimizer has {len(self._extra_groups)}")
+        for grp, s in zip(self._extra_groups, group_states):
+            grp["state"] = s
         self.defaults.update(state_dict.get("defaults", {}))

@@ -44,7 +44,31 @@ class SyncBatchNorm(nn.Module):
     process_group: Optional[str] = None  # mesh axis name
     channel_last: bool = True
     axis_name: Optional[str] = "data"
+    group_size: Optional[int] = None  # stats groups of N consecutive ranks
     dtype: Any = jnp.float32
+
+    def _group_merge(self, axis_name, local_count, local_mean, local_m2):
+        """Merge (count, mean, M2) within groups of ``group_size``
+        consecutive ranks (ref distributed/synced_batchnorm/test_groups.py;
+        the reference builds NCCL subgroups). shard_map's psum does not
+        support axis_index_groups, so gather the tiny per-channel stats and
+        reduce this rank's group slice locally — Chan's merge unchanged."""
+        n = jax.lax.axis_size(axis_name)
+        g = self.group_size
+        if n % g:
+            raise ValueError(f"group_size={g} must divide axis size {n}")
+        start = (jax.lax.axis_index(axis_name) // g) * g
+        counts = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_count, axis_name), start, g)
+        means = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_mean, axis_name), start, g)
+        m2s = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_m2, axis_name), start, g)
+        total_count = jnp.sum(counts)
+        mean = jnp.sum(counts[:, None] * means, 0) / total_count
+        m2 = jnp.sum(m2s + counts[:, None] * jnp.square(means - mean[None]),
+                     0)
+        return total_count, mean, m2
 
     @nn.compact
     def __call__(self, x, use_running_average: bool = False):
@@ -73,14 +97,18 @@ class SyncBatchNorm(nn.Module):
                 jnp.square(x32 - local_mean.reshape(stat_shape)),
                 axis=reduce_axes)
             try:
-                total_count = jax.lax.psum(local_count, axis_name)
-                mean = jax.lax.psum(local_count * local_mean,
-                                    axis_name) / total_count
-                # Chan's parallel merge of per-replica (mean, M2, count)
-                m2 = jax.lax.psum(
-                    local_m2
-                    + local_count * jnp.square(local_mean - mean),
-                    axis_name)
+                if self.group_size is not None:
+                    total_count, mean, m2 = self._group_merge(
+                        axis_name, local_count, local_mean, local_m2)
+                else:
+                    total_count = jax.lax.psum(local_count, axis_name)
+                    mean = jax.lax.psum(local_count * local_mean,
+                                        axis_name) / total_count
+                    # Chan's parallel merge of per-replica (mean, M2, count)
+                    m2 = jax.lax.psum(
+                        local_m2
+                        + local_count * jnp.square(local_mean - mean),
+                        axis_name)
             except NameError:
                 # outside pmap/shard_map: plain (single-replica) batch norm
                 total_count, mean, m2 = local_count, local_mean, local_m2

@@ -75,7 +75,8 @@ def parse_args(extra_args_provider=None, defaults=None,
         parsed.ffn_hidden_size = 4 * parsed.hidden_size
     if parsed.kv_channels is None:
         if parsed.hidden_size % parsed.num_attention_heads:
-            raise ValueError("hidden_size must divide num_attention_heads")
+            raise ValueError(
+                "num_attention_heads must divide hidden_size evenly")
         parsed.kv_channels = parsed.hidden_size // parsed.num_attention_heads
     if parsed.max_position_embeddings is None:
         parsed.max_position_embeddings = parsed.seq_length

@@ -84,6 +84,26 @@ class IdentityLayer:
         return self.weight
 
 
+# ------------------------------------------- stage splitting (model zoo)
+
+
+def split_stages(params, n_stages: int):
+    """Split a model-zoo params tree's [L, ...] layer stack into
+    [n_stages, L/n_stages, ...] (shared by the standalone GPT/BERT
+    builders; the stacked-layer convention is uniform across the zoo)."""
+    layers = params["layers"]
+    L = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]), layers)
+
+
+def io_params(params):
+    """Stage-replicated non-layer params (embeddings, final norms, heads)."""
+    return {k: v for k, v in params.items() if k != "layers"}
+
+
 # ------------------------------------------------------------ environment
 
 

@@ -41,19 +41,7 @@ def gpt_config_from_args(args) -> gpt2.GPT2Config:
     )
 
 
-def split_stages(params, n_stages: int):
-    """Split the [L, ...] layer stack into [n_stages, L/n_stages, ...]."""
-    layers = params["layers"]
-    L = jax.tree_util.tree_leaves(layers)[0].shape[0]
-    if L % n_stages:
-        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
-    return jax.tree_util.tree_map(
-        lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]), layers)
-
-
-def io_params(params):
-    """Stage-replicated non-layer params (embed/pos/final ln)."""
-    return {k: v for k, v in params.items() if k != "layers"}
+from apex_tpu.transformer.testing.commons import io_params, split_stages  # noqa: E402,F401 - re-export (harness contract)
 
 
 def embed(io, tokens, cfg: gpt2.GPT2Config, tp_axis: Optional[str] = "tp"):

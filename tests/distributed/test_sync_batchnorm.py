@@ -140,3 +140,45 @@ def test_welford_survives_large_mean():
     # the old sum-of-squares formulation fails this outright:
     sq = (x.astype(np.float32) ** 2).mean(0) - x.astype(np.float32).mean(0) ** 2
     assert not np.allclose(sq, x64.var(0), rtol=0.5)
+
+
+def test_syncbn_group_size_subgroups():
+    """group_size=2 on an 8-rank axis: each pair of consecutive ranks shares
+    stats, matching per-pair concatenated-batch BN (ref
+    tests/distributed/synced_batchnorm/test_groups.py)."""
+    mesh = mesh8()
+    bn = SyncBatchNorm(affine=False, group_size=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 6)) * 3 + \
+        jnp.arange(16)[:, None] * 1.0  # make per-pair stats differ
+    variables = bn.init(jax.random.PRNGKey(1), x[:2])
+
+    @jax.jit
+    def run(x):
+        def f(x):
+            y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+            return y
+        return shard_map(f, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))(x)
+
+    y = np.asarray(run(x))
+    xs = np.asarray(x)
+    # 8 ranks x 2 rows each; groups = rank pairs = 4-row slices
+    for g in range(4):
+        want = ref_bn(xs[g * 4:(g + 1) * 4])
+        np.testing.assert_allclose(y[g * 4:(g + 1) * 4], want,
+                                   rtol=2e-4, atol=2e-4)
+    # and it differs from whole-axis normalization
+    assert not np.allclose(y, ref_bn(xs), atol=1e-2)
+
+
+def test_syncbn_group_size_must_divide():
+    mesh = mesh8()
+    bn = SyncBatchNorm(affine=False, group_size=3)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 6))
+    variables = bn.init(jax.random.PRNGKey(1), x[:2])
+    with pytest.raises(ValueError):
+        def f(x):
+            y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+            return y
+        jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data")))(x)

@@ -1,0 +1,74 @@
+"""amp + RNN integration (ref tests/L0/run_amp/test_rnn.py): LSTM/GRU
+training through the O2 machinery — casts, dynamic loss scaling, fused
+optimizer — must converge and keep finite scales; O1 boundary casting
+must run the RNN in compute dtype."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.rnn import models as rnn_models
+
+
+@pytest.mark.parametrize("mode", ["LSTM", "GRU"])
+def test_rnn_amp_o2_training_converges(mode):
+    seq, batch, inp, hid = 8, 4, 6, 10
+    model = getattr(rnn_models, mode)(inp, hid, num_layers=2)
+    params32 = model.params
+    _, handle = amp.initialize(params32, opt_level="O2", verbosity=0)
+    policy, scaler = handle.policy, handle.scaler
+    sstate = handle.scaler_state
+    tx = fused_adam(lr=1e-2)
+    opt_state = tx.init(params32)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (seq, batch, inp))
+    target = jnp.ones((seq, batch, hid)) * 0.1
+
+    @jax.jit
+    def train_step(master, opt_state, sstate):
+        def loss_fn(p):
+            cast = policy.cast_to_compute(p)
+            outs, _ = model(x.astype(policy.compute_dtype), params=cast)
+            return jnp.mean((outs.astype(jnp.float32) - target) ** 2)
+
+        def scaled(p):
+            loss = loss_fn(p)
+            return scaler.scale_loss(loss, sstate), loss
+
+        (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(master)
+        updates, opt_state2, sstate2, _ = amp.scaled_update(
+            tx, scaler, grads, opt_state, master, sstate)
+        master = jax.tree_util.tree_map(lambda a, u: a + u, master, updates)
+        return master, opt_state2, sstate2, loss
+
+    master = params32
+    first = None
+    for _ in range(30):
+        master, opt_state, sstate, loss = train_step(
+            master, opt_state, sstate)
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss))
+    assert float(loss) < first * 0.7, (first, float(loss))
+    assert float(scaler.loss_scale(sstate)) > 0
+
+
+def test_rnn_amp_o1_boundary_casting():
+    """Under an active O1 policy an RNN behind half_function runs in the
+    compute dtype and matches the fp32 path within bf16 tolerance."""
+    model = rnn_models.Tanh(4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 2, 4))
+    handle = amp.initialize(None, opt_level="O1", verbosity=0)
+
+    # params must cross the cast boundary too (half_function casts the
+    # call's inputs, not closed-over state)
+    fast_rnn = amp.half_function(lambda xx, pp: model(xx, params=pp)[0])
+    with amp.casting(handle.policy):
+        y = fast_rnn(x, model.params)
+    assert y.dtype == jnp.bfloat16
+    y32, _ = model(x)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y32, np.float32), atol=3e-2)

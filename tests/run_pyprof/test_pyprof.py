@@ -1,0 +1,38 @@
+"""pyprof shim (ref tests/L0/run_pyprof_nvtx/test_pyprof_nvtx.py): the
+annotate/nvtx API must be usable around jitted work and produce a trace
+directory when enabled."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import pyprof
+
+
+def test_annotate_and_nvtx_api(tmp_path):
+    pyprof.init(enable_trace=False)
+
+    with pyprof.annotate("matmul-block"):
+        x = jnp.ones((8, 8))
+        y = jax.jit(lambda a: a @ a)(x)
+    jax.block_until_ready(y)
+
+    pyprof.nvtx.range_push("legacy-range")   # ref nvtx API names
+    pyprof.nvtx.range_pop()
+
+    @pyprof.wrap
+    def f(a):
+        return a * 2
+
+    assert float(f(jnp.ones(()))) == 2.0
+
+
+def test_trace_start_stop(tmp_path):
+    trace_dir = os.path.join(str(tmp_path), "trace")
+    pyprof.init(enable_trace=True, trace_dir=trace_dir)
+    pyprof.start()
+    y = jax.jit(lambda a: a + 1)(jnp.zeros((4,)))
+    jax.block_until_ready(y)
+    pyprof.stop()
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)

@@ -1,0 +1,74 @@
+"""Dynamic batch-size rampup end to end (ref
+tests/L0/run_transformer/run_dynamic_batchsize_test.py): the rampup
+calculator, ``update_num_microbatches``, and the batch sampler's
+``local_minibatch_size`` setter must compose into a growing global batch."""
+
+import pytest
+
+from apex_tpu.transformer._data import MegatronPretrainingSampler
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.testing import global_vars
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    global_vars.destroy_global_vars()
+    yield
+    global_vars.destroy_global_vars()
+
+
+def test_rampup_schedule_grows_microbatches():
+    """global batch ramps 4 -> 16 by +4 every 8 samples; micro batch 2,
+    dp 2 => num_microbatches ramps 1 -> 4 (the reference's rampup math)."""
+    calc = build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=[4, 4, 24], global_batch_size=16,
+        micro_batch_size=2, data_parallel_size=2)
+    seen = []
+    for consumed in (0, 8, 16, 24, 40):
+        calc.update(consumed, consistency_check=True)
+        seen.append((calc.get_current_global_batch_size(), calc.get()))
+    assert seen[0] == (4, 1)
+    assert seen[-1] == (16, 4)
+    assert [g for g, _ in seen] == sorted(g for g, _ in seen)  # monotonic
+
+
+def test_rampup_through_global_vars_and_sampler():
+    """Driver loop: consume what the calculator says, update it, resize the
+    sampler — every yielded local minibatch matches the current schedule."""
+    dp = 2
+    global_vars.set_global_variables(
+        args=["--global-batch-size", "16", "--micro-batch-size", "2",
+              "--rampup-batch-size", "4", "4", "24"],
+        data_parallel_size=dp)
+
+    consumed = 0
+    total = 96
+    sampler = MegatronPretrainingSampler(
+        total_samples=total, consumed_samples=0,
+        local_minibatch_size=global_vars.get_current_global_batch_size() // dp,
+        data_parallel_rank=0, data_parallel_size=dp)
+    sizes = []
+    it = iter(sampler)
+    for _ in range(8):
+        global_vars.update_num_microbatches(consumed, consistency_check=False)
+        gbs = global_vars.get_current_global_batch_size()
+        sampler.local_minibatch_size = gbs // dp
+        batch = next(it)
+        assert len(batch) == gbs // dp
+        sizes.append(gbs)
+        consumed += gbs
+    assert sizes[0] == 4 and sizes[-1] == 16
+    assert sizes == sorted(sizes)
+
+
+def test_consistency_check_rejects_indivisible_batch():
+    """Mid-ramp global batch 6 is not divisible by micro*dp = 4 — the
+    consistency check must reject it (ref microbatches.py divide())."""
+    calc = build_num_microbatches_calculator(
+        rank=0, rampup_batch_size=[4, 2, 24], global_batch_size=16,
+        micro_batch_size=2, data_parallel_size=2)
+    with pytest.raises(Exception):
+        # consumed=4 -> one +2 increment -> current global batch 6
+        calc.update(4, consistency_check=True)
