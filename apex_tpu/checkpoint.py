@@ -41,6 +41,9 @@ def restore_checkpoint(path: str, target: Optional[Any] = None,
     """Restore; ``target`` (a matching pytree of arrays/ShapeDtypeStructs)
     pins structure, dtypes and shardings."""
     ocp = _ocp()
+    if step is None:
+        # resume semantics: a stepped checkpoint dir restores its newest step
+        step = latest_step(path)
     if step is not None:
         path = os.path.join(path, f"step_{step:08d}")
     path = os.path.abspath(path)

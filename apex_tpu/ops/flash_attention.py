@@ -128,8 +128,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            pallas_config.out_struct((bh, sq, d), q.dtype, q, k, v),
+            pallas_config.out_struct((bh, sq), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -285,7 +285,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=pallas_config.out_struct((bh, sq, d), q.dtype, q, k, v,
+                                           do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -306,8 +307,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
+            pallas_config.out_struct((bh_kv, sk, d), k.dtype, q, k, v, do),
+            pallas_config.out_struct((bh_kv, sk, d), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),

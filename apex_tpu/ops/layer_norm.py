@@ -101,9 +101,9 @@ def _ln_fwd_pallas(x2, w, b, eps):
         in_specs=in_specs,
         out_specs=[row_spec, stat_spec, stat_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, h), x2.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            pallas_config.out_struct((rows, h), x2.dtype, *args),
+            pallas_config.out_struct((rows, 1), jnp.float32, *args),
+            pallas_config.out_struct((rows, 1), jnp.float32, *args),
         ],
         interpret=pallas_config.interpret(),
     )(*args)
@@ -133,8 +133,8 @@ def _rms_fwd_pallas(x2, w, eps):
         in_specs=in_specs,
         out_specs=[row_spec, stat_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, h), x2.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            pallas_config.out_struct((rows, h), x2.dtype, *args),
+            pallas_config.out_struct((rows, 1), jnp.float32, *args),
         ],
         interpret=pallas_config.interpret(),
     )(*args)

@@ -18,6 +18,27 @@ import jax
 _MODE = "auto"  # auto | off | on | interpret
 
 
+def out_struct(shape, dtype, *like):
+    """``jax.ShapeDtypeStruct`` for a ``pallas_call`` out_shape that works
+    inside ``shard_map``: with jax's check_vma on, pallas outputs must
+    declare which mesh axes they vary over — the union of the inputs'
+    vma (``like``) is the right answer for every elementwise/blockwise
+    kernel here. Outside shard_map (or on older jax) this reduces to a
+    plain ShapeDtypeStruct."""
+    vma: frozenset = frozenset()
+    for x in like:
+        try:
+            vma = vma | jax.typeof(x).vma
+        except (AttributeError, TypeError):
+            pass
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # jax without the vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def mode() -> str:
     return _MODE
 

@@ -37,8 +37,12 @@ class FusedOptimizer:
         self.state = tx.init(params)
         self._jit_step = jax.jit(self._functional_step)
         # torch-style param groups: group 0 aliases (params, state) above;
-        # groups added later carry their own transform + state
+        # groups added later carry their own transform + state. Hyperparams
+        # in these dicts are LIVE: mutating param_groups[i]['lr'] (the
+        # torch LR-scheduler idiom) rebuilds that group's transform at the
+        # next step() via tx_factory.
         self.param_groups = [{"params": params, **self.defaults}]
+        self._group_hparams = [dict(self.defaults)]
         self._extra_groups = []
 
     def _functional_step(self, grads, state, params):
@@ -73,11 +77,38 @@ class FusedOptimizer:
                 lambda g, s, p, _tx=tx: self._group_step(_tx, g, s, p)),
         })
         self.param_groups.append({**self.defaults, **group})
+        self._group_hparams.append({**self.defaults, **overrides})
 
     @staticmethod
     def _group_step(tx, grads, state, params):
         updates, new_state = tx.update(grads, state, params)
         return optax.apply_updates(params, updates), new_state
+
+    def _sync_group_hparams(self) -> None:
+        """Honor torch-style in-place edits of ``param_groups[i]`` (e.g. an
+        LR scheduler writing ``group['lr']``): rebuild the affected group's
+        transform with the new hyperparameters. State layouts are shared
+        across hyperparam values, so the existing state carries over."""
+        for i, pg in enumerate(self.param_groups):
+            current = {k: pg[k] for k in self.defaults if k in pg}
+            if current == self._group_hparams[i]:
+                continue
+            if self._tx_factory is None:
+                raise ValueError(
+                    "param_groups hyperparameters changed but this "
+                    "optimizer has no tx_factory to rebuild from")
+            changed = {k: v for k, v in current.items()
+                       if v != self.defaults.get(k)}
+            tx = self._tx_factory(**changed)
+            if i == 0:
+                self.tx = tx
+                self._jit_step = jax.jit(self._functional_step)
+            else:
+                grp = self._extra_groups[i - 1]
+                grp["tx"] = tx
+                grp["jit_step"] = jax.jit(
+                    lambda g, s, p, _tx=tx: self._group_step(_tx, g, s, p))
+            self._group_hparams[i] = current
 
     def step(self, grads=None, closure: Optional[Callable] = None):
         """Apply one fused update. Returns the new params (also stored on
@@ -89,6 +120,7 @@ class FusedOptimizer:
                 "apex_tpu optimizers are functional: pass grads to step() "
                 "(there is no .grad attribute to read on TPU)."
             )
+        self._sync_group_hparams()
         if not self._extra_groups:
             self.params, self.state = self._jit_step(
                 grads, self.state, self.params)
@@ -137,6 +169,12 @@ class FusedOptimizer:
             raise ValueError(
                 f"loaded state has {len(group_states)} extra param groups, "
                 f"optimizer has {len(self._extra_groups)}")
-        for grp, s in zip(self._extra_groups, group_states):
+        for i, (grp, s) in enumerate(zip(self._extra_groups, group_states)):
+            have = jax.tree_util.tree_structure(grp["state"])
+            got = jax.tree_util.tree_structure(s)
+            if have != got:
+                raise ValueError(
+                    f"loaded state for param group {i + 1} has structure "
+                    f"{got}, optimizer has {have}")
             grp["state"] = s
         self.defaults.update(state_dict.get("defaults", {}))

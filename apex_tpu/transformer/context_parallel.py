@@ -48,7 +48,27 @@ def ring_attention(
     q/k/v: [b, s_local, h, d] — this rank's sequence shard. Returns the
     attention output for the local queries, identical (up to fp roundoff) to
     full attention over the gathered sequence.
+
+    On TPU (Pallas enabled) each ring step runs the flash-attention kernel
+    on the resident K/V block and per-block results merge by logsumexp —
+    peak memory O(s_local·d), never a score matrix in HBM (see
+    :func:`_ring_flash`); elsewhere the jnp online-softmax path below runs.
     """
+    from apex_tpu.ops import pallas_config
+
+    if pallas_config.use_pallas():
+        b, s_local, h, d = q.shape
+        h_kv = k.shape[2]
+        if h % h_kv:
+            raise ValueError(
+                f"query heads {h} not a multiple of kv heads {h_kv}")
+        sc = float(scale if scale is not None else 1.0 / (d ** 0.5))
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h, s_local, d)
+        kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_local, d)
+        vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, s_local, d)
+        o = _ring_flash(_axis(axis_name), causal, sc, qt, kt, vt)
+        return (o.reshape(b, h, s_local, d).transpose(0, 2, 1, 3)
+                .astype(q.dtype))
     axis = _axis(axis_name)
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
@@ -118,6 +138,139 @@ def ring_attention(
     )
     out = o / jnp.maximum(l, 1e-20)[..., None]  # [b, h, q, d]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+# ------------------------------------------------------ ring flash (Pallas)
+# Each ring step runs the flash-attention TPU kernel on the resident K/V
+# block; per-block (out, lse) pairs merge by logsumexp. Backward re-runs
+# the ring calling the flash dq/dk/dv kernels with the GLOBAL (out, lse) —
+# block probabilities recompute exactly, and the circulating dK/dV
+# accumulators arrive home after a full rotation (the ring-flash-attention
+# algorithm; same design as the fwd/bwd kernels in ops/flash_attention).
+
+
+def _rotate(x, axis):
+    n = jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _merge_lse(o_acc, lse_acc, o_i, lse_i):
+    """Merge normalized block outputs by their logsumexps (fp32)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+    w_a = jnp.exp(lse_acc - safe)[..., None]
+    w_i = jnp.exp(lse_i - safe)[..., None]
+    return o_acc * w_a + o_i.astype(jnp.float32) * w_i, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_flash(axis, causal, scale, q, k, v):
+    """Flattened flash ring: q [bh, s, d], k/v [bh_kv, s, d] (GQA via
+    fewer kv rows, kv-major head order as in ops.flash_attention)."""
+    return _ring_flash_fwd(axis, causal, scale, q, k, v)[0]
+
+
+def _ring_flash_block_fwd(q, kb, vb, src, rank, causal, scale, axis, interp):
+    from apex_tpu.ops.flash_attention import _flash_fwd_pallas
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    bh, s, d = q.shape
+
+    def diag(_):
+        return _flash_fwd_pallas(q, kb, vb, True, scale, 512, 512, interp)
+
+    def full(_):
+        return _flash_fwd_pallas(q, kb, vb, False, scale, 512, 512, interp)
+
+    def skip(_):
+        # zeros must carry the same vma as the kernel outputs
+        return (_to_varying(jnp.zeros((bh, s, d), q.dtype), axis),
+                _to_varying(jnp.full((bh, s), -jnp.inf, jnp.float32), axis))
+
+    if not causal:
+        return full(None)
+    return jax.lax.cond(
+        src == rank, diag,
+        lambda _: jax.lax.cond(src < rank, full, skip, None), None)
+
+
+def _ring_flash_fwd(axis, causal, scale, q, k, v):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    interp = pallas_config.interpret()
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    bh, s, d = q.shape
+
+    def step(carry, i):
+        kb, vb, o_acc, lse_acc = carry
+        src = (rank - i) % n
+        o_i, lse_i = _ring_flash_block_fwd(q, kb, vb, src, rank, causal,
+                                           scale, axis, interp)
+        o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_i, lse_i)
+        return (_rotate(kb, axis), _rotate(vb, axis), o_acc, lse_acc), None
+
+    o0 = _to_varying(jnp.zeros((bh, s, d), jnp.float32), axis)
+    lse0 = _to_varying(jnp.full((bh, s), -jnp.inf, jnp.float32), axis)
+    (_, _, o, lse), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    o = o.astype(q.dtype)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, res, do):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import _flash_bwd_pallas
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    q, k, v, o, lse = res
+    interp = pallas_config.interpret()
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    bh, s, d = q.shape
+    bh_kv = k.shape[0]
+
+    def block_bwd(kb, vb, src):
+        def diag(_):
+            return _flash_bwd_pallas(q, kb, vb, o, lse, do, True, scale,
+                                     256, 256, interp)
+
+        def full(_):
+            return _flash_bwd_pallas(q, kb, vb, o, lse, do, False, scale,
+                                     256, 256, interp)
+
+        def skip(_):
+            return (_to_varying(jnp.zeros((bh, s, d), q.dtype), axis),
+                    _to_varying(jnp.zeros((bh_kv, s, d), k.dtype), axis),
+                    _to_varying(jnp.zeros((bh_kv, s, d), v.dtype), axis))
+
+        if not causal:
+            return full(None)
+        return jax.lax.cond(
+            src == rank, diag,
+            lambda _: jax.lax.cond(src < rank, full, skip, None), None)
+
+    def step(carry, i):
+        kb, vb, dkb, dvb, dq_acc = carry
+        src = (rank - i) % n
+        dq_i, dk_i, dv_i = block_bwd(kb, vb, src)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dkb = dkb + dk_i.astype(jnp.float32)
+        dvb = dvb + dv_i.astype(jnp.float32)
+        # dK/dV accumulators travel WITH their block; after the full
+        # rotation they are home with every rank's contribution
+        return (_rotate(kb, axis), _rotate(vb, axis), _rotate(dkb, axis),
+                _rotate(dvb, axis), dq_acc), None
+
+    z_kv = _to_varying(jnp.zeros((bh_kv, s, d), jnp.float32), axis)
+    z_q = _to_varying(jnp.zeros((bh, s, d), jnp.float32), axis)
+    (_, _, dk_out, dv_out, dq_out), _ = jax.lax.scan(
+        step, (k, v, z_kv, z_kv, z_q), jnp.arange(n))
+    return (dq_out.astype(q.dtype), dk_out.astype(k.dtype),
+            dv_out.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ulysses_attention(

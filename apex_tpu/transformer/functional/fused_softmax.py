@@ -117,7 +117,7 @@ def _pallas_causal(x, scale):
     idx = lambda i, j: (i, j, 0)
     return pl.pallas_call(
         functools.partial(_causal_kernel, scale, rows, sq, sk),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=pallas_config.out_struct(x.shape, x.dtype, x),
         grid=(b, sq // rows),
         in_specs=[pl.BlockSpec(blk, idx)],
         out_specs=pl.BlockSpec(blk, idx),
@@ -214,7 +214,7 @@ def _pallas_blocked(x, mask, scale, causal):
         grid=grid,
         in_specs=in_specs,
         out_specs=[rowspec, rowspec],
-        out_shape=[jax.ShapeDtypeStruct((b, sq), jnp.float32)] * 2,
+        out_shape=[pallas_config.out_struct((b, sq), jnp.float32, *args)] * 2,
         scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32)] * 2,
         interpret=pallas_config.interpret(),
     )(*args)
@@ -224,7 +224,7 @@ def _pallas_blocked(x, mask, scale, causal):
         grid=grid,
         in_specs=in_specs + [rowspec, rowspec],
         out_specs=xspec,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=pallas_config.out_struct(x.shape, x.dtype, *args, m, l),
         interpret=pallas_config.interpret(),
     )(*args, m, l)
 
@@ -247,7 +247,7 @@ def _pallas_masked(x, mask, scale):
     idx = lambda i, j: (i, j, 0)
     out = pl.pallas_call(
         functools.partial(_masked_kernel, scale),
-        out_shape=jax.ShapeDtypeStruct(x3.shape, x.dtype),
+        out_shape=pallas_config.out_struct(x3.shape, x.dtype, x3, mask3),
         grid=(x3.shape[0], sq // rows),
         in_specs=[pl.BlockSpec(blk, idx), pl.BlockSpec(blk, idx)],
         out_specs=pl.BlockSpec(blk, idx),

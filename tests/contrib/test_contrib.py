@@ -254,6 +254,31 @@ class TestAttention:
         out = fmha_packed_qkv(qkv)
         assert out.shape == (2, 16, 4, 8)
 
+    def test_fmha_varlen_masks_padding(self):
+        """cu_seqlens/seqlens must exclude padded keys (ref fmha varlen):
+        output for the valid prefix equals attention over the truncated
+        sequence, and padded query rows are zeroed."""
+        from apex_tpu.contrib.fmha import FMHAFun
+
+        b, s, h, d = 2, 12, 2, 8
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (b, s, 3, h, d))
+        seqlens = jnp.array([12, 7])
+        cu = jnp.array([0, 12, 19])
+        out = FMHAFun.apply(qkv, cu_seqlens=cu)
+        out2 = FMHAFun.apply(qkv, seqlens=seqlens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   rtol=1e-6)
+        # batch 1, valid rows == attention over the 7-token slice
+        want = fmha(qkv[1:2, :7, 0], qkv[1:2, :7, 1], qkv[1:2, :7, 2])
+        np.testing.assert_allclose(np.asarray(out[1, :7]),
+                                   np.asarray(want[0]), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[1, 7:]), 0.0)
+        # full-length batch 0 matches the unmasked kernel
+        full = fmha(qkv[0:1, :, 0], qkv[0:1, :, 1], qkv[0:1, :, 2])
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(full[0]),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_self_mha_shapes_and_norm_add(self):
         s, b, h = 12, 2, 32
         x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h))
@@ -414,3 +439,36 @@ class TestHaloExchange:
             np.testing.assert_allclose(got[r, 0, 0], slabs[r - 1, -1])
         for r in range(0, 3):
             np.testing.assert_allclose(got[r, 0, -1], slabs[r + 1, 0])
+
+
+def test_fmha_varlen_empty_sequence_grads_finite():
+    """A zero-length sequence (legal in reference varlen batching) must
+    give finite (zero) grads, not NaN."""
+    from apex_tpu.contrib.fmha import fmha_packed_qkv
+
+    qkv = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 3, 2, 4))
+    seqlens = jnp.array([8, 0])
+
+    def loss(qkv):
+        return jnp.sum(fmha_packed_qkv(qkv, seqlens=seqlens) ** 2)
+
+    g = jax.grad(loss)(qkv)
+    assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_allclose(np.asarray(g[1]), 0.0)  # empty seq: no grad
+
+
+def test_fmha_varlen_gqa_matches_repeat():
+    from apex_tpu.contrib.fmha import fmha_packed_qkv
+
+    b, s, h, d = 2, 8, 4, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h // 2, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h // 2, d))
+    seqlens = jnp.array([8, 5])
+    from apex_tpu.contrib.fmha import _masked_dense_attention
+
+    got = _masked_dense_attention(q, k, v, seqlens, None)
+    want = _masked_dense_attention(
+        q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2), seqlens, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                               atol=1e-6)

@@ -9,8 +9,17 @@ import numpy as np
 import pytest
 
 from apex_tpu import amp
+from apex_tpu.amp._amp_state import _amp_state
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.rnn import models as rnn_models
+
+
+@pytest.fixture(autouse=True)
+def _reset_amp_handle():
+    """amp.initialize installs a process-global handle; an O1 handle would
+    leak an active policy into later boundary-casting tests."""
+    yield
+    _amp_state.handle = None
 
 
 @pytest.mark.parametrize("mode", ["LSTM", "GRU"])

@@ -1,0 +1,114 @@
+"""Checkpoint/resume (SURVEY §5; ref amp state_dict + Megatron
+save/load): params + optimizer state + amp automaton must round-trip, and
+CheckpointManager must retain only max_to_keep newest steps."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.checkpoint import (
+    CheckpointManager,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from apex_tpu.optimizers import fused_adam
+
+
+def _train_state():
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros((3,))}
+    tx = fused_adam(lr=1e-2)
+    opt_state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params, opt_state, tx
+
+
+def test_save_restore_roundtrip(tmp_path):
+    params, opt_state, _ = _train_state()
+    state = {"params": params, "opt": opt_state}
+    save_checkpoint(str(tmp_path / "ckpt"), state, step=3)
+    assert latest_step(str(tmp_path / "ckpt")) == 3
+    got = restore_checkpoint(str(tmp_path / "ckpt"), target=state)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_restore_resumes_training_identically(tmp_path):
+    """Training N steps == training k, checkpoint, restore, train N-k."""
+    params = {"w": jnp.ones((4, 4))}
+    tx = fused_adam(lr=1e-2)
+
+    def steps(params, opt_state, n, seed0):
+        for i in range(n):
+            g = {"w": jax.random.normal(jax.random.PRNGKey(seed0 + i),
+                                        (4, 4))}
+            u, opt_state = tx.update(g, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, q: p + q, params, u)
+        return params, opt_state
+
+    full, _ = steps(params, tx.init(params), 6, 0)
+
+    p3, s3 = steps(params, tx.init(params), 3, 0)
+    save_checkpoint(str(tmp_path / "c"), {"p": p3, "o": s3}, step=3)
+    got = restore_checkpoint(str(tmp_path / "c"), target={"p": p3, "o": s3})
+    resumed, _ = steps(got["p"], got["o"], 3, 3)
+    np.testing.assert_allclose(np.asarray(resumed["w"]),
+                               np.asarray(full["w"]), rtol=1e-6)
+
+
+def test_amp_state_roundtrips_through_checkpoint(tmp_path):
+    params = {"w": jnp.ones((2, 2))}
+    _, handle = amp.initialize(params, opt_level="O2", verbosity=0)
+    sstate = handle.scaler_state
+    # advance the automaton: one overflow halves the scale
+    sstate = handle.scaler.update(sstate, jnp.asarray(True))
+    save_checkpoint(str(tmp_path / "c"), {"amp": sstate}, step=0)
+    got = restore_checkpoint(str(tmp_path / "c"), target={"amp": sstate})
+    assert float(got["amp"].loss_scale) == float(sstate.loss_scale)
+    assert int(got["amp"].overflows) == 1
+
+
+def test_manager_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, {"x": jnp.asarray(float(step))})
+    assert mgr.latest_step() == 4
+    got = mgr.restore(target={"x": jnp.asarray(0.0)})
+    assert float(got["x"]) == 4.0
+    # only the 2 newest survive
+    got3 = mgr.restore(target={"x": jnp.asarray(0.0)}, step=3)
+    assert float(got3["x"]) == 3.0
+    with pytest.raises(Exception):
+        mgr.restore(target={"x": jnp.asarray(0.0)}, step=1)
+
+
+def test_master_params_track_model_params(tmp_path):
+    """ref tests/distributed/amp_master_params: after O2 steps the bf16
+    model params equal the fp32 masters within cast tolerance."""
+    params32 = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8))}
+    cast_params, handle = amp.initialize(params32, opt_level="O2",
+                                         verbosity=0)
+    policy, scaler = handle.policy, handle.scaler
+    sstate = handle.scaler_state
+    tx = fused_adam(lr=1e-2)
+    opt_state = tx.init(params32)
+
+    master = params32
+    for i in range(3):
+        g = jax.tree_util.tree_map(
+            lambda p: 0.1 * jax.random.normal(jax.random.PRNGKey(i),
+                                              p.shape), master)
+        updates, opt_state, sstate, _ = amp.scaled_update(
+            tx, scaler, g, opt_state, master, sstate)
+        master = jax.tree_util.tree_map(lambda p, u: p + u, master, updates)
+        model = policy.cast_model(master)  # bf16 view
+
+    assert jax.tree_util.tree_leaves(model)[0].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(model["w"], np.float32), np.asarray(master["w"]),
+        atol=4e-3)  # bf16 quantization of fp32 masters

@@ -116,3 +116,29 @@ def test_state_dict_roundtrip_with_groups():
     for ta, tb in zip(jax.tree_util.tree_leaves(a[1]),
                       jax.tree_util.tree_leaves(b[1])):
         np.testing.assert_allclose(np.asarray(ta), np.asarray(tb), rtol=1e-6)
+
+
+def test_scheduler_idiom_lr_mutation_takes_effect():
+    """The torch LR-scheduler idiom — writing param_groups[i]['lr'] —
+    must change the next step's update magnitude."""
+    p0 = _params(0)
+    opt = FusedAdam(p0, lr=1e-3)
+    g = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 0.1), p0)
+    new1 = opt.step(g)
+    d1 = float(jnp.max(jnp.abs(new1["w"] - p0["w"])))
+    np.testing.assert_allclose(d1, 1e-3, rtol=1e-3)  # first Adam step ~ lr
+
+    for group in opt.param_groups:
+        group["lr"] = 1e-1  # scheduler writes the group dict in place
+    new2 = opt.step(g)
+    d2 = float(jnp.max(jnp.abs(new2["w"] - new1["w"])))
+    np.testing.assert_allclose(d2, 1e-1, rtol=2e-2)
+
+    # extra groups honor it too
+    p1 = _params(1)
+    opt.add_param_group({"params": p1, "lr": 1e-3})
+    opt.param_groups[1]["lr"] = 5e-2
+    outs = opt.step([g, jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, 0.1), p1)])
+    d3 = float(jnp.max(jnp.abs(outs[1]["w"] - p1["w"])))
+    np.testing.assert_allclose(d3, 5e-2, rtol=2e-2)
