@@ -23,56 +23,18 @@ def fmha(q, k, v, causal: bool = False, scale: Optional[float] = None):
     return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _masked_dense_attention(q, k, v, seqlens, scale):
-    """[b, s, h, d] attention where batch row i only attends to its first
-    ``seqlens[i]`` keys (padded keys excluded; ref fmha varlen semantics).
-
-    fp32 softmax (the repo-wide attention accumulator policy); GQA via the
-    grouped einsum (no repeated K/V copy); the mask fill is finite so an
-    all-masked (empty) sequence stays NaN-free in forward AND backward —
-    its query rows are zeroed, which also zeroes their gradients.
-    """
-    b, s, hq, d = q.shape
-    h_kv = k.shape[2]
-    rep = hq // h_kv
-    scale = scale if scale is not None else d ** -0.5
-    q32 = q.astype(jnp.float32) * scale
-    k32 = k.astype(jnp.float32)
-    v32 = v.astype(jnp.float32)
-    key_ok = jnp.arange(s)[None, :] < seqlens[:, None]  # [b, sk]
-    neg = jnp.float32(-1e30)
-    if rep > 1:
-        qg = q32.reshape(b, s, h_kv, rep, d)
-        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k32)
-        scores = jnp.where(key_ok[:, None, None, None, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v32)
-        out = out.reshape(b, s, hq, d)
-    else:
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
-        scores = jnp.where(key_ok[:, None, None, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v32)
-    # padded QUERY rows are meaningless; zero them like the reference's
-    # varlen kernels (no garbage flows into downstream dense layers)
-    out = jnp.where(key_ok[:, :, None, None], out, 0.0)
-    return out.astype(q.dtype)
-
-
 def fmha_packed_qkv(qkv, causal: bool = False,
                     scale: Optional[float] = None, seqlens=None):
     """qkv [b, s, 3, h, d] (the reference's packed layout, batched).
 
     ``seqlens`` [b] masks per-sequence padding (the reference's varlen
-    cu_seqlens semantics on the padded-dense TPU layout).
+    cu_seqlens semantics on the padded-dense TPU layout) — handled INSIDE
+    the flash kernel, so varlen batches keep O(s·d) memory.
     """
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if seqlens is not None:
-        if causal:
-            raise NotImplementedError(
-                "causal + varlen: combine a causal attn_mask with the "
-                "key-padding path in contrib.multihead_attn")
-        return _masked_dense_attention(q, k, v, jnp.asarray(seqlens), scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               kv_lens=jnp.asarray(seqlens))
     return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
@@ -89,11 +51,12 @@ class FMHAFun:
     @staticmethod
     def apply(qkv, cu_seqlens=None, seqlens=None, p_dropout=0.0,
               max_s=None, is_training=True, zero_tensors=False):
-        del max_s, is_training, zero_tensors
-        if p_dropout:
+        del max_s, zero_tensors
+        if p_dropout and is_training:
             raise NotImplementedError(
                 "attention dropout: apply dropout to the output projection "
-                "(TPU kernels keep the softmax deterministic)")
+                "(TPU kernels keep the softmax deterministic); at eval "
+                "(is_training=False) dropout is inactive and allowed")
         if qkv.ndim != 5:
             raise ValueError(
                 "apex_tpu FMHAFun takes padded-dense qkv [b, s, 3, h, d]; "

@@ -34,8 +34,13 @@ from apex_tpu.ops import pallas_config
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
-                q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc):
+def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen,
+                q_ref, k_ref, v_ref, *refs):
+    if varlen:
+        kvlen_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        kvlen_ref = None
+        o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -55,6 +60,9 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
     if causal:
         # whole block above the diagonal ⇒ nothing to do
         run = (ki * block_k) <= (qi * block_q + block_q - 1)
+    if varlen:
+        # whole block past this sequence's keys ⇒ nothing to do
+        run = run & ((ki * block_k) < kvlen_ref[0, 0])
 
     @pl.when(run)
     def _step():
@@ -68,6 +76,8 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk,
         # mask key padding (sk not multiple of block_k)
         if sk % block_k:
             s = jnp.where(k_pos < sk, s, _NEG_INF)
+        if varlen:
+            s = jnp.where(k_pos < kvlen_ref[0, 0], s, _NEG_INF)
 
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -99,13 +109,16 @@ def _pick_block(s, target):
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                      interpret=False):
+                      interpret=False, kv_lens=None):
     """q [bh, sq, d], k/v [bh_kv, sk, d] → o [bh, sq, d].
 
     GQA: when bh_kv < bh, ``rep = bh // bh_kv`` query heads read the SAME
     k/v block via the BlockSpec index map — no repeated copy in HBM.
     Layout requirement: q heads grouped kv-major (head g*rep+r shares kv
     head g), which :func:`flash_attention` arranges.
+
+    ``kv_lens`` [bh] int32 (varlen): row b attends only to its first
+    kv_lens[b] keys; blocks entirely past the bound are skipped.
     """
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
@@ -113,16 +126,23 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
+    varlen = kv_lens is not None
 
-    kernel = functools.partial(_fwd_kernel, causal, scale, bq, bk, sq, sk)
+    kernel = functools.partial(_fwd_kernel, causal, scale, bq, bk, sq, sk,
+                               varlen)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+    ]
+    args = (q, k, v)
+    if varlen:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        args = (q, k, v, kv_lens.astype(jnp.int32).reshape(bh, 1))
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
@@ -137,13 +157,15 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
-def _reference_attention(q, k, v, causal, scale):
+def _reference_attention(q, k, v, causal, scale, kv_lens=None):
     """jnp reference — also the VJP path (rematerialized). GQA-aware:
-    q [bh, sq, d] with k/v [bh_kv, sk, d]; grouped einsum, no kv copy."""
+    q [bh, sq, d] with k/v [bh_kv, sk, d]; grouped einsum, no kv copy.
+    ``kv_lens`` [bh]: varlen key bound per row (finite fill — empty
+    sequences stay NaN-free through autodiff)."""
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     rep = bh // bh_kv
@@ -153,6 +175,10 @@ def _reference_attention(q, k, v, causal, scale):
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
         s = jnp.where(kpos <= qpos, s, _NEG_INF)
+    if kv_lens is not None:
+        ok = (jnp.arange(sk)[None, None, None, :]
+              < kv_lens.reshape(bh_kv, rep)[:, :, None, None])  # [g,r,1,sk]
+        s = jnp.where(ok, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("grqk,gkd->grqd", p, v.astype(jnp.float32))
     return o.reshape(bh, sq, d).astype(q.dtype)
@@ -166,9 +192,14 @@ def _reference_attention(q, k, v, causal, scale):
 # ever exists in HBM (ref csrc/fmha dgrad kernels).
 
 
-def _bwd_dq_kernel(causal, scale, bq, bk,
+def _bwd_dq_kernel(causal, scale, bq, bk, varlen,
                    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                   dq_ref, acc_sc):
+                   *refs):
+    if varlen:
+        kvlen_ref, dq_ref, acc_sc = refs
+    else:
+        kvlen_ref = None
+        dq_ref, acc_sc = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -180,6 +211,8 @@ def _bwd_dq_kernel(causal, scale, bq, bk,
     run = True
     if causal:
         run = (ki * bk) <= (qi * bq + bq - 1)
+    if varlen:
+        run = run & ((ki * bk) < kvlen_ref[0, 0])
 
     @pl.when(run)
     def _step():
@@ -191,12 +224,15 @@ def _bwd_dq_kernel(causal, scale, bq, bk,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
         p = jnp.exp(s - lse_ref[0][:, None])
-        if causal:
+        if causal or varlen:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
+        if causal:
             p = jnp.where(k_pos <= q_pos, p, 0.0)
+        if varlen:
+            p = jnp.where(k_pos < kvlen_ref[0, 0], p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
@@ -210,9 +246,14 @@ def _bwd_dq_kernel(causal, scale, bq, bk,
         dq_ref[0] = acc_sc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq,
+def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen,
                     q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc):
+                    *refs):
+    if varlen:
+        kvlen_ref, dk_ref, dv_ref, dk_sc, dv_sc = refs
+    else:
+        kvlen_ref = None
+        dk_ref, dv_ref, dk_sc, dv_sc = refs
     ki = pl.program_id(1)
     r = pl.program_id(2)
     qi = pl.program_id(3)
@@ -225,6 +266,8 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq,
     run = True
     if causal:
         run = (qi * bq + bq - 1) >= (ki * bk)
+    if varlen:
+        run = run & ((ki * bk) < kvlen_ref[0, 0])
 
     @pl.when(run)
     def _step():
@@ -236,12 +279,15 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
         p = jnp.exp(s - lse_ref[0][:, None])
-        if causal:
+        if causal or varlen:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
+        if causal:
             p = jnp.where(k_pos <= q_pos, p, 0.0)
+        if varlen:
+            p = jnp.where(k_pos < kvlen_ref[0, 0], p, 0.0)
         dv_sc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, d]
@@ -262,46 +308,60 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq,
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret"))
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                      interpret=False):
+                      interpret=False, kv_lens=None):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     rep = bh // bh_kv
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     nq, nk = sq // bq, sk // bk
+    varlen = kv_lens is not None
 
     # D_i = rowsum(dO * O): elementwise, O(s·d) — fine as fused XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+    ]
+    dq_args = (q, k, v, do, lse, delta)
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
+        pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
+        pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
+    ]
+    dkv_args = (q, k, v, do, lse, delta)
+    if varlen:
+        kvl = kv_lens.astype(jnp.int32).reshape(bh, 1)
+        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        dq_args = dq_args + (kvl,)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1), lambda g, j, r, i: (g * rep + r, 0)))
+        dkv_args = dkv_args + (kvl,)
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk),
+        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk, varlen),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=pallas_config.out_struct((bh, sq, d), q.dtype, q, k, v,
                                            do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal, scale, bq, bk, rep, nq),
+        functools.partial(_bwd_dkv_kernel, causal, scale, bq, bk, rep, nq,
+                          varlen),
         grid=(bh_kv, nk, rep, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
-            pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
-            pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
@@ -315,7 +375,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -352,11 +412,52 @@ def _flash_bwd(causal, scale, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# varlen (kv_lens-bounded) flavor: same kernels, masked to each row's key
+# count — the reference's cu_seqlens semantics with flash memory behavior.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _flash_varlen(causal, scale, q, k, v, kv_lens):
+    return _flash_varlen_fwd(causal, scale, q, k, v, kv_lens)[0]
+
+
+def _flash_varlen_fwd(causal, scale, q, k, v, kv_lens):
+    if _use_pallas():
+        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+                                   pallas_config.interpret(),
+                                   kv_lens=kv_lens)
+        return o, (q, k, v, kv_lens, o, lse)
+    o = _reference_attention(q, k, v, causal, scale, kv_lens=kv_lens)
+    return o, (q, k, v, kv_lens, None, None)
+
+
+def _flash_varlen_bwd(causal, scale, res, g):
+    import numpy as _np
+
+    q, k, v, kv_lens, o, lse = res
+    if lse is not None:
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
+                                       256, 256, pallas_config.interpret(),
+                                       kv_lens=kv_lens)
+    else:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference_attention(q, k, v, causal, scale,
+                                                 kv_lens=kv_lens), q, k, v)
+        dq, dk, dv = vjp(g)
+    dlens = _np.zeros(kv_lens.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, kv_lens=None):
     """Fused attention on [b, s, h, d] (heads may differ for k/v — GQA).
 
     Returns [b, sq, h, d]; fp32 softmax internally, output in q's dtype.
+    ``kv_lens`` [b] int32 bounds each sequence's keys (varlen batching —
+    ref fmha cu_seqlens); padded QUERY rows of the output are zeroed.
     """
     b, sq, h, d = q.shape
     h_kv = k.shape[2]
@@ -370,5 +471,13 @@ def flash_attention(q, k, v, causal: bool = False,
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
-    o = _flash(qt, kt, vt, causal, float(scale))
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if kv_lens is None:
+        o = _flash(qt, kt, vt, causal, float(scale))
+        return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    kv_lens = jnp.asarray(kv_lens, jnp.int32)
+    o = _flash_varlen(causal, float(scale), qt, kt, vt,
+                      jnp.repeat(kv_lens, h))
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    # zero meaningless padded-query rows (and their gradients)
+    q_ok = jnp.arange(sq)[None, :] < kv_lens[:, None]
+    return jnp.where(q_ok[:, :, None, None], o, 0.0)

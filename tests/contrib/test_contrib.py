@@ -457,18 +457,65 @@ def test_fmha_varlen_empty_sequence_grads_finite():
     np.testing.assert_allclose(np.asarray(g[1]), 0.0)  # empty seq: no grad
 
 
-def test_fmha_varlen_gqa_matches_repeat():
-    from apex_tpu.contrib.fmha import fmha_packed_qkv
+def _varlen_reference(q, k, v, seqlens):
+    """Independent dense reference for varlen attention."""
+    b, s, h, d = q.shape
+    if k.shape[2] != h:
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    ok = jnp.arange(s)[None, :] < seqlens[:, None]
+    scores = jnp.where(ok[:, None, None, :], scores, -1e30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+    return jnp.where(ok[:, :, None, None], out, 0.0)
+
+
+def test_fmha_varlen_gqa_matches_reference():
+    from apex_tpu.ops.flash_attention import flash_attention
 
     b, s, h, d = 2, 8, 4, 8
     q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
     k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h // 2, d))
     v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h // 2, d))
     seqlens = jnp.array([8, 5])
-    from apex_tpu.contrib.fmha import _masked_dense_attention
-
-    got = _masked_dense_attention(q, k, v, seqlens, None)
-    want = _masked_dense_attention(
-        q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2), seqlens, None)
+    got = flash_attention(q, k, v, kv_lens=seqlens)
+    want = _varlen_reference(q, k, v, seqlens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_fmha_varlen_pallas_kernel_matches():
+    """The in-kernel kv_lens bound (interpret mode) must match the jnp
+    fallback, forward and backward, including an empty sequence."""
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 2, 64, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+    seqlens = jnp.array([64, 0])
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, kv_lens=seqlens) ** 2)
+
+    ref_out = flash_attention(q, k, v, kv_lens=seqlens)
+    ref_g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    with pallas_config.force("interpret"):
+        out = flash_attention(q, k, v, kv_lens=seqlens)
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-4, atol=1e-5)
+    for name, a, bb in zip("qkv", g, ref_g):
+        assert np.isfinite(np.asarray(a)).all(), f"d{name} not finite"
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name}")
+    # ragged middle length through the blocked kernel too
+    seqlens2 = jnp.array([37, 64])
+
+    with pallas_config.force("interpret"):
+        out2 = flash_attention(q, k, v, kv_lens=seqlens2)
+    want2 = _varlen_reference(q, k, v, seqlens2)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(want2),
+                               rtol=1e-4, atol=1e-5)
