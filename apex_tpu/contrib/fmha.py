@@ -18,13 +18,23 @@ import jax.numpy as jnp
 from apex_tpu.ops.flash_attention import flash_attention
 
 
-def fmha(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """[b, s, h, d] fused attention (flash; no s×s HBM materialization)."""
-    return flash_attention(q, k, v, causal=causal, scale=scale)
+def fmha(q, k, v, causal: bool = False, scale: Optional[float] = None,
+         dropout_p: float = 0.0, dropout_key=None,
+         deterministic: bool = False):
+    """[b, s, h, d] fused attention (flash; no s×s HBM materialization).
+
+    ``dropout_p`` drops softmax probs inside the kernel (ref
+    fmha.py:35 p_dropout); pass ``dropout_key`` when training.
+    """
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           dropout_p=dropout_p, dropout_key=dropout_key,
+                           deterministic=deterministic)
 
 
 def fmha_packed_qkv(qkv, causal: bool = False,
-                    scale: Optional[float] = None, seqlens=None):
+                    scale: Optional[float] = None, seqlens=None,
+                    dropout_p: float = 0.0, dropout_key=None,
+                    deterministic: bool = False):
     """qkv [b, s, 3, h, d] (the reference's packed layout, batched).
 
     ``seqlens`` [b] masks per-sequence padding (the reference's varlen
@@ -32,10 +42,11 @@ def fmha_packed_qkv(qkv, causal: bool = False,
     the flash kernel, so varlen batches keep O(s·d) memory.
     """
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if seqlens is not None:
-        return flash_attention(q, k, v, causal=causal, scale=scale,
-                               kv_lens=jnp.asarray(seqlens))
-    return flash_attention(q, k, v, causal=causal, scale=scale)
+    kv_lens = jnp.asarray(seqlens) if seqlens is not None else None
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           kv_lens=kv_lens, dropout_p=dropout_p,
+                           dropout_key=dropout_key,
+                           deterministic=deterministic)
 
 
 class FMHAFun:
@@ -50,13 +61,15 @@ class FMHAFun:
 
     @staticmethod
     def apply(qkv, cu_seqlens=None, seqlens=None, p_dropout=0.0,
-              max_s=None, is_training=True, zero_tensors=False):
+              max_s=None, is_training=True, zero_tensors=False,
+              dropout_key=None):
+        """``p_dropout`` drops softmax probs in the kernel (ref
+        fmha.py:35). Stateless RNG: pass a FRESH ``dropout_key`` (jax PRNG
+        key) every step — the torch reference reads global CUDA RNG state,
+        which does not exist in a functional framework, so the key is a
+        required training-time argument (same contract as flax ``rngs``).
+        """
         del max_s, zero_tensors
-        if p_dropout and is_training:
-            raise NotImplementedError(
-                "attention dropout: apply dropout to the output projection "
-                "(TPU kernels keep the softmax deterministic); at eval "
-                "(is_training=False) dropout is inactive and allowed")
         if qkv.ndim != 5:
             raise ValueError(
                 "apex_tpu FMHAFun takes padded-dense qkv [b, s, 3, h, d]; "
@@ -65,4 +78,12 @@ class FMHAFun:
         if seqlens is None and cu_seqlens is not None:
             cu = jnp.asarray(cu_seqlens)
             seqlens = cu[1:] - cu[:-1]
-        return fmha_packed_qkv(qkv, seqlens=seqlens)
+        if p_dropout and is_training and dropout_key is None:
+            raise ValueError(
+                "FMHAFun.apply with p_dropout in training needs "
+                "dropout_key (a jax PRNG key, fresh each step) — a fixed "
+                "implicit key would repeat the same dropout mask every "
+                "step and silently bias training")
+        return fmha_packed_qkv(qkv, seqlens=seqlens, dropout_p=p_dropout,
+                               dropout_key=dropout_key,
+                               deterministic=not is_training)

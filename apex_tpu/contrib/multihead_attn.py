@@ -21,7 +21,8 @@ from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
 
 
-def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale):
+def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale,
+                      dropout_p=0.0, dropout_rng=None):
     """[b, s, h, d] attention with torch-style masks (ref
     self_multihead_attn.py:144-156):
 
@@ -29,6 +30,8 @@ def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale):
       from every query's softmax.
     - ``attn_mask`` [sq, sk], bool (True = masked) or additive float
       (-inf = masked), applied to every batch/head.
+    - ``dropout_p``/``dropout_rng``: inverted dropout on the softmax
+      probabilities (ref self_multihead_attn_func.py:100 fused dropout).
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
     b, _, sq, sk = scores.shape
@@ -48,6 +51,10 @@ def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale):
         else:  # additive float mask: fold into the (scaled) scores
             scores = scores + attn_mask[None, None, :, :] / scale
     probs = scaled_masked_softmax(scores, mask, scale).astype(v.dtype)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -86,19 +93,23 @@ class SelfMultiheadAttn(nn.Module):
         def heads_first(t):
             return t.transpose(1, 0, 2).reshape(b, s, self.heads, d)
 
+        # dropout applies to the softmax PROBS (ref
+        # self_multihead_attn_func.py:100), not the output projection
+        det = (not is_training) if deterministic is None else deterministic
+        drop = 0.0 if det else self.dropout
+        rng = self.make_rng("dropout") if drop > 0.0 else None
         if key_padding_mask is not None or attn_mask is not None:
             o = _masked_attention(heads_first(q), heads_first(k),
                                   heads_first(v), key_padding_mask,
-                                  attn_mask, d ** -0.5)
+                                  attn_mask, d ** -0.5,
+                                  dropout_p=drop, dropout_rng=rng)
         else:
             o = flash_attention(heads_first(q), heads_first(k),
                                 heads_first(v), causal=False,
-                                scale=d ** -0.5)
+                                scale=d ** -0.5, dropout_p=drop,
+                                dropout_key=rng, deterministic=det)
         o = o.reshape(b, s, h).transpose(1, 0, 2)
         o = nn.Dense(h, use_bias=self.bias, name="out_proj")(o)
-        if self.dropout > 0.0:
-            det = (not is_training) if deterministic is None else deterministic
-            o = nn.Dropout(self.dropout, deterministic=det)(o)
         if self.include_norm_add:
             o = o + query  # fused residual add (ref *_norm_add backward)
         return o
@@ -131,12 +142,14 @@ class EncdecMultiheadAttn(nn.Module):
         q4 = q.transpose(1, 0, 2).reshape(b, sq, self.heads, d)
         k4 = k.transpose(1, 0, 2).reshape(b, sk, self.heads, d)
         v4 = v.transpose(1, 0, 2).reshape(b, sk, self.heads, d)
-        o = flash_attention(q4, k4, v4, causal=False, scale=d ** -0.5)
+        det = (not is_training) if deterministic is None else deterministic
+        drop = 0.0 if det else self.dropout
+        rng = self.make_rng("dropout") if drop > 0.0 else None
+        o = flash_attention(q4, k4, v4, causal=False, scale=d ** -0.5,
+                            dropout_p=drop, dropout_key=rng,
+                            deterministic=det)
         o = o.reshape(b, sq, h).transpose(1, 0, 2)
         o = nn.Dense(h, use_bias=self.bias, name="out_proj")(o)
-        if self.dropout > 0.0:
-            det = (not is_training) if deterministic is None else deterministic
-            o = nn.Dropout(self.dropout, deterministic=det)(o)
         if self.include_norm_add:
             o = o + query
         return o

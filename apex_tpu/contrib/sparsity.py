@@ -50,6 +50,131 @@ def create_mask(w, pattern: str = "m4n2_1d"):
     raise ValueError(f"unknown pattern {pattern}")
 
 
+# --------------------------------------------------------------- permutation
+# Channel-permutation search (ref apex/contrib/sparsity/permutation_lib.py +
+# permutation_search_kernels/): an N:M mask must keep n-of-m CONSECUTIVE
+# channels, so when large-magnitude channels cluster in one group the mask
+# is forced to drop some of them. Permuting input channels regroups them;
+# the reference searches permutations with CUDA kernels, here a host-side
+# numpy search (sort+deal seeding, then bounded best-improvement column
+# swaps) runs once offline, like the reference's apply-time search.
+
+
+def _group_retained(cols: "np.ndarray", n: int):
+    """Total magnitude kept by n-of-m on [rows, m] group columns."""
+    import numpy as np
+
+    s = np.sort(np.abs(cols), axis=1)[:, -n:]
+    return float(s.sum())
+
+
+def find_channel_permutation(w, m: int = 4, n: int = 2, iters: int = 200,
+                             pairs_per_iter: int = 2048, seed: int = 0):
+    """Permutation of w's LAST dim maximizing n:m retained magnitude.
+
+    Seeding: columns sorted by L1 norm are dealt round-robin across groups
+    (spreads heavy channels). Refinement: bounded best-improvement search
+    over sampled cross-group column swaps (the reference's
+    permutation_search_kernels do the same exchange moves exhaustively on
+    GPU). Returns an int array ``perm`` such that ``w[..., perm]`` is the
+    permuted layout.
+    """
+    import numpy as np
+
+    w2 = np.asarray(jax.device_get(w), np.float64).reshape(-1, w.shape[-1])
+    # bound the search cost on huge matrices: a deterministic row
+    # subsample drives the SEARCH objective (the final mask is computed on
+    # the full matrix either way; the reference's GPU kernels bound cost
+    # with a time budget instead)
+    max_rows = 4096
+    if w2.shape[0] > max_rows:
+        stride = -(-w2.shape[0] // max_rows)
+        w2 = w2[::stride]
+    C = w2.shape[1]
+    if C % m:
+        raise ValueError(f"channels {C} not divisible by m={m}")
+    G = C // m
+
+    order = np.argsort(-np.abs(w2).sum(0), kind="stable")
+    perm = np.empty(C, dtype=np.int64)
+    for i, c in enumerate(order):
+        g, slot = i % G, i // G
+        perm[g * m + slot] = c
+
+    if G < 2:
+        return perm
+
+    rng = np.random.default_rng(seed)
+    cur = w2[:, perm]
+    ret = np.array([_group_retained(cur[:, g * m:(g + 1) * m], n)
+                    for g in range(G)])
+
+    # chunk candidate evaluation so peak memory stays ~[rows, chunk, m]
+    chunk = max(1, min(pairs_per_iter,
+                       (8 << 20) // max(1, w2.shape[0] * m * 8)))
+
+    misses = 0
+    for _ in range(iters):
+        # sample cross-group position pairs (i, j)
+        i = rng.integers(0, C, pairs_per_iter)
+        j = rng.integers(0, C, pairs_per_iter)
+        ok = (i // m) != (j // m)
+        i, j = i[ok], j[ok]
+        if i.size == 0:
+            continue
+        gi, gj = i // m, j // m
+
+        def retained(cand):
+            s = np.sort(np.abs(cand), axis=2)[:, :, -n:]
+            return s.sum(axis=(0, 2))                         # [P]
+
+        delta = np.empty(i.size)
+        for c0 in range(0, i.size, chunk):
+            sl = slice(c0, min(c0 + chunk, i.size))
+            idx_i = gi[sl, None] * m + np.arange(m)[None, :]  # [p, m]
+            idx_j = gj[sl, None] * m + np.arange(m)[None, :]
+            cand_i = cur[:, idx_i].copy()                     # [rows, p, m]
+            cand_j = cur[:, idx_j].copy()
+            p_n = idx_i.shape[0]
+            cand_i[:, np.arange(p_n), i[sl] % m] = cur[:, j[sl]]
+            cand_j[:, np.arange(p_n), j[sl] % m] = cur[:, i[sl]]
+            delta[sl] = (retained(cand_i) + retained(cand_j)
+                         - ret[gi[sl]] - ret[gj[sl]])
+        best = int(np.argmax(delta))
+        if delta[best] <= 1e-12:
+            misses += 1
+            if misses >= 3:
+                break
+            continue
+        misses = 0
+        bi, bj = int(i[best]), int(j[best])
+        perm[bi], perm[bj] = perm[bj], perm[bi]
+        cur[:, [bi, bj]] = cur[:, [bj, bi]]
+        for g in (bi // m, bj // m):
+            ret[g] = _group_retained(cur[:, g * m:(g + 1) * m], n)
+    return perm
+
+
+def permuted_mn_mask(w, m: int = 4, n: int = 2, **search_kw):
+    """n:m mask in w's ORIGINAL layout that is n:m-structured under the
+    searched channel permutation (ref permutation_lib.py semantics: the
+    reference physically permutes the weights and compensates neighboring
+    layers; functionally the inverse-permuted mask retains the identical
+    magnitude). Returns (mask, perm)."""
+    import numpy as np
+
+    perm = find_channel_permutation(w, m, n, **search_kw)
+    mask_p = mn_1d_mask(w[..., perm], m, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return mask_p[..., inv], perm
+
+
+def retained_magnitude(w, mask) -> float:
+    """Total |w| kept by the mask (the permutation-search objective)."""
+    return float(jnp.sum(jnp.abs(w) * mask.astype(w.dtype)))
+
+
 def apply_masks(params, masks):
     """w * mask over the tree (the reference's in-place hook, functional)."""
     return jax.tree_util.tree_map(
@@ -90,14 +215,23 @@ class ASP:
 
     @staticmethod
     def compute_sparse_masks(params, pattern: str = "m4n2_1d",
-                             eligible: Optional[Callable] = None):
+                             eligible: Optional[Callable] = None,
+                             allow_permutation: bool = False,
+                             **search_kw):
+        """``allow_permutation=True`` runs the channel-permutation search
+        per eligible weight (ref asp.py allow_permutation +
+        permutation_lib.py) — masks retain >= the naive pattern's
+        magnitude, at offline search cost."""
         elig = eligible or ASP._eligible
 
         def mk(path, leaf):
             name = jax.tree_util.keystr(path)
-            if elig(name, leaf):
-                return create_mask(leaf, pattern)
-            return None
+            if not elig(name, leaf):
+                return None
+            if allow_permutation and pattern == "m4n2_1d":
+                mask, _ = permuted_mn_mask(leaf, 4, 2, **search_kw)
+                return mask
+            return create_mask(leaf, pattern)
 
         return jax.tree_util.tree_map_with_path(mk, params)
 

@@ -34,13 +34,41 @@ from apex_tpu.ops import pallas_config
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen,
+def _keep_mask(seed, bh, q_pos, k_pos, p_drop):
+    """Counter-based Bernoulli keep mask for attention dropout.
+
+    Deterministic in the ABSOLUTE (head, query, key) coordinates — the
+    forward and backward kernels run different block grids, so a stateful
+    per-block PRNG could not reproduce the same mask; a murmur3-finalized
+    hash of the position counter can, from any tiling (ref
+    apex/contrib/fmha/fmha.py:35 threads p_dropout through the fused
+    kernel; philox counters play this role in the CUDA kernels).
+    Pure elementwise uint32 math: runs identically inside a Pallas kernel
+    and in the jnp fallback path.
+    """
+    x = (k_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + q_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         + bh.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         + seed.astype(jnp.uint32))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    # compare in the positive-int31 domain: a logical >>1 makes the value
+    # fit signed int32, so the threshold test never depends on how the
+    # backend treats unsigned comparisons (Mosaic-safe)
+    x31 = (x >> jnp.uint32(1)).astype(jnp.int32)
+    return x31 > jnp.int32(min(int(p_drop * 2147483648.0), 2147483647))
+
+
+def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen, p_drop,
                 q_ref, k_ref, v_ref, *refs):
-    if varlen:
-        kvlen_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
-    else:
-        kvlen_ref = None
-        o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
+    refs = list(refs)
+    kvlen_ref = refs.pop(0) if varlen else None
+    seed_ref = refs.pop(0) if p_drop else None
+    o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
+    bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -86,8 +114,16 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen,
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         l_sc[:, 0] = l_sc[:, 0] * alpha + jnp.sum(p, axis=-1)
+        # dropout applies to the NORMALIZED probs (torch semantics:
+        # dropout(softmax) @ v), so the numerator is masked+rescaled while
+        # the normalizer l accumulates the raw probs
+        pv = p
+        if p_drop:
+            keep = _keep_mask(seed_ref[0, 0], bh_idx.astype(jnp.uint32),
+                              q_pos, k_pos, p_drop)
+            pv = jnp.where(keep, p / (1.0 - p_drop), 0.0)
         acc_sc[:] = acc_sc[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            pv, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_sc[:, 0] = m_new
 
@@ -107,9 +143,10 @@ def _pick_block(s, target):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "p_drop"))
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                      interpret=False, kv_lens=None):
+                      interpret=False, kv_lens=None, p_drop=0.0, seed=None):
     """q [bh, sq, d], k/v [bh_kv, sk, d] → o [bh, sq, d].
 
     GQA: when bh_kv < bh, ``rep = bh // bh_kv`` query heads read the SAME
@@ -118,7 +155,12 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     head g), which :func:`flash_attention` arranges.
 
     ``kv_lens`` [bh] int32 (varlen): row b attends only to its first
-    kv_lens[b] keys; blocks entirely past the bound are skipped.
+    kv_lens[b] keys; blocks entirely past the bound are skipped. The
+    length rides as a (1, 1) VMEM block per row; scalar prefetch (SMEM via
+    PrefetchScalarGridSpec) would let Mosaic skip the block FETCH too, but
+    needs per-shape grid plumbing — revisit if varlen profiles hot. The
+    compiled-Mosaic behavior of this sub-tile scalar block is exercised by
+    bench.py's hardware kernel runs (round-3).
     """
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
@@ -129,7 +171,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     varlen = kv_lens is not None
 
     kernel = functools.partial(_fwd_kernel, causal, scale, bq, bk, sq, sk,
-                               varlen)
+                               varlen, p_drop)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
@@ -138,7 +180,10 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     args = (q, k, v)
     if varlen:
         in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
-        args = (q, k, v, kv_lens.astype(jnp.int32).reshape(bh, 1))
+        args = args + (kv_lens.astype(jnp.int32).reshape(bh, 1),)
+    if p_drop:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (0, 0)))
+        args = args + (seed.astype(jnp.uint32).reshape(1, 1),)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -161,11 +206,14 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     return o, lse
 
 
-def _reference_attention(q, k, v, causal, scale, kv_lens=None):
+def _reference_attention(q, k, v, causal, scale, kv_lens=None, p_drop=0.0,
+                         seed=None):
     """jnp reference — also the VJP path (rematerialized). GQA-aware:
     q [bh, sq, d] with k/v [bh_kv, sk, d]; grouped einsum, no kv copy.
     ``kv_lens`` [bh]: varlen key bound per row (finite fill — empty
-    sequences stay NaN-free through autodiff)."""
+    sequences stay NaN-free through autodiff). Dropout uses the SAME
+    counter-based mask as the Pallas kernels, so both backends produce
+    bit-identical masks for a given seed."""
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     rep = bh // bh_kv
@@ -180,6 +228,15 @@ def _reference_attention(q, k, v, causal, scale, kv_lens=None):
               < kv_lens.reshape(bh_kv, rep)[:, :, None, None])  # [g,r,1,sk]
         s = jnp.where(ok, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if p_drop:
+        bh_idx = (jnp.arange(bh_kv, dtype=jnp.uint32)[:, None]
+                  * jnp.uint32(rep)
+                  + jnp.arange(rep, dtype=jnp.uint32)[None, :])
+        keep = _keep_mask(
+            seed, bh_idx[:, :, None, None],
+            jnp.arange(sq, dtype=jnp.uint32)[None, None, :, None],
+            jnp.arange(sk, dtype=jnp.uint32)[None, None, None, :], p_drop)
+        p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
     o = jnp.einsum("grqk,gkd->grqd", p, v.astype(jnp.float32))
     return o.reshape(bh, sq, d).astype(q.dtype)
 
@@ -192,14 +249,14 @@ def _reference_attention(q, k, v, causal, scale, kv_lens=None):
 # ever exists in HBM (ref csrc/fmha dgrad kernels).
 
 
-def _bwd_dq_kernel(causal, scale, bq, bk, varlen,
+def _bwd_dq_kernel(causal, scale, bq, bk, varlen, p_drop,
                    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                    *refs):
-    if varlen:
-        kvlen_ref, dq_ref, acc_sc = refs
-    else:
-        kvlen_ref = None
-        dq_ref, acc_sc = refs
+    refs = list(refs)
+    kvlen_ref = refs.pop(0) if varlen else None
+    seed_ref = refs.pop(0) if p_drop else None
+    dq_ref, acc_sc = refs
+    bh_idx = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -224,7 +281,7 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
         p = jnp.exp(s - lse_ref[0][:, None])
-        if causal or varlen:
+        if causal or varlen or p_drop:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
@@ -236,6 +293,13 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
+        if p_drop:
+            # o = (p∘m)@v with m = keep/(1-pd): dL/dp = m∘(do@vᵀ), and the
+            # softmax-backward row term stays D = rowsum(do∘o) because
+            # Σ_k p_k m_k (do·v_k) = do·o — only dp gets masked
+            keep = _keep_mask(seed_ref[0, 0], bh_idx.astype(jnp.uint32),
+                              q_pos, k_pos, p_drop)
+            dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
         ds = p * (dp - dl_ref[0][:, None]) * scale
         acc_sc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -246,14 +310,14 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen,
         dq_ref[0] = acc_sc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen,
+def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen, p_drop,
                     q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                     *refs):
-    if varlen:
-        kvlen_ref, dk_ref, dv_ref, dk_sc, dv_sc = refs
-    else:
-        kvlen_ref = None
-        dk_ref, dv_ref, dk_sc, dv_sc = refs
+    refs = list(refs)
+    kvlen_ref = refs.pop(0) if varlen else None
+    seed_ref = refs.pop(0) if p_drop else None
+    dk_ref, dv_ref, dk_sc, dv_sc = refs
+    g_idx = pl.program_id(0)
     ki = pl.program_id(1)
     r = pl.program_id(2)
     qi = pl.program_id(3)
@@ -279,7 +343,7 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
         p = jnp.exp(s - lse_ref[0][:, None])
-        if causal or varlen:
+        if causal or varlen or p_drop:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
@@ -288,12 +352,21 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen,
             p = jnp.where(k_pos <= q_pos, p, 0.0)
         if varlen:
             p = jnp.where(k_pos < kvlen_ref[0, 0], p, 0.0)
+        if p_drop:
+            # same counter-based mask as the forward: bh = g*rep + r here
+            bh_idx = (g_idx * rep + r).astype(jnp.uint32)
+            keep = _keep_mask(seed_ref[0, 0], bh_idx, q_pos, k_pos, p_drop)
+            pm = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+        else:
+            pm = p
         dv_sc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pm, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if p_drop:
+            dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
         ds = p * (dp - dl_ref[0][:, None]) * scale
         dk_sc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -306,9 +379,10 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "p_drop"))
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                      interpret=False, kv_lens=None):
+                      interpret=False, kv_lens=None, p_drop=0.0, seed=None):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     rep = bh // bh_kv
@@ -345,9 +419,17 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         dkv_in_specs.append(
             pl.BlockSpec((1, 1), lambda g, j, r, i: (g * rep + r, 0)))
         dkv_args = dkv_args + (kvl,)
+    if p_drop:
+        sd = seed.astype(jnp.uint32).reshape(1, 1)
+        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (0, 0)))
+        dq_args = dq_args + (sd,)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1), lambda g, j, r, i: (0, 0)))
+        dkv_args = dkv_args + (sd,)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk, varlen),
+        functools.partial(_bwd_dq_kernel, causal, scale, bq, bk, varlen,
+                          p_drop),
         grid=(bh, nq, nk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -359,7 +441,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal, scale, bq, bk, rep, nq,
-                          varlen),
+                          varlen, p_drop),
         grid=(bh_kv, nk, rep, nq),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -383,17 +465,24 @@ def _use_pallas() -> bool:
     return pallas_config.use_pallas()
 
 
+def _blocks(kind, q, k):
+    return pallas_config.flash_blocks(kind, q.shape[1], k.shape[1],
+                                      q.shape[2])
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     if _use_pallas():
-        return _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+        bq, bk = _blocks("fwd", q, k)
+        return _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
                                  pallas_config.interpret())[0]
     return _reference_attention(q, k, v, causal, scale)
 
 
 def _flash_fwd(q, k, v, causal, scale):
     if _use_pallas():
-        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+        bq, bk = _blocks("fwd", q, k)
+        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
                                    pallas_config.interpret())
         return o, (q, k, v, o, lse)
     return _reference_attention(q, k, v, causal, scale), (q, k, v, None, None)
@@ -402,7 +491,8 @@ def _flash_fwd(q, k, v, causal, scale):
 def _flash_bwd(causal, scale, res, g):
     q, k, v, o, lse = res
     if lse is not None:
-        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, 256, 256,
+        bq, bk = _blocks("bwd", q, k)
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale, bq, bk,
                                  pallas_config.interpret())
     _, vjp = jax.vjp(
         lambda q, k, v: _reference_attention(q, k, v, causal, scale), q, k, v)
@@ -412,59 +502,141 @@ def _flash_bwd(causal, scale, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# dropout flavor (ref apex/contrib/fmha/fmha.py:35 p_dropout): the seed
+# rides as a traced uint32 so changing it does NOT retrace; the mask is
+# recomputed in the backward kernels from the same counter hash.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_dropout(q, k, v, seed, causal, scale, p_drop):
+    return _flash_dropout_fwd(q, k, v, seed, causal, scale, p_drop)[0]
+
+
+def _flash_dropout_fwd(q, k, v, seed, causal, scale, p_drop):
+    if _use_pallas():
+        bq, bk = _blocks("fwd", q, k)
+        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
+                                   pallas_config.interpret(),
+                                   p_drop=p_drop, seed=seed)
+        return o, (q, k, v, seed, o, lse)
+    o = _reference_attention(q, k, v, causal, scale, p_drop=p_drop,
+                             seed=seed)
+    return o, (q, k, v, seed, None, None)
+
+
+def _flash_dropout_bwd(causal, scale, p_drop, res, g):
+    import numpy as _np
+
+    q, k, v, seed, o, lse = res
+    if lse is not None:
+        bq, bk = _blocks("bwd", q, k)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
+                                       bq, bk, pallas_config.interpret(),
+                                       p_drop=p_drop, seed=seed)
+    else:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference_attention(
+                q, k, v, causal, scale, p_drop=p_drop, seed=seed), q, k, v)
+        dq, dk, dv = vjp(g)
+    dseed = _np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed
+
+
+_flash_dropout.defvjp(_flash_dropout_fwd, _flash_dropout_bwd)
+
+
 # varlen (kv_lens-bounded) flavor: same kernels, masked to each row's key
 # count — the reference's cu_seqlens semantics with flash memory behavior.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _flash_varlen(causal, scale, q, k, v, kv_lens):
-    return _flash_varlen_fwd(causal, scale, q, k, v, kv_lens)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash_varlen(causal, scale, p_drop, q, k, v, kv_lens, seed):
+    return _flash_varlen_fwd(causal, scale, p_drop, q, k, v, kv_lens,
+                             seed)[0]
 
 
-def _flash_varlen_fwd(causal, scale, q, k, v, kv_lens):
+def _flash_varlen_fwd(causal, scale, p_drop, q, k, v, kv_lens, seed):
     if _use_pallas():
-        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, 512, 512,
+        bq, bk = _blocks("fwd", q, k)
+        o, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
                                    pallas_config.interpret(),
-                                   kv_lens=kv_lens)
-        return o, (q, k, v, kv_lens, o, lse)
-    o = _reference_attention(q, k, v, causal, scale, kv_lens=kv_lens)
-    return o, (q, k, v, kv_lens, None, None)
+                                   kv_lens=kv_lens, p_drop=p_drop,
+                                   seed=seed)
+        return o, (q, k, v, kv_lens, seed, o, lse)
+    o = _reference_attention(q, k, v, causal, scale, kv_lens=kv_lens,
+                             p_drop=p_drop, seed=seed)
+    return o, (q, k, v, kv_lens, seed, None, None)
 
 
-def _flash_varlen_bwd(causal, scale, res, g):
+def _flash_varlen_bwd(causal, scale, p_drop, res, g):
     import numpy as _np
 
-    q, k, v, kv_lens, o, lse = res
+    q, k, v, kv_lens, seed, o, lse = res
     if lse is not None:
+        bq, bk = _blocks("bwd", q, k)
         dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
-                                       256, 256, pallas_config.interpret(),
-                                       kv_lens=kv_lens)
+                                       bq, bk, pallas_config.interpret(),
+                                       kv_lens=kv_lens, p_drop=p_drop,
+                                       seed=seed)
     else:
         _, vjp = jax.vjp(
             lambda q, k, v: _reference_attention(q, k, v, causal, scale,
-                                                 kv_lens=kv_lens), q, k, v)
+                                                 kv_lens=kv_lens,
+                                                 p_drop=p_drop, seed=seed),
+            q, k, v)
         dq, dk, dv = vjp(g)
     dlens = _np.zeros(kv_lens.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlens
+    dseed = _np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlens, dseed
 
 
 _flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
 
 
+def _dropout_seed(dropout_key):
+    """uint32 kernel seed from a jax PRNG key (traced, so a fresh key per
+    step does not retrace)."""
+    try:
+        return jax.random.bits(dropout_key, (), jnp.uint32)
+    except (AttributeError, TypeError):  # older jax without random.bits
+        return jax.random.randint(
+            dropout_key, (), 0, jnp.iinfo(jnp.int32).max).astype(jnp.uint32)
+
+
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, kv_lens=None):
+                    scale: Optional[float] = None, kv_lens=None,
+                    dropout_p: float = 0.0, dropout_key=None,
+                    deterministic: bool = False):
     """Fused attention on [b, s, h, d] (heads may differ for k/v — GQA).
 
     Returns [b, sq, h, d]; fp32 softmax internally, output in q's dtype.
     ``kv_lens`` [b] int32 bounds each sequence's keys (varlen batching —
     ref fmha cu_seqlens); padded QUERY rows of the output are zeroed.
+    The varlen path is SELF-attention only (one shared length per row
+    bounds both queries and keys, so it requires sq == sk); cross-attention
+    with separate q/kv lengths is not expressible with a single kv_lens.
+
+    ``dropout_p`` drops SOFTMAX PROBABILITIES inside the kernel (inverted
+    dropout, ref apex/contrib/fmha/fmha.py:35 p_dropout) — requires
+    ``dropout_key`` (jax PRNG key) unless ``deterministic`` is set, in
+    which case dropout is a no-op (eval mode).
     """
     b, sq, h, d = q.shape
     h_kv = k.shape[2]
     if h % h_kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     sk = k.shape[1]
+    if kv_lens is not None and sq != sk:
+        raise ValueError(
+            f"kv_lens implies self-attention (shared per-row length) but "
+            f"sq={sq} != sk={sk}; cross-attention varlen needs separate "
+            f"q_lens/kv_lens, which this kernel does not support")
     scale = scale if scale is not None else 1.0 / d ** 0.5
+    p_drop = 0.0 if deterministic else float(dropout_p)
+    if p_drop and dropout_key is None:
+        raise ValueError(
+            "dropout_p > 0 in training needs dropout_key (jax PRNG key); "
+            "pass deterministic=True for eval")
 
     # heads-major flatten; q head g*rep+r shares kv head g (standard GQA
     # head order), matching the kernel's b//rep kv indexing
@@ -472,11 +644,17 @@ def flash_attention(q, k, v, causal: bool = False,
     kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, sk, d)
     if kv_lens is None:
-        o = _flash(qt, kt, vt, causal, float(scale))
+        if p_drop:
+            o = _flash_dropout(qt, kt, vt, _dropout_seed(dropout_key),
+                               causal, float(scale), p_drop)
+        else:
+            o = _flash(qt, kt, vt, causal, float(scale))
         return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     kv_lens = jnp.asarray(kv_lens, jnp.int32)
-    o = _flash_varlen(causal, float(scale), qt, kt, vt,
-                      jnp.repeat(kv_lens, h))
+    seed = (_dropout_seed(dropout_key) if p_drop
+            else jnp.zeros((), jnp.uint32))
+    o = _flash_varlen(causal, float(scale), p_drop, qt, kt, vt,
+                      jnp.repeat(kv_lens, h), seed)
     o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     # zero meaningless padded-query rows (and their gradients)
     q_ok = jnp.arange(sq)[None, :] < kv_lens[:, None]

@@ -100,6 +100,22 @@ class FusedOptimizer:
             changed = {k: v for k, v in current.items()
                        if v != self.defaults.get(k)}
             tx = self._tx_factory(**changed)
+            # the carried state is only valid if the rebuilt transform has
+            # the same state LAYOUT (a tx_factory whose overrides toggle
+            # state structure, e.g. momentum on/off, would silently
+            # mismatch at the next jit step)
+            group_params = self.param_groups[i]["params"]
+            old_state = (self.state if i == 0
+                         else self._extra_groups[i - 1]["state"])
+            new_struct = jax.tree_util.tree_structure(
+                jax.eval_shape(tx.init, group_params))
+            old_struct = jax.tree_util.tree_structure(old_state)
+            if new_struct != old_struct:
+                raise ValueError(
+                    f"param_groups[{i}] hyperparameter change altered the "
+                    f"optimizer state structure ({old_struct} -> "
+                    f"{new_struct}); carried state cannot be reused — "
+                    f"rebuild the optimizer instead")
             if i == 0:
                 self.tx = tx
                 self._jit_step = jax.jit(self._functional_step)

@@ -113,10 +113,12 @@ class MegatronPretrainingSampler(_Base):
             # of the reference's fixed-offset slice, which hands every rank
             # past the remainder an empty list (ref _batchsampler.py:97-100);
             # consumers must still expect a ragged final batch. A tail with
-            # fewer samples than ranks is dropped outright — some rank would
-            # otherwise get an empty batch, which no SPMD consumer survives.
+            # fewer samples than ranks is padded by REPEATING the last index
+            # so drop_last=False keeps its contract (every sample yielded,
+            # every rank non-empty) — an empty batch kills SPMD consumers.
             if len(batch) < self.data_parallel_size:
-                return
+                batch = batch + [batch[-1]] * (
+                    self.data_parallel_size - len(batch))
             base, rem = divmod(len(batch), self.data_parallel_size)
             r = self.data_parallel_rank
             start = r * base + min(r, rem)

@@ -34,6 +34,25 @@ def _axis(axis_name: Optional[str]) -> str:
     return axis_name if axis_name is not None else parallel_state.CONTEXT_AXIS
 
 
+def _vary_like(x, axis, *like):
+    """pvary ``x`` over ``axis`` plus every mesh axis any of ``like`` varies
+    over. Fresh-zeros scan carries and cond branches must match the vma of
+    values computed from the real inputs — when cp composes with tp/pp/dp
+    in one shard_map (the 4-axis dryrun), q/k/v vary over MORE than the
+    ring axis and a carry marked only {cp} trips the scan vma check."""
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    axes = {axis}
+    for t in like:
+        try:
+            axes |= set(jax.typeof(t).vma)
+        except (AttributeError, TypeError):
+            pass
+    for ax in sorted(axes):
+        x = _to_varying(x, ax)
+    return x
+
+
 def ring_attention(
     q,
     k,
@@ -126,13 +145,13 @@ def ring_attention(
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
         return (k_blk, v_blk, m_new, l, o), None
 
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
-
     step_fn = jax.checkpoint(step) if remat else step
     # accumulators become device-varying inside the loop; start them that way
-    m0 = _to_varying(jnp.full((b, h, s_local), _NEG_INF, jnp.float32), axis)
-    l0 = _to_varying(jnp.zeros((b, h, s_local), jnp.float32), axis)
-    o0 = _to_varying(jnp.zeros((b, h, s_local, d), jnp.float32), axis)
+    m0 = _vary_like(jnp.full((b, h, s_local), _NEG_INF, jnp.float32), axis,
+                    q, k, v)
+    l0 = _vary_like(jnp.zeros((b, h, s_local), jnp.float32), axis, q, k, v)
+    o0 = _vary_like(jnp.zeros((b, h, s_local, d), jnp.float32), axis,
+                    q, k, v)
     (_, _, m, l, o), _ = jax.lax.scan(
         step_fn, (k, v, m0, l0, o0), jnp.arange(n)
     )
@@ -171,21 +190,23 @@ def _ring_flash(axis, causal, scale, q, k, v):
 
 
 def _ring_flash_block_fwd(q, kb, vb, src, rank, causal, scale, axis, interp):
+    from apex_tpu.ops import pallas_config
     from apex_tpu.ops.flash_attention import _flash_fwd_pallas
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
 
     bh, s, d = q.shape
+    bq, bk = pallas_config.flash_blocks("fwd", s, s, d)
 
     def diag(_):
-        return _flash_fwd_pallas(q, kb, vb, True, scale, 512, 512, interp)
+        return _flash_fwd_pallas(q, kb, vb, True, scale, bq, bk, interp)
 
     def full(_):
-        return _flash_fwd_pallas(q, kb, vb, False, scale, 512, 512, interp)
+        return _flash_fwd_pallas(q, kb, vb, False, scale, bq, bk, interp)
 
     def skip(_):
         # zeros must carry the same vma as the kernel outputs
-        return (_to_varying(jnp.zeros((bh, s, d), q.dtype), axis),
-                _to_varying(jnp.full((bh, s), -jnp.inf, jnp.float32), axis))
+        return (_vary_like(jnp.zeros((bh, s, d), q.dtype), axis, q, kb, vb),
+                _vary_like(jnp.full((bh, s), -jnp.inf, jnp.float32), axis,
+                           q, kb, vb))
 
     if not causal:
         return full(None)
@@ -196,7 +217,6 @@ def _ring_flash_block_fwd(q, kb, vb, src, rank, causal, scale, axis, interp):
 
 def _ring_flash_fwd(axis, causal, scale, q, k, v):
     from apex_tpu.ops import pallas_config
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
 
     interp = pallas_config.interpret()
     n = jax.lax.axis_size(axis)
@@ -211,8 +231,9 @@ def _ring_flash_fwd(axis, causal, scale, q, k, v):
         o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_i, lse_i)
         return (_rotate(kb, axis), _rotate(vb, axis), o_acc, lse_acc), None
 
-    o0 = _to_varying(jnp.zeros((bh, s, d), jnp.float32), axis)
-    lse0 = _to_varying(jnp.full((bh, s), -jnp.inf, jnp.float32), axis)
+    o0 = _vary_like(jnp.zeros((bh, s, d), jnp.float32), axis, q, k, v)
+    lse0 = _vary_like(jnp.full((bh, s), -jnp.inf, jnp.float32), axis,
+                      q, k, v)
     (_, _, o, lse), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
     o = o.astype(q.dtype)
     return o, (q, k, v, o, lse)
@@ -221,7 +242,6 @@ def _ring_flash_fwd(axis, causal, scale, q, k, v):
 def _ring_flash_bwd(axis, causal, scale, res, do):
     from apex_tpu.ops import pallas_config
     from apex_tpu.ops.flash_attention import _flash_bwd_pallas
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
 
     q, k, v, o, lse = res
     interp = pallas_config.interpret()
@@ -229,20 +249,24 @@ def _ring_flash_bwd(axis, causal, scale, res, do):
     rank = jax.lax.axis_index(axis)
     bh, s, d = q.shape
     bh_kv = k.shape[0]
+    bq, bk = pallas_config.flash_blocks("bwd", s, s, d)
 
     def block_bwd(kb, vb, src):
         def diag(_):
             return _flash_bwd_pallas(q, kb, vb, o, lse, do, True, scale,
-                                     256, 256, interp)
+                                     bq, bk, interp)
 
         def full(_):
             return _flash_bwd_pallas(q, kb, vb, o, lse, do, False, scale,
-                                     256, 256, interp)
+                                     bq, bk, interp)
 
         def skip(_):
-            return (_to_varying(jnp.zeros((bh, s, d), q.dtype), axis),
-                    _to_varying(jnp.zeros((bh_kv, s, d), k.dtype), axis),
-                    _to_varying(jnp.zeros((bh_kv, s, d), v.dtype), axis))
+            return (_vary_like(jnp.zeros((bh, s, d), q.dtype), axis,
+                               q, kb, vb, do),
+                    _vary_like(jnp.zeros((bh_kv, s, d), k.dtype), axis,
+                               q, kb, vb, do),
+                    _vary_like(jnp.zeros((bh_kv, s, d), v.dtype), axis,
+                               q, kb, vb, do))
 
         if not causal:
             return full(None)
@@ -262,8 +286,9 @@ def _ring_flash_bwd(axis, causal, scale, res, do):
         return (_rotate(kb, axis), _rotate(vb, axis), _rotate(dkb, axis),
                 _rotate(dvb, axis), dq_acc), None
 
-    z_kv = _to_varying(jnp.zeros((bh_kv, s, d), jnp.float32), axis)
-    z_q = _to_varying(jnp.zeros((bh, s, d), jnp.float32), axis)
+    z_kv = _vary_like(jnp.zeros((bh_kv, s, d), jnp.float32), axis,
+                      q, k, v, do)
+    z_q = _vary_like(jnp.zeros((bh, s, d), jnp.float32), axis, q, k, v, do)
     (_, _, dk_out, dv_out, dq_out), _ = jax.lax.scan(
         step, (k, v, z_kv, z_kv, z_q), jnp.arange(n))
     return (dq_out.astype(q.dtype), dk_out.astype(k.dtype),

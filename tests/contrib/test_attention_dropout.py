@@ -1,0 +1,188 @@
+"""Attention dropout (ref apex/contrib/fmha/fmha.py:35 p_dropout +
+self_multihead_attn_func.py:100 fused softmax-prob dropout).
+
+The TPU design drops softmax probabilities inside the flash kernel using a
+counter-based keep mask (hash of seed/head/q/k positions) so the forward
+and backward kernels — which run different block grids — reconstruct the
+identical mask. The jnp fallback computes the SAME mask, so interpret-mode
+Pallas and the fallback are bit-comparable.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.fmha import FMHAFun, fmha
+from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+from apex_tpu.ops import pallas_config
+from apex_tpu.ops.flash_attention import _keep_mask, flash_attention
+
+
+def _qkv(key, b=2, s=64, h=4, d=16, h_kv=None):
+    kq, kk, kv = jax.random.split(key, 3)
+    h_kv = h if h_kv is None else h_kv
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h_kv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h_kv, d), jnp.float32)
+    return q, k, v
+
+
+class TestKeepMask:
+    def test_rate(self):
+        seed = jnp.uint32(1234)
+        bh = jnp.arange(8, dtype=jnp.uint32)[:, None, None]
+        qp = jnp.arange(128, dtype=jnp.uint32)[None, :, None]
+        kp = jnp.arange(128, dtype=jnp.uint32)[None, None, :]
+        for p in (0.1, 0.5, 0.9):
+            keep = _keep_mask(seed, bh, qp, kp, p)
+            rate = float(jnp.mean(keep.astype(jnp.float32)))
+            assert abs(rate - (1.0 - p)) < 0.01, (p, rate)
+
+    def test_seed_sensitivity(self):
+        bh = jnp.uint32(0)
+        qp = jnp.arange(64, dtype=jnp.uint32)[:, None]
+        kp = jnp.arange(64, dtype=jnp.uint32)[None, :]
+        m1 = _keep_mask(jnp.uint32(1), bh, qp, kp, 0.5)
+        m2 = _keep_mask(jnp.uint32(2), bh, qp, kp, 0.5)
+        assert bool(jnp.any(m1 != m2))
+
+
+class TestFlashDropout:
+    def test_eval_noop(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        base = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, dropout_p=0.3,
+                              dropout_key=jax.random.PRNGKey(1),
+                              deterministic=True)
+        np.testing.assert_allclose(base, out, rtol=1e-6)
+
+    def test_determinism_and_key_sensitivity(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+        o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k1)
+        o1b = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k1)
+        o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_key=k2)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+        assert bool(jnp.any(jnp.abs(o1 - o2) > 1e-6))
+
+    def test_changes_output(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        base = flash_attention(q, k, v)
+        o = flash_attention(q, k, v, dropout_p=0.5,
+                            dropout_key=jax.random.PRNGKey(1))
+        assert bool(jnp.any(jnp.abs(base - o) > 1e-4))
+
+    def test_missing_key_raises(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="dropout_key"):
+            flash_attention(q, k, v, dropout_p=0.3)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("gqa", [False, True])
+    def test_pallas_matches_jnp_fwd(self, causal, gqa):
+        q, k, v = _qkv(jax.random.PRNGKey(0), h_kv=2 if gqa else None)
+        key = jax.random.PRNGKey(7)
+        with pallas_config.force("interpret"):
+            o_pallas = flash_attention(q, k, v, causal=causal,
+                                       dropout_p=0.3, dropout_key=key)
+        with pallas_config.force("off"):
+            o_jnp = flash_attention(q, k, v, causal=causal,
+                                    dropout_p=0.3, dropout_key=key)
+        np.testing.assert_allclose(np.asarray(o_pallas), np.asarray(o_jnp),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("gqa", [False, True])
+    def test_pallas_matches_jnp_grads(self, gqa):
+        q, k, v = _qkv(jax.random.PRNGKey(0), h_kv=2 if gqa else None)
+        key = jax.random.PRNGKey(11)
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, dropout_p=0.25,
+                                dropout_key=key)
+            return jnp.sum(o * o)
+
+        with pallas_config.force("interpret"):
+            gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        with pallas_config.force("off"):
+            gj = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gj):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_varlen_with_dropout(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0), b=3, s=32)
+        lens = jnp.array([32, 17, 5], jnp.int32)
+        key = jax.random.PRNGKey(3)
+        with pallas_config.force("interpret"):
+            o_p = flash_attention(q, k, v, kv_lens=lens, dropout_p=0.3,
+                                  dropout_key=key)
+        with pallas_config.force("off"):
+            o_j = flash_attention(q, k, v, kv_lens=lens, dropout_p=0.3,
+                                  dropout_key=key)
+        np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_j),
+                                   rtol=2e-5, atol=2e-5)
+        # padded query rows stay zero
+        assert float(jnp.max(jnp.abs(o_p[2, 5:]))) == 0.0
+
+    def test_mean_preserving(self):
+        # inverted dropout: E[dropout(p)] == p, so averaged over many seeds
+        # the output approaches the no-dropout output
+        q, k, v = _qkv(jax.random.PRNGKey(0), b=1, s=32, h=2)
+        base = flash_attention(q, k, v)
+        acc = jnp.zeros_like(base)
+        n = 32
+        for i in range(n):
+            acc = acc + flash_attention(q, k, v, dropout_p=0.5,
+                                        dropout_key=jax.random.PRNGKey(i))
+        err = float(jnp.max(jnp.abs(acc / n - base)))
+        assert err < 0.5, err  # loose: statistical
+
+
+class TestFMHADropout:
+    def test_apply_training_no_raise(self):
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 3, 4, 16))
+        out = FMHAFun.apply(qkv, p_dropout=0.2, is_training=True,
+                            dropout_key=jax.random.PRNGKey(1))
+        assert out.shape == (2, 32, 4, 16)
+        base = FMHAFun.apply(qkv, p_dropout=0.2, is_training=False)
+        assert bool(jnp.any(jnp.abs(out - base) > 1e-5))
+
+    def test_apply_training_missing_key_raises(self):
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 3, 4, 16))
+        with pytest.raises(ValueError, match="dropout_key"):
+            FMHAFun.apply(qkv, p_dropout=0.2, is_training=True)
+        # eval needs no key
+        out = FMHAFun.apply(qkv, p_dropout=0.2, is_training=False)
+        assert out.shape == (2, 32, 4, 16)
+
+    def test_fmha_fn(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        o = fmha(q, k, v, dropout_p=0.1, dropout_key=jax.random.PRNGKey(4))
+        assert o.shape == q.shape
+
+
+class TestMHADropout:
+    def test_self_attn_prob_dropout(self):
+        mod = SelfMultiheadAttn(hidden_dim=32, heads=4, dropout=0.4)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 2, 32))
+        params = mod.init(jax.random.PRNGKey(1), x, is_training=False)
+        eval_out = mod.apply(params, x, is_training=False)
+        t1 = mod.apply(params, x, is_training=True,
+                       rngs={"dropout": jax.random.PRNGKey(2)})
+        t2 = mod.apply(params, x, is_training=True,
+                       rngs={"dropout": jax.random.PRNGKey(3)})
+        assert bool(jnp.any(jnp.abs(t1 - eval_out) > 1e-5))
+        assert bool(jnp.any(jnp.abs(t1 - t2) > 1e-5))
+
+    def test_self_attn_masked_path_dropout(self):
+        mod = SelfMultiheadAttn(hidden_dim=32, heads=4, dropout=0.4)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 2, 32))
+        pad = jnp.zeros((2, 16), bool).at[:, -4:].set(True)
+        params = mod.init(jax.random.PRNGKey(1), x, key_padding_mask=pad,
+                          is_training=False)
+        eval_out = mod.apply(params, x, key_padding_mask=pad,
+                             is_training=False)
+        t1 = mod.apply(params, x, key_padding_mask=pad, is_training=True,
+                       rngs={"dropout": jax.random.PRNGKey(2)})
+        assert bool(jnp.any(jnp.abs(t1 - eval_out) > 1e-5))

@@ -335,6 +335,50 @@ class TestSparsity:
         m = create_mask(w, "m4n2_2d_best")
         assert float(jnp.mean(m.astype(jnp.float32))) <= 0.5
 
+    def test_permutation_search_beats_naive(self):
+        # adversarial layout (ref permutation_lib.py's motivating case):
+        # all big channels packed into the same m=4 groups, so naive m4n2
+        # must drop half of them; a permutation spreads them out
+        from apex_tpu.contrib.sparsity import (
+            find_channel_permutation,
+            permuted_mn_mask,
+            retained_magnitude,
+        )
+
+        rng = np.random.default_rng(0)
+        big = rng.normal(size=(8, 8)) * 10.0
+        small = rng.normal(size=(8, 24)) * 0.1
+        w = jnp.asarray(np.concatenate([big, small], axis=1))
+
+        naive = mn_1d_mask(w, 4, 2)
+        mask, perm = permuted_mn_mask(w, 4, 2)
+        r_naive = retained_magnitude(w, naive)
+        r_perm = retained_magnitude(w, mask)
+        assert r_perm > r_naive, (r_perm, r_naive)
+        # permuted mask is still 2-of-4 under the found permutation
+        perm_mask = np.asarray(mask)[:, perm].reshape(8, 8, 4)
+        assert (perm_mask.sum(-1) == 2).all()
+        assert sorted(perm.tolist()) == list(range(32))
+
+    def test_permutation_identity_on_uniform(self):
+        # permutation can never LOSE magnitude vs naive
+        from apex_tpu.contrib.sparsity import (
+            permuted_mn_mask,
+            retained_magnitude,
+        )
+
+        w = jax.random.normal(jax.random.PRNGKey(3), (16, 32))
+        naive = mn_1d_mask(w, 4, 2)
+        mask, _ = permuted_mn_mask(w, 4, 2)
+        assert (retained_magnitude(w, mask)
+                >= retained_magnitude(w, naive) - 1e-6)
+
+    def test_asp_allow_permutation(self):
+        params = {"w": jax.random.normal(jax.random.PRNGKey(4), (8, 16))}
+        masks = ASP.compute_sparse_masks(params, allow_permutation=True)
+        dens = float(jnp.mean(masks["w"].astype(jnp.float32)))
+        assert dens == 0.5
+
 
 class TestDistributedFusedAdam:
     def test_matches_plain_adam(self):

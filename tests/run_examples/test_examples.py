@@ -45,3 +45,15 @@ def test_llama_train():
 def test_dcgan():
     out = _run("dcgan.py", "--steps", "4")
     assert "ran to completion: OK" in out
+
+
+@pytest.mark.slow
+def test_bert_train():
+    out = _run("bert_train.py", "--steps", "8")
+    assert "(decreased)" in out
+
+
+@pytest.mark.slow
+def test_gpt2_train():
+    out = _run("gpt2_train.py", "--steps", "8")
+    assert "(decreased)" in out

@@ -57,15 +57,27 @@ def test_sequential_tail_split_across_ranks():
     assert abs(len(tails[0]) - len(tails[1])) <= 1
 
 
-def test_sequential_tiny_tail_dropped():
-    """A tail smaller than the rank count is dropped on every rank (no rank
-    may ever receive an empty batch)."""
+def test_sequential_tiny_tail_padded():
+    """drop_last=False guarantees every sample is yielded: a tail smaller
+    than the rank count is padded by repeating the last index so no rank
+    receives an empty batch (an empty batch kills SPMD consumers)."""
     for rank in range(2):
         batches = list(MegatronPretrainingSampler(
             total_samples=9, consumed_samples=0, local_minibatch_size=4,
             data_parallel_rank=rank, data_parallel_size=2, drop_last=False))
-        assert batches == [[rank * 4 + i for i in range(4)]]
+        assert batches[0] == [rank * 4 + i for i in range(4)]
+        # tail [8] padded to [8, 8]: rank0 -> [8], rank1 -> [8]
+        assert batches[1] == [8]
         assert all(len(b) > 0 for b in batches)
+    # sample 8 is yielded (drop_last=False contract)
+    seen = set()
+    for rank in range(2):
+        for b in MegatronPretrainingSampler(
+                total_samples=9, consumed_samples=0, local_minibatch_size=4,
+                data_parallel_rank=rank, data_parallel_size=2,
+                drop_last=False):
+            seen.update(b)
+    assert seen == set(range(9))
 
 
 def test_random_deterministic_and_disjoint():

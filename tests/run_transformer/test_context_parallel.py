@@ -180,13 +180,20 @@ def test_ring_flash_gqa(mesh):
 
 
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_flash_grads_match_full(mesh, causal):
+@pytest.mark.parametrize("gqa", [False, True])
+def test_ring_flash_grads_match_full(mesh, causal, gqa):
     """The hand-written ring backward (flash dq/dk/dv kernels with global
     lse, circulating dK/dV accumulators) must match autodiff through full
-    attention."""
+    attention — including the GQA lane (bh_kv < bh), where dK/dV
+    accumulate over the query heads sharing each kv head."""
     from apex_tpu.ops import pallas_config
 
-    q, k, v = qkv(4)
+    if gqa:
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H // 2, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H // 2, D))
+    else:
+        q, k, v = qkv(4)
 
     def ring_loss(q, k, v):
         def fn(q, k, v):
@@ -200,7 +207,9 @@ def test_ring_flash_grads_match_full(mesh, causal):
         )(q, k, v)
 
     def full_loss(q, k, v):
-        return jnp.sum(full_attention(q, k, v, causal) ** 2)
+        kr = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
+        vr = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+        return jnp.sum(full_attention(q, kr, vr, causal) ** 2)
 
     with pallas_config.force("interpret"):
         got = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
