@@ -160,14 +160,23 @@ def permuted_mn_mask(w, m: int = 4, n: int = 2, **search_kw):
     searched channel permutation (ref permutation_lib.py semantics: the
     reference physically permutes the weights and compensates neighboring
     layers; functionally the inverse-permuted mask retains the identical
-    magnitude). Returns (mask, perm)."""
+    magnitude). Returns (mask, perm).
+
+    Guarantee: the result never retains LESS than the naive (identity
+    permutation) mask — the search is heuristic (seeded deal + bounded
+    swaps on a row subsample), so the identity layout is kept whenever it
+    measures better on the FULL matrix."""
     import numpy as np
 
     perm = find_channel_permutation(w, m, n, **search_kw)
     mask_p = mn_1d_mask(w[..., perm], m, n)
     inv = np.empty_like(perm)
     inv[perm] = np.arange(perm.size)
-    return mask_p[..., inv], perm
+    mask = mask_p[..., inv]
+    naive = mn_1d_mask(w, m, n)
+    if retained_magnitude(w, mask) < retained_magnitude(w, naive):
+        return naive, np.arange(perm.size)
+    return mask, perm
 
 
 def retained_magnitude(w, mask) -> float:
@@ -224,11 +233,18 @@ class ASP:
         magnitude, at offline search cost."""
         elig = eligible or ASP._eligible
 
+        if allow_permutation and pattern != "m4n2_1d":
+            raise ValueError(
+                f"allow_permutation is only implemented for the m4n2_1d "
+                f"pattern (got {pattern!r}); the 2d patterns constrain "
+                f"both dims, so a column permutation alone cannot "
+                f"preserve them")
+
         def mk(path, leaf):
             name = jax.tree_util.keystr(path)
             if not elig(name, leaf):
                 return None
-            if allow_permutation and pattern == "m4n2_1d":
+            if allow_permutation:
                 mask, _ = permuted_mn_mask(leaf, 4, 2, **search_kw)
                 return mask
             return create_mask(leaf, pattern)

@@ -5,9 +5,13 @@ TPU re-design of the reference CUDA kernels
 
 Design: one single-pass kernel per row-block computes the statistics and the
 normalized output in VMEM (fp32 math regardless of storage dtype — same
-policy as the CUDA kernel's float accumulators). The backward runs as pure
-XLA (it is a couple of row reductions that XLA fuses into one pass; saved
-activations are just (mu, rstd), which is the memory-efficient choice).
+policy as the CUDA kernel's float accumulators). The backward is ALSO a
+single-pass Pallas kernel (dx per row-block + dw/db accumulated across the
+sequential grid into one (1, h) output — the TPU analog of the reference's
+dedicated bwd kernels, csrc/layer_norm_cuda_kernel.cu cuComputeGradInput +
+cuComputePartGradGammaBeta); saved activations are just (mu, rstd). A
+closed-form jnp backward remains as the non-TPU fallback and as the
+baseline bench.py races the kernel against.
 
 On non-TPU backends (tests run on a CPU mesh) the forward falls back to an
 equivalent jnp implementation — same math, same vjp.
@@ -141,6 +145,135 @@ def _rms_fwd_pallas(x2, w, eps):
     return y[:n], rstd[:n]
 
 
+# ------------------------------------------------------- backward kernels
+
+
+def _ln_bwd_kernel(affine, x_ref, dy_ref, mu_ref, rstd_ref, *refs):
+    """dx for one row block; dw/db accumulate across the (sequential) grid
+    into a shared (1, h) block — no [grid, h] partials in HBM."""
+    i = pl.program_id(0)
+    if affine:
+        w_ref, dx_ref, dw_ref, db_ref = refs
+    else:
+        dx_ref, = refs
+    x = x_ref[:].astype(jnp.float32)
+    g = dy_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = (x - mu_ref[:]) * rstd
+    gw = g * w_ref[:].astype(jnp.float32) if affine else g
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (gw - m1 - xhat * m2)).astype(dx_ref.dtype)
+    if affine:
+        @pl.when(i == 0)
+        def _init():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+            db_ref[:] = jnp.zeros_like(db_ref)
+
+        dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+        db_ref[:] += jnp.sum(g, axis=0, keepdims=True)
+
+
+def _rms_bwd_kernel(affine, x_ref, dy_ref, rstd_ref, *refs):
+    i = pl.program_id(0)
+    if affine:
+        w_ref, dx_ref, dw_ref = refs
+    else:
+        dx_ref, = refs
+    x = x_ref[:].astype(jnp.float32)
+    g = dy_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = x * rstd
+    gw = g * w_ref[:].astype(jnp.float32) if affine else g
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (gw - xhat * m2)).astype(dx_ref.dtype)
+    if affine:
+        @pl.when(i == 0)
+        def _init():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+
+        dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+
+def _ln_bwd_pallas(x2, w, mu, rstd, dy):
+    affine = w is not None
+    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    x2p, n = _pad_rows(x2, block)
+    dyp, _ = _pad_rows(dy, block)
+    mup, _ = _pad_rows(mu, block)
+    rstdp, _ = _pad_rows(rstd, block)
+    rows, h = x2p.shape
+    grid = (rows // block,)
+    row_spec = pl.BlockSpec((block, h), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [row_spec, row_spec, stat_spec, stat_spec]
+    args = (x2p, dyp, mup, rstdp)
+    out_specs = [row_spec]
+    out_shape = [pallas_config.out_struct((rows, h), x2.dtype, *args)]
+    if affine:
+        in_specs.append(vec_spec)
+        args = args + (w.reshape(1, h),)
+        out_specs += [vec_spec, vec_spec]
+        out_shape += [
+            pallas_config.out_struct((1, h), jnp.float32, *args),
+            pallas_config.out_struct((1, h), jnp.float32, *args),
+        ]
+    outs = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, affine),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=pallas_config.interpret(),
+    )(*args)
+    if affine:
+        dx, dw, db = outs
+        return dx[:n], dw[0].astype(w.dtype), db[0].astype(w.dtype)
+    return outs[0][:n]
+
+
+def _rms_bwd_pallas(x2, w, rstd, dy):
+    affine = w is not None
+    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    x2p, n = _pad_rows(x2, block)
+    dyp, _ = _pad_rows(dy, block)
+    rstdp, _ = _pad_rows(rstd, block)
+    rows, h = x2p.shape
+    grid = (rows // block,)
+    row_spec = pl.BlockSpec((block, h), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [row_spec, row_spec, stat_spec]
+    args = (x2p, dyp, rstdp)
+    out_specs = [row_spec]
+    out_shape = [pallas_config.out_struct((rows, h), x2.dtype, *args)]
+    if affine:
+        in_specs.append(vec_spec)
+        args = args + (w.reshape(1, h),)
+        out_specs.append(vec_spec)
+        out_shape.append(
+            pallas_config.out_struct((1, h), jnp.float32, *args))
+    outs = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, affine),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=pallas_config.interpret(),
+    )(*args)
+    if affine:
+        dx, dw = outs
+        return dx[:n], dw[0].astype(w.dtype)
+    return outs[0][:n]
+
+
 # ------------------------------------------------------- fallbacks (jnp)
 
 
@@ -183,6 +316,8 @@ def _layer_norm_affine_fwd(x2, w, b, eps):
 
 def _layer_norm_affine_bwd(eps, res, dy):
     x2, w, mu, rstd = res
+    if _use_pallas():
+        return _ln_bwd_pallas(x2, w, mu, rstd, dy)
     x = x2.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     xhat = (x - mu) * rstd
@@ -212,6 +347,8 @@ def _layer_norm_plain_fwd(x2, eps):
 
 def _layer_norm_plain_bwd(eps, res, dy):
     x2, mu, rstd = res
+    if _use_pallas():
+        return (_ln_bwd_pallas(x2, None, mu, rstd, dy),)
     x = x2.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     xhat = (x - mu) * rstd
@@ -238,6 +375,8 @@ def _rms_norm_affine_fwd(x2, w, eps):
 
 def _rms_norm_affine_bwd(eps, res, dy):
     x2, w, rstd = res
+    if _use_pallas():
+        return _rms_bwd_pallas(x2, w, rstd, dy)
     x = x2.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     xhat = x * rstd
@@ -265,6 +404,8 @@ def _rms_norm_plain_fwd(x2, eps):
 
 def _rms_norm_plain_bwd(eps, res, dy):
     x2, rstd = res
+    if _use_pallas():
+        return (_rms_bwd_pallas(x2, None, rstd, dy),)
     x = x2.astype(jnp.float32)
     g = dy.astype(jnp.float32)
     xhat = x * rstd

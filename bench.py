@@ -188,9 +188,13 @@ def bench_fused_adam(cpu_mode, extras):
 
 
 def _is_oom(e) -> bool:
+    """OOM or any runtime-layer failure that a cheaper config might dodge.
+    Python-level errors (shape bugs, TypeErrors) are NOT resource failures
+    and must fail fast instead of walking the ladder."""
     s = repr(e)
     return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
-            or "out of memory" in s or "OOM" in s)
+            or "out of memory" in s or "OOM" in s
+            or "XlaRuntimeError" in type(e).__name__ or "XlaRuntimeError" in s)
 
 
 def bench_llama(extras):
@@ -253,6 +257,8 @@ def bench_llama(extras):
                 f"remat={remat},B={B}: {repr(e)[:120]}")
             print(f"llama remat={remat} B={B} failed: {repr(e)[:200]}",
                   file=sys.stderr)
+            if not _is_oom(e):
+                raise  # genuine bug: fail fast, don't recompile 3 rungs
             gc.collect()
     if step_t is None:
         raise RuntimeError(

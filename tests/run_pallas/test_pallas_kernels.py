@@ -85,6 +85,47 @@ def test_layer_norm_interpret_grads():
         np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=1e-5)
 
 
+@pytest.mark.parametrize("rows", [32, 13])  # 13 exercises bwd row padding
+@pytest.mark.parametrize("affine", [True, False])
+def test_rms_norm_interpret_grads(rows, affine):
+    """The Pallas RMS bwd kernel (dx + grid-accumulated dw) vs autodiff
+    through the jnp path."""
+    h = 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, h), jnp.float32)
+    w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (h,))
+
+    if affine:
+        def f(x, w):
+            return jnp.sum(jnp.sin(rms_norm(x, w, h)))
+
+        ref = jax.grad(f, argnums=(0, 1))(x, w)
+        with pallas_config.force("interpret"):
+            out = jax.grad(f, argnums=(0, 1))(x, w)
+    else:
+        def f(x):
+            return jnp.sum(jnp.sin(rms_norm(x, None, h)))
+
+        ref = (jax.grad(f)(x),)
+        with pallas_config.force("interpret"):
+            out = (jax.grad(f)(x),)
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=1e-5)
+
+
+@pytest.mark.parametrize("rows", [32, 13])
+def test_layer_norm_plain_interpret_grads(rows):
+    h = 64
+    x = jax.random.normal(jax.random.PRNGKey(2), (rows, h), jnp.float32)
+
+    def f(x):
+        return jnp.sum(jnp.cos(layer_norm(x, None, None, h)))
+
+    ref = jax.grad(f)(x)
+    with pallas_config.force("interpret"):
+        out = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 # ---------------------------------------------------------- flash attention
 
 

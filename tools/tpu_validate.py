@@ -1,0 +1,252 @@
+"""On-hardware validation of the compiled (Mosaic) Pallas kernel paths.
+
+CI only ever exercises the kernels through the Pallas interpreter on the
+CPU mesh (tests/conftest.py forces JAX_PLATFORMS=cpu), so compiled-mode
+lowering — VMEM fit, sub-tile scalar blocks, uint32 dropout-mask ops —
+is unproven until something runs on a real chip. This script is that
+something: each check runs the compiled kernel (pallas_config 'auto' on
+TPU) and compares against the jnp fallback ('off') at bench-like shapes.
+
+Run on a live TPU (the axon tunnel must be up):
+
+    python tools/tpu_validate.py            # all checks
+    python tools/tpu_validate.py --quick    # small shapes only
+
+Prints one PASS/FAIL line per check and exits nonzero on any failure.
+Keep it fast (~a minute of compiles): it is the pre-flight for bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RESULTS = []
+
+
+def check(name):
+    def deco(fn):
+        def run(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                fn(*a, **kw)
+                RESULTS.append((name, True, ""))
+                print(f"PASS {name} ({time.perf_counter() - t0:.1f}s)",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                RESULTS.append((name, False, repr(e)[:300]))
+                print(f"FAIL {name}: {repr(e)[:300]}", flush=True)
+        return run
+    return deco
+
+
+def _close(a, b, rtol=2e-2, atol=2e-2, name=""):
+    # bf16 compiled vs fp32-ish jnp fallback: loose but real tolerance
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        rtol=rtol, atol=atol, err_msg=name)
+
+
+@check("flash_fwd_causal")
+def flash_fwd(B, S, H, D):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in ks)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True))(q, k, v)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True))(q, k, v)
+    _close(got, want, name="flash fwd")
+
+
+@check("flash_bwd_causal_gqa")
+def flash_bwd(B, S, H, D):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H // 2, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H // 2, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    with pallas_config.force("on"):
+        got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready(got)
+    with pallas_config.force("off"):
+        want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for n, a, b in zip("qkv", got, want):
+        _close(a, b, rtol=5e-2, atol=5e-2, name=f"flash d{n}")
+
+
+@check("flash_varlen")
+def flash_varlen(B, S, H, D):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in ks)
+    lens = jnp.asarray([S] + [max(1, S // (i + 2)) for i in range(B - 1)],
+                       jnp.int32)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, kv_lens=lens))(q, k, v)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, kv_lens=lens))(q, k, v)
+    _close(got, want, name="flash varlen")
+
+
+@check("flash_dropout_fwd_bwd")
+def flash_dropout(B, S, H, D):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+               for kk in ks)
+    key = jax.random.PRNGKey(7)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dropout_p=0.25,
+                            dropout_key=key)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    # same counter-based mask on both paths -> grads must agree
+    with pallas_config.force("on"):
+        got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready(got)
+    with pallas_config.force("off"):
+        want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for n, a, b in zip("qkv", got, want):
+        _close(a, b, rtol=5e-2, atol=5e-2, name=f"dropout d{n}")
+
+
+@check("layer_norm_fwd_bwd")
+def layer_norm(rows, hidden):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.layer_norm import layer_norm as ln
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (rows, hidden),
+                          jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    b = jnp.zeros((hidden,), jnp.float32)
+
+    def loss(x, w, b):
+        return jnp.sum(ln(x, w, b, (hidden,)).astype(jnp.float32) ** 2)
+
+    with pallas_config.force("on"):
+        got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(x, w, b)
+        jax.block_until_ready(got)
+    with pallas_config.force("off"):
+        want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(x, w, b)
+    for n, a, b2 in zip(["dx", "dw", "db"], got, want):
+        _close(a, b2, rtol=5e-2, atol=5e-1, name=f"ln {n}")
+
+
+@check("rms_norm_fwd")
+def rms_norm(rows, hidden):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.layer_norm import rms_norm as rms
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (rows, hidden),
+                          jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda x: rms(x, w, (hidden,)))(x)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda x: rms(x, w, (hidden,)))(x)
+    _close(got, want, name="rms")
+
+
+@check("causal_softmax")
+def causal_softmax(bh, S):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax as causal_sm,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(6), (bh, S, S), jnp.bfloat16)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda x: causal_sm(x, None, 1.0))(x)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda x: causal_sm(x, None, 1.0))(x)
+    _close(got, want, name="causal softmax")
+
+
+@check("odd_rows_layer_norm")
+def odd_rows(hidden):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.layer_norm import layer_norm as ln
+
+    x = jax.random.normal(jax.random.PRNGKey(8), (13, hidden), jnp.bfloat16)
+    w = jnp.ones((hidden,), jnp.float32)
+    b = jnp.zeros((hidden,), jnp.float32)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda x: ln(x, w, b, (hidden,)))(x)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda x: ln(x, w, b, (hidden,)))(x)
+    _close(got, want, name="odd rows")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--force", action="store_true",
+                   help="run even on a non-TPU backend (compiled Pallas "
+                        "off-TPU is unsupported/slow; for CI debugging)")
+    args = p.parse_args()
+
+    dev = jax.devices()[0]
+    print(f"platform={dev.platform} kind={dev.device_kind}", flush=True)
+    if dev.platform != "tpu" and not args.force:
+        print("not a TPU backend — compiled Mosaic kernels cannot be "
+              "validated here (tests cover interpret mode); pass --force "
+              "to try anyway", flush=True)
+        return 2
+
+    if args.quick:
+        B, S, H, D = 2, 512, 4, 128
+        rows, hidden = 1024, 1024
+        bh, sm_s = 8, 512
+    else:
+        B, S, H, D = 4, 2048, 16, 128
+        rows, hidden = 8192, 4096
+        bh, sm_s = 64, 1024
+
+    flash_fwd(B, S, H, D)
+    flash_bwd(B, S, H, D)
+    flash_varlen(B, S, H, D)
+    flash_dropout(B, S, H, D)
+    layer_norm(rows, hidden)
+    rms_norm(rows, hidden)
+    causal_softmax(bh, sm_s)
+    odd_rows(hidden)
+
+    fails = [r for r in RESULTS if not r[1]]
+    print(f"{len(RESULTS) - len(fails)}/{len(RESULTS)} checks passed",
+          flush=True)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
