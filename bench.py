@@ -243,12 +243,33 @@ def bench_llama(extras):
         jax.block_until_ready(loss)
         return (time.perf_counter() - t0) / iters, n_params, B
 
+    from apex_tpu.ops import pallas_config
+
     ladder = [(False, 4), (True, 4), (True, 2), (True, 1)]
     step_t = None
     for remat, B in ladder:
         try:
             step_t, n_params, B_used = attempt(remat, B)
             extras["llama_config"] = f"remat={remat} batch={B}"
+            # race the kernel paths: Pallas flash attention (auto on TPU)
+            # vs the jnp/XLA fallback — both are first-class paths of the
+            # framework; report both, headline the faster (a kernel that
+            # loses to XLA must not tax the flagship number). Off-TPU the
+            # 'auto' mode already IS the fallback, so there is no race.
+            if jax.default_backend() == "tpu":
+                extras["llama_step_ms_pallas"] = round(step_t * 1e3, 2)
+                try:
+                    with pallas_config.force("off"):
+                        xla_t, _, _ = attempt(remat, B)
+                    extras["llama_step_ms_xla"] = round(xla_t * 1e3, 2)
+                    if xla_t < step_t:
+                        extras["llama_fastest_path"] = "xla"
+                        step_t = xla_t
+                    else:
+                        extras["llama_fastest_path"] = "pallas"
+                except Exception as e:  # noqa: BLE001
+                    print(f"llama xla-path timing failed: {repr(e)[:160]}",
+                          file=sys.stderr)
             break
         except Exception as e:  # noqa: BLE001
             # record every rung's failure (OOM rungs included) so a fully
