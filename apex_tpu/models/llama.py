@@ -20,6 +20,11 @@ under tp-only shard_map, and as one pipeline stage:
 - cp:   ring attention over the 'cp' axis; RoPE uses global positions
 - pp:   :func:`stage_fn` applies a contiguous slice of layers — feed it to
         ``pipeline_parallel.schedules``
+- ep:   ``num_experts > 0`` swaps the dense SwiGLU MLP for Mixtral-style
+        top-k routed experts (apex_tpu.transformer.moe); experts shard
+        over the 'ep' axis, the router replicates. The load-balancing aux
+        loss is returned by :func:`loss_fn`; the pipeline ``stage_fn``
+        path drops it (documented — activations are the only pp payload).
 """
 
 from __future__ import annotations
@@ -67,6 +72,15 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     tie_embeddings: bool = False
+    # Mixtral-style MoE: 0 = dense SwiGLU; >0 routes tokens through that
+    # many SwiGLU experts (top-k, capacity-dropped) over the 'ep' axis
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def moe(self) -> bool:
+        return self.num_experts > 0
 
     @property
     def head_dim(self) -> int:
@@ -103,19 +117,32 @@ def init_params(key, cfg: LlamaConfig):
     def norm(k, *shape, fan_in=None):
         return fan_in_normal(k, *shape, fan_in=fan_in, dtype=dt)
 
-    params = {
-        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
-        "layers": {
-            "attn_norm": jnp.ones((L, h), dt),
-            "wq": norm(ks[1], L, h, nq * d),
-            "wk": norm(ks[2], L, h, nkv * d),
-            "wv": norm(ks[3], L, h, nkv * d),
-            "wo": norm(ks[4], L, nq * d, h),
-            "mlp_norm": jnp.ones((L, h), dt),
+    layers = {
+        "attn_norm": jnp.ones((L, h), dt),
+        "wq": norm(ks[1], L, h, nq * d),
+        "wk": norm(ks[2], L, h, nkv * d),
+        "wv": norm(ks[3], L, h, nkv * d),
+        "wo": norm(ks[4], L, nq * d, h),
+        "mlp_norm": jnp.ones((L, h), dt),
+    }
+    if cfg.moe:
+        E = cfg.num_experts
+        layers.update({
+            "router": (jax.random.normal(ks[9], (L, h, E)) * 0.02
+                       ).astype(dt),
+            "wg": norm(ks[5], L, E, h, i),
+            "wu": norm(ks[6], L, E, h, i),
+            "wd": norm(ks[7], L, E, i, h),
+        })
+    else:
+        layers.update({
             "wg": norm(ks[5], L, h, i),
             "wu": norm(ks[6], L, h, i),
             "wd": norm(ks[7], L, i, h),
-        },
+        })
+    params = {
+        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
+        "layers": layers,
         "final_norm": jnp.ones((h,), dt),
     }
     if not cfg.tie_embeddings:
@@ -181,11 +208,48 @@ def _mlp(x, lp, tp_axis, sequence_parallel):
                                axis_name=tp_axis, seq_dim=1)
 
 
+def _moe_cfg(cfg: LlamaConfig):
+    from apex_tpu.transformer.moe import MoEConfig
+
+    return MoEConfig(hidden_size=cfg.hidden_size,
+                     ffn_hidden_size=cfg.intermediate_size,
+                     num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                     capacity_factor=cfg.moe_capacity_factor)
+
+
+def _moe_mlp(x, lp, cfg: LlamaConfig, ep_axis, tp_axis, sequence_parallel):
+    """Mixtral-style routed SwiGLU experts in place of the dense MLP.
+
+    x arrives sequence-full and tp-replicated (every tp rank computes the
+    same routing — experts shard over 'ep', orthogonal to tp; grads of the
+    expert weights are therefore tp-identical). Returns (y, aux); in sp
+    mode y is scattered back to the sequence-sharded stream.
+    """
+    from apex_tpu.transformer.moe import expert_parallel_apply
+
+    def expert_fn(p, tokens):  # [E_local, C', h] -> [E_local, C', h]
+        g = jnp.einsum("ech,ehf->ecf", tokens,
+                       p["wg"].astype(tokens.dtype))
+        u = jnp.einsum("ech,ehf->ecf", tokens,
+                       p["wu"].astype(tokens.dtype))
+        return jnp.einsum("ecf,efh->ech", jax.nn.silu(g) * u,
+                          p["wd"].astype(tokens.dtype))
+
+    y, aux = expert_parallel_apply(
+        expert_fn, {"wg": lp["wg"], "wu": lp["wu"], "wd": lp["wd"]}, x,
+        lp["router"], _moe_cfg(cfg), ep_axis=ep_axis)
+    if sequence_parallel:
+        y = scatter_to_sequence_parallel_region(y, tp_axis, seq_dim=1)
+    return y, aux
+
+
 def decoder_layer(x, lp, cfg: LlamaConfig, positions,
                   tp_axis: Optional[str] = "tp",
                   cp_axis: Optional[str] = "cp",
-                  sequence_parallel: bool = False):
+                  sequence_parallel: bool = False,
+                  ep_axis: Optional[str] = "ep"):
     """One pre-norm block on a single layer's (unstacked) params ``lp``.
+    Returns ``(x, aux)`` — aux is the MoE load-balancing loss (0 dense).
 
     In sp mode the residual stream (and the norms) stay sequence-sharded;
     each half-block all-gathers the normed input ONCE for its column gemms
@@ -202,8 +266,12 @@ def decoder_layer(x, lp, cfg: LlamaConfig, positions,
     x = x + _attention(h, lp, cfg, positions, tp_axis, cp_axis,
                        sequence_parallel)
     h = to_full(_rmsnorm(x, lp["mlp_norm"], cfg.rms_eps))
-    x = x + _mlp(h, lp, tp_axis, sequence_parallel)
-    return x
+    if cfg.moe:
+        y, aux = _moe_mlp(h, lp, cfg, ep_axis, tp_axis, sequence_parallel)
+    else:
+        y, aux = _mlp(h, lp, tp_axis, sequence_parallel), jnp.zeros(
+            (), jnp.float32)
+    return x + y, aux
 
 
 def _positions(b, s_local, cp_axis):
@@ -216,17 +284,28 @@ def _positions(b, s_local, cp_axis):
 
 def run_layers(x, stacked, cfg: LlamaConfig, positions,
                tp_axis="tp", cp_axis="cp", sequence_parallel=False,
-               remat: bool = True):
-    """Scan a stacked [L, ...] layer pytree over the residual stream."""
+               remat: bool = True, ep_axis: Optional[str] = "ep"):
+    """Scan a stacked [L, ...] layer pytree over the residual stream.
+    Returns ``(x, aux)`` — aux sums the per-layer MoE balance losses."""
 
     def body(h, lp):
+        # aux rides the scan's stacked outputs, not the carry — a fresh
+        # zero carry would need its vma hand-matched under shard_map
         return decoder_layer(h, lp, cfg, positions, tp_axis, cp_axis,
-                             sequence_parallel), None
+                             sequence_parallel, ep_axis)
 
+    if cfg.moe and _axis_bound(ep_axis):
+        # the MoE all_to_all makes the stream ep-varying; the carry must
+        # start that way or the scan's vma check trips
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            _to_varying,
+        )
+
+        x = _to_varying(x, ep_axis)
     if remat:
         body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, stacked)
-    return x
+    x, auxs = jax.lax.scan(body, x, stacked)
+    return x, jnp.sum(auxs)
 
 
 def embed(params, tokens, cfg: LlamaConfig, tp_axis="tp",
@@ -249,45 +328,72 @@ def lm_head(params, x, cfg: LlamaConfig, tp_axis="tp",
     return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
 
 
-def forward(params, tokens, cfg: LlamaConfig,
-            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
-            sequence_parallel: bool = False, remat: bool = True):
-    """tokens [b, s_local] → vocab-sharded logits [b, s_local, v_local]."""
+def forward_with_aux(params, tokens, cfg: LlamaConfig,
+                     tp_axis: Optional[str] = "tp",
+                     cp_axis: Optional[str] = "cp",
+                     sequence_parallel: bool = False, remat: bool = True,
+                     ep_axis: Optional[str] = "ep"):
+    """tokens [b, s_local] → (vocab-sharded logits, moe aux loss)."""
     b, s = tokens.shape
     positions = _positions(b, s, cp_axis)
     x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
-    x = run_layers(x, params["layers"], cfg, positions, tp_axis, cp_axis,
-                   sequence_parallel, remat)
-    return lm_head(params, x, cfg, tp_axis, sequence_parallel)
+    x, aux = run_layers(x, params["layers"], cfg, positions, tp_axis,
+                        cp_axis, sequence_parallel, remat, ep_axis)
+    return lm_head(params, x, cfg, tp_axis, sequence_parallel), aux
+
+
+def forward(params, tokens, cfg: LlamaConfig,
+            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
+            sequence_parallel: bool = False, remat: bool = True,
+            ep_axis: Optional[str] = "ep"):
+    """tokens [b, s_local] → vocab-sharded logits [b, s_local, v_local]."""
+    return forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
+                            sequence_parallel, remat, ep_axis)[0]
 
 
 def loss_fn(params, batch, cfg: LlamaConfig,
             tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
-            sequence_parallel: bool = False, remat: bool = True):
-    """Next-token CE; ``batch = (tokens, targets)`` both [b, s_local]."""
+            sequence_parallel: bool = False, remat: bool = True,
+            ep_axis: Optional[str] = "ep"):
+    """Next-token CE (+ MoE balance aux when cfg.moe);
+    ``batch = (tokens, targets)`` both [b, s_local]."""
     tokens, targets = batch
-    logits = forward(params, tokens, cfg, tp_axis, cp_axis,
-                     sequence_parallel, remat)
+    logits, aux = forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
+                                   sequence_parallel, remat, ep_axis)
     losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
-    return jnp.mean(losses)
+    return jnp.mean(losses) + aux
 
 
-def param_specs(cfg: LlamaConfig, tp_axis: str = "tp"):
+def param_specs(cfg: LlamaConfig, tp_axis: str = "tp",
+                ep_axis: str = "ep"):
     """PartitionSpec pytree matching :func:`init_params` (tp sharding):
     column kernels split the output dim, row kernels the input dim, the
     embedding/head split the vocab dim, norms replicate."""
     from jax.sharding import PartitionSpec as P
 
     t = tp_axis
-    specs = {
-        "embed": P(t, None),
-        "layers": {
-            "attn_norm": P(), "mlp_norm": P(),
-            "wq": P(None, None, t), "wk": P(None, None, t),
-            "wv": P(None, None, t), "wo": P(None, t, None),
+    layer_specs = {
+        "attn_norm": P(), "mlp_norm": P(),
+        "wq": P(None, None, t), "wk": P(None, None, t),
+        "wv": P(None, None, t), "wo": P(None, t, None),
+    }
+    if cfg.moe:
+        # experts shard over ep_axis (orthogonal to tp); router replicates
+        e = ep_axis
+        layer_specs.update({
+            "router": P(),
+            "wg": P(None, e, None, None),
+            "wu": P(None, e, None, None),
+            "wd": P(None, e, None, None),
+        })
+    else:
+        layer_specs.update({
             "wg": P(None, None, t), "wu": P(None, None, t),
             "wd": P(None, t, None),
-        },
+        })
+    specs = {
+        "embed": P(t, None),
+        "layers": layer_specs,
         "final_norm": P(),
     }
     if not cfg.tie_embeddings:
@@ -299,12 +405,18 @@ def param_specs(cfg: LlamaConfig, tp_axis: str = "tp"):
 
 
 def stage_fn(stage_params, x, cfg: LlamaConfig, positions,
-             tp_axis="tp", cp_axis=None, sequence_parallel=False):
+             tp_axis="tp", cp_axis=None, sequence_parallel=False,
+             ep_axis: Optional[str] = "ep"):
     """Apply one pipeline stage's stacked layer slice to the residual
     stream — plug into ``pipeline_parallel.schedules`` (embedding/head live
-    outside via :func:`embed`/:func:`lm_head` on the first/last stage)."""
-    return run_layers(x, stage_params, cfg, positions, tp_axis, cp_axis,
-                      sequence_parallel, remat=False)
+    outside via :func:`embed`/:func:`lm_head` on the first/last stage).
+    The MoE aux loss is dropped here: the pipeline transports activations
+    only — train MoE stages with the aux folded in via :func:`loss_fn`
+    style accounting outside pp, or accept routing without the balance
+    regularizer under pp."""
+    x, _ = run_layers(x, stage_params, cfg, positions, tp_axis, cp_axis,
+                      sequence_parallel, remat=False, ep_axis=ep_axis)
+    return x
 
 
 def split_stages(params, n_stages: int):

@@ -7,6 +7,7 @@ Each optimizer exists in two forms:
   familiarity with the reference API (ref apex/optimizers/__init__.py).
 """
 
+from apex_tpu.optimizers._base import opt_partition_specs
 from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam
 from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd
 from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb
@@ -18,6 +19,7 @@ from apex_tpu.optimizers.fused_mixed_precision_lamb import (
 )
 
 __all__ = [
+    "opt_partition_specs",
     "FusedAdam", "fused_adam",
     "FusedSGD", "fused_sgd",
     "FusedLAMB", "fused_lamb",

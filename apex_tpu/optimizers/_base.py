@@ -194,3 +194,20 @@ class FusedOptimizer:
                     f"{got}, optimizer has {have}")
             grp["state"] = s
         self.defaults.update(state_dict.get("defaults", {}))
+
+
+def opt_partition_specs(tx, params, param_specs):
+    """PartitionSpec tree for ``tx.init(params)`` state whose moment trees
+    mirror the param sharding (the Fused* ``(count, mu, nu)`` NamedTuples;
+    any other state replicates). The standard companion to sharding a
+    fused optimizer's state under ``shard_map``/``jit``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+    specs = jax.tree_util.tree_map(
+        lambda _: P(), shapes,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    if hasattr(specs, "_replace") and hasattr(specs, "mu"):
+        specs = specs._replace(mu=param_specs, nu=param_specs)
+    return specs

@@ -106,8 +106,14 @@ def router_gates(logits, cfg: MoEConfig):
         gates_sum = gates_sum + gate
         remaining = remaining * (1.0 - onehot)
 
-    # normalize the kept gates so they sum to 1 per token (GShard combine)
-    denom = jnp.maximum(gates_sum, 1e-9)
+    # top-k>1: normalize the kept gates to sum to 1 per token (GShard /
+    # Mixtral combine). top-1 keeps the RAW probability (Switch eq. 2):
+    # normalizing would make the gate a constant 1 and kill the router's
+    # task-loss gradient — it would learn from the balance loss only.
+    if cfg.top_k == 1:
+        denom = jnp.ones_like(gates_sum)
+    else:
+        denom = jnp.maximum(gates_sum, 1e-9)
     for onehot, gate, pos_t, keep in pieces:
         slot = jax.nn.one_hot(pos_t, c, dtype=jnp.float32)       # [T, C]
         contrib = (gate / denom)[:, None, None] * onehot[:, :, None] \
@@ -124,24 +130,27 @@ def router_gates(logits, cfg: MoEConfig):
     return combine, dispatch, aux
 
 
-def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
-            activation=jax.nn.gelu, router_key=None):
-    """MoE feed-forward on [..., h]; returns (y, aux_loss).
+def expert_parallel_apply(expert_fn, expert_params, x, router,
+                          cfg: MoEConfig,
+                          ep_axis: Optional[str] = EXPERT_AXIS,
+                          router_key=None):
+    """Route tokens through per-expert functions; returns (y, aux_loss).
 
-    Inside ``shard_map`` with ``ep_axis`` bound, experts run
-    expert-parallel: params['wi']/'wo' hold only the LOCAL experts
-    ([E/n, ...], sharded with :func:`moe_param_specs`) while the router
-    and dispatch math see all E experts. Without a bound axis it runs all
-    experts locally (single-device semantics, same math).
+    ``expert_fn(expert_params, tokens)`` maps [E_local, C', h] ->
+    [E_local, C', h] with the LOCAL experts' stacked params (any
+    structure — a dict of stacked weights works). Inside ``shard_map``
+    with ``ep_axis`` bound the dispatch swaps the expert dim for the
+    token dim with a pair of tiled all_to_all collectives so each rank
+    runs only its experts; without the axis everything runs locally
+    (identical math). This is the layer other modules build on — e.g.
+    the Llama Mixtral-style SwiGLU experts — while :func:`moe_mlp` is
+    the plain two-matmul MLP instance.
     """
     lead = x.shape[:-1]
     h = x.shape[-1]
     xt = x.reshape(-1, h)
-    t = xt.shape[0]
-    e = cfg.num_experts
 
-    logits = jnp.matmul(xt.astype(jnp.float32),
-                        params["router"].astype(jnp.float32))
+    logits = jnp.matmul(xt.astype(jnp.float32), router.astype(jnp.float32))
     if router_key is not None and cfg.router_jitter > 0.0:
         logits = logits * jax.random.uniform(
             router_key, logits.shape, jnp.float32,
@@ -159,9 +168,7 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
         expert_in = jax.lax.all_to_all(
             expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True)
 
-    y = jnp.einsum("ech,ehf->ecf", expert_in, params["wi"].astype(xt.dtype))
-    y = activation(y)
-    y = jnp.einsum("ecf,efh->ech", y, params["wo"].astype(xt.dtype))
+    y = expert_fn(expert_params, expert_in)
 
     if _axis_bound(ep_axis):
         # inverse: [E/n, n*C, h] -> [E, C, h]; capacity slab j returns to
@@ -171,3 +178,24 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
 
     out = jnp.einsum("tec,ech->th", combine.astype(xt.dtype), y)
     return out.reshape(*lead, h).astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
+            activation=jax.nn.gelu, router_key=None):
+    """MoE feed-forward on [..., h]; returns (y, aux_loss).
+
+    Inside ``shard_map`` with ``ep_axis`` bound, experts run
+    expert-parallel: params['wi']/'wo' hold only the LOCAL experts
+    ([E/n, ...], sharded with :func:`moe_param_specs`) while the router
+    and dispatch math see all E experts. Without a bound axis it runs all
+    experts locally (single-device semantics, same math).
+    """
+
+    def expert_fn(p, tokens):
+        y = jnp.einsum("ech,ehf->ecf", tokens, p["wi"].astype(tokens.dtype))
+        y = activation(y)
+        return jnp.einsum("ecf,efh->ech", y, p["wo"].astype(tokens.dtype))
+
+    return expert_parallel_apply(
+        expert_fn, {"wi": params["wi"], "wo": params["wo"]}, x,
+        params["router"], cfg, ep_axis=ep_axis, router_key=router_key)

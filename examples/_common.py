@@ -53,16 +53,8 @@ def synthetic_images(key, batch: int, size: int, classes: int):
 
 
 def opt_partition_specs(tx, params, param_specs):
-    """PartitionSpec tree for an optimizer state whose moment trees mirror
-    the param sharding (FusedAdam/FusedLAMB-style ``(count, mu, nu)``
-    NamedTuples; anything else replicates). Shared by the parallel
-    training examples so the spec-construction dance lives in one place."""
-    from jax.sharding import PartitionSpec as P
+    """Re-export of :func:`apex_tpu.optimizers.opt_partition_specs` (the
+    examples imported it from here before it was promoted to the package)."""
+    from apex_tpu.optimizers import opt_partition_specs as f
 
-    shapes = jax.eval_shape(tx.init, params)
-    specs = jax.tree_util.tree_map(
-        lambda _: P(), shapes,
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-    if hasattr(specs, "_replace") and hasattr(specs, "mu"):
-        specs = specs._replace(mu=param_specs, nu=param_specs)
-    return specs
+    return f(tx, params, param_specs)

@@ -57,3 +57,9 @@ def test_bert_train():
 def test_gpt2_train():
     out = _run("gpt2_train.py", "--steps", "8")
     assert "(decreased)" in out
+
+
+@pytest.mark.slow
+def test_moe_train():
+    out = _run("moe_train.py", "--steps", "10")
+    assert "(decreased)" in out

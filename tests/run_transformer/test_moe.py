@@ -37,10 +37,25 @@ class TestRouter:
         chosen = jnp.argmax(combine.sum(-1), axis=-1)
         np.testing.assert_array_equal(np.asarray(chosen),
                                       np.asarray(jnp.argmax(logits, -1)))
-        # top-1 normalized gate is 1 for every kept token
+        # Switch keeps the RAW top probability as the gate (a normalized
+        # top-1 gate would be the constant 1 — no router gradient)
         np.testing.assert_allclose(np.asarray(combine.sum((-2, -1))),
-                                   1.0, rtol=1e-5)
-        del probs, aux
+                                   np.asarray(jnp.max(probs, -1)),
+                                   rtol=1e-5)
+        del aux
+
+    def test_top1_router_gets_task_gradient(self):
+        cfg = _cfg(top_k=1, capacity_factor=8.0, aux_loss_coef=0.0)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+
+        def loss(p):
+            y, _ = moe_mlp(p, x, cfg, ep_axis=None)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.max(jnp.abs(g["router"]))) > 0, (
+            "top-1 router must learn from the task loss")
 
     def test_capacity_limit(self):
         cfg = _cfg(top_k=1, capacity_factor=0.25)
