@@ -20,9 +20,15 @@ from apex_tpu.distributed.backend import (
     reduce_scatter,
     ReduceOp,
 )
+from apex_tpu.distributed.divergence import (
+    DivergenceMonitor,
+    assert_replicas_equal,
+    replica_divergence,
+)
 
 __all__ = [
     "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
     "get_rank", "get_world_size", "init_process_group", "is_initialized",
     "new_group", "reduce_scatter", "ReduceOp",
+    "DivergenceMonitor", "assert_replicas_equal", "replica_divergence",
 ]

@@ -52,6 +52,14 @@ def _axis_bound(axis: str) -> bool:
         return False
 
 
+def make_varying(x, axis: str):
+    """Mark a replicated value as device-varying over a shard_map axis
+    (transpose: psum). Idempotent: values already varying over ``axis``
+    pass through. Public — model code, examples, and other subsystems
+    need it whenever fresh values must match the vma of computed ones."""
+    return _to_varying(x, axis)
+
+
 def _to_varying(x, axis: str):
     """Mark a replicated value as device-varying (transpose: psum).
     Idempotent: values already varying over ``axis`` pass through."""

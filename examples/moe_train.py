@@ -50,7 +50,7 @@ def main():
         moe_mlp,
         moe_param_specs,
     )
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+    from apex_tpu.transformer.tensor_parallel.mappings import make_varying
 
     dp, ep = args.dp, args.ep
     mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(dp, ep),
@@ -65,14 +65,14 @@ def main():
     tx = fused_adam(lr=args.lr)
 
     def pmean(t, ax):
-        return jax.lax.pmean(_to_varying(t, ax), ax)
+        return jax.lax.pmean(make_varying(t, ax), ax)
 
     def train_step(params, opt_state, x, target):
         def loss_fn(params):
             vary = params
             for ax in ("dp", "ep"):
                 vary = jax.tree_util.tree_map(
-                    lambda a, ax=ax: _to_varying(a, ax), vary)
+                    lambda a, ax=ax: make_varying(a, ax), vary)
             y, aux = moe_mlp(vary, x, cfg, ep_axis="ep")
             mse = jnp.mean((y - target) ** 2)
             for ax in ("dp", "ep"):
