@@ -32,13 +32,32 @@ from typing import NamedTuple, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.transformer.tensor_parallel.mappings import make_varying
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    make_varying,
+    tree_vma,
+)
 
 Axes = Union[str, Sequence[str]]
 
 
 def _axes_tuple(axis_name: Axes):
     return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _spread(h, mag, axis_name: Axes) -> jax.Array:
+    """Axis-wide digest comparison: exact integer hash decides WHETHER
+    replicas diverge; the f32 magnitude spread (floored to stay nonzero)
+    estimates HOW MUCH."""
+    h_hi = h_lo = h.astype(jnp.int32)
+    m_hi = m_lo = mag
+    for ax in _axes_tuple(axis_name):
+        h_hi = jax.lax.pmax(make_varying(h_hi, ax), ax)
+        h_lo = jax.lax.pmin(make_varying(h_lo, ax), ax)
+        m_hi = jax.lax.pmax(make_varying(m_hi, ax), ax)
+        m_lo = jax.lax.pmin(make_varying(m_lo, ax), ax)
+    return jnp.where(h_hi != h_lo,
+                     jnp.maximum(jnp.abs(m_hi - m_lo),
+                                 jnp.float32(1e-30)), 0.0)
 
 
 def _leaf_bits(leaf) -> jax.Array:
@@ -85,16 +104,7 @@ def replica_divergence(tree, axis_name: Axes) -> jax.Array:
     collectives plus one pass over the tree.
     """
     h, mag = _fingerprint(tree)
-    h_hi, h_lo = h.astype(jnp.int32), h.astype(jnp.int32)
-    m_hi, m_lo = mag, mag
-    for ax in _axes_tuple(axis_name):
-        h_hi = jax.lax.pmax(make_varying(h_hi, ax), ax)
-        h_lo = jax.lax.pmin(make_varying(h_lo, ax), ax)
-        m_hi = jax.lax.pmax(make_varying(m_hi, ax), ax)
-        m_lo = jax.lax.pmin(make_varying(m_lo, ax), ax)
-    differs = h_hi != h_lo
-    spread = jnp.abs(m_hi - m_lo)
-    return jnp.where(differs, jnp.maximum(spread, jnp.float32(1e-30)), 0.0)
+    return _spread(h, mag, axis_name)
 
 
 def assert_replicas_equal(tree, axis_name: Axes, atol: float = 0.0):
@@ -142,43 +152,35 @@ class DivergenceMonitor:
         step = state.step + 1
         due = (step % self.every) == 0
         if force is not None:
-            due = jnp.logical_or(due, force)
+            # a rank-local force would make the cond predicate differ
+            # across ranks and latch a false positive (one rank digests,
+            # the others produce zeros) — make it axis-uniform: ANY rank
+            # forcing forces everyone
+            f = force.astype(jnp.int32)
+            for ax in _axes_tuple(axis_name):
+                f = jax.lax.pmax(make_varying(f, ax), ax)
+            due = jnp.logical_or(due, f > 0)
 
         # the expensive full-tree digest only computes on due steps
         # (lax.cond with no collectives inside); the cheap SCALAR
-        # collectives below run unconditionally — `due` is step-derived
-        # and identical on every rank, so both branches agree axis-wide
-        # and the off-step digest is a zero that trivially matches
+        # collectives in _spread run unconditionally — `due` is uniform
+        # across the axis (step-derived, or pmax'd force), so both
+        # branches agree axis-wide and the off-step zeros trivially match
         def digest(_):
             return _fingerprint(tree)
 
         def skip(_):
-            # fresh zeros must match the digest branch's vma (the union of
-            # the tree leaves' varying axes) or the cond types disagree
-            vma = set()
-            for leaf in jax.tree_util.tree_leaves(tree):
-                try:
-                    vma |= set(jax.typeof(leaf).vma)
-                except (AttributeError, TypeError):
-                    pass
+            # fresh zeros must match the digest branch's vma (the union
+            # of the tree leaves' varying axes) or the cond types disagree
             h0 = jnp.zeros((), jnp.uint32)
             m0 = jnp.zeros((), jnp.float32)
-            for ax in sorted(vma):
+            for ax in sorted(tree_vma(tree)):
                 h0 = make_varying(h0, ax)
                 m0 = make_varying(m0, ax)
             return h0, m0
 
         h, mag = jax.lax.cond(due, digest, skip, None)
-        h_hi, h_lo = h.astype(jnp.int32), h.astype(jnp.int32)
-        m_hi, m_lo = mag, mag
-        for ax in _axes_tuple(axis_name):
-            h_hi = jax.lax.pmax(make_varying(h_hi, ax), ax)
-            h_lo = jax.lax.pmin(make_varying(h_lo, ax), ax)
-            m_hi = jax.lax.pmax(make_varying(m_hi, ax), ax)
-            m_lo = jax.lax.pmin(make_varying(m_lo, ax), ax)
-        div = jnp.where(h_hi != h_lo,
-                        jnp.maximum(jnp.abs(m_hi - m_lo),
-                                    jnp.float32(1e-30)), 0.0)
+        div = _spread(h, mag, axis_name)
         bad = div > self.atol
         return DivergenceState(
             step=step,

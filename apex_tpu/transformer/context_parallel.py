@@ -40,15 +40,12 @@ def _vary_like(x, axis, *like):
     values computed from the real inputs — when cp composes with tp/pp/dp
     in one shard_map (the 4-axis dryrun), q/k/v vary over MORE than the
     ring axis and a carry marked only {cp} trips the scan vma check."""
-    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        _to_varying,
+        tree_vma,
+    )
 
-    axes = {axis}
-    for t in like:
-        try:
-            axes |= set(jax.typeof(t).vma)
-        except (AttributeError, TypeError):
-            pass
-    for ax in sorted(axes):
+    for ax in sorted({axis} | tree_vma(like)):
         x = _to_varying(x, ax)
     return x
 

@@ -3,6 +3,7 @@
 
 from apex_tpu.transformer.pipeline_parallel import p2p
 from apex_tpu.transformer.pipeline_parallel import utils
+from apex_tpu.transformer.pipeline_parallel._timers import Timers
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     ExperimentalWarning,
     build_model,
@@ -22,6 +23,7 @@ __all__ = [
     "p2p",
     "p2p_communication",
     "utils",
+    "Timers",
     "ExperimentalWarning",
     "build_model",
     "forward_backward_no_pipelining",

@@ -60,6 +60,22 @@ def make_varying(x, axis: str):
     return _to_varying(x, axis)
 
 
+def tree_vma(*trees) -> set:
+    """Union of the mesh axes any leaf of the given pytrees varies over.
+
+    The standard companion to :func:`make_varying`: fresh zeros for scan
+    carries / cond branches must be marked varying over exactly these
+    axes to type-match values computed from the real inputs."""
+    axes: set = set()
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            try:
+                axes |= set(jax.typeof(leaf).vma)
+            except (AttributeError, TypeError):
+                pass
+    return axes
+
+
 def _to_varying(x, axis: str):
     """Mark a replicated value as device-varying (transpose: psum).
     Idempotent: values already varying over ``axis`` pass through."""

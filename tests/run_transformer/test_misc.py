@@ -160,3 +160,54 @@ def test_rampup_equal_start_and_global_batch():
     assert calc.get_current_global_batch_size() == 16
     calc.update(10, True)
     assert calc.get() == 4
+
+
+class TestTimers:
+    """ref pipeline_parallel/_timers.py parity (device-sync via
+    block_until_ready instead of cuda.synchronize)."""
+
+    def test_basic_and_elapsed(self):
+        import time as _time
+
+        from apex_tpu.transformer.pipeline_parallel import Timers
+
+        timers = Timers()
+        timers("phase").start()
+        _time.sleep(0.01)
+        x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+        timers("phase").stop(block_on=x)
+        e = timers("phase").elapsed(reset=True)
+        assert e >= 0.01
+        assert timers("phase").elapsed() == 0.0
+
+    def test_log_and_write(self):
+        from apex_tpu.transformer.pipeline_parallel import Timers
+
+        timers = Timers()
+        timers("a").start()
+        timers("a").stop()
+        lines = []
+        timers.log(["a"], printer=lines.append)
+        assert lines and "a:" in lines[0]
+
+        class W:
+            def __init__(self):
+                self.calls = []
+
+            def add_scalar(self, *a):
+                self.calls.append(a)
+
+        timers("b").start()
+        timers("b").stop()
+        w = W()
+        timers.write(["b"], w, iteration=3)
+        assert w.calls and w.calls[0][0] == "b-time"
+
+    def test_double_start_asserts(self):
+        from apex_tpu.transformer.pipeline_parallel import Timers
+
+        timers = Timers()
+        timers("x").start()
+        with pytest.raises(AssertionError):
+            timers("x").start()
+        timers("x").stop()
