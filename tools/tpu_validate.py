@@ -192,6 +192,25 @@ def causal_softmax(bh, S):
     _close(got, want, name="causal softmax")
 
 
+@check("masked_softmax")
+def masked_softmax(bh, S):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_masked_softmax,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, bh // 4, S, S),
+                          jnp.bfloat16)
+    mask = (jax.random.uniform(jax.random.PRNGKey(10), (4, 1, S, S))
+            > 0.8)
+    with pallas_config.force("on"):
+        got = jax.jit(lambda x: scaled_masked_softmax(x, mask, 0.5))(x)
+        got.block_until_ready()
+    with pallas_config.force("off"):
+        want = jax.jit(lambda x: scaled_masked_softmax(x, mask, 0.5))(x)
+    _close(got, want, name="masked softmax")
+
+
 @check("odd_rows_layer_norm")
 def odd_rows(hidden):
     from apex_tpu.ops import pallas_config
@@ -240,6 +259,7 @@ def main():
     layer_norm(rows, hidden)
     rms_norm(rows, hidden)
     causal_softmax(bh, sm_s)
+    masked_softmax(bh, sm_s // 2)
     odd_rows(hidden)
 
     fails = [r for r in RESULTS if not r[1]]
