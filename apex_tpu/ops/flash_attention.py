@@ -90,7 +90,7 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen, p_drop,
         run = (ki * block_k) <= (qi * block_q + block_q - 1)
     if varlen:
         # whole block past this sequence's keys ⇒ nothing to do
-        run = run & ((ki * block_k) < kvlen_ref[0, 0])
+        run = run & ((ki * block_k) < kvlen_ref[0, 0, 0])
 
     @pl.when(run)
     def _step():
@@ -105,7 +105,7 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen, p_drop,
         if sk % block_k:
             s = jnp.where(k_pos < sk, s, _NEG_INF)
         if varlen:
-            s = jnp.where(k_pos < kvlen_ref[0, 0], s, _NEG_INF)
+            s = jnp.where(k_pos < kvlen_ref[0, 0, 0], s, _NEG_INF)
 
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -131,8 +131,12 @@ def _fwd_kernel(causal, scale, block_q, block_k, sq, sk, varlen, p_drop,
     def _finish():
         l = jnp.maximum(l_sc[:, 0], 1e-30)
         o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
-        # exact per-row logsumexp — the backward's p-block recompute key
-        lse_ref[0] = (m_sc[:, 0] + jnp.log(l)).astype(jnp.float32)
+        # exact per-row logsumexp — the backward's p-block recompute key.
+        # lse rides as [bh, sq, 1]: a (1, bq) block over [bh, sq] violates
+        # Mosaic's last-two-dims rule (second-to-last must divide 8 or
+        # equal the array dim); the trailing singleton makes the block
+        # (1, bq, 1) legal (bq % 8 == 0, 1 == full dim)
+        lse_ref[0, :, 0] = (m_sc[:, 0] + jnp.log(l)).astype(jnp.float32)
 
 
 def _pick_block(s, target):
@@ -156,11 +160,12 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
 
     ``kv_lens`` [bh] int32 (varlen): row b attends only to its first
     kv_lens[b] keys; blocks entirely past the bound are skipped. The
-    length rides as a (1, 1) VMEM block per row; scalar prefetch (SMEM via
-    PrefetchScalarGridSpec) would let Mosaic skip the block FETCH too, but
-    needs per-shape grid plumbing — revisit if varlen profiles hot. The
-    compiled-Mosaic behavior of this sub-tile scalar block is exercised by
-    bench.py's hardware kernel runs (round-3).
+    length rides as a [bh, 1, 1] array with a (1, 1, 1) VMEM block per
+    row (the last two block dims must equal the array dims or divide the
+    (8, 128) tile — CI pins this via tests/run_pallas/test_tpu_lowering);
+    scalar prefetch (SMEM via PrefetchScalarGridSpec) would let Mosaic
+    skip the block FETCH too, but needs per-shape grid plumbing —
+    revisit if varlen profiles hot.
     """
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
@@ -179,8 +184,11 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
     ]
     args = (q, k, v)
     if varlen:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
-        args = args + (kv_lens.astype(jnp.int32).reshape(bh, 1),)
+        # [bh, 1, 1] with a (1, 1, 1) block: last two dims equal the
+        # array's, which Mosaic accepts ((1, 1) over [bh, 1] does not)
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0)))
+        args = args + (kv_lens.astype(jnp.int32).reshape(bh, 1, 1),)
     if p_drop:
         in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (0, 0)))
         args = args + (seed.astype(jnp.uint32).reshape(1, 1),)
@@ -190,11 +198,11 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             pallas_config.out_struct((bh, sq, d), q.dtype, q, k, v),
-            pallas_config.out_struct((bh, sq), jnp.float32, q, k, v),
+            pallas_config.out_struct((bh, sq, 1), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -203,7 +211,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
         ],
         interpret=interpret,
     )(*args)
-    return o, lse
+    # public lse stays [bh, sq]; the singleton is a kernel-layout detail
+    return o, lse[:, :, 0]
 
 
 def _reference_attention(q, k, v, causal, scale, kv_lens=None, p_drop=0.0,
@@ -269,7 +278,7 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen, p_drop,
     if causal:
         run = (ki * bk) <= (qi * bq + bq - 1)
     if varlen:
-        run = run & ((ki * bk) < kvlen_ref[0, 0])
+        run = run & ((ki * bk) < kvlen_ref[0, 0, 0])
 
     @pl.when(run)
     def _step():
@@ -280,7 +289,7 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen, p_drop,
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])
         if causal or varlen or p_drop:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
@@ -289,7 +298,7 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen, p_drop,
         if causal:
             p = jnp.where(k_pos <= q_pos, p, 0.0)
         if varlen:
-            p = jnp.where(k_pos < kvlen_ref[0, 0], p, 0.0)
+            p = jnp.where(k_pos < kvlen_ref[0, 0, 0], p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
@@ -300,7 +309,7 @@ def _bwd_dq_kernel(causal, scale, bq, bk, varlen, p_drop,
             keep = _keep_mask(seed_ref[0, 0], bh_idx.astype(jnp.uint32),
                               q_pos, k_pos, p_drop)
             dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
-        ds = p * (dp - dl_ref[0][:, None]) * scale
+        ds = p * (dp - dl_ref[0]) * scale
         acc_sc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -331,7 +340,7 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen, p_drop,
     if causal:
         run = (qi * bq + bq - 1) >= (ki * bk)
     if varlen:
-        run = run & ((ki * bk) < kvlen_ref[0, 0])
+        run = run & ((ki * bk) < kvlen_ref[0, 0, 0])
 
     @pl.when(run)
     def _step():
@@ -342,7 +351,7 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen, p_drop,
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])
         if causal or varlen or p_drop:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
@@ -351,7 +360,7 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen, p_drop,
         if causal:
             p = jnp.where(k_pos <= q_pos, p, 0.0)
         if varlen:
-            p = jnp.where(k_pos < kvlen_ref[0, 0], p, 0.0)
+            p = jnp.where(k_pos < kvlen_ref[0, 0, 0], p, 0.0)
         if p_drop:
             # same counter-based mask as the forward: bh = g*rep + r here
             bh_idx = (g_idx * rep + r).astype(jnp.uint32)
@@ -367,7 +376,7 @@ def _bwd_dkv_kernel(causal, scale, bq, bk, rep, nq, varlen, p_drop,
             preferred_element_type=jnp.float32)
         if p_drop:
             dp = jnp.where(keep, dp / (1.0 - p_drop), 0.0)
-        ds = p * (dp - dl_ref[0][:, None]) * scale
+        ds = p * (dp - dl_ref[0]) * scale
         dk_sc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, d]
@@ -391,33 +400,38 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     nq, nk = sq // bq, sk // bk
     varlen = kv_lens is not None
 
-    # D_i = rowsum(dO * O): elementwise, O(s·d) — fine as fused XLA
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # D_i = rowsum(dO * O): elementwise, O(s·d) — fine as fused XLA.
+    # lse/delta ride as [bh, sq, 1] (same Mosaic block-shape rule as the
+    # forward's lse output)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None]
+    lse3 = lse.reshape(bh, sq, 1)
 
     dq_in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b // rep, j, 0)),
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-        pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
     ]
-    dq_args = (q, k, v, do, lse, delta)
+    dq_args = (q, k, v, do, lse3, delta)
     dkv_in_specs = [
         pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
         pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
         pl.BlockSpec((1, bk, d), lambda g, j, r, i: (g, j, 0)),
         pl.BlockSpec((1, bq, d), lambda g, j, r, i: (g * rep + r, i, 0)),
-        pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
-        pl.BlockSpec((1, bq), lambda g, j, r, i: (g * rep + r, i)),
+        pl.BlockSpec((1, bq, 1), lambda g, j, r, i: (g * rep + r, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda g, j, r, i: (g * rep + r, i, 0)),
     ]
-    dkv_args = (q, k, v, do, lse, delta)
+    dkv_args = (q, k, v, do, lse3, delta)
     if varlen:
-        kvl = kv_lens.astype(jnp.int32).reshape(bh, 1)
-        dq_in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b, 0)))
+        kvl = kv_lens.astype(jnp.int32).reshape(bh, 1, 1)
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0)))
         dq_args = dq_args + (kvl,)
         dkv_in_specs.append(
-            pl.BlockSpec((1, 1), lambda g, j, r, i: (g * rep + r, 0)))
+            pl.BlockSpec((1, 1, 1), lambda g, j, r, i: (g * rep + r, 0, 0)))
         dkv_args = dkv_args + (kvl,)
     if p_drop:
         sd = seed.astype(jnp.uint32).reshape(1, 1)
