@@ -78,6 +78,20 @@ class TestFlashLowering:
 
         lowers_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
 
+    def test_varlen_dropout_combo_fwd_bwd(self):
+        # kvlen (3-D block) and seed (2-D block) in ONE pallas_call, all
+        # three kernels — the densest ref configuration
+        q, k, v = _qkv()
+        lens = jnp.full((B,), S // 2, jnp.int32)
+        key = jax.random.PRNGKey(1)
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, kv_lens=lens, dropout_p=0.1,
+                                dropout_key=key)
+            return jnp.sum(o.astype(jnp.float32))
+
+        lowers_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
     def test_small_heads_and_blocks(self):
         # d=64, sq below the default block -> _pick_block shrink path
         q = jnp.ones((4, 192, 2, 64), jnp.bfloat16)
@@ -107,6 +121,80 @@ class TestNormLowering:
             return jnp.sum(rms_norm(x, w, (h,)).astype(jnp.float32))
 
         lowers_for_tpu(jax.grad(loss, argnums=(0, 1)), x, w)
+
+
+class TestRingFlashLowering:
+    """The Pallas flash kernels INSIDE shard_map (ring attention over
+    'cp') — collectives lower alongside Mosaic kernels."""
+
+    def _mesh(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:4]), ("cp",))
+
+    def test_ring_fwd(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        q = jnp.ones((2, 1024, 4, 128), jnp.bfloat16)
+        f = shard_map(
+            lambda q: ring_attention(q, q, q, causal=True),
+            mesh=self._mesh(), in_specs=P(None, "cp"),
+            out_specs=P(None, "cp"), check_vma=False)
+        lowers_for_tpu(f, q)
+
+    def test_ring_fwd_bwd(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer.context_parallel import ring_attention
+
+        q = jnp.ones((2, 1024, 4, 128), jnp.bfloat16)
+        mesh = self._mesh()
+
+        def loss(q):
+            def inner(q):
+                o = ring_attention(q, q, q, causal=True)
+                return jax.lax.psum(jnp.sum(o.astype(jnp.float32)), "cp")
+
+            return shard_map(inner, mesh=mesh, in_specs=P(None, "cp"),
+                             out_specs=P(), check_vma=False)(q)
+
+        lowers_for_tpu(jax.grad(loss), q)
+
+
+class TestMoELowering:
+    def test_ep_all_to_all(self):
+        import numpy as np
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.transformer.moe import (
+            MoEConfig,
+            init_moe_params,
+            moe_mlp,
+            moe_param_specs,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("dp", "ep"))
+        cfg = MoEConfig(hidden_size=128, ffn_hidden_size=256,
+                        num_experts=8, top_k=2)
+        params = init_moe_params(jax.random.PRNGKey(0), cfg)
+        x = jnp.ones((64, 128), jnp.bfloat16)
+
+        def fn(params, x):
+            y, aux = moe_mlp(params, x, cfg, ep_axis="ep")
+            return y, jax.lax.pmean(jax.lax.pmean(aux, "ep"), "dp")
+
+        f = shard_map(fn, mesh=mesh,
+                      in_specs=(moe_param_specs(cfg),
+                                P(("dp", "ep"), None)),
+                      out_specs=(P(("dp", "ep"), None), P()))
+        lowers_for_tpu(f, params, x)
 
 
 class TestSoftmaxLowering:
