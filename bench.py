@@ -73,11 +73,23 @@ def make_params(key, n_layers=24, hidden=1024, vocab=50304):
     return params
 
 
-def time_fn(fn, *args, iters=20, warmup=3):
+def time_fn(fn, *args, iters=20, warmup=3, max_time_s=None):
+    """Warmup then time ``iters`` calls. ``max_time_s`` caps the TIMED
+    loop's wall clock: the last warmup call (synced) estimates the per-step
+    cost and ``iters`` shrinks to fit — the dispatch-bound baselines can
+    take tens of seconds per step through a remote device tunnel, and one
+    pass of a 2k-dispatch loop is a statistically fine sample. With
+    ``warmup=1`` the estimate includes compile time, which only makes the
+    shrink more conservative (the timed loop itself runs compile-free)."""
     import jax
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1) - 1):
         out = fn(*args)
+    t0 = time.perf_counter()
+    out = fn(*args)
     jax.block_until_ready(out)
+    per_step = time.perf_counter() - t0
+    if max_time_s is not None:
+        iters = max(1, min(iters, int(max_time_s / max(per_step, 1e-9))))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
@@ -177,7 +189,8 @@ def bench_fused_adam(cpu_mode, extras):
                 out[k] = (p + d, m, v)
         return out
 
-    eager_t = time_fn(eager_step, iters=eager_iters, warmup=1)
+    eager_t = time_fn(eager_step, iters=eager_iters, warmup=1,
+                      max_time_s=60.0)
     print(f"eager (op-by-op): {eager_t * 1e3:.3f} ms/step", file=sys.stderr)
 
     # secondary, stricter baseline: one jitted dispatch per tensor (each
@@ -195,7 +208,8 @@ def bench_fused_adam(cpu_mode, extras):
         return {k: one_tensor(grads[k], single_states[k], p)
                 for k, p in params.items()}
 
-    pt_t = time_fn(per_tensor_step, iters=eager_iters, warmup=1)
+    pt_t = time_fn(per_tensor_step, iters=eager_iters, warmup=1,
+                   max_time_s=60.0)
     print(f"per-tensor-jit: {pt_t * 1e3:.3f} ms/step", file=sys.stderr)
     extras["eager_step_ms"] = round(eager_t * 1e3, 3)
     extras["per_tensor_jit_step_ms"] = round(pt_t * 1e3, 3)
