@@ -652,12 +652,52 @@ def _run_worker(env, timeout, errors):
     return None
 
 
+def _axon_relay_down() -> bool:
+    """True only when this container's TPU transport is the axon local
+    relay (JAX_PLATFORMS=axon + pool env) AND its stateless port refuses
+    connections — the observed 2026-07-30 outage mode, where the PJRT
+    client retries forever and the worker burns its whole watchdog.
+    Any other transport returns False (never skip a reachable TPU)."""
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        return False
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False
+    import socket
+    try:
+        with socket.create_connection(("127.0.0.1", 8083), timeout=3):
+            return False
+    except ConnectionRefusedError:
+        return True  # nothing listening — the observed outage mode
+    except OSError:
+        # timeout / transient errno: the relay may be alive but slow —
+        # never skip a possibly-reachable TPU
+        return False
+
+
 def launcher():
     env = dict(os.environ)
     env.pop("BENCH_FORCE_CPU", None)
     errors = []
+
+    skip_tpu = False
+    if _axon_relay_down():
+        # give the relay ~90s to come back, then skip the doomed 600s
+        # watchdog attempts entirely
+        for _ in range(6):
+            time.sleep(15)
+            if not _axon_relay_down():
+                break
+        else:
+            skip_tpu = True
+            print("axon relay 127.0.0.1:8083 refused for 90s; "
+                  "skipping TPU attempts", file=sys.stderr)
+            errors.append("axon relay 127.0.0.1:8083 connection refused "
+                          "(local relay down; PJRT client would retry "
+                          "forever)")
     delays = [20]
     for attempt in range(len(delays) + 1):
+        if skip_tpu:
+            break
         line = _run_worker(env, timeout=1500, errors=errors)
         if line is not None:
             print(line)
