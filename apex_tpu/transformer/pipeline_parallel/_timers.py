@@ -37,7 +37,8 @@ class _Timer:
         self._annotation = None
 
     def start(self):
-        assert not self.started_, "timer has already been started"
+        if self.started_:
+            raise RuntimeError("timer has already been started")
         self._annotation = jax.profiler.TraceAnnotation(
             f"timer/{self.name_}")
         self._annotation.__enter__()
@@ -49,7 +50,8 @@ class _Timer:
         region — blocked on so the elapsed time covers device execution
         (the reference's cuda.synchronize analog). Omit for host-only
         regions."""
-        assert self.started_, "timer is not started"
+        if not self.started_:
+            raise RuntimeError("timer is not started")
         if block_on is not None:
             jax.block_until_ready(block_on)
         self.elapsed_ += time.time() - self.start_time
@@ -96,6 +98,8 @@ class Timers:
         ``add_scalar(tag, value, step)``)."""
         assert normalizer > 0.0
         for name in names:
+            if name not in self.timers:
+                continue  # same contract as log(): unstarted phases skip
             value = self.timers[name].elapsed(reset=reset) / normalizer
             writer.add_scalar(f"{name}-time", value, iteration)
 
@@ -104,7 +108,13 @@ class Timers:
         assert normalizer > 0.0
         string = "time (ms)"
         for name in names:
+            if name not in self.timers:
+                continue  # never-started phases just don't report
             elapsed_time = (self.timers[name].elapsed(reset=reset)
                             * 1000.0 / normalizer)
             string += f" | {name}: {elapsed_time:.2f}"
-        (printer or print)(string)
+        if printer is not None:
+            printer(string)
+        else:
+            # flushed: timing lines must survive a watchdog os._exit
+            print(string, flush=True)

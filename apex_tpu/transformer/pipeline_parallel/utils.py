@@ -2,10 +2,14 @@
 
 from __future__ import annotations
 
-import time
 from typing import List, Optional, Union
 
 import jax
+
+from apex_tpu.transformer.pipeline_parallel._timers import (  # noqa: F401
+    Timers,
+    _Timer,
+)
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state
@@ -205,61 +209,9 @@ def get_ltor_masks_and_position_ids(
 # ------------------------------------------------------------------- timers
 
 
-class _Timer:
-    """ref _timers.py:6."""
-
-    def __init__(self, name):
-        self.name_ = name
-        self.elapsed_ = 0.0
-        self.started_ = False
-        self.start_time = 0.0
-
-    def start(self):
-        if self.started_:
-            raise RuntimeError("timer has already been started")
-        self.start_time = time.time()
-        self.started_ = True
-
-    def stop(self):
-        if not self.started_:
-            raise RuntimeError("timer is not started")
-        self.elapsed_ += time.time() - self.start_time
-        self.started_ = False
-
-    def reset(self):
-        self.elapsed_ = 0.0
-        self.started_ = False
-
-    def elapsed(self, reset=True):
-        started = self.started_
-        if started:
-            self.stop()
-        e = self.elapsed_
-        if reset:
-            self.reset()
-        if started:
-            self.start()
-        return e
-
-
-class Timers:
-    """ref _timers.py:51."""
-
-    def __init__(self):
-        self.timers = {}
-
-    def __call__(self, name):
-        if name not in self.timers:
-            self.timers[name] = _Timer(name)
-        return self.timers[name]
-
-    def log(self, names, normalizer=1.0, reset=True):
-        strings = [
-            f"{name}: {self.timers[name].elapsed(reset) * 1000.0 / normalizer:.2f}"
-            for name in names
-            if name in self.timers
-        ]
-        print("time (ms) | " + " | ".join(strings))
+# _Timer/Timers live in _timers.py (the single implementation: device
+# sync via block_until_ready, profiler TraceAnnotations, tensorboard
+# write) — re-exported here for the reference's utils-level access path.
 
 
 def _set_timers():

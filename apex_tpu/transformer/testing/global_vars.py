@@ -10,11 +10,11 @@ reference's timers ``cuda.synchronize`` (ref global_vars.py:191).
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import jax
 
+from apex_tpu.transformer.pipeline_parallel import _timers as _shared_timers
 from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
 )
@@ -92,58 +92,29 @@ def destroy_global_vars():
     _GLOBAL_TIMERS = None
 
 
-class _Timer:
-    """ref global_vars.py:191 — start/stop/elapsed with device sync."""
+class _Timer(_shared_timers._Timer):
+    """Shared timer + an up-front device drain: start/stop first flush
+    ALL pending async dispatches (jax.device_put round-trip), so the
+    bracket excludes work queued before the region — the strictest
+    reading of the reference's cuda.synchronize placement
+    (ref global_vars.py:191)."""
 
-    def __init__(self, name):
-        self.name = name
-        self.elapsed_ = 0.0
-        self.started_ = False
-        self.start_time = None
+    def _drain(self):
+        jax.device_put(0.0).block_until_ready()
 
     def start(self):
-        assert not self.started_, "timer has already been started"
-        (jax.device_put(0.0)).block_until_ready()  # drain pending work
-        self.start_time = time.time()
-        self.started_ = True
+        self._drain()
+        super().start()
 
-    def stop(self):
-        assert self.started_, "timer is not started"
-        (jax.device_put(0.0)).block_until_ready()
-        self.elapsed_ += time.time() - self.start_time
-        self.started_ = False
-
-    def reset(self):
-        self.elapsed_ = 0.0
-        self.started_ = False
-
-    def elapsed(self, reset=True):
-        started = self.started_
-        if started:
-            self.stop()
-        e = self.elapsed_
-        if reset:
-            self.reset()
-        if started:
-            self.start()
-        return e
+    def stop(self, block_on=None):
+        self._drain()
+        super().stop(block_on)
 
 
-class Timers:
-    """ref global_vars.py:236 — named timer registry."""
-
-    def __init__(self):
-        self.timers = {}
+class Timers(_shared_timers.Timers):
+    """ref global_vars.py:236 — named registry over the draining timer."""
 
     def __call__(self, name):
         if name not in self.timers:
             self.timers[name] = _Timer(name)
         return self.timers[name]
-
-    def log(self, names, normalizer=1.0, reset=True):
-        assert normalizer > 0.0
-        strings = [
-            f"{name}: {self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer:.2f}"
-            for name in names if name in self.timers
-        ]
-        print("time (ms) | " + " | ".join(strings), flush=True)

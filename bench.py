@@ -660,7 +660,12 @@ def _axon_relay_down() -> bool:
     Any other transport returns False (never skip a reachable TPU)."""
     if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
         return False
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if not pool:
+        return False
+    if pool != "127.0.0.1" and os.environ.get("AXON_LOOPBACK_RELAY") != "1":
+        # remote pool addresses don't go through the local relay —
+        # a loopback refusal says nothing about THAT transport
         return False
     import socket
     try:
