@@ -85,8 +85,17 @@ def new_group(axis_name: str) -> str:
     return axis_name
 
 
+def _vary_group(x, group: Group):
+    """pvary over EVERY axis of the group — a tuple group's collective
+    needs the value varying over all of its axes, not just the first."""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    for ax in axes:
+        x = _to_varying(x, ax)
+    return x
+
+
 def all_reduce(x, op: ReduceOp = ReduceOp.SUM, group: Group = "dp"):
-    x = _to_varying(x, group if isinstance(group, str) else group[0])
+    x = _vary_group(x, group)
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         y = jax.lax.psum(x, group)
         if op == ReduceOp.AVG:
@@ -105,7 +114,7 @@ def all_reduce(x, op: ReduceOp = ReduceOp.SUM, group: Group = "dp"):
 
 
 def all_gather(x, group: Group = "dp", axis: int = 0, tiled: bool = True):
-    x = _to_varying(x, group if isinstance(group, str) else group[0])
+    x = _vary_group(x, group)
     return jax.lax.all_gather(x, group, axis=axis, tiled=tiled)
 
 
@@ -113,7 +122,7 @@ def reduce_scatter(x, group: Group = "dp", axis: int = 0,
                    op: ReduceOp = ReduceOp.SUM):
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError("reduce_scatter supports SUM/AVG")
-    x = _to_varying(x, group if isinstance(group, str) else group[0])
+    x = _vary_group(x, group)
     y = jax.lax.psum_scatter(x, group, scatter_dimension=axis, tiled=True)
     if op == ReduceOp.AVG:
         y = y / get_world_size(group)
@@ -122,19 +131,20 @@ def reduce_scatter(x, group: Group = "dp", axis: int = 0,
 
 def all_to_all(x, group: Group = "cp", split_axis: int = 0,
                concat_axis: int = 0):
-    x = _to_varying(x, group if isinstance(group, str) else group[0])
+    x = _vary_group(x, group)
     return jax.lax.all_to_all(x, group, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, src: int = 0, group: Group = "dp"):
     """Every rank gets rank ``src``'s value (psum of the masked value —
-    variant→invariant, so the result is replicated like NCCL bcast)."""
-    axis = group if isinstance(group, str) else group[0]
-    rank = jax.lax.axis_index(axis)
-    contrib = jnp.where(rank == src, _to_varying(x, axis),
+    variant→invariant, so the result is replicated like NCCL bcast).
+    ``src`` is the COMPOSITE rank for tuple groups (get_rank's order)."""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    rank = get_rank(group)
+    contrib = jnp.where(rank == src, _vary_group(x, group),
                         jnp.zeros_like(x))
-    return jax.lax.psum(contrib, axis)
+    return jax.lax.psum(contrib, axes if len(axes) > 1 else axes[0])
 
 
 def barrier(group: Group = "dp"):

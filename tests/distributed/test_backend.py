@@ -73,3 +73,37 @@ def test_host_init():
     dist.init_process_group()
     assert dist.is_initialized()
     assert dist.get_world_size() >= 1
+
+
+def test_all_reduce_tuple_group():
+    """Multi-axis groups must pvary over EVERY axis of the tuple (only
+    varying the first tripped vma checking on psum over the pair)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    x = jnp.arange(8.0).reshape(8, 1) + 1.0  # 1..8 over the 2x4 mesh
+
+    def fn(v):
+        return dist.all_reduce(v[0], dist.ReduceOp.SUM, ("dp", "tp"))[None]
+
+    got = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(("dp", "tp")),
+                            out_specs=P(("dp", "tp"))))(x)
+    np.testing.assert_allclose(np.asarray(got), 36.0)
+
+    def avg(v):
+        return dist.all_reduce(v[0], dist.ReduceOp.AVG, ("dp", "tp"))[None]
+
+    got = jax.jit(shard_map(avg, mesh=mesh, in_specs=P(("dp", "tp")),
+                            out_specs=P(("dp", "tp"))))(x)
+    np.testing.assert_allclose(np.asarray(got), 4.5)
+
+
+def test_broadcast_tuple_group():
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    x = jnp.arange(8.0).reshape(8, 1) + 1.0
+
+    def fn(v):
+        return dist.broadcast(v[0], src=5, group=("dp", "tp"))[None]
+
+    got = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(("dp", "tp")),
+                            out_specs=P(("dp", "tp"))))(x)
+    # composite rank 5 on the 2x4 mesh holds 6.0
+    np.testing.assert_allclose(np.asarray(got), 6.0)
