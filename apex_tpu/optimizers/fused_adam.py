@@ -46,8 +46,15 @@ def fused_adam(
     adam_w_mode: bool = True,
     weight_decay: float = 0.0,
     flat: bool = False,
+    use_kernel: Union[bool, None] = None,
 ) -> optax.GradientTransformation:
-    """Functional FusedAdam. Arguments mirror apex/optimizers/fused_adam.py:64."""
+    """Functional FusedAdam. Arguments mirror apex/optimizers/fused_adam.py:64.
+
+    ``use_kernel`` (flat mode only): run the flat update through the
+    Pallas kernel (ops/fused_adam_kernel.py — the multi_tensor_adam.cu
+    analog) instead of the XLA-fused jnp chain. ``None`` defers to the
+    pallas gate (kernel on TPU); the bench races both paths.
+    """
     b1, b2 = betas
 
     def init(params):
@@ -72,6 +79,10 @@ def fused_adam(
             adam_w_mode=adam_w_mode, step=step, bias_correction=bias_correction,
         )
         if flat:
+            from apex_tpu.ops import pallas_config
+
+            kernel_on = (pallas_config.use_pallas() if use_kernel is None
+                         else use_kernel)
             # Group by *param* dtype; grads may arrive in a different dtype
             # (e.g. fp32 grads over bf16 params) and are packed fp32 anyway.
             pbufs, meta = flatten_tree(params)
@@ -81,8 +92,21 @@ def fused_adam(
             for k, (idxs, spec) in specs.items():
                 gbuf = jnp.concatenate(
                     [g_leaves[i].ravel().astype(jnp.float32) for i in idxs])
-                d, m, v = _math.adam_step(
-                    gbuf, pbufs[k], state.mu[k], state.nu[k], **kw)
+                if kernel_on:
+                    from apex_tpu.ops.fused_adam_kernel import (
+                        adam_flat_pallas,
+                    )
+
+                    d, m, v = adam_flat_pallas(
+                        gbuf, pbufs[k], state.mu[k], state.nu[k],
+                        jnp.asarray(lr_t, jnp.float32), step,
+                        b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+                        adam_w_mode=adam_w_mode,
+                        bias_correction=bias_correction,
+                        interpret=pallas_config.interpret())
+                else:
+                    d, m, v = _math.adam_step(
+                        gbuf, pbufs[k], state.mu[k], state.nu[k], **kw)
                 deltas[k] = d.astype(spec.dtype)
                 mu[k], nu[k] = m, v
             updates = unflatten_tree(deltas, meta)

@@ -509,6 +509,21 @@ def bench_kernels(extras):
     compare("causal_softmax", lambda: lambda x:
             scaled_upper_triang_masked_softmax(x, None, 1.0), xs)
 
+    # --- flat-buffer fused adam: Pallas kernel vs the XLA-fused chain
+    # (the multi_tensor_adam.cu race on the packed ~350M-element buffer).
+    # use_kernel=None defers to the pallas gate, so compare()'s
+    # force('on'/'off') toggles the path; trees ride as jit ARGUMENTS
+    # (a zero-arg closure would bake gigabytes in as constants)
+    from apex_tpu.optimizers import fused_adam as _fa
+
+    fa_params = make_params(jax.random.PRNGKey(2))
+    fa_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, 1e-3), fa_params)
+    fa_tx = _fa(lr=1e-3, weight_decay=0.01, flat=True)
+    fa_state = fa_tx.init(fa_params)
+    compare("flat_adam", lambda: lambda g, s, p: fa_tx.update(g, s, p)[0],
+            fa_grads, fa_state, fa_params, iters=10)
+
     extras["kernels"] = kern
 
 

@@ -99,6 +99,25 @@ class TestFlashLowering:
             functools.partial(flash_attention, causal=True), q, q, q)
 
 
+class TestFlatAdamLowering:
+    def test_adam_kernel(self):
+        from apex_tpu.ops.fused_adam_kernel import adam_flat_pallas
+
+        n = 1024 * 520 + 7  # forces slab padding
+        g = jnp.ones((n,), jnp.float32)
+        p = jnp.ones((n,), jnp.bfloat16)
+        m = jnp.zeros((n,), jnp.float32)
+        v = jnp.zeros((n,), jnp.float32)
+
+        def run(g, p, m, v):
+            return adam_flat_pallas(
+                g, p, m, v, jnp.float32(1e-3), jnp.float32(1.0),
+                b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01,
+                adam_w_mode=True, bias_correction=True)
+
+        lowers_for_tpu(run, g, p, m, v)
+
+
 class TestNormLowering:
     @pytest.mark.parametrize("rows", [4096, 13])  # 13 -> padding path
     def test_layer_norm_fwd_bwd(self, rows):
