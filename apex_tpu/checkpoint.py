@@ -105,7 +105,15 @@ def latest_step(path: str) -> Optional[int]:
 
 class CheckpointManager:
     """Thin rotation/bookkeeping wrapper (orbax CheckpointManager analog
-    with the apex-era torch.save ergonomics)."""
+    with the apex-era torch.save ergonomics).
+
+    Async mode (``async_save=True``): retention runs *before* the
+    just-issued write lands, so up to ``max_to_keep + 1`` finalized step
+    dirs can transiently exist between saves — that is by design, not a
+    leak. Call :meth:`wait_until_finished` at the end of the training
+    loop: it flushes the in-flight write AND applies final retention; a
+    caller that skips it only gets the last write flushed at interpreter
+    exit (orbax's atexit hook) and keeps the extra step dir on disk."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = False):

@@ -209,5 +209,13 @@ def opt_partition_specs(tx, params, param_specs):
         lambda _: P(), shapes,
         is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
     if hasattr(specs, "_replace") and hasattr(specs, "mu"):
-        specs = specs._replace(mu=param_specs, nu=param_specs)
+        # flat=True Fused* state packs mu/nu into dtype-keyed flat buffers
+        # whose tree structure does NOT mirror the params; grafting
+        # param_specs onto them would build a structure-mismatched spec
+        # tree that fails much later inside jit/shard_map. Leave flat
+        # moment buffers replicated (P()) instead.
+        mirrors = (jax.tree_util.tree_structure(shapes.mu)
+                   == jax.tree_util.tree_structure(params))
+        if mirrors:
+            specs = specs._replace(mu=param_specs, nu=param_specs)
     return specs

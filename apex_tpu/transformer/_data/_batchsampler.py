@@ -73,11 +73,20 @@ class MegatronPretrainingSampler(_Base):
     only ``local_minibatch_size`` before slicing — ref _batchsampler.py:88-93
     — which hands every rank > 0 an empty slice; we follow the upstream
     Megatron-LM semantics the reference's docstring points at instead.)
+
+    .. warning:: With ``drop_last=False``, a final tail shorter than
+       ``data_parallel_size`` is padded by REPEATING the last sample index
+       so every rank stays non-empty (an empty per-rank batch kills SPMD
+       consumers). Eval/metric loops that must not double-count the
+       repeated sample should pass ``with_validity=True``, which makes the
+       sampler yield ``(indices, valid)`` pairs where ``valid`` is a
+       boolean list marking padding entries ``False``.
     """
 
     def __init__(self, total_samples: int, consumed_samples: int,
                  local_minibatch_size: int, data_parallel_rank: int,
-                 data_parallel_size: int, drop_last: bool = True):
+                 data_parallel_size: int, drop_last: bool = True,
+                 with_validity: bool = False):
         _check_args(total_samples, local_minibatch_size, data_parallel_rank,
                     data_parallel_size)
         if consumed_samples >= total_samples:
@@ -92,6 +101,7 @@ class MegatronPretrainingSampler(_Base):
         self.local_minibatch_times_data_parallel_size = (
             local_minibatch_size * data_parallel_size)
         self.drop_last = drop_last
+        self.with_validity = with_validity
 
     def __len__(self) -> int:
         return self.total_samples
@@ -100,13 +110,19 @@ class MegatronPretrainingSampler(_Base):
         start = self.data_parallel_rank * self.local_minibatch_size
         return start, start + self.local_minibatch_size
 
+    def _emit(self, indices, valid=None):
+        if self.with_validity:
+            return indices, ([True] * len(indices) if valid is None
+                             else valid)
+        return indices
+
     def __iter__(self):
         batch = []
         for idx in range(self.consumed_samples, self.total_samples):
             batch.append(idx)
             if len(batch) == self.local_minibatch_times_data_parallel_size:
                 start, end = self.get_start_end_idx()
-                yield batch[start:end]
+                yield self._emit(batch[start:end])
                 batch = []
         if batch and not self.drop_last:
             # split the short tail evenly (sizes differ by at most 1) instead
@@ -116,13 +132,17 @@ class MegatronPretrainingSampler(_Base):
             # fewer samples than ranks is padded by REPEATING the last index
             # so drop_last=False keeps its contract (every sample yielded,
             # every rank non-empty) — an empty batch kills SPMD consumers.
+            # with_validity=True marks those repeats False (class warning).
+            n_real = len(batch)
             if len(batch) < self.data_parallel_size:
                 batch = batch + [batch[-1]] * (
                     self.data_parallel_size - len(batch))
+            valid = [True] * n_real + [False] * (len(batch) - n_real)
             base, rem = divmod(len(batch), self.data_parallel_size)
             r = self.data_parallel_rank
             start = r * base + min(r, rem)
-            yield batch[start:start + base + (1 if r < rem else 0)]
+            end = start + base + (1 if r < rem else 0)
+            yield self._emit(batch[start:end], valid[start:end])
 
 
 class MegatronPretrainingRandomSampler(_Base):

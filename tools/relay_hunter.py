@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Round-long axon relay hunter (VERDICT r3 next-step #1).
+
+Rounds 1-3 treated the TPU benchmark as a one-shot at round end and lost
+every time to relay outages. This script turns it into a standing hunt:
+poll the axon local relay (127.0.0.1:8083, the stateless port that
+``jax.devices()`` dials) for the whole round and, the moment it answers,
+run the on-hardware pre-flight (``tools/tpu_validate.py``) followed by
+``bench.py``, persisting every artifact incrementally so a later hang
+loses nothing:
+
+  RELAY_PROBES.log        one JSON line per probe (proof of the hunt)
+  TPU_VALIDATE_r04.log    validate stdout/stderr, appended per attempt
+  BENCH_TPU_attempts.log  full bench stdout/stderr per attempt
+  BENCH_r04_live.json     last parsed bench JSON with platform=tpu
+
+Exit 0 as soon as a ``platform=tpu`` bench JSON lands; exit 1 at the
+deadline with the probe log as evidence of the hunt. Timed-out children
+get SIGTERM and a long grace period — a SIGKILLed TPU client has been
+observed (memory note 2026-07-30) to wedge the tunnel lease server-side
+for >1h, so SIGKILL is a logged last resort only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(REPO, "RELAY_PROBES.log")
+VALIDATE_LOG = os.path.join(REPO, "TPU_VALIDATE_r04.log")
+BENCH_LOG = os.path.join(REPO, "BENCH_TPU_attempts.log")
+LIVE_JSON = os.path.join(REPO, "BENCH_r04_live.json")
+
+
+def log_probe(**kw):
+    kw["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+
+
+def port_open(port=8083, timeout=3.0) -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def run_child(cmd, timeout, log_path, header):
+    """Run cmd appending output to log_path; SIGTERM (not SIGKILL) on
+    timeout with a 120s grace, SIGKILL only as a logged last resort.
+    Returns (rc, stdout_text)."""
+    with open(log_path, "a") as log:
+        log.write(f"\n===== {header} {time.strftime('%H:%M:%S')} =====\n")
+        log.flush()
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+            # own process group so signals reach grandchildren (bench.py
+            # spawns a worker subprocess)
+            preexec_fn=os.setsid)
+        chunks = []
+        deadline = time.time() + timeout
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+            chunks.append(out or "")
+        except subprocess.TimeoutExpired:
+            log.write(f"--- timeout {timeout}s: SIGTERM ---\n")
+            log.flush()
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                out, _ = proc.communicate(timeout=120)
+                chunks.append(out or "")
+            except subprocess.TimeoutExpired:
+                log.write("--- SIGTERM ignored for 120s: SIGKILL "
+                          "(last resort) ---\n")
+                log.flush()
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                out, _ = proc.communicate()
+                chunks.append(out or "")
+        text = "".join(chunks)
+        log.write(text[-200000:])
+        log.write(f"\n--- rc={proc.returncode} ---\n")
+    return proc.returncode, text
+
+
+def last_bench_json(text):
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=10.5)
+    ap.add_argument("--interval", type=float, default=60.0)
+    args = ap.parse_args()
+    deadline = time.time() + args.hours * 3600
+    log_probe(event="hunter_start", hours=args.hours, pid=os.getpid())
+
+    n, last_attempt = 0, 0.0
+    while time.time() < deadline:
+        n += 1
+        up = port_open()
+        log_probe(event="probe", n=n, relay_up=up)
+        if not up:
+            time.sleep(args.interval)
+            continue
+
+        # don't hammer a flapping relay: at most one full attempt / 10 min
+        if time.time() - last_attempt < 600:
+            time.sleep(args.interval)
+            continue
+        last_attempt = time.time()
+
+        # cheap reality check: does the backend actually initialize?
+        rc, _ = run_child(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d); "
+             "assert d[0].platform=='tpu', d"],
+            timeout=240, log_path=BENCH_LOG, header="devices-probe")
+        log_probe(event="devices_probe", rc=rc)
+        if rc != 0:
+            continue
+
+        # pre-flight: compiled-Mosaic kernel parity (VERDICT r3 weak #2)
+        rc_v, _ = run_child(
+            [sys.executable, "tools/tpu_validate.py"],
+            timeout=2400, log_path=VALIDATE_LOG, header="tpu_validate")
+        log_probe(event="tpu_validate", rc=rc_v)
+
+        # the benchmark itself (bench.py has its own watchdogs/fallbacks)
+        rc_b, out = run_child(
+            [sys.executable, "bench.py"],
+            timeout=5400, log_path=BENCH_LOG, header="bench")
+        parsed = last_bench_json(out)
+        platform = (parsed or {}).get("platform")
+        log_probe(event="bench", rc=rc_b, platform=platform)
+        if parsed is not None and platform == "tpu":
+            parsed["tpu_validate_rc"] = rc_v
+            with open(LIVE_JSON, "w") as f:
+                json.dump(parsed, f, indent=1)
+            log_probe(event="SUCCESS", file=LIVE_JSON)
+            return 0
+        # relay answered but bench fell back / failed — keep hunting
+
+    log_probe(event="deadline", probes=n)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
