@@ -127,3 +127,39 @@ def test_validation():
         MegatronPretrainingSampler(8, 8, 4, 0, 1)
     with pytest.raises(ValueError):
         MegatronPretrainingRandomSampler(8, 0, 4, 2, 2)
+
+
+def test_with_validity_marks_padded_tail():
+    """with_validity=True yields (indices, valid) pairs; the repeated-tail
+    padding entries (drop_last=False, tail shorter than dp_size) are the
+    ONLY entries marked False, across all ranks."""
+    total, local_mb, dp = 9, 2, 4  # tail = 1 sample, padded to 4
+    seen, n_pad = [], 0
+    for rank in range(dp):
+        batches = list(MegatronPretrainingSampler(
+            total_samples=total, consumed_samples=0,
+            local_minibatch_size=local_mb, data_parallel_rank=rank,
+            data_parallel_size=dp, drop_last=False, with_validity=True))
+        for indices, valid in batches:
+            assert len(indices) == len(valid)
+            seen += [i for i, ok in zip(indices, valid) if ok]
+            n_pad += sum(not ok for ok in valid)
+    # every real sample exactly once over the union of ranks, pads excluded
+    assert sorted(seen) == list(range(total))
+    assert n_pad == dp - 1  # tail of 1 padded up to dp ranks
+
+    # full batches carry an all-True mask
+    s = MegatronPretrainingSampler(
+        total_samples=8, consumed_samples=0, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2, drop_last=False,
+        with_validity=True)
+    for indices, valid in s:
+        assert valid == [True] * len(indices)
+
+
+def test_with_validity_off_keeps_plain_yields():
+    s = MegatronPretrainingSampler(
+        total_samples=8, consumed_samples=0, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2)
+    first = next(iter(s))
+    assert isinstance(first, list) and first == [0, 1]

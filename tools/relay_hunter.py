@@ -14,8 +14,11 @@ loses nothing:
   BENCH_TPU_attempts.log  full bench stdout/stderr per attempt
   BENCH_r04_live.json     last parsed bench JSON with platform=tpu
 
-Exit 0 as soon as a ``platform=tpu`` bench JSON lands; exit 1 at the
-deadline with the probe log as evidence of the hunt. Timed-out children
+Any ``platform=tpu`` bench JSON is persisted to BENCH_r04_live.json the
+moment it lands, but only a CLEAN run (tpu_validate rc=0 AND bench rc=0)
+exits 0 and ends the hunt — a partial result is kept while hunting for a
+clean window. Exit 1 at the deadline with the probe log as evidence of
+the hunt. Timed-out children
 get SIGTERM and a long grace period — a SIGKILLed TPU client has been
 observed (memory note 2026-07-30) to wedge the tunnel lease server-side
 for >1h, so SIGKILL is a logged last resort only.
@@ -54,23 +57,29 @@ def port_open(port=8083, timeout=3.0) -> bool:
 
 
 def run_child(cmd, timeout, log_path, header):
-    """Run cmd appending output to log_path; SIGTERM (not SIGKILL) on
-    timeout with a 120s grace, SIGKILL only as a logged last resort.
-    Returns (rc, stdout_text)."""
-    with open(log_path, "a") as log:
+    """Run cmd with stdout/stderr redirected to a scratch file (so an
+    abandoned child can never block on a full pipe); SIGTERM on timeout
+    with a 300s grace. NEVER SIGKILL: a SIGKILLed TPU client has been
+    observed to wedge the tunnel lease server-side for >1h, defeating the
+    whole hunt — a child that ignores SIGTERM is logged and abandoned
+    (rc=None), and the next devices-probe naturally waits out the lease.
+    Returns (rc_or_None, output_text)."""
+    # unique scratch per invocation: an abandoned child keeps its fd (and
+    # write offset) on the old inode, so reusing one path would bleed a
+    # zombie's output — including its bench JSON — into a later attempt
+    run_child.n = getattr(run_child, "n", 0) + 1
+    out_path = f"{log_path}.cur{run_child.n}"
+    with open(log_path, "a") as log, open(out_path, "w") as out:
         log.write(f"\n===== {header} {time.strftime('%H:%M:%S')} =====\n")
         log.flush()
         proc = subprocess.Popen(
-            cmd, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True,
+            cmd, cwd=REPO, stdout=out, stderr=subprocess.STDOUT,
             # own process group so signals reach grandchildren (bench.py
             # spawns a worker subprocess)
             preexec_fn=os.setsid)
-        chunks = []
-        deadline = time.time() + timeout
+        rc = None
         try:
-            out, _ = proc.communicate(timeout=timeout)
-            chunks.append(out or "")
+            rc = proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             log.write(f"--- timeout {timeout}s: SIGTERM ---\n")
             log.flush()
@@ -79,22 +88,16 @@ def run_child(cmd, timeout, log_path, header):
             except ProcessLookupError:
                 pass
             try:
-                out, _ = proc.communicate(timeout=120)
-                chunks.append(out or "")
+                rc = proc.wait(timeout=300)
             except subprocess.TimeoutExpired:
-                log.write("--- SIGTERM ignored for 120s: SIGKILL "
-                          "(last resort) ---\n")
-                log.flush()
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-                out, _ = proc.communicate()
-                chunks.append(out or "")
-        text = "".join(chunks)
+                log.write(f"--- SIGTERM ignored for 300s: abandoning "
+                          f"pid {proc.pid} UNKILLED (SIGKILL wedges the "
+                          f"tunnel lease) ---\n")
+        with open(out_path, errors="replace") as f:
+            text = f.read()
         log.write(text[-200000:])
-        log.write(f"\n--- rc={proc.returncode} ---\n")
-    return proc.returncode, text
+        log.write(f"\n--- rc={rc} ---\n")
+    return rc, text
 
 
 def last_bench_json(text):
@@ -155,11 +158,20 @@ def main():
         platform = (parsed or {}).get("platform")
         log_probe(event="bench", rc=rc_b, platform=platform)
         if parsed is not None and platform == "tpu":
+            # persist ANY tpu result immediately (a later hang loses
+            # nothing), but only a clean validate + clean bench ends the
+            # hunt — a partial/failed run must not ship as the round's
+            # number while a clean window might still come
             parsed["tpu_validate_rc"] = rc_v
+            parsed["bench_rc"] = rc_b
             with open(LIVE_JSON, "w") as f:
                 json.dump(parsed, f, indent=1)
-            log_probe(event="SUCCESS", file=LIVE_JSON)
-            return 0
+            if rc_v == 0 and rc_b == 0:
+                log_probe(event="SUCCESS", file=LIVE_JSON)
+                return 0
+            log_probe(event="partial_tpu_result", validate_rc=rc_v,
+                      bench_rc=rc_b)
+            last_attempt = time.time() + 1200  # ease off the chip
         # relay answered but bench fell back / failed — keep hunting
 
     log_probe(event="deadline", probes=n)
