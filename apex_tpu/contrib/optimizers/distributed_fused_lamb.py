@@ -59,9 +59,17 @@ def distributed_fused_lamb(
     weight_decay: float = 0.01, adam_w_mode: bool = True,
     grad_averaging: bool = True, max_grad_norm: float = 1.0,
     use_nvlamb: bool = False, axis_name: str = "dp",
+    master_dtype=jnp.float32, fp32_reduce_scatter: bool = True,
 ) -> optax.GradientTransformation:
     """optax-style transform; MUST run inside shard_map with ``axis_name``
-    bound. Each replica passes the FULL grads; state is sharded."""
+    bound. Each replica passes the FULL grads; state is sharded.
+
+    ``master_dtype`` controls the storage dtype of the sharded
+    master/moment buffers (the reference's fp16-master memory knob;
+    bf16 halves ZeRO state memory, the step math stays fp32).
+    ``fp32_reduce_scatter`` (ref DistributedFusedLAMB's flag of the same
+    name) reduces grads in fp32; False reduce-scatters in the gradient's
+    own dtype — half the ICI bytes, bf16 summation error."""
     b1, b2 = betas
 
     def init(params):
@@ -70,7 +78,8 @@ def distributed_fused_lamb(
         bufs, _ = flatten_tree(params)
         master, mu, nu = {}, {}, {}
         for k, buf in bufs.items():
-            flat = _to_varying(_pad_to(buf.astype(jnp.float32), n), axis_name)
+            flat = _to_varying(_pad_to(buf.astype(master_dtype), n),
+                               axis_name)
             shard = jax.lax.dynamic_slice_in_dim(
                 flat, r * (flat.size // n), flat.size // n)
             master[k] = shard
@@ -95,11 +104,14 @@ def distributed_fused_lamb(
         gshards = {}
         gsq_local = jnp.zeros([], jnp.float32)
         for k, (idxs, spec) in pspecs.items():
+            rs_dtype = (jnp.float32 if fp32_reduce_scatter
+                        else g_leaves[idxs[0]].dtype)
             gbuf = jnp.concatenate(
-                [g_leaves[i].ravel().astype(jnp.float32) for i in idxs])
+                [g_leaves[i].ravel().astype(rs_dtype) for i in idxs])
             gflat = _to_varying(_pad_to(gbuf, n), axis_name)
-            gshard = jax.lax.psum_scatter(
-                gflat, axis_name, scatter_dimension=0, tiled=True) / n
+            gshard = (jax.lax.psum_scatter(
+                gflat, axis_name, scatter_dimension=0, tiled=True)
+                .astype(jnp.float32) / n)
             gshards[k] = gshard
             gsq_local = gsq_local + jnp.sum(jnp.square(gshard))
         gnorm = jnp.sqrt(jax.lax.psum(gsq_local, axis_name))
@@ -111,9 +123,13 @@ def distributed_fused_lamb(
         new_master, new_mu, new_nu, out_bufs = {}, {}, {}, {}
         for k, (idxs, spec) in pspecs.items():
             gshard = gshards[k]
-            p_shard = state.master_shard[k]
+            # step math is always fp32; only the stored shards honor
+            # master_dtype (the down-cast happens at state write below)
+            p_shard = state.master_shard[k].astype(jnp.float32)
             m, v = _math.lamb_moments(
-                gshard, p_shard, state.mu_shard[k], state.nu_shard[k],
+                gshard, p_shard,
+                state.mu_shard[k].astype(jnp.float32),
+                state.nu_shard[k].astype(jnp.float32),
                 b1=b1, b2=b2, grad_averaging=grad_averaging,
                 clip_coeff=clip_coeff, weight_decay=weight_decay,
                 adam_w_mode=adam_w_mode)
@@ -139,7 +155,9 @@ def distributed_fused_lamb(
             ratio = jnp.concatenate([ratio_t, jnp.ones((1,))])[seg]
 
             master = p_shard - lr_t * ratio * u
-            new_master[k], new_mu[k], new_nu[k] = master, m, v
+            new_master[k] = master.astype(master_dtype)
+            new_mu[k] = m.astype(master_dtype)
+            new_nu[k] = v.astype(master_dtype)
 
             # all-gather updated shards (psum of rank-offset placement —
             # output is vma-invariant, same trick as distributed_fused_adam)
@@ -165,12 +183,16 @@ class DistributedFusedLAMB:
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  grad_averaging=True, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, max_grad_norm=0.0, adam_w_mode=True,
-                 use_nvlamb=False, axis_name: str = "dp", **unused):
+                 use_nvlamb=False, axis_name: str = "dp",
+                 master_dtype=jnp.float32, fp32_reduce_scatter=True,
+                 **unused):
         self.tx = distributed_fused_lamb(
             lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
             weight_decay=weight_decay, adam_w_mode=adam_w_mode,
             grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
-            use_nvlamb=use_nvlamb, axis_name=axis_name)
+            use_nvlamb=use_nvlamb, axis_name=axis_name,
+            master_dtype=master_dtype,
+            fp32_reduce_scatter=fp32_reduce_scatter)
         self.params = params
         self.state = None  # init must run inside shard_map
 

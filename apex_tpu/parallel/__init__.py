@@ -9,6 +9,7 @@ from apex_tpu.parallel.distributed import (
     sync_gradients,
     sync_gradients_flat,
     average_reduced,
+    sync_autodiff_gradients,
 )
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
 from apex_tpu.parallel.larc import LARC, larc
@@ -17,6 +18,7 @@ from apex_tpu.parallel import multiproc
 __all__ = [
     "DistributedDataParallel", "Reducer",
     "sync_gradients", "sync_gradients_flat", "average_reduced",
+    "sync_autodiff_gradients",
     "SyncBatchNorm", "convert_syncbn_model",
     "LARC", "larc", "multiproc",
 ]

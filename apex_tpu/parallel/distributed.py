@@ -28,6 +28,15 @@ grads arrive globally **summed**; use :func:`average_reduced` (divide by
 world size), NOT :func:`sync_gradients`, or you double-reduce. Explicit
 :func:`sync_gradients` is for genuinely per-replica grads: pmap-style
 per-device param copies, or params made varying with ``jax.lax.pvary``.
+
+CAVEAT to the auto-psum: a ``jax.custom_vjp`` in the model (every Pallas
+fused kernel — layer_norm, rms_norm, flash attention) hides the broadcast
+from transposition, so the grads of params feeding ONLY through custom_vjp
+ops arrive per-device **local** (varying) while everything else arrives
+summed (invariant) — a mixed tree that :func:`average_reduced` silently
+mis-scales. :func:`sync_autodiff_gradients` inspects each leaf's varying
+set and repairs both kinds; it is the safe default for replicated-param
+DDP over real models.
 """
 
 from __future__ import annotations
@@ -133,6 +142,24 @@ def average_reduced(grads, axis_name: str = "data"):
     return jax.tree_util.tree_map(avg, grads)
 
 
+def sync_autodiff_gradients(grads, axis_name: str = "data"):
+    """Per-leaf vma-aware gradient averaging for the replicated-params
+    pattern (see the module-note CAVEAT): autodiff auto-psums the grads of
+    replicated params — EXCEPT those flowing only through ``custom_vjp``
+    ops (the fused kernels), which arrive per-device local. Inspecting
+    ``jax.typeof(leaf).vma``: a leaf still varying over ``axis_name`` gets
+    an explicit ``pmean``; an invariant (already-summed) leaf is divided
+    by the axis size. Either way the result is the invariant global-batch
+    -mean gradient, safe for ``lax.cond``-based overflow skips."""
+    def one(g):
+        vma = getattr(jax.typeof(g), "vma", frozenset())
+        if axis_name in vma:
+            return jax.lax.pmean(g, axis_name)
+        n = jax.lax.axis_size(axis_name)
+        return (g / jnp.asarray(n, g.dtype)).astype(g.dtype)
+    return jax.tree_util.tree_map(one, grads)
+
+
 class Reducer:
     """Manually-triggered parameter allreducer (ref apex/parallel/__init__.py
     Reducer: "allreduce_params() averages parameters across processes")."""
@@ -221,10 +248,11 @@ class DistributedDataParallel:
 
     def average_reduced(self, grads):
         """Average grads that were already psummed by autodiff (the
-        replicated-params pattern — see module docstring)."""
+        replicated-params pattern — see module docstring). vma-aware:
+        leaves a custom_vjp kernel left unsummed get a real pmean."""
         if not self.gradient_average:
             return grads
-        return average_reduced(grads, self.axis_name)
+        return sync_autodiff_gradients(grads, self.axis_name)
 
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Return a grad fn whose outputs are already synced (per-replica

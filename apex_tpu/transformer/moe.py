@@ -43,6 +43,9 @@ class MoEConfig(NamedTuple):
     capacity_factor: float = 1.25
     router_jitter: float = 0.0     # optional exploration noise (training)
     aux_loss_coef: float = 1e-2
+    # router z-loss (ST-MoE §4, arXiv:2202.08906): penalizes large router
+    # logits, which destabilize bf16 training; 0 disables (default)
+    z_loss_coef: float = 0.0
 
 
 def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32):
@@ -73,12 +76,20 @@ def _capacity(tokens: int, cfg: MoEConfig) -> int:
     return max(cap, cfg.top_k)
 
 
-def router_gates(logits, cfg: MoEConfig):
+def router_gates(logits, cfg: MoEConfig, with_stats: bool = False):
     """Top-k gating with position-in-expert assignment (GShard algo).
 
     logits [T, E] -> (combine [T, E, C], dispatch [T, E, C], aux_loss).
     All shapes static; tokens past an expert's capacity get zero gates
     (dropped — the residual stream carries them unchanged).
+
+    ``aux_loss`` is the scalar TOTAL auxiliary loss (load-balance +
+    optional z-loss) so callers can add it straight to the task loss.
+    ``with_stats=True`` appends a telemetry dict
+    ``{"dropped_frac", "balance_loss", "z_loss"}`` — dropped_frac is the
+    fraction of the T·k routing assignments that fell past an expert's
+    capacity (the production drop-rate signal a capacity_factor is tuned
+    against).
     """
     t, e = logits.shape
     c = _capacity(t, cfg)
@@ -126,14 +137,34 @@ def router_gates(logits, cfg: MoEConfig):
     first_onehot = pieces[0][0]
     frac = jnp.mean(first_onehot, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
-    aux = cfg.aux_loss_coef * e * jnp.sum(frac * mean_prob)
-    return combine, dispatch, aux
+    balance = cfg.aux_loss_coef * e * jnp.sum(frac * mean_prob)
+
+    # router z-loss (ST-MoE eq. 5): mean (logsumexp of the fp32 logits)^2.
+    # cfg.z_loss_coef is a static float: skip the logsumexp (+ backward)
+    # entirely at the 0.0 default — 0*z is not DCE-safe for XLA
+    if cfg.z_loss_coef:
+        z_loss = cfg.z_loss_coef * jnp.mean(jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1) ** 2)
+    else:
+        z_loss = jnp.zeros((), jnp.float32)
+    aux = balance + z_loss
+    if not with_stats:
+        return combine, dispatch, aux
+
+    kept = sum(jnp.sum(keep.astype(jnp.float32))
+               for _, _, _, keep in pieces)
+    stats = {
+        "dropped_frac": 1.0 - kept / (t * cfg.top_k),
+        "balance_loss": balance,
+        "z_loss": z_loss,
+    }
+    return combine, dispatch, aux, stats
 
 
 def expert_parallel_apply(expert_fn, expert_params, x, router,
                           cfg: MoEConfig,
                           ep_axis: Optional[str] = EXPERT_AXIS,
-                          router_key=None):
+                          router_key=None, with_stats: bool = False):
     """Route tokens through per-expert functions; returns (y, aux_loss).
 
     ``expert_fn(expert_params, tokens)`` maps [E_local, C', h] ->
@@ -145,6 +176,10 @@ def expert_parallel_apply(expert_fn, expert_params, x, router,
     (identical math). This is the layer other modules build on — e.g.
     the Llama Mixtral-style SwiGLU experts — while :func:`moe_mlp` is
     the plain two-matmul MLP instance.
+
+    ``with_stats=True`` returns ``(y, aux_loss, stats)`` (see
+    :func:`router_gates`); inside ``shard_map`` the stats are per-rank —
+    ``pmean`` them over the dp/ep axes for global telemetry.
     """
     lead = x.shape[:-1]
     h = x.shape[-1]
@@ -155,7 +190,8 @@ def expert_parallel_apply(expert_fn, expert_params, x, router,
         logits = logits * jax.random.uniform(
             router_key, logits.shape, jnp.float32,
             1.0 - cfg.router_jitter, 1.0 + cfg.router_jitter)
-    combine, dispatch, aux = router_gates(logits, cfg)
+    gated = router_gates(logits, cfg, with_stats=with_stats)
+    combine, dispatch, aux = gated[:3]
 
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(xt.dtype), xt)
 
@@ -177,11 +213,15 @@ def expert_parallel_apply(expert_fn, expert_params, x, router,
                                tiled=True)
 
     out = jnp.einsum("tec,ech->th", combine.astype(xt.dtype), y)
-    return out.reshape(*lead, h).astype(x.dtype), aux.astype(jnp.float32)
+    out = out.reshape(*lead, h).astype(x.dtype)
+    if with_stats:
+        return out, aux.astype(jnp.float32), gated[3]
+    return out, aux.astype(jnp.float32)
 
 
 def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
-            activation=jax.nn.gelu, router_key=None):
+            activation=jax.nn.gelu, router_key=None,
+            with_stats: bool = False):
     """MoE feed-forward on [..., h]; returns (y, aux_loss).
 
     Inside ``shard_map`` with ``ep_axis`` bound, experts run
@@ -198,4 +238,5 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
 
     return expert_parallel_apply(
         expert_fn, {"wi": params["wi"], "wo": params["wo"]}, x,
-        params["router"], cfg, ep_axis=ep_axis, router_key=router_key)
+        params["router"], cfg, ep_axis=ep_axis, router_key=router_key,
+        with_stats=with_stats)

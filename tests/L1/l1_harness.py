@@ -1,0 +1,264 @@
+"""L1 cross-product integration harness.
+
+The repo's analog of the reference's end-to-end precision matrix
+(ref tests/L1/cross_product/run.sh, tests/L1/common/main_amp.py:1-526,
+tests/L1/common/compare.py:1): train real (tiny) models through the
+public amp + fused-optimizer APIs across opt-level x model x optimizer
+x loss-scale x DDP, record the per-step loss curve, and compare every
+mixed-precision run against the fp32/O0 run of the same (model,
+optimizer) pair. The reference compares saved torch loss logs bitwise
+between with/without-extension runs; on TPU the analog axis is
+"amp curve must track the fp32 curve within bf16 tolerance" plus
+"DDP over the dp mesh must track single-device over the same global
+batch".
+
+Everything runs on the 8-device virtual CPU mesh (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import bert, gpt2, mlp, resnet
+from apex_tpu.optimizers import fused_adam, fused_lamb, fused_sgd
+from apex_tpu.parallel import sync_autodiff_gradients
+
+GLOBAL_BATCH = 16
+N_BATCHES = 8  # distinct batches, cycled — every run sees the same data
+
+
+# --------------------------------------------------------------- model zoo
+
+
+def _mlp_adapter():
+    cfg = mlp.MLPConfig(sizes=(32, 64, 64, 10))
+
+    def init(key):
+        return mlp.init_params(key, cfg), None
+
+    def loss(params, aux, batch):
+        return mlp.loss_fn(params, batch, cfg), aux
+
+    def make_batch(key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (GLOBAL_BATCH, 32), jnp.float32)
+        y = jax.random.randint(ky, (GLOBAL_BATCH,), 0, 10)
+        return x, y
+
+    return init, loss, make_batch
+
+
+def _gpt2_adapter():
+    cfg = gpt2.tiny(num_layers=2)
+
+    def init(key):
+        return gpt2.init_params(key, cfg), None
+
+    def loss(params, aux, batch):
+        tokens, targets = batch
+        return gpt2.loss_fn(params, (tokens, targets), cfg,
+                            tp_axis=None), aux
+
+    def make_batch(key):
+        tokens = jax.random.randint(key, (4, 32), 0, cfg.vocab_size)
+        return tokens, tokens
+
+    return init, loss, make_batch
+
+
+def _bert_adapter():
+    cfg = bert.tiny(num_layers=2)
+
+    def init(key):
+        return bert.init_params(key, cfg), None
+
+    def loss(params, aux, batch):
+        return bert.loss_fn(params, batch, cfg, tp_axis=None), aux
+
+    def make_batch(key):
+        km, kt = jax.random.split(key)
+        tokens = jax.random.randint(kt, (4, 32), 4, cfg.vocab_size)
+        mask = jax.random.bernoulli(km, 0.25, (4, 32)).astype(jnp.float32)
+        return tokens, tokens, mask
+
+    return init, loss, make_batch
+
+
+def _resnet_adapter(half=False):
+    model = resnet.tiny(axis_name=None,
+                        dtype=jnp.bfloat16 if half else jnp.float32)
+    x0 = jnp.ones((2, 32, 32, 3), jnp.float32)
+
+    def init(key):
+        variables = model.init(key, x0, train=False)
+        return variables["params"], variables["batch_stats"]
+
+    def loss(params, batch_stats, batch):
+        x, y = batch
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        l = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y).mean()
+        return l, mut["batch_stats"]
+
+    def make_batch(key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (GLOBAL_BATCH, 32, 32, 3), jnp.float32)
+        y = jax.random.randint(ky, (GLOBAL_BATCH,), 0, 10)
+        return x, y
+
+    return init, loss, make_batch
+
+
+def get_model(name, opt_level):
+    if name == "mlp":
+        return _mlp_adapter()
+    if name == "gpt2":
+        return _gpt2_adapter()
+    if name == "bert":
+        return _bert_adapter()
+    if name == "resnet":
+        # the flax module's compute dtype is a model attribute, the
+        # L1 analog of the reference rebuilding resnet under amp
+        return _resnet_adapter(half=opt_level in ("O2", "O3"))
+    raise ValueError(name)
+
+
+def make_tx(name, lr=3e-3):
+    if name == "adam":
+        return fused_adam(lr=lr)
+    if name == "lamb":
+        return fused_lamb(lr=lr, weight_decay=0.0)
+    if name == "sgd":
+        return fused_sgd(lr=lr * 3, momentum=0.9)
+    raise ValueError(name)
+
+
+# ------------------------------------------------------------ train runner
+
+
+def _cast_for_forward(handle, opt_level, params, batch):
+    """The dtype story of each opt level, functional form: O0 fp32;
+    O1 boundary-casts params+inputs per call (weights STAY fp32 between
+    steps); O2/O3 cast the model (O2 keeps norm params fp32 and holds
+    fp32 masters — here the master IS the optimizer-visible tree)."""
+    if opt_level == "O0":
+        return params, batch
+    cast_batch = tuple(
+        b.astype(handle.policy.compute_dtype)
+        if jnp.issubdtype(b.dtype, jnp.floating) else b for b in batch)
+    if opt_level == "O1":
+        return handle.policy.cast_to_compute(params), cast_batch
+    return handle.policy.cast_model(params), cast_batch
+
+
+def train_curve(model_name, opt_level, tx_name, steps=50, ddp=False,
+                loss_scale=None, seed=0):
+    """Train and return the per-step loss curve as a float numpy array.
+
+    ``ddp=True`` runs the identical step inside shard_map over a 4-way
+    'dp' mesh with the global batch sharded and grads pmean-synced —
+    the analog of the reference's --nproc_per_node=2 distributed leg.
+    """
+    handle = amp.initialize(opt_level=opt_level, loss_scale=loss_scale,
+                            verbosity=0)
+    init, loss_fn, make_batch = get_model(model_name, opt_level)
+    params, aux = init(jax.random.PRNGKey(seed))
+
+    if opt_level == "O3":
+        # pure half: no fp32 master copy survives (ref O3 semantics) —
+        # the optimizer state itself is built over bf16 params
+        params = handle.policy.cast_model(params)
+
+    tx = make_tx(tx_name)
+    opt_state = tx.init(params)
+    sstate = handle.scaler.init()
+
+    batches = [make_batch(jax.random.PRNGKey(1000 + i))
+               for i in range(N_BATCHES)]
+
+    def step_body(params, aux, opt_state, sstate, batch, axis_name=None):
+        def scaled(p):
+            fwd_p, fwd_b = _cast_for_forward(handle, opt_level, p, batch)
+            l, new_aux = loss_fn(fwd_p, aux, fwd_b)
+            return handle.scaler.scale_loss(l, sstate), (l, new_aux)
+
+        grads, (l, new_aux) = jax.grad(scaled, has_aux=True)(params)
+        if axis_name is not None:
+            # vma-aware: the fused-kernel custom_vjp grads arrive local
+            # while plain grads arrive auto-psummed (distributed.py note)
+            grads = sync_autodiff_gradients(grads, axis_name=axis_name)
+            l = jax.lax.pmean(l, axis_name)
+        updates, opt_state, sstate, _ = handle.scaled_update(
+            tx, grads, opt_state, params, sstate)
+        params = optax.apply_updates(params, updates)
+        return params, new_aux, opt_state, sstate, l
+
+    if not ddp:
+        step = jax.jit(step_body)
+    else:
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        none_aux = aux is None
+
+        def sharded(params, aux, opt_state, sstate, batch):
+            return step_body(params, aux if not none_aux else None,
+                             opt_state, sstate, batch, axis_name="dp")
+
+        batch_spec = jax.tree_util.tree_map(lambda _: P("dp"), batches[0])
+        rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        # check_vma left ON: replicated-param grads arrive auto-psummed
+        # (the library's DDP pattern, parallel/distributed.py module note)
+        # and average_reduced turns them into the global-batch mean
+        step = jax.jit(shard_map(
+            sharded, mesh=mesh,
+            in_specs=(rep(params), rep(aux), rep(opt_state), rep(sstate),
+                      batch_spec),
+            out_specs=(rep(params), rep(aux), rep(opt_state), rep(sstate),
+                       P())))
+
+    losses = []
+    for i in range(steps):
+        params, aux, opt_state, sstate, l = step(
+            params, aux, opt_state, sstate, batches[i % N_BATCHES])
+        losses.append(l)
+    return np.asarray(jax.device_get(losses), np.float64)
+
+
+@functools.lru_cache(maxsize=None)
+def baseline_curve(model_name, tx_name, steps=50, ddp=False):
+    """The fp32/O0 run every amp config is compared against
+    (the reference's saved-baseline role, compare.py --use_baseline)."""
+    return train_curve(model_name, "O0", tx_name, steps=steps, ddp=ddp)
+
+
+# ------------------------------------------------------------- comparators
+
+
+def assert_decreased(curve, name=""):
+    first = float(np.mean(curve[:3]))
+    last = float(np.mean(curve[-3:]))
+    assert last < first, f"{name}: loss did not decrease ({first} -> {last})"
+
+
+def assert_tracks(curve, ref, rel_tol, name=""):
+    """Mean relative deviation between two loss curves (the compare.py
+    closeness check, with bf16 tolerance instead of bitwise equality).
+    The denominator is floored at 10% of the initial loss so the metric
+    stays meaningful when tiny models memorize the 8-batch dataset and
+    the absolute loss (hence the naive relative error) goes to ~0."""
+    curve, ref = np.asarray(curve), np.asarray(ref)
+    floor = 0.1 * abs(float(ref[0])) + 1e-6
+    rel = np.abs(curve - ref) / np.maximum(np.abs(ref), floor)
+    mean_rel = float(np.mean(rel))
+    assert mean_rel < rel_tol, (
+        f"{name}: curve deviates from reference by {mean_rel:.4f} "
+        f"(tol {rel_tol}); curve[:5]={curve[:5]}, ref[:5]={ref[:5]}")

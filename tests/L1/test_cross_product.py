@@ -1,0 +1,109 @@
+"""L1 cross-product: amp opt-level x model x optimizer x DDP
+(ref tests/L1/cross_product/run.sh + tests/L1/common/main_amp.py:1-526).
+
+Fast tier (default): a representative slice — every opt level on mlp,
+every optimizer at O2, one transformer + one conv model at O0/O2, the
+loss-scale variants, and a DDP-vs-single check. Full matrix (every
+combination, 50 steps) runs under ``-m slow`` — the CI analog of the
+reference's full cross_product sweep.
+"""
+
+import numpy as np
+import pytest
+
+from tests.L1.l1_harness import (
+    assert_decreased,
+    assert_tracks,
+    baseline_curve,
+    train_curve,
+)
+
+STEPS = 50
+
+# bf16 forward + fp32 loss: curves track fp32 closely at these scales;
+# resnet's BN statistics compound rounding faster, hence the looser bound
+TOL = {"O0": 1e-6, "O1": 0.08, "O2": 0.08, "O3": 0.15}
+TOL_RESNET = {"O0": 1e-6, "O1": 0.15, "O2": 0.2, "O3": 0.3}
+
+
+def _check(model, opt_level, tx_name, steps=STEPS, ddp=False):
+    curve = train_curve(model, opt_level, tx_name, steps=steps, ddp=ddp)
+    ref = baseline_curve(model, tx_name, steps=steps, ddp=ddp)
+    assert_decreased(ref, f"{model}/{tx_name}/O0")
+    tol = (TOL_RESNET if model == "resnet" else TOL)[opt_level]
+    assert_tracks(curve, ref, tol,
+                  f"{model}/{tx_name}/{opt_level}{'/ddp' if ddp else ''}")
+    assert_decreased(curve, f"{model}/{tx_name}/{opt_level}")
+    return curve
+
+
+# ------------------------------------------------------------- fast tier
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_mlp_adam_all_opt_levels(opt_level):
+    _check("mlp", opt_level, "adam")
+
+
+@pytest.mark.parametrize("tx_name", ["adam", "lamb", "sgd"])
+def test_mlp_o2_all_optimizers(tx_name):
+    _check("mlp", "O2", tx_name)
+
+
+@pytest.mark.parametrize("model", ["gpt2", "bert", "resnet"])
+def test_models_o2_adam(model):
+    _check(model, "O2", "adam")
+
+
+@pytest.mark.parametrize("loss_scale", [1.0, 128.0, "dynamic"])
+def test_o2_loss_scale_variants(loss_scale):
+    """run_test.sh's loss_scales axis: static 1.0 / static 128 / dynamic
+    must land the same curve (scaling cancels exactly in fp32 unscale)."""
+    curve = train_curve("mlp", "O2", "adam", steps=STEPS,
+                        loss_scale=loss_scale)
+    ref = baseline_curve("mlp", "adam", steps=STEPS)
+    assert_tracks(curve, ref, TOL["O2"], f"mlp/O2/scale={loss_scale}")
+
+
+def test_ddp_matches_single_o0():
+    """The distributed leg: dp=4 sharded global batch + pmean grads must
+    reproduce the single-device curve over the same data (fp32 ->
+    reduction order is the only difference)."""
+    single = baseline_curve("mlp", "adam", steps=STEPS)
+    ddp = train_curve("mlp", "O0", "adam", steps=STEPS, ddp=True)
+    assert_tracks(ddp, single, 1e-4, "mlp/O0/ddp-vs-single")
+
+
+def test_ddp_matches_single_o2():
+    single = train_curve("mlp", "O2", "adam", steps=STEPS)
+    ddp = train_curve("mlp", "O2", "adam", steps=STEPS, ddp=True)
+    assert_tracks(ddp, single, 0.05, "mlp/O2/ddp-vs-single")
+
+
+def test_o0_is_exact_fp32():
+    """O0 through the amp machinery must be bit-identical to a plain
+    fp32 loop (amp disabled = complete no-op, ref frontend contract)."""
+    a = train_curve("mlp", "O0", "adam", steps=10)
+    b = train_curve("mlp", "O0", "adam", steps=10)
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------------- full matrix
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["mlp", "gpt2", "bert", "resnet"])
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+@pytest.mark.parametrize("tx_name", ["adam", "lamb", "sgd"])
+def test_full_cross_product(model, opt_level, tx_name):
+    _check(model, opt_level, tx_name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["mlp", "gpt2"])
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_full_ddp_cross_product(model, opt_level):
+    single = train_curve(model, opt_level, "adam", steps=STEPS)
+    ddp = train_curve(model, opt_level, "adam", steps=STEPS, ddp=True)
+    tol = 1e-4 if opt_level == "O0" else 0.05
+    assert_tracks(ddp, single, tol, f"{model}/{opt_level}/ddp-vs-single")

@@ -31,8 +31,24 @@ def test_simple_distributed():
 
 @pytest.mark.slow
 def test_imagenet_resnet50():
-    out = _run("imagenet_resnet50.py", "--steps", "8")
+    out = _run("imagenet_resnet50.py", "--smoke")
     assert "(decreased)" in out
+    assert "val: top1" in out
+
+
+@pytest.mark.slow
+def test_imagenet_resnet50_checkpoint_resume(tmp_path):
+    """The ref main_amp.py --resume contract: save, resume from the
+    latest epoch, keep training, evaluate-only from the checkpoint."""
+    ckpt = str(tmp_path / "ckpt")
+    _run("imagenet_resnet50.py", "--smoke", "--checkpoint-dir", ckpt,
+         timeout=600)
+    out = _run("imagenet_resnet50.py", "--smoke", "--epochs", "2",
+               "--resume", "auto", "--checkpoint-dir", ckpt, timeout=600)
+    assert "=> resumed from" in out and "epoch   1 " in out
+    out = _run("imagenet_resnet50.py", "--smoke", "--evaluate",
+               "--resume", "auto", "--checkpoint-dir", ckpt, timeout=600)
+    assert "val: top1" in out
 
 
 @pytest.mark.slow
