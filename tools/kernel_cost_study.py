@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Offline Pallas-vs-XLA kernel cost study (VERDICT r3 next-step #2,
+fallback clause: no chip required).
+
+Methodology — for each fused kernel at its bench.py shapes:
+
+- **flops**: taken from XLA's HLO cost analysis of the *fallback* path,
+  lowered AOT for TPU (``jit(f).trace(x).lower(lowering_platforms=
+  ('tpu',)).cost_analysis()``). Flops are fusion-invariant, so they
+  apply to both paths. (The Pallas path lowers to an opaque custom_call
+  the analysis cannot see — hence the fallback as the flops source.)
+- **HBM bytes, analytic**: both paths modeled as pass structures over
+  the operands. XLA's HLO 'bytes accessed' is a no-fusion upper bound
+  (every op's operands summed), so the XLA number here is the
+  *post-fusion* analytic estimate — XLA reliably fuses elementwise
+  chains into their producing/consuming reductions but must
+  materialize matmul operands and reduction results between fusions.
+- **roofline**: t = max(flops / peak_flops, bytes / hbm_bw) per chip
+  generation; predicted speedup = t_xla / t_pallas.
+
+The predictions justify each kernel's dispatch default until
+``bench.py``'s on-chip ``bench_kernels`` race replaces them with
+measurements (the study's decision table lives in
+docs/kernel_cost_study.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu.ops import pallas_config  # noqa: E402
+
+# v5e; override with --peak/--bw for other generations
+PEAK_FLOPS = 197e12  # bf16
+HBM_BW = 819e9       # bytes/s
+
+B, S, H, D = 4, 2048, 16, 128
+ROWS, HIDDEN = 8192, 4096
+BH, SM_S = 64, 1024
+BF2, FP4 = 2, 4
+
+
+def xla_flops(fn, *args):
+    with pallas_config.force("off"):
+        low = jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+    ca = low.cost_analysis()
+    return float(ca.get("flops", 0.0))
+
+
+def roofline(flops, bytes_):
+    return max(flops / PEAK_FLOPS, bytes_ / HBM_BW)
+
+
+def study():
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax,
+    )
+
+    rows = []
+
+    def add(name, flops, pallas_bytes, xla_bytes, note):
+        tp, tx = roofline(flops, pallas_bytes), roofline(flops, xla_bytes)
+        rows.append({
+            "kernel": name,
+            "flops_g": round(flops / 1e9, 2),
+            "pallas_mb": round(pallas_bytes / 2**20, 1),
+            "xla_mb": round(xla_bytes / 2**20, 1),
+            "pallas_roofline_us": round(tp * 1e6, 1),
+            "xla_roofline_us": round(tx * 1e6, 1),
+            "predicted_speedup": round(tx / tp, 2),
+            "bound": "flops" if flops / PEAK_FLOPS > pallas_bytes / HBM_BW
+                     else "memory",
+            "note": note,
+        })
+
+    # ---- layer norm fwd: x bf16 [ROWS, HIDDEN], w/b fp32
+    x = jnp.ones((ROWS, HIDDEN), jnp.bfloat16)
+    w = jnp.ones((HIDDEN,), jnp.float32)
+    b = jnp.zeros((HIDDEN,), jnp.float32)
+    xb = ROWS * HIDDEN * BF2
+    f = xla_flops(lambda x: layer_norm(x, w, b, (HIDDEN,)), x)
+    add("layer_norm_fwd", f,
+        pallas_bytes=2 * xb,           # one pass: read x, write y
+        xla_bytes=3 * xb,              # stat reduction pass + normalize pass
+        note="fused Welford single pass vs reduce-then-normalize")
+
+    # ---- layer norm fwd+bwd
+    f = xla_flops(jax.grad(lambda x: jnp.sum(
+        layer_norm(x, w, b, (HIDDEN,)).astype(jnp.float32))), x)
+    add("layer_norm_fwd_bwd", f,
+        # fwd (2 passes incl. stat save) + bwd kernel: read x, dy, write
+        # dx + dw/db partials in one pass
+        pallas_bytes=5 * xb,
+        # fwd 3 + bwd: two reduction couplings (dy·xhat terms) force
+        # re-reads of x and dy before the dx pass: ~5 passes
+        xla_bytes=8 * xb,
+        note="bwd needs x, dy twice in XLA (reduction + dx) vs once")
+
+    # ---- rms norm fwd
+    f = xla_flops(lambda x: rms_norm(x, w, (HIDDEN,)), x)
+    add("rms_norm_fwd", f, pallas_bytes=2 * xb, xla_bytes=3 * xb,
+        note="same structure as LN, one stat instead of two")
+
+    # ---- flash attention fwd (causal)
+    q = jnp.ones((B, S, H, D), jnp.bfloat16)
+    f = xla_flops(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                  q, q, q)
+    qkv = B * S * H * D * BF2           # one of q/k/v/o
+    scores = B * H * S * S * BF2        # the S^2 materialization
+    bq, _ = pallas_config.flash_blocks("fwd", S, S, D)
+    reread = S // bq                    # k/v stream once per q block
+    add("flash_fwd_causal", f,
+        pallas_bytes=2 * qkv + 2 * reread * qkv,   # q+o once, k+v rereads
+        # scores written (QK^T), read+written (softmax), read (PV):
+        # 4 passes over the S^2 buffer + q/k/v/o — causality halves it
+        xla_bytes=(4 * scores) // 2 + 4 * qkv,
+        note=f"S^2 materialization vs streamed tiles (k/v reread x{reread})")
+
+    # ---- flash attention fwd+bwd
+    def floss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    f = xla_flops(jax.grad(floss, argnums=(0, 1, 2)), q, q, q)
+    bqb, _ = pallas_config.flash_blocks("bwd", S, S, D)
+    reread_b = S // bqb
+    add("flash_fwd_bwd_causal", f,
+        # fwd + recompute-based bwd: dq/dk/dv accumulated over tile
+        # streams; ~3x the fwd traffic at bwd tile rereads
+        pallas_bytes=(2 * qkv + 2 * reread * qkv)
+        + (4 * qkv + 3 * reread_b * qkv),
+        # XLA bwd re-materializes scores AND probs grads: ~8 S^2 passes
+        xla_bytes=(8 * scores) // 2 + 8 * qkv,
+        note="bwd recompute streams tiles vs dS/dP materialization")
+
+    # ---- causal fused softmax [BH, SM_S, SM_S] bf16
+    xs = jnp.ones((BH, SM_S, SM_S), jnp.bfloat16)
+    f = xla_flops(lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0),
+                  xs)
+    sb = BH * SM_S * SM_S * BF2
+    add("causal_softmax", f,
+        pallas_bytes=3 * sb,   # two-pass (max+sum, then normalize) + write
+        xla_bytes=4 * sb,      # mask+max, exp+sum, normalize as 3 fusions
+        note="two-pass k-blocked vs three XLA reduction fusions")
+
+    # ---- flat-buffer fused adam (~350M params): g,p fp32 packed + m,v
+    n = 350e6
+    adam_bytes = n * (4 * FP4 + 3 * FP4)  # read g,p,m,v; write d,m,v
+    add("flat_adam", 13 * n,
+        pallas_bytes=adam_bytes, xla_bytes=adam_bytes,
+        note="pure elementwise chain: XLA fusion already traffic-optimal "
+             "-> tie at best; r3 CPU race lost -> default XLA")
+
+    return rows
+
+
+def main():
+    rows = study()
+    print(json.dumps(rows, indent=1))
+    print()
+    hdr = ("kernel", "flops_g", "pallas_mb", "xla_mb",
+           "pallas_roofline_us", "xla_roofline_us", "predicted_speedup",
+           "bound")
+    print(" | ".join(hdr))
+    for r in rows:
+        print(" | ".join(str(r[k]) for k in hdr))
+
+
+if __name__ == "__main__":
+    main()
