@@ -39,6 +39,11 @@ def main():
     p.add_argument("--no-sequence-parallel", action="store_true")
     p.add_argument("--fixed-data", action="store_true",
                    help="overfit one fixed batch (deterministic decrease)")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="save sharded train state here (orbax)")
+    p.add_argument("--save-every", type=int, default=5)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest step in --checkpoint-dir")
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
@@ -162,10 +167,28 @@ def main():
             out_specs=(stage_specs, io_specs, opt_specs, P()),
         ))
 
+        # checkpoint/resume of the SHARDED train state (the ref-style
+        # epoch checkpointing of main_amp.py, applied to the 3D-parallel
+        # flagship: params + opt state round-trip through orbax intact)
+        manager = start_it = None
+        if args.checkpoint_dir:
+            from apex_tpu.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(args.checkpoint_dir, max_to_keep=2)
+            if args.resume and manager.latest_step() is not None:
+                template = {"stage": stage_params, "io": io_params,
+                            "opt": opt_state,
+                            "it": np.zeros((), np.int32)}
+                st = manager.restore(template)
+                stage_params, io_params = st["stage"], st["io"]
+                opt_state = st["opt"]
+                start_it = int(st["it"]) + 1
+                print(f"=> resumed from step {int(st['it'])}")
+
         key = jax.random.PRNGKey(1)
         first = None
         fixed = None
-        for it in range(args.steps):
+        for it in range(start_it or 0, args.steps):
             if args.fixed_data and fixed is not None:
                 tokens, targets = fixed
             else:
@@ -182,6 +205,11 @@ def main():
                 first = loss
             print(f"step {it:3d}  loss {loss:.4f}  "
                   f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+            if manager is not None and (it % args.save_every == 0
+                                        or it == args.steps - 1):
+                manager.save(it, {"stage": stage_params, "io": io_params,
+                                  "opt": opt_state,
+                                  "it": np.asarray(it, np.int32)})
 
     print(f"mesh pp={pp} dp={dp} tp={tp} sp={sp}: "
           f"loss {first:.4f} -> {loss:.4f} "

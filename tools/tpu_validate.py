@@ -211,6 +211,33 @@ def masked_softmax(bh, S):
     _close(got, want, name="masked softmax")
 
 
+@check("flat_adam_kernel")
+def flat_adam(n_params):
+    """The Pallas flat-buffer Adam (non-default since r4 — the XLA chain
+    won the cost study) must still execute correctly when forced on:
+    scalar (1,4) block + slab padding are Mosaic-sensitive."""
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.optimizers import fused_adam
+
+    params = {"a": jax.random.normal(jax.random.PRNGKey(11), (n_params,)),
+              "b": jax.random.normal(jax.random.PRNGKey(12), (137,))}
+    grads = jax.tree_util.tree_map(lambda p: p * 1e-2, params)
+
+    def one_step(use_kernel):
+        tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=True,
+                        use_kernel=use_kernel)
+        state = tx.init(params)
+        updates, _ = jax.jit(tx.update)(grads, state, params)
+        jax.block_until_ready(updates)
+        return updates
+
+    with pallas_config.force("on"):
+        got = one_step(True)
+    want = one_step(False)
+    for k in params:
+        _close(got[k], want[k], rtol=1e-5, atol=1e-6, name=f"adam {k}")
+
+
 @check("odd_rows_layer_norm")
 def odd_rows(hidden):
     from apex_tpu.ops import pallas_config
@@ -260,6 +287,7 @@ def main():
     rms_norm(rows, hidden)
     causal_softmax(bh, sm_s)
     masked_softmax(bh, sm_s // 2)
+    flat_adam(4096 if args.quick else 1_000_000)
     odd_rows(hidden)
 
     fails = [r for r in RESULTS if not r[1]]
