@@ -284,9 +284,16 @@ def _positions(b, s_local, cp_axis):
 
 def run_layers(x, stacked, cfg: LlamaConfig, positions,
                tp_axis="tp", cp_axis="cp", sequence_parallel=False,
-               remat: bool = True, ep_axis: Optional[str] = "ep"):
+               remat=True, ep_axis: Optional[str] = "ep"):
     """Scan a stacked [L, ...] layer pytree over the residual stream.
-    Returns ``(x, aux)`` — aux sums the per-layer MoE balance losses."""
+    Returns ``(x, aux)`` — aux sums the per-layer MoE balance losses.
+
+    ``remat``: False = save all activations; True = full per-layer
+    recompute; ``"dots"`` = recompute only elementwise/norm chains while
+    keeping matmul outputs resident
+    (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) — the
+    usual best memory/MFU trade on TPU, where the recompute that hurts is
+    the MXU work, not the VPU chains."""
 
     def body(h, lp):
         # aux rides the scan's stacked outputs, not the carry — a fresh
@@ -303,7 +310,9 @@ def run_layers(x, stacked, cfg: LlamaConfig, positions,
 
         x = _to_varying(x, ep_axis)
     if remat:
-        body = jax.checkpoint(body)
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if remat == "dots" else None)
+        body = jax.checkpoint(body, policy=policy)
     x, auxs = jax.lax.scan(body, x, stacked)
     return x, jnp.sum(auxs)
 

@@ -97,6 +97,18 @@ def forward_backward_no_pipelining(
 # ------------------------------------------------------ collective pipeline
 
 
+
+def _maybe_remat(stage_fn, remat):
+    """remat: False = none; True = full recompute; "dots" = keep matmul
+    outputs, recompute VPU chains (jax.checkpoint_policies
+    .dots_with_no_batch_dims_saveable) — same contract as
+    apex_tpu.models.llama.run_layers."""
+    if not remat:
+        return stage_fn
+    policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+              if remat == "dots" else None)
+    return jax.checkpoint(stage_fn, policy=policy)
+
 def pipelined_forward(
     stage_fn: Callable,
     stage_params,
@@ -117,7 +129,7 @@ def pipelined_forward(
     m_count = inputs.shape[0]
     steps = m_count + n_stage - 1
 
-    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    body_fn = _maybe_remat(stage_fn, remat)
 
     def step(carry, t):
         incoming, outputs = carry
@@ -272,7 +284,7 @@ def pipelined_forward_interleaved(
     units = v * m_count
     steps = interleaved_num_steps(m_count, p, v)
 
-    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    body_fn = _maybe_remat(stage_fn, remat)
 
     from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
 

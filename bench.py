@@ -269,7 +269,10 @@ def bench_llama(extras):
 
     from apex_tpu.ops import pallas_config
 
-    ladder = [(False, 4), (True, 4), (True, 2), (True, 1)]
+    # "dots" (keep matmul outputs, recompute VPU chains) sits between
+    # no-remat and full remat in HBM footprint and beats full remat on
+    # MFU wherever it fits — docs/kernel_cost_study.md method note
+    ladder = [(False, 4), ("dots", 4), (True, 4), (True, 2), (True, 1)]
     step_t = None
     for remat, B in ladder:
         try:
