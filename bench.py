@@ -401,6 +401,40 @@ def bench_bert(extras):
           f"{B/step_t:.1f} seq/s", file=sys.stderr)
 
 
+def bench_allreduce(extras):
+    """DDP allreduce bandwidth over the device mesh (SURVEY §6 row 3:
+    'DDP allreduce bandwidth over ICI'). Multi-chip only — a
+    single-device psum is a copy, not a collective; the driver's
+    one-chip tunnel records the skip reason instead of a fake number."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    import numpy as np
+    from apex_tpu.parallel import sync_gradients
+
+    n = jax.device_count()
+    if n < 2:
+        extras["allreduce_skipped"] = f"1 device (need >=2 for ICI)"
+        return
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
+    nbytes = 256 * 2**20  # 256 MiB fp32 payload per device
+    x = jnp.ones((n, nbytes // 4), jnp.float32)
+
+    def f(x):
+        return sync_gradients({"g": x}, axis_name="data")["g"]
+
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                           out_specs=P("data")))
+    t = time_fn(fn, x, iters=10, warmup=2)
+    # ring allreduce moves 2(n-1)/n * payload per device
+    bw = 2 * (n - 1) / n * nbytes / t
+    extras["allreduce_256mb_ms"] = round(t * 1e3, 2)
+    extras["allreduce_algo_gbps"] = round(bw / 1e9, 1)
+    print(f"allreduce 256MiB x{n}: {t*1e3:.2f} ms  "
+          f"{bw/1e9:.1f} GB/s algo-bw", file=sys.stderr)
+
+
 def bench_kernels(extras):
     """Pallas vs XLA-fallback per-kernel timings at Llama-ish shapes
     (VERDICT r2 item 2: the kernels had never been Mosaic-compiled on
@@ -598,7 +632,8 @@ def worker():
         budget_s = 1100
         # priority order under the budget: kernels (VERDICT r2 item 2)
         # must not be crowded out by the newer bert config
-        for fn in (bench_llama, bench_resnet, bench_kernels, bench_bert):
+        for fn in (bench_llama, bench_resnet, bench_kernels, bench_bert,
+                   bench_allreduce):
             spent = time.perf_counter() - t_worker
             if spent > budget_s:
                 extras[fn.__name__ + "_skipped"] = (

@@ -31,6 +31,11 @@ def main():
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="save train state here every --save-every steps")
+    p.add_argument("--save-every", type=int, default=5)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest step in --checkpoint-dir")
     args = p.parse_args()
 
     n_dev = args.dp
@@ -91,9 +96,22 @@ def main():
             out_specs=(specs, opt_specs, P()),
         ))
 
+        manager = start_it = None
+        if args.checkpoint_dir:
+            from apex_tpu.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(args.checkpoint_dir, max_to_keep=2)
+            if args.resume and manager.latest_step() is not None:
+                template = {"params": params, "opt": opt_state,
+                            "it": np.zeros((), np.int32)}
+                st = manager.restore(template)
+                params, opt_state = st["params"], st["opt"]
+                start_it = int(st["it"]) + 1
+                print(f"=> resumed from step {int(st['it'])}")
+
         key = jax.random.PRNGKey(1)
         first = loss = None
-        for it in range(args.steps):
+        for it in range(start_it or 0, args.steps):
             key, k1, k2 = jax.random.split(key, 3)
             clean = jax.random.randint(k1, (B * dp, S), 4, cfg.vocab_size)
             mask = jax.random.bernoulli(k2, args.mask_prob, (B * dp, S))
@@ -107,6 +125,10 @@ def main():
                 first = loss
             print(f"step {it:3d}  mlm loss {loss:.4f}  "
                   f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+            if manager is not None and (it % args.save_every == 0
+                                        or it == args.steps - 1):
+                manager.save(it, {"params": params, "opt": opt_state,
+                                  "it": np.asarray(it, np.int32)})
 
     print(f"mesh dp={dp} FusedLAMB: loss {first:.4f} -> {loss:.4f} "
           f"({'decreased' if loss < first else 'NOT decreased'})")

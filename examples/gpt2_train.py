@@ -26,6 +26,11 @@ def main():
     p.add_argument("--seq", type=int, default=32)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="save train state here every --save-every steps")
+    p.add_argument("--save-every", type=int, default=5)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest step in --checkpoint-dir")
     args = p.parse_args()
 
     n_dev = args.dp * args.tp
@@ -88,9 +93,22 @@ def main():
             out_specs=(specs, opt_specs, P()),
         ))
 
+        manager = start_it = None
+        if args.checkpoint_dir:
+            from apex_tpu.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(args.checkpoint_dir, max_to_keep=2)
+            if args.resume and manager.latest_step() is not None:
+                template = {"params": params, "opt": opt_state,
+                            "it": np.zeros((), np.int32)}
+                st = manager.restore(template)
+                params, opt_state = st["params"], st["opt"]
+                start_it = int(st["it"]) + 1
+                print(f"=> resumed from step {int(st['it'])}")
+
         key = jax.random.PRNGKey(1)
         first = loss = None
-        for it in range(args.steps):
+        for it in range(start_it or 0, args.steps):
             key, sub = jax.random.split(key)
             tokens = jax.random.randint(sub, (B * dp, S), 0, cfg.vocab_size)
             targets = jnp.roll(tokens, -1, axis=-1)
@@ -102,6 +120,10 @@ def main():
                 first = loss
             print(f"step {it:3d}  loss {loss:.4f}  "
                   f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+            if manager is not None and (it % args.save_every == 0
+                                        or it == args.steps - 1):
+                manager.save(it, {"params": params, "opt": opt_state,
+                                  "it": np.asarray(it, np.int32)})
 
     print(f"mesh dp={dp} tp={tp}: loss {first:.4f} -> {loss:.4f} "
           f"({'decreased' if loss < first else 'NOT decreased'})")
