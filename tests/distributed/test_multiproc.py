@@ -52,3 +52,76 @@ def test_launcher_two_processes_psum(tmp_path):
          str(script)],
         capture_output=True, text=True, timeout=420, env=env, cwd=_REPO)
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+
+
+@pytest.mark.slow
+def test_launcher_model_training_across_processes(tmp_path):
+    """A real train loop (fused Adam + vma-aware DDP sync) where the
+    'dp' axis spans TWO processes: grads cross the host boundary, every
+    process must hold identical params after each step, and the loss
+    must decrease."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        from apex_tpu.parallel.multiproc import initialize_distributed
+
+        pid, nproc = initialize_distributed()
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from apex_tpu.optimizers import fused_adam
+        from apex_tpu.parallel import sync_autodiff_gradients
+
+        n = jax.device_count()
+        assert n == 4
+        mesh = Mesh(np.array(jax.devices()).reshape(n), ("dp",))
+        tx = fused_adam(lr=5e-2)
+
+        rng = np.random.default_rng(0)
+        w_true = rng.standard_normal((8, 1)).astype(np.float32)
+        X = rng.standard_normal((32, 8)).astype(np.float32)
+        Y = X @ w_true
+
+        params = {"w": jnp.zeros((8, 1))}
+        opt_state = tx.init(params)
+
+        def step(params, opt_state, x, y):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"] - y) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            g = sync_autodiff_gradients(g, axis_name="dp")
+            u, opt_state2 = tx.update(g, opt_state, params)
+            import optax
+            return (optax.apply_updates(params, u), opt_state2,
+                    jax.lax.pmean(loss, "dp"))
+
+        sh = NamedSharding(mesh, P("dp"))
+        xg = jax.make_array_from_callback(X.shape, sh, lambda i: X[i])
+        yg = jax.make_array_from_callback(Y.shape, sh, lambda i: Y[i])
+        jstep = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P())))
+
+        losses = []
+        for _ in range(30):
+            params, opt_state, loss = jstep(params, opt_state, xg, yg)
+            losses.append(float(np.asarray(
+                loss.addressable_shards[0].data)))
+        assert losses[-1] < 0.1 * losses[0], losses[:3] + losses[-3:]
+        print(f"proc {pid}: loss {losses[0]:.4f} -> {losses[-1]:.4f} "
+              f"w[0]={float(np.asarray(params['w'].addressable_shards[0].data)[0, 0]):.4f}")
+    """))
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+         "--nprocs", "2", "--cpu", "--devices-per-proc", "2",
+         str(script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
