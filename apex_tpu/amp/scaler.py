@@ -148,13 +148,29 @@ def scaled_update(tx, scaler: LossScaler, grads, opt_state, params, scaler_state
 
     # both cond branches must produce identical avals; derive the skip
     # branch's zeros from the update branch's output shapes/dtypes (updates
-    # may be in grad dtype while params are in model dtype)
+    # may be in grad dtype while params are in model dtype). Under
+    # shard_map the update branch's avals can be VARYING over mesh axes
+    # (e.g. grads a custom_vjp kernel left per-device local) — match each
+    # leaf's vma or lax.cond rejects the branches with a type error.
     out_shapes = jax.eval_shape(do_update, None)
+
+    def _match_vma(x, sd):
+        want = getattr(sd, "vma", frozenset()) or frozenset()
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+        missing = tuple(sorted(want - have))
+        if missing:
+            try:
+                x = jax.lax.pcast(x, missing, to="varying")
+            except (AttributeError, TypeError):
+                x = jax.lax.pvary(x, missing)
+        return x
 
     def skip(_):
         zeros = jax.tree_util.tree_map(
-            lambda sd: jnp.zeros(sd.shape, sd.dtype), out_shapes[0])
-        return zeros, opt_state
+            lambda sd: _match_vma(jnp.zeros(sd.shape, sd.dtype), sd),
+            out_shapes[0])
+        kept = jax.tree_util.tree_map(_match_vma, opt_state, out_shapes[1])
+        return zeros, kept
 
     updates, new_opt_state = jax.lax.cond(overflow, skip, do_update, None)
     new_scaler_state = scaler.update(scaler_state, overflow)
