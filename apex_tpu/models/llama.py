@@ -363,10 +363,32 @@ def forward(params, tokens, cfg: LlamaConfig,
 def loss_fn(params, batch, cfg: LlamaConfig,
             tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
             sequence_parallel: bool = False, remat: bool = True,
-            ep_axis: Optional[str] = "ep"):
+            ep_axis: Optional[str] = "ep",
+            vocab_chunks: Optional[int] = None):
     """Next-token CE (+ MoE balance aux when cfg.moe);
-    ``batch = (tokens, targets)`` both [b, s_local]."""
+    ``batch = (tokens, targets)`` both [b, s_local].
+
+    ``vocab_chunks`` (vocab-full path only, i.e. ``tp_axis=None``):
+    stream the lm-head + CE in that many vocab slices so the fp32
+    ``[b·s, vocab]`` logits — the largest live buffer of an LLM step —
+    are never materialized (functional/chunked_ce.py)."""
     tokens, targets = batch
+    if vocab_chunks and tp_axis is None:
+        from apex_tpu.transformer.functional.chunked_ce import (
+            chunked_lm_cross_entropy,
+        )
+
+        b, s = tokens.shape
+        positions = _positions(b, s, cp_axis)
+        x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
+        x, aux = run_layers(x, params["layers"], cfg, positions, tp_axis,
+                            cp_axis, sequence_parallel, remat, ep_axis)
+        x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+        w = (params["embed"].T if cfg.tie_embeddings
+             else params["lm_head"])
+        losses = chunked_lm_cross_entropy(
+            x.reshape(b * s, -1), w, targets.reshape(-1), vocab_chunks)
+        return jnp.mean(losses) + aux
     logits, aux = forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
                                    sequence_parallel, remat, ep_axis)
     losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)

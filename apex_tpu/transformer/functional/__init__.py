@@ -5,6 +5,9 @@ from apex_tpu.transformer.functional.fused_softmax import (
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
 )
+from apex_tpu.transformer.functional.chunked_ce import (
+    chunked_lm_cross_entropy,
+)
 from apex_tpu.transformer.functional.rope import (
     apply_rotary_pos_emb,
     apply_rotary_qk,
@@ -13,6 +16,7 @@ from apex_tpu.transformer.functional.rope import (
 )
 
 __all__ = [
+    "chunked_lm_cross_entropy",
     "FusedScaleMaskSoftmax",
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",

@@ -1,0 +1,102 @@
+"""Chunked fused lm-head + cross-entropy: the ``[N, vocab]`` logits are
+never materialized.
+
+No reference-file analog (the CUDA reference predates this pattern; its
+closest relative is contrib/xentropy's fused CE over *existing* logits).
+TPU-first rationale: for an LLM loss the fp32 logits are often the
+single largest live buffer (B·S·V·4 bytes — 1 GiB at the bench.py Llama
+shapes), bigger than any activation. Streaming the vocab dimension in
+``num_chunks`` slices with an online logsumexp (the flash-attention
+trick applied to the classifier) caps that at ``B·S·V/num_chunks`` and
+lets a larger batch fit HBM — more MXU work per step, higher MFU. The
+backward recomputes each chunk's logits from the saved row statistics
+instead of saving them.
+
+All math is fp32 regardless of input dtypes (CE is range-sensitive;
+same policy as contrib.xentropy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["chunked_lm_cross_entropy"]
+
+
+def _chunk_weights(weight, num_chunks):
+    h, v = weight.shape
+    if v % num_chunks:
+        raise ValueError(
+            f"vocab {v} must divide into num_chunks={num_chunks}")
+    vc = v // num_chunks
+    w = weight.reshape(h, num_chunks, vc).transpose(1, 0, 2)  # [C, h, Vc]
+    los = (jnp.arange(num_chunks) * vc).astype(jnp.int32)
+    return w, los, vc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_lm_cross_entropy(hidden, weight, labels, num_chunks=8):
+    """Per-token CE of ``hidden @ weight`` vs ``labels`` without the
+    ``[N, V]`` logits: ``hidden`` [N, h], ``weight`` [h, V] (the lm-head
+    kernel; pass ``embed.T`` for tied embeddings), ``labels`` [N] int.
+    Returns per-token losses [N] (fp32)."""
+    return _fwd(hidden, weight, labels, num_chunks)[0]
+
+
+def _fwd(hidden, weight, labels, num_chunks):
+    w, los, vc = _chunk_weights(weight, num_chunks)
+    x32 = hidden.astype(jnp.float32)
+    n = x32.shape[0]
+
+    def body(carry, inp):
+        m, s, tgt = carry
+        w_c, lo = inp
+        logits = x32 @ w_c.astype(jnp.float32)           # [N, Vc]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
+        idx = labels.astype(jnp.int32) - lo
+        in_c = (idx >= 0) & (idx < vc)
+        tl = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, vc - 1)[:, None], axis=1)[:, 0]
+        tgt = jnp.where(in_c, tl, tgt)
+        return (m_new, s, tgt), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, tgt), _ = jax.lax.scan(body, init, (w, los))
+    lse = jnp.log(s) + m
+    return lse - tgt, (hidden, weight, labels, lse)
+
+
+def _bwd(num_chunks, res, g):
+    hidden, weight, labels, lse = res
+    w, los, vc = _chunk_weights(weight, num_chunks)
+    x32 = hidden.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+
+    def body(dx, inp):
+        w_c, lo = inp
+        w32 = w_c.astype(jnp.float32)
+        logits = x32 @ w32                                # recompute [N, Vc]
+        p = jnp.exp(logits - lse[:, None])                # softmax slice
+        idx = labels.astype(jnp.int32) - lo
+        in_c = (idx >= 0) & (idx < vc)
+        onehot = (jax.nn.one_hot(jnp.clip(idx, 0, vc - 1), vc,
+                                 dtype=jnp.float32)
+                  * in_c[:, None].astype(jnp.float32))
+        d = (p - onehot) * g32[:, None]                   # [N, Vc]
+        dx = dx + d @ w32.T
+        dw_c = x32.T @ d                                  # [h, Vc]
+        return dx, dw_c
+
+    dx, dws = jax.lax.scan(body, jnp.zeros_like(x32), (w, los))
+    dweight = dws.transpose(1, 0, 2).reshape(weight.shape)
+    return (dx.astype(hidden.dtype), dweight.astype(weight.dtype), None)
+
+
+chunked_lm_cross_entropy.defvjp(_fwd, _bwd)

@@ -246,7 +246,7 @@ def bench_llama(extras):
         dtype=jnp.bfloat16)
     S = 2048
 
-    def attempt(remat, B):
+    def attempt(remat, B, vocab_chunks=None):
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
@@ -258,7 +258,8 @@ def bench_llama(extras):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(llama.loss_fn)(
-                params, batch, cfg, tp_axis=None, cp_axis=None, remat=remat)
+                params, batch, cfg, tp_axis=None, cp_axis=None, remat=remat,
+                vocab_chunks=vocab_chunks)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(jnp.add, params, updates)
             return params, opt_state, loss
@@ -269,15 +270,18 @@ def bench_llama(extras):
 
     from apex_tpu.ops import pallas_config
 
-    # "dots" (keep matmul outputs, recompute VPU chains) sits between
-    # no-remat and full remat in HBM footprint and beats full remat on
-    # MFU wherever it fits — docs/kernel_cost_study.md method note
-    ladder = [(False, 4), ("dots", 4), (True, 4), (True, 2), (True, 1)]
+    # top rung: chunked lm-head CE (the fp32 [B·S, 32k] logits never
+    # materialize) buys room for batch 8 without remat; then "dots"
+    # (keep matmul outputs, recompute VPU chains) between no-remat and
+    # full remat — docs/kernel_cost_study.md method note
+    ladder = [(False, 8, 8), (False, 4, None), ("dots", 4, None),
+              (True, 4, None), (True, 2, None), (True, 1, None)]
     step_t = None
-    for remat, B in ladder:
+    for remat, B, chunks in ladder:
         try:
-            step_t, n_params, B_used = attempt(remat, B)
-            extras["llama_config"] = f"remat={remat} batch={B}"
+            step_t, n_params, B_used = attempt(remat, B, chunks)
+            extras["llama_config"] = (
+                f"remat={remat} batch={B} vocab_chunks={chunks}")
             # race the kernel paths: Pallas flash attention (auto on TPU)
             # vs the jnp/XLA fallback — both are first-class paths of the
             # framework; report both, headline the faster (a kernel that
@@ -287,7 +291,7 @@ def bench_llama(extras):
                 extras["llama_step_ms_pallas"] = round(step_t * 1e3, 2)
                 try:
                     with pallas_config.force("off"):
-                        xla_t, _, _ = attempt(remat, B)
+                        xla_t, _, _ = attempt(remat, B, chunks)
                     extras["llama_step_ms_xla"] = round(xla_t * 1e3, 2)
                     if xla_t < step_t:
                         extras["llama_fastest_path"] = "xla"
@@ -302,8 +306,8 @@ def bench_llama(extras):
             # record every rung's failure (OOM rungs included) so a fully
             # failed ladder still carries its causes into the JSON
             extras.setdefault("llama_ladder_errors", []).append(
-                f"remat={remat},B={B}: {repr(e)[:120]}")
-            print(f"llama remat={remat} B={B} failed: {repr(e)[:200]}",
+                f"remat={remat},B={B},chunks={chunks}: {repr(e)[:120]}")
+            print(f"llama remat={remat} B={B} chunks={chunks} failed: {repr(e)[:200]}",
                   file=sys.stderr)
             if not _is_oom(e):
                 raise  # genuine bug: fail fast, don't recompile 3 rungs

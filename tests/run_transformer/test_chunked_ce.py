@@ -1,0 +1,104 @@
+"""Chunked fused lm-head + CE: the [N, V] logits are never built
+(functional/chunked_ce.py; no reference analog — TPU-first memory
+feature, companion to contrib.xentropy's fused CE over existing
+logits)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import llama
+from apex_tpu.transformer.functional import chunked_lm_cross_entropy
+
+
+def _naive(x, w, y):
+    logits = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, y[:, None], axis=1)[:, 0]
+    return lse - tl
+
+
+def _data(n=64, h=32, v=256, dtype=jnp.bfloat16, seed=0):
+    k = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(k[0], (n, h), dtype)
+    w = jax.random.normal(k[1], (h, v), dtype) * 0.1
+    y = jax.random.randint(k[2], (n,), 0, v)
+    return x, w, y
+
+
+@pytest.mark.parametrize("num_chunks", [1, 4, 8])
+def test_loss_parity(num_chunks):
+    x, w, y = _data()
+    want = _naive(x, w, y)
+    got = jax.jit(lambda x, w: chunked_lm_cross_entropy(
+        x, w, y, num_chunks))(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grad_parity():
+    x, w, y = _data()
+    want = jax.grad(lambda x, w: jnp.mean(_naive(x, w, y)),
+                    argnums=(0, 1))(x, w)
+    got = jax.jit(jax.grad(
+        lambda x, w: jnp.mean(chunked_lm_cross_entropy(x, w, y, 8)),
+        argnums=(0, 1)))(x, w)
+    for a, b, n in zip(got, want, "xw"):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=1e-4, err_msg=n)
+
+
+def test_large_logit_stability():
+    """Online logsumexp must survive logits that overflow exp in fp32."""
+    x, w, y = _data(dtype=jnp.float32)
+    x = x * 100.0  # logits ~ O(1000)
+    want = _naive(x, w, y)
+    got = chunked_lm_cross_entropy(x, w, y, 8)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_vocab_not_divisible_raises():
+    x, w, y = _data(v=250)
+    with pytest.raises(ValueError, match="divide"):
+        chunked_lm_cross_entropy(x, w, y, 8)
+
+
+class TestLlamaIntegration:
+    def test_loss_and_grads_match_unchunked(self):
+        cfg = llama.tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                 cfg.vocab_size)
+        batch = (tok, jnp.roll(tok, -1, -1))
+
+        def loss(p, chunks):
+            return llama.loss_fn(p, batch, cfg, tp_axis=None, cp_axis=None,
+                                 vocab_chunks=chunks)
+
+        base = jax.jit(lambda p: loss(p, None))(params)
+        chunked = jax.jit(lambda p: loss(p, 4))(params)
+        np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
+
+        g0 = jax.jit(jax.grad(lambda p: loss(p, None)))(params)
+        g1 = jax.jit(jax.grad(lambda p: loss(p, 4)))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-2, atol=2e-4)
+
+    def test_tied_embeddings_path(self):
+        cfg = llama.tiny(tie_embeddings=True)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                 cfg.vocab_size)
+        batch = (tok, jnp.roll(tok, -1, -1))
+        base = llama.loss_fn(params, batch, cfg, tp_axis=None,
+                             cp_axis=None)
+        chunked = llama.loss_fn(params, batch, cfg, tp_axis=None,
+                                cp_axis=None, vocab_chunks=4)
+        np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
