@@ -326,15 +326,36 @@ def embed(params, tokens, cfg: LlamaConfig, tp_axis="tp",
     return x
 
 
+def lm_head_weight(params, cfg: LlamaConfig):
+    """The [h, vocab] classifier kernel (embed.T when tied)."""
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
 def lm_head(params, x, cfg: LlamaConfig, tp_axis="tp",
             sequence_parallel=False):
     """Final norm + vocab-sharded logits [b, s, vocab/tp] (fp32)."""
     if sequence_parallel:
         x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
-    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    w = lm_head_weight(params, cfg)
     # vocab-sharded output: plain local gemm, no gather (CE is vocab-parallel)
     return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
+
+
+def hidden_states(params, tokens, cfg: LlamaConfig,
+                  tp_axis: Optional[str] = "tp",
+                  cp_axis: Optional[str] = "cp",
+                  sequence_parallel: bool = False, remat: bool = True,
+                  ep_axis: Optional[str] = "ep"):
+    """The shared model trunk: embed + all decoder layers (pre-final-norm).
+    tokens [b, s_local] → (hidden [b, s_local, h], moe aux loss). Both
+    loss paths (lm_head logits, chunked CE) consume this, so model
+    changes land in each exactly once."""
+    b, s = tokens.shape
+    positions = _positions(b, s, cp_axis)
+    x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
+    return run_layers(x, params["layers"], cfg, positions, tp_axis,
+                      cp_axis, sequence_parallel, remat, ep_axis)
 
 
 def forward_with_aux(params, tokens, cfg: LlamaConfig,
@@ -343,11 +364,8 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig,
                      sequence_parallel: bool = False, remat: bool = True,
                      ep_axis: Optional[str] = "ep"):
     """tokens [b, s_local] → (vocab-sharded logits, moe aux loss)."""
-    b, s = tokens.shape
-    positions = _positions(b, s, cp_axis)
-    x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
-    x, aux = run_layers(x, params["layers"], cfg, positions, tp_axis,
-                        cp_axis, sequence_parallel, remat, ep_axis)
+    x, aux = hidden_states(params, tokens, cfg, tp_axis, cp_axis,
+                           sequence_parallel, remat, ep_axis)
     return lm_head(params, x, cfg, tp_axis, sequence_parallel), aux
 
 
@@ -378,16 +396,12 @@ def loss_fn(params, batch, cfg: LlamaConfig,
             chunked_lm_cross_entropy,
         )
 
-        b, s = tokens.shape
-        positions = _positions(b, s, cp_axis)
-        x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
-        x, aux = run_layers(x, params["layers"], cfg, positions, tp_axis,
-                            cp_axis, sequence_parallel, remat, ep_axis)
+        x, aux = hidden_states(params, tokens, cfg, tp_axis, cp_axis,
+                               sequence_parallel, remat, ep_axis)
         x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
-        w = (params["embed"].T if cfg.tie_embeddings
-             else params["lm_head"])
         losses = chunked_lm_cross_entropy(
-            x.reshape(b * s, -1), w, targets.reshape(-1), vocab_chunks)
+            x.reshape(-1, x.shape[-1]), lm_head_weight(params, cfg),
+            targets.reshape(-1), vocab_chunks)
         return jnp.mean(losses) + aux
     logits, aux = forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
                                    sequence_parallel, remat, ep_axis)

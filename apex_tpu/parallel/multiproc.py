@@ -97,10 +97,10 @@ def launch(script_args, nprocs: int, devices_per_proc: int = 1,
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "apex_tpu.parallel.multiproc",
              *script_args], env=env_p))
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
-    return rc
+    # wait on EVERY worker before returning (a short-circuit here would
+    # orphan still-running workers after the first failure)
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
 
 
 def main():

@@ -423,7 +423,13 @@ def bench_allreduce(extras):
         return
     mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
     nbytes = 256 * 2**20  # 256 MiB fp32 payload per device
-    x = jnp.ones((n, nbytes // 4), jnp.float32)
+    # build pre-sharded: a plain jnp.ones would materialize all n shards
+    # on device 0 first (16 GiB at n=64) before the jit reshards
+    from jax.sharding import NamedSharding
+
+    x = jax.make_array_from_callback(
+        (n, nbytes // 4), NamedSharding(mesh, P("data")),
+        lambda idx: jnp.ones((1, nbytes // 4), jnp.float32))
 
     def f(x):
         return sync_gradients({"g": x}, axis_name="data")["g"]

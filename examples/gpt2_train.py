@@ -105,6 +105,10 @@ def main():
                 params, opt_state = st["params"], st["opt"]
                 start_it = int(st["it"]) + 1
                 print(f"=> resumed from step {int(st['it'])}")
+                if start_it >= args.steps:
+                    print(f"nothing to do: resumed step + 1 "
+                          f"({start_it}) >= --steps {args.steps}")
+                    return
 
         key = jax.random.PRNGKey(1)
         first = loss = None

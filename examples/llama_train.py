@@ -184,6 +184,10 @@ def main():
                 opt_state = st["opt"]
                 start_it = int(st["it"]) + 1
                 print(f"=> resumed from step {int(st['it'])}")
+                if start_it >= args.steps:
+                    print(f"nothing to do: resumed step + 1 "
+                          f"({start_it}) >= --steps {args.steps}")
+                    return
 
         key = jax.random.PRNGKey(1)
         first = None
