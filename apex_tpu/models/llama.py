@@ -386,22 +386,25 @@ def loss_fn(params, batch, cfg: LlamaConfig,
     """Next-token CE (+ MoE balance aux when cfg.moe);
     ``batch = (tokens, targets)`` both [b, s_local].
 
-    ``vocab_chunks`` (vocab-full path only, i.e. ``tp_axis=None``):
-    stream the lm-head + CE in that many vocab slices so the fp32
-    ``[b·s, vocab]`` logits — the largest live buffer of an LLM step —
-    are never materialized (functional/chunked_ce.py)."""
+    ``vocab_chunks``: stream the lm-head + CE in that many vocab slices
+    so the fp32 ``[b·s, vocab]`` logits — the largest live buffer of an
+    LLM step — are never materialized (functional/chunked_ce.py). With a
+    bound ``tp_axis`` the per-rank streams merge vocab-parallel."""
     tokens, targets = batch
-    if vocab_chunks and tp_axis is None:
+    if vocab_chunks:
         from apex_tpu.transformer.functional.chunked_ce import (
             chunked_lm_cross_entropy,
         )
 
         x, aux = hidden_states(params, tokens, cfg, tp_axis, cp_axis,
                                sequence_parallel, remat, ep_axis)
+        if sequence_parallel:
+            x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
         x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
         losses = chunked_lm_cross_entropy(
             x.reshape(-1, x.shape[-1]), lm_head_weight(params, cfg),
-            targets.reshape(-1), vocab_chunks)
+            targets.reshape(-1), vocab_chunks,
+            tp_axis=tp_axis if _axis_bound(tp_axis) else None)
         return jnp.mean(losses) + aux
     logits, aux = forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
                                    sequence_parallel, remat, ep_axis)

@@ -37,19 +37,45 @@ def _chunk_weights(weight, num_chunks):
     return w, los, vc
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def chunked_lm_cross_entropy(hidden, weight, labels, num_chunks=8):
+def _rank_offset(tp_axis, v_local):
+    if tp_axis is None:
+        return jnp.int32(0)
+    return (jax.lax.axis_index(tp_axis) * v_local).astype(jnp.int32)
+
+
+def _vary(x, tp_axis):
+    """Mark a fresh array varying over ``tp_axis`` so a scan carry that
+    becomes rank-dependent inside the loop starts with matching vma."""
+    if tp_axis is None:
+        return x
+    try:
+        return jax.lax.pcast(x, (tp_axis,), to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, (tp_axis,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_lm_cross_entropy(hidden, weight, labels, num_chunks=8,
+                             tp_axis=None):
     """Per-token CE of ``hidden @ weight`` vs ``labels`` without the
     ``[N, V]`` logits: ``hidden`` [N, h], ``weight`` [h, V] (the lm-head
     kernel; pass ``embed.T`` for tied embeddings), ``labels`` [N] int.
-    Returns per-token losses [N] (fp32)."""
-    return _fwd(hidden, weight, labels, num_chunks)[0]
+    Returns per-token losses [N] (fp32).
+
+    ``tp_axis``: inside ``shard_map`` with a vocab-sharded weight
+    ([h, V/tp] per rank, Megatron layout), composes the chunked pass
+    with the vocab-parallel reduction — local online logsumexp per rank,
+    then pmax/psum across ranks (the vocab_parallel_cross_entropy math,
+    streamed). The backward psums the partial ``d_hidden`` the way the
+    column-parallel matmul transpose would."""
+    return _fwd(hidden, weight, labels, num_chunks, tp_axis)[0]
 
 
-def _fwd(hidden, weight, labels, num_chunks):
+def _fwd(hidden, weight, labels, num_chunks, tp_axis):
     w, los, vc = _chunk_weights(weight, num_chunks)
     x32 = hidden.astype(jnp.float32)
     n = x32.shape[0]
+    lo_rank = _rank_offset(tp_axis, weight.shape[1])
 
     def body(carry, inp):
         m, s, tgt = carry
@@ -58,33 +84,41 @@ def _fwd(hidden, weight, labels, num_chunks):
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = (s * jnp.exp(m - m_new)
              + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
-        idx = labels.astype(jnp.int32) - lo
+        idx = labels.astype(jnp.int32) - lo_rank - lo
         in_c = (idx >= 0) & (idx < vc)
         tl = jnp.take_along_axis(
             logits, jnp.clip(idx, 0, vc - 1)[:, None], axis=1)[:, 0]
         tgt = jnp.where(in_c, tl, tgt)
         return (m_new, s, tgt), None
 
-    init = (jnp.full((n,), -jnp.inf, jnp.float32),
-            jnp.zeros((n,), jnp.float32),
-            jnp.zeros((n,), jnp.float32))
+    init = (_vary(jnp.full((n,), -jnp.inf, jnp.float32), tp_axis),
+            _vary(jnp.zeros((n,), jnp.float32), tp_axis),
+            _vary(jnp.zeros((n,), jnp.float32), tp_axis))
     (m, s, tgt), _ = jax.lax.scan(body, init, (w, los))
+    if tp_axis is not None:
+        # vocab-parallel merge of the per-rank streams (the stable
+        # cross-rank max/sum of tensor_parallel/cross_entropy.py)
+        m_g = jax.lax.pmax(m, tp_axis)
+        s = jax.lax.psum(s * jnp.exp(m - m_g), tp_axis)
+        tgt = jax.lax.psum(tgt, tp_axis)  # exactly one rank contributed
+        m = m_g
     lse = jnp.log(s) + m
     return lse - tgt, (hidden, weight, labels, lse)
 
 
-def _bwd(num_chunks, res, g):
+def _bwd(num_chunks, tp_axis, res, g):
     hidden, weight, labels, lse = res
     w, los, vc = _chunk_weights(weight, num_chunks)
     x32 = hidden.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
+    lo_rank = _rank_offset(tp_axis, weight.shape[1])
 
     def body(dx, inp):
         w_c, lo = inp
         w32 = w_c.astype(jnp.float32)
         logits = x32 @ w32                                # recompute [N, Vc]
         p = jnp.exp(logits - lse[:, None])                # softmax slice
-        idx = labels.astype(jnp.int32) - lo
+        idx = labels.astype(jnp.int32) - lo_rank - lo
         in_c = (idx >= 0) & (idx < vc)
         onehot = (jax.nn.one_hot(jnp.clip(idx, 0, vc - 1), vc,
                                  dtype=jnp.float32)
@@ -94,7 +128,12 @@ def _bwd(num_chunks, res, g):
         dw_c = x32.T @ d                                  # [h, Vc]
         return dx, dw_c
 
-    dx, dws = jax.lax.scan(body, jnp.zeros_like(x32), (w, los))
+    dx, dws = jax.lax.scan(body, _vary(jnp.zeros_like(x32), tp_axis),
+                           (w, los))
+    if tp_axis is not None:
+        # each rank's dx covers only its vocab shard's columns — the
+        # column-parallel transpose is an allreduce
+        dx = jax.lax.psum(dx, tp_axis)
     dweight = dws.transpose(1, 0, 2).reshape(weight.shape)
     return (dx.astype(hidden.dtype), dweight.astype(weight.dtype), None)
 

@@ -102,3 +102,70 @@ class TestLlamaIntegration:
         chunked = llama.loss_fn(params, batch, cfg, tp_axis=None,
                                 cp_axis=None, vocab_chunks=4)
         np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
+
+
+def test_vocab_parallel_chunked_parity():
+    """tp=4 vocab-sharded weight + chunked streaming must equal the
+    unsharded loss AND grads (dx psum = the column-parallel transpose)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    x, w, y = _data(n=32, h=16, v=64, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+
+    def fn(x, w, y):
+        # w arrives [h, 64/4] per rank
+        losses = chunked_lm_cross_entropy(x, w, y, num_chunks=2,
+                                          tp_axis="tp")
+        return losses
+
+    got = jax.jit(shard_map(fn, mesh=mesh,
+                            in_specs=(P(), P(None, "tp"), P()),
+                            out_specs=P()))(x, w, y)
+    want = _naive(x, w, y)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def mean_loss_sharded(x, w):
+        def fn(x, w):
+            return jnp.mean(chunked_lm_cross_entropy(
+                x, w, y, num_chunks=2, tp_axis="tp"))
+
+        return shard_map(fn, mesh=mesh,
+                         in_specs=(P(), P(None, "tp")),
+                         out_specs=P())(x, w)
+
+    gx, gw = jax.jit(jax.grad(mean_loss_sharded, argnums=(0, 1)))(x, w)
+    wx, ww = jax.grad(lambda x, w: jnp.mean(_naive(x, w, y)),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(wx),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(ww),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_llama_tp_chunked_parity():
+    """llama.loss_fn with vocab_chunks under a tp=2 mesh equals the
+    vocab-parallel logits path."""
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    cfg = llama.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    specs = llama.param_specs(cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    batch = (tok, jnp.roll(tok, -1, -1))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+    def run(chunks):
+        fn = functools.partial(
+            llama.loss_fn, cfg=cfg, tp_axis="tp", cp_axis=None,
+            vocab_chunks=chunks)
+        return float(jax.jit(shard_map(
+            lambda p, b: jax.lax.pmean(fn(p, b), "tp"),
+            mesh=mesh, in_specs=(specs, P()), out_specs=P()))(
+                params, batch))
+
+    np.testing.assert_allclose(run(4), run(None), rtol=1e-5)
