@@ -156,24 +156,48 @@ def forward(params, tokens, cfg: BertConfig, type_ids=None, pad_mask=None,
     return x
 
 
+def mlm_transform(params, hidden, cfg: BertConfig):
+    """The pre-decoder MLM head transform: dense + gelu + LN."""
+    x = jnp.matmul(hidden, params["mlm_dense"].astype(hidden.dtype))
+    x = jax.nn.gelu(x + params["mlm_bias"], approximate=False)
+    return _ln(x, params["mlm_ln_w"], params["mlm_ln_b"], cfg.ln_eps)
+
+
 def mlm_logits(params, hidden, cfg: BertConfig,
                tp_axis: Optional[str] = "tp"):
     """Masked-LM head: dense+gelu+LN, tied decoder → [b, s, v_local]."""
-    x = jnp.matmul(hidden, params["mlm_dense"].astype(hidden.dtype))
-    x = jax.nn.gelu(x + params["mlm_bias"], approximate=False)
-    x = _ln(x, params["mlm_ln_w"], params["mlm_ln_b"], cfg.ln_eps)
+    x = mlm_transform(params, hidden, cfg)
     return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
 
 
 def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
-            tp_axis: Optional[str] = "tp", remat: bool = True):
+            tp_axis: Optional[str] = "tp", remat: bool = True,
+            vocab_chunks: Optional[int] = None):
     """MLM loss; ``batch = (tokens, targets, loss_mask)`` — loss_mask selects
     the masked positions (targets elsewhere are ignored). ``pad_mask``
-    (True = padding) masks attention; the loss_mask only masks the CE sum."""
+    (True = padding) masks attention; the loss_mask only masks the CE sum.
+    ``vocab_chunks`` streams the tied decoder + CE without materializing
+    the fp32 [b·s, vocab] logits (functional/chunked_ce.py)."""
     tokens, targets, loss_mask = batch
     hidden = forward(params, tokens, cfg, type_ids=type_ids,
                      pad_mask=pad_mask, tp_axis=tp_axis, remat=remat)
-    logits = mlm_logits(params, hidden, cfg, tp_axis)
-    losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    if vocab_chunks:
+        from apex_tpu.transformer.functional.chunked_ce import (
+            chunked_lm_cross_entropy,
+        )
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            _axis_bound,
+        )
+
+        x = mlm_transform(params, hidden, cfg)
+        losses = chunked_lm_cross_entropy(
+            x.reshape(-1, x.shape[-1]), params["embed"].T,
+            targets.reshape(-1), vocab_chunks,
+            tp_axis=tp_axis if _axis_bound(tp_axis) else None)
+        losses = losses.reshape(targets.shape)
+    else:
+        logits = mlm_logits(params, hidden, cfg, tp_axis)
+        losses = vocab_parallel_cross_entropy(logits, targets,
+                                              axis_name=tp_axis)
     denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
     return jnp.sum(losses * loss_mask) / denom

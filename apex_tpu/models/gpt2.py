@@ -26,6 +26,9 @@ from apex_tpu.models._common import (
 from apex_tpu.transformer.functional.fused_softmax import (
     scaled_upper_triang_masked_softmax,
 )
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    _axis_bound,
+)
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
 )
@@ -133,9 +136,9 @@ def decoder_layer(x, lp, cfg: GPT2Config, tp_axis: Optional[str] = "tp"):
     return x
 
 
-def forward(params, tokens, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
-            remat: bool = True):
-    """tokens [b, s] → vocab-sharded logits [b, s, v_local] (tied head)."""
+def hidden_states(params, tokens, cfg: GPT2Config,
+                  tp_axis: Optional[str] = "tp", remat: bool = True):
+    """Shared trunk: embeddings + layers + final LN (pre-head)."""
     b, s = tokens.shape
     x = vocab_parallel_embedding(tokens, params["embed"], axis_name=tp_axis)
     x = (x + params["pos_embed"][None, :s]).astype(cfg.dtype)
@@ -146,14 +149,33 @@ def forward(params, tokens, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
     if remat:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_eps)
+    return _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_eps)
+
+
+def forward(params, tokens, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
+            remat: bool = True):
+    """tokens [b, s] → vocab-sharded logits [b, s, v_local] (tied head)."""
+    x = hidden_states(params, tokens, cfg, tp_axis, remat)
     # tied embedding head → vocab-sharded logits (embed rows are the shard)
     return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
 
 
 def loss_fn(params, batch, cfg: GPT2Config, tp_axis: Optional[str] = "tp",
-            remat: bool = True):
+            remat: bool = True, vocab_chunks: Optional[int] = None):
+    """Next-token CE; ``vocab_chunks`` streams the tied head + CE so the
+    fp32 [b·s, vocab] logits never materialize (functional/chunked_ce.py)."""
     tokens, targets = batch
+    if vocab_chunks:
+        from apex_tpu.transformer.functional.chunked_ce import (
+            chunked_lm_cross_entropy,
+        )
+
+        x = hidden_states(params, tokens, cfg, tp_axis, remat)
+        losses = chunked_lm_cross_entropy(
+            x.reshape(-1, x.shape[-1]), params["embed"].T,
+            targets.reshape(-1), vocab_chunks,
+            tp_axis=tp_axis if _axis_bound(tp_axis) else None)
+        return jnp.mean(losses)
     logits = forward(params, tokens, cfg, tp_axis, remat)
     return jnp.mean(
         vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)

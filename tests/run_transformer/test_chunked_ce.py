@@ -169,3 +169,29 @@ def test_llama_tp_chunked_parity():
                 params, batch))
 
     np.testing.assert_allclose(run(4), run(None), rtol=1e-5)
+
+
+def test_gpt2_and_bert_chunked_parity():
+    from apex_tpu.models import bert, gpt2
+
+    cfg = gpt2.tiny()
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    batch = (tok, jnp.roll(tok, -1, -1))
+    base = gpt2.loss_fn(params, batch, cfg, tp_axis=None)
+    chunked = gpt2.loss_fn(params, batch, cfg, tp_axis=None,
+                           vocab_chunks=4)
+    np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
+
+    bcfg = bert.tiny()
+    bparams = bert.init_params(jax.random.PRNGKey(0), bcfg)
+    btok = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 4,
+                              bcfg.vocab_size)
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(3), 0.3, (2, 32)).astype(jnp.float32)
+    bbatch = (btok, btok, mask)
+    bbase = bert.loss_fn(bparams, bbatch, bcfg, tp_axis=None)
+    bchunked = bert.loss_fn(bparams, bbatch, bcfg, tp_axis=None,
+                            vocab_chunks=4)
+    np.testing.assert_allclose(float(bchunked), float(bbase), rtol=1e-5)
