@@ -168,6 +168,13 @@ def main():
                 json.dump(parsed, f, indent=1)
             if rc_v == 0 and rc_b == 0:
                 log_probe(event="SUCCESS", file=LIVE_JSON)
+                # bonus evidence while the window is open: an xplane
+                # trace of the flagship step (failure is non-fatal)
+                rc_p, _ = run_child(
+                    [sys.executable, "tools/tpu_profile.py",
+                     "--out", os.path.join(REPO, "TPU_TRACE_r04")],
+                    timeout=1200, log_path=BENCH_LOG, header="tpu_profile")
+                log_probe(event="profile", rc=rc_p)
                 return 0
             log_probe(event="partial_tpu_result", validate_rc=rc_v,
                       bench_rc=rc_b)

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the flagship Llama train step on the
+live TPU (SURVEY §5 tracing subsystem, operationalized).
+
+Companion to tools/tpu_validate.py (correctness pre-flight) and bench.py
+(numbers): this produces the xplane trace that says WHERE the step time
+goes — MXU busy %, HBM stalls, collective time — for the
+profile-and-iterate loop the scaling playbook prescribes.
+
+    python tools/tpu_profile.py                 # ~5 traced steps
+    python tools/tpu_profile.py --out /tmp/trace --steps 10 --batch 8
+
+View with TensorBoard's profile plugin or xprof on the written logdir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/apex_tpu_trace")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--vocab-chunks", type=int, default=0,
+                    help="stream the lm-head CE in N slices (0 = off)")
+    ap.add_argument("--remat", default="dots",
+                    choices=["none", "dots", "full"])
+    ap.add_argument("--force", action="store_true",
+                    help="profile even on a non-TPU backend")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (implies --force); without "
+                         "this, a dead TPU relay makes the first device "
+                         "query hang — probe with tools/relay_hunter.py "
+                         "semantics first")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        args.force = True
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"platform={dev.platform} kind={dev.device_kind}", flush=True)
+    if dev.platform != "tpu" and not args.force:
+        print("not a TPU backend — pass --force to trace anyway")
+        return 2
+
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+        dtype=jnp.bfloat16)
+    remat = {"none": False, "dots": "dots", "full": True}[args.remat]
+    chunks = args.vocab_chunks or None
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (args.batch, 2048),
+                                0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    tx = fused_adam(lr=1e-4)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, batch, cfg, tp_axis=None, cp_axis=None, remat=remat,
+            vocab_chunks=chunks)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    batch = (tokens, targets)
+    # compile + warm outside the trace
+    params, opt_state, loss = train_step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    print(f"warm step loss={float(loss):.4f}; tracing {args.steps} steps "
+          f"to {args.out}", flush=True)
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(args.out):
+        for i in range(args.steps):
+            with jax.profiler.StepTraceAnnotation("train", step_num=i):
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     batch)
+        jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"traced: {dt * 1e3:.1f} ms/step  -> {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
