@@ -91,6 +91,17 @@ def llama3_8b(**over) -> LlamaConfig:
     return LlamaConfig(**over)
 
 
+def flagship_0p9b(**over) -> LlamaConfig:
+    """The single-chip benchmark config (bench.py's Llama MFU model and
+    tools/tpu_profile.py's traced model — one definition so the profile
+    always explains the bench number)."""
+    kw = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+              num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+              dtype=jnp.bfloat16)
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
 def tiny(**over) -> LlamaConfig:
     """Test-scale config (tp/cp-divisible heads)."""
     kw = dict(

@@ -44,14 +44,13 @@ def _rank_offset(tp_axis, v_local):
 
 
 def _vary(x, tp_axis):
-    """Mark a fresh array varying over ``tp_axis`` so a scan carry that
-    becomes rank-dependent inside the loop starts with matching vma."""
+    """Mark a fresh scan carry varying over ``tp_axis`` (it becomes
+    rank-dependent inside the loop); no-op when the axis is unbound."""
     if tp_axis is None:
         return x
-    try:
-        return jax.lax.pcast(x, (tp_axis,), to="varying")
-    except (AttributeError, TypeError):
-        return jax.lax.pvary(x, (tp_axis,))
+    from apex_tpu.transformer.tensor_parallel.mappings import make_varying
+
+    return make_varying(x, tp_axis)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

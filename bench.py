@@ -240,11 +240,8 @@ def bench_llama(extras):
     from apex_tpu.models import llama
     from apex_tpu.optimizers import fused_adam
 
-    cfg = llama.LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
-        dtype=jnp.bfloat16)
-    S = 2048
+    cfg = llama.flagship_0p9b()
+    S = cfg.max_seq_len
 
     def attempt(remat, B, vocab_chunks=None):
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -424,12 +421,14 @@ def bench_allreduce(extras):
     mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
     nbytes = 256 * 2**20  # 256 MiB fp32 payload per device
     # build pre-sharded: a plain jnp.ones would materialize all n shards
-    # on device 0 first (16 GiB at n=64) before the jit reshards
+    # on device 0 first (16 GiB at n=64) before the jit reshards. One
+    # hoisted HOST buffer -> each shard transfers host-to-device direct.
     from jax.sharding import NamedSharding
 
+    ones = np.ones((1, nbytes // 4), np.float32)
     x = jax.make_array_from_callback(
         (n, nbytes // 4), NamedSharding(mesh, P("data")),
-        lambda idx: jnp.ones((1, nbytes // 4), jnp.float32))
+        lambda idx: ones)
 
     def f(x):
         return sync_gradients({"g": x}, axis_name="data")["g"]

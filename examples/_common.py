@@ -58,3 +58,13 @@ def opt_partition_specs(tx, params, param_specs):
     from apex_tpu.optimizers import opt_partition_specs as f
 
     return f(tx, params, param_specs)
+
+
+def resume_exhausted(start_it, total_steps) -> bool:
+    """True (with a message) when a resumed step index is already past
+    the requested step count — the train loop would run zero iterations."""
+    if start_it is not None and start_it >= total_steps:
+        print(f"nothing to do: resumed step + 1 ({start_it}) >= "
+              f"--steps {total_steps}")
+        return True
+    return False

@@ -39,7 +39,8 @@ def main():
     args = p.parse_args()
 
     n_dev = args.dp
-    from examples._common import ensure_devices, opt_partition_specs
+    from examples._common import (
+        ensure_devices, opt_partition_specs, resume_exhausted)
 
     ensure_devices(n_dev)
 
@@ -108,9 +109,7 @@ def main():
                 params, opt_state = st["params"], st["opt"]
                 start_it = int(st["it"]) + 1
                 print(f"=> resumed from step {int(st['it'])}")
-                if start_it >= args.steps:
-                    print(f"nothing to do: resumed step + 1 "
-                          f"({start_it}) >= --steps {args.steps}")
+                if resume_exhausted(start_it, args.steps):
                     return
 
         key = jax.random.PRNGKey(1)

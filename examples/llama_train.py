@@ -47,7 +47,8 @@ def main():
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
-    from examples._common import ensure_devices, opt_partition_specs
+    from examples._common import (
+        ensure_devices, opt_partition_specs, resume_exhausted)
 
     ensure_devices(n_dev)
 
@@ -184,9 +185,7 @@ def main():
                 opt_state = st["opt"]
                 start_it = int(st["it"]) + 1
                 print(f"=> resumed from step {int(st['it'])}")
-                if start_it >= args.steps:
-                    print(f"nothing to do: resumed step + 1 "
-                          f"({start_it}) >= --steps {args.steps}")
+                if resume_exhausted(start_it, args.steps):
                     return
 
         key = jax.random.PRNGKey(1)

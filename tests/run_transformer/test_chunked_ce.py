@@ -195,3 +195,44 @@ def test_gpt2_and_bert_chunked_parity():
     bchunked = bert.loss_fn(bparams, bbatch, bcfg, tp_axis=None,
                             vocab_chunks=4)
     np.testing.assert_allclose(float(bchunked), float(bbase), rtol=1e-5)
+
+
+def test_gpt2_bert_tp_chunked_parity():
+    """gpt2/bert vocab_chunks under a bound tp=2 axis must equal their
+    vocab-parallel logits paths (mirrors test_llama_tp_chunked_parity)."""
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from apex_tpu.models import bert, gpt2
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+    def run(model, cfg, params, batch, chunks, **kw):
+        fn = functools.partial(model.loss_fn, cfg=cfg, tp_axis="tp",
+                               vocab_chunks=chunks, **kw)
+        specs = model.param_specs(cfg)
+        return float(jax.jit(shard_map(
+            lambda p, b: jax.lax.pmean(fn(p, b), "tp"),
+            mesh=mesh, in_specs=(specs, P()), out_specs=P()))(
+                params, batch))
+
+    cfg = gpt2.tiny()
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    batch = (tok, jnp.roll(tok, -1, -1))
+    np.testing.assert_allclose(run(gpt2, cfg, params, batch, 4),
+                               run(gpt2, cfg, params, batch, None),
+                               rtol=1e-5)
+
+    bcfg = bert.tiny()
+    bparams = bert.init_params(jax.random.PRNGKey(0), bcfg)
+    btok = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 4,
+                              bcfg.vocab_size)
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(3), 0.3, (2, 32)).astype(jnp.float32)
+    bbatch = (btok, btok, mask)
+    np.testing.assert_allclose(run(bert, bcfg, bparams, bbatch, 4),
+                               run(bert, bcfg, bparams, bbatch, None),
+                               rtol=1e-5)

@@ -58,15 +58,13 @@ def main():
     from apex_tpu.models import llama
     from apex_tpu.optimizers import fused_adam
 
-    cfg = llama.LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
-        dtype=jnp.bfloat16)
+    cfg = llama.flagship_0p9b()
     remat = {"none": False, "dots": "dots", "full": True}[args.remat]
     chunks = args.vocab_chunks or None
 
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (args.batch, 2048),
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (args.batch, cfg.max_seq_len),
                                 0, cfg.vocab_size)
     targets = jnp.roll(tokens, -1, axis=-1)
     tx = fused_adam(lr=1e-4)
