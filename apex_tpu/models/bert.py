@@ -86,12 +86,16 @@ def init_params(key, cfg: BertConfig):
     }
 
 
-def param_specs(cfg: BertConfig, tp_axis: str = "tp"):
-    """tp PartitionSpec pytree matching :func:`init_params`."""
+def param_specs(cfg: BertConfig, tp_axis: str = "tp",
+                with_decoder_bias: bool = False):
+    """tp PartitionSpec pytree matching :func:`init_params`
+    (``with_decoder_bias`` adds the HF-imported ``mlm_decoder_bias``
+    entry, models/convert.py)."""
     from jax.sharding import PartitionSpec as P
 
     t = tp_axis
-    return {
+    extra = {"mlm_decoder_bias": P()} if with_decoder_bias else {}
+    return {**extra,
         "embed": P(t, None), "pos_embed": P(), "type_embed": P(),
         "emb_ln_w": P(), "emb_ln_b": P(),
         "layers": {
@@ -165,9 +169,15 @@ def mlm_transform(params, hidden, cfg: BertConfig):
 
 def mlm_logits(params, hidden, cfg: BertConfig,
                tp_axis: Optional[str] = "tp"):
-    """Masked-LM head: dense+gelu+LN, tied decoder → [b, s, v_local]."""
+    """Masked-LM head: dense+gelu+LN, tied decoder → [b, s, v_local].
+    An optional ``mlm_decoder_bias`` [vocab] (HF BERT's
+    cls.predictions.bias) adds per-vocab offsets when present."""
     x = mlm_transform(params, hidden, cfg)
-    return jnp.matmul(x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    logits = jnp.matmul(
+        x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    if "mlm_decoder_bias" in params:
+        logits = logits + params["mlm_decoder_bias"].astype(jnp.float32)
+    return logits
 
 
 def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
@@ -181,6 +191,11 @@ def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
     tokens, targets, loss_mask = batch
     hidden = forward(params, tokens, cfg, type_ids=type_ids,
                      pad_mask=pad_mask, tp_axis=tp_axis, remat=remat)
+    if vocab_chunks and "mlm_decoder_bias" in params:
+        # the chunked CE streams hidden @ embed.T only — it has no slot
+        # for the HF decoder bias, and silently dropping it would change
+        # the loss of a converted checkpoint; take the logits path
+        vocab_chunks = None
     if vocab_chunks:
         from apex_tpu.transformer.functional.chunked_ce import (
             chunked_lm_cross_entropy,
