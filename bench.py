@@ -402,6 +402,47 @@ def bench_bert(extras):
           f"{B/step_t:.1f} seq/s", file=sys.stderr)
 
 
+def bench_gpt2(extras):
+    """GPT-2 345M train step (fwd+bwd+FusedAdam) through the fused
+    causal-softmax attention — the BASELINE.json 'GPT-2 345M TP + fused
+    softmax' config on a single chip (tp collectives no-op at tp=1,
+    same code path)."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.models import gpt2
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = gpt2.gpt2_345m()  # 1024 hidden, 24 layers, vocab 50304
+    B, S = 8, 1024
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    tx = fused_adam(lr=1e-4)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(gpt2.loss_fn)(
+            params, batch, cfg, tp_axis=None, vocab_chunks=8)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    step_t = time_train_step(train_step, (params, opt_state),
+                             ((tokens, targets),))
+    extras["gpt2_345m_step_ms"] = round(step_t * 1e3, 2)
+    extras["gpt2_345m_tokens_per_sec"] = round(B * S / step_t)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    flops = B * S * 6 * n_params
+    if peak:
+        extras["gpt2_345m_mfu"] = round(flops / step_t / peak, 3)
+    print(f"gpt2-345m: {step_t*1e3:.1f} ms/step  "
+          f"{B*S/step_t:.0f} tok/s", file=sys.stderr)
+
+
 def bench_allreduce(extras):
     """DDP allreduce bandwidth over the device mesh (SURVEY §6 row 3:
     'DDP allreduce bandwidth over ICI'). Multi-chip only — a
@@ -642,7 +683,7 @@ def worker():
         # priority order under the budget: kernels (VERDICT r2 item 2)
         # must not be crowded out by the newer bert config
         for fn in (bench_llama, bench_resnet, bench_kernels, bench_bert,
-                   bench_allreduce):
+                   bench_gpt2, bench_allreduce):
             spent = time.perf_counter() - t_worker
             if spent > budget_s:
                 extras[fn.__name__ + "_skipped"] = (
