@@ -1,0 +1,174 @@
+"""KV-cache autoregressive decoding for the llama family.
+
+No reference analog (apex is a training toolkit); provided because the
+HF checkpoint import (models/convert.py) makes the model zoo hold real
+weights, and the natural smoke test of real weights is sampling. The
+design is decode-native rather than a re-run of the training forward:
+
+- static shapes throughout: the cache is ``[L, b, max_len, nkv, d]``
+  and a position mask (``idx <= pos``) replaces dynamic slicing, so the
+  whole generation loop is ONE ``lax.scan`` under jit;
+- prefill is a single full-sequence pass (flash attention) that also
+  emits every layer's rotated k / v — the prompt costs one step, not
+  one step per token;
+- decode attends one query token against the cache with a plain fp32
+  softmax (a [b, nq, max_len] score row — no S×S anything).
+
+Greedy (``temperature=0``) or temperature sampling. Works on any
+backend; sharded serving is out of scope (single-host batch decode).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import llama as _llama
+from apex_tpu.transformer.functional.rope import apply_rotary_qk
+
+__all__ = ["greedy_generate", "generate"]
+
+
+def _split_heads(x, n, d):
+    b, s, _ = x.shape
+    return x.reshape(b, s, n, d)
+
+
+def _layer_qkv(x, lp, cfg, positions):
+    """Projections + rope for one (unstacked) layer on [b, s, h]."""
+    d = cfg.head_dim
+    q = _split_heads(jnp.matmul(x, lp["wq"].astype(x.dtype)),
+                     cfg.num_heads, d)
+    k = _split_heads(jnp.matmul(x, lp["wk"].astype(x.dtype)),
+                     cfg.num_kv_heads, d)
+    v = _split_heads(jnp.matmul(x, lp["wv"].astype(x.dtype)),
+                     cfg.num_kv_heads, d)
+    q, k = apply_rotary_qk(q, k, positions=positions, base=cfg.rope_theta)
+    return q, k, v
+
+
+def _decode_attention(q, k_cache, v_cache, pos):
+    """q [b, 1, nq, d] vs cache [b, max_len, nkv, d], valid idx <= pos."""
+    b, _, nq, d = q.shape
+    nkv = k_cache.shape[2]
+    rep = nq // nkv
+    k = jnp.repeat(k_cache, rep, axis=2)          # [b, T, nq, d]
+    v = jnp.repeat(v_cache, rep, axis=2)
+    scores = jnp.einsum("bqnd,btnd->bnt", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (d ** -0.5)
+    idx = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(idx[None, None, :] <= pos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bnt,btnd->bnd", probs, v.astype(jnp.float32))
+    return o.reshape(b, 1, nq * d)
+
+
+def _decode_layer(x, lp, cfg, k_cache, v_cache, pos):
+    """One decode step through one layer; returns (x, new_k, new_v)."""
+    h = _llama._rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
+    q, k, v = _layer_qkv(h, lp, cfg,
+                         positions=jnp.full((x.shape[0], 1), pos,
+                                            jnp.int32))
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v.astype(v_cache.dtype), pos, axis=1)
+    o = _decode_attention(q, k_cache, v_cache, pos).astype(x.dtype)
+    x = x + jnp.matmul(o, lp["wo"].astype(x.dtype))
+    hm = _llama._rmsnorm(x, lp["mlp_norm"], cfg.rms_eps)
+    g = jnp.matmul(hm, lp["wg"].astype(x.dtype))
+    u = jnp.matmul(hm, lp["wu"].astype(x.dtype))
+    x = x + jnp.matmul(jax.nn.silu(g) * u, lp["wd"].astype(x.dtype))
+    return x, k_cache, v_cache
+
+
+def _prefill_layer(x, lp, cfg, positions):
+    """Full-sequence layer pass that also returns rotated k / v."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    h = _llama._rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
+    q, k, v = _layer_qkv(h, lp, cfg, positions)
+    o = flash_attention(q, k, v, causal=True, scale=cfg.head_dim ** -0.5)
+    b, s = x.shape[:2]
+    x = x + jnp.matmul(o.reshape(b, s, -1), lp["wo"].astype(x.dtype))
+    hm = _llama._rmsnorm(x, lp["mlp_norm"], cfg.rms_eps)
+    g = jnp.matmul(hm, lp["wg"].astype(x.dtype))
+    u = jnp.matmul(hm, lp["wu"].astype(x.dtype))
+    x = x + jnp.matmul(jax.nn.silu(g) * u, lp["wd"].astype(x.dtype))
+    return x, k, v
+
+
+def _logits(params, x, cfg):
+    x = _llama._rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    w = _llama.lm_head_weight(params, cfg)
+    return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
+
+
+def generate(params, prompt_tokens, cfg, max_new_tokens: int,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None):
+    """Autoregressive decode: prompt [b, p] → tokens [b, p + new].
+
+    Greedy at ``temperature=0`` (default); otherwise softmax sampling
+    with ``key``. The prompt must be dense (no padding); cache length is
+    ``p + max_new_tokens``.
+    """
+    if cfg.moe:
+        raise NotImplementedError("decode for MoE llama not implemented")
+    b, p = prompt_tokens.shape
+    max_len = p + max_new_tokens
+    if temperature and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    # ---- prefill: one full pass, caches for every layer
+    positions = jnp.broadcast_to(jnp.arange(p), (b, p))
+    x = _llama.embed(params, prompt_tokens, cfg, tp_axis=None)
+
+    def pre_body(h, lp):
+        h, k, v = _prefill_layer(h, lp, cfg, positions)
+        return h, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(pre_body, x, params["layers"])
+    pad = [(0, 0), (0, 0), (0, max_new_tokens), (0, 0), (0, 0)]
+    k_cache = jnp.pad(ks.astype(cfg.dtype), pad)  # [L, b, max_len, ...]
+    v_cache = jnp.pad(vs.astype(cfg.dtype), pad)
+    key, key0 = jax.random.split(key)
+    logits0 = _logits(params, x[:, -1:], cfg)[:, 0]
+    if temperature:
+        first = jax.random.categorical(key0, logits0 / temperature)[:, None]
+    else:
+        first = jnp.argmax(logits0, axis=-1)[:, None]  # [b, 1]
+
+    # ---- decode loop: one scan step per generated token
+    def step(carry, key_t):
+        token, k_cache, v_cache, pos = carry
+        x = _llama.embed(params, token, cfg, tp_axis=None)
+
+        def body(h, layer):
+            lp, kc, vc = layer
+            h, kc, vc = _decode_layer(h, lp, cfg, kc, vc, pos)
+            return h, (kc, vc)
+
+        x, (k_cache, v_cache) = jax.lax.scan(
+            body, x, (params["layers"], k_cache, v_cache))
+        logits = _logits(params, x, cfg)[:, 0]
+        if temperature:
+            nxt = jax.random.categorical(key_t, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return (nxt[:, None], k_cache, v_cache, pos + 1), token[:, 0]
+
+    keys = jax.random.split(key, max_new_tokens)
+    (last, _, _, _), toks = jax.lax.scan(
+        step, (first, k_cache, v_cache, jnp.int32(p)), keys)
+    new = jnp.concatenate([toks.T, last], axis=1)  # [b, max_new]
+    return jnp.concatenate([prompt_tokens, new[:, :max_new_tokens]],
+                           axis=1)
+
+
+def greedy_generate(params, prompt_tokens, cfg, max_new_tokens: int):
+    return generate(params, prompt_tokens, cfg, max_new_tokens,
+                    temperature=0.0)
