@@ -94,7 +94,8 @@ def param_specs(cfg: BertConfig, tp_axis: str = "tp",
     from jax.sharding import PartitionSpec as P
 
     t = tp_axis
-    extra = {"mlm_decoder_bias": P()} if with_decoder_bias else {}
+    # the decoder bias adds onto the vocab-LOCAL logits → vocab-sharded
+    extra = {"mlm_decoder_bias": P(t)} if with_decoder_bias else {}
     return {**extra,
         "embed": P(t, None), "pos_embed": P(), "type_embed": P(),
         "emb_ln_w": P(), "emb_ln_b": P(),
@@ -191,11 +192,6 @@ def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
     tokens, targets, loss_mask = batch
     hidden = forward(params, tokens, cfg, type_ids=type_ids,
                      pad_mask=pad_mask, tp_axis=tp_axis, remat=remat)
-    if vocab_chunks and "mlm_decoder_bias" in params:
-        # the chunked CE streams hidden @ embed.T only — it has no slot
-        # for the HF decoder bias, and silently dropping it would change
-        # the loss of a converted checkpoint; take the logits path
-        vocab_chunks = None
     if vocab_chunks:
         from apex_tpu.transformer.functional.chunked_ce import (
             chunked_lm_cross_entropy,
@@ -208,7 +204,8 @@ def loss_fn(params, batch, cfg: BertConfig, type_ids=None, pad_mask=None,
         losses = chunked_lm_cross_entropy(
             x.reshape(-1, x.shape[-1]), params["embed"].T,
             targets.reshape(-1), vocab_chunks,
-            tp_axis=tp_axis if _axis_bound(tp_axis) else None)
+            tp_axis=tp_axis if _axis_bound(tp_axis) else None,
+            bias=params.get("mlm_decoder_bias"))
         losses = losses.reshape(targets.shape)
     else:
         logits = mlm_logits(params, hidden, cfg, tp_axis)

@@ -142,7 +142,8 @@ def generate(params, prompt_tokens, cfg, max_new_tokens: int,
     else:
         first = jnp.argmax(logits0, axis=-1)[:, None]  # [b, 1]
 
-    # ---- decode loop: one scan step per generated token
+    # ---- decode loop: max_new - 1 steps, each consuming the previous
+    # token and EMITTING the next (the final token needs no decode pass)
     def step(carry, key_t):
         token, k_cache, v_cache, pos = carry
         x = _llama.embed(params, token, cfg, tp_axis=None)
@@ -159,14 +160,13 @@ def generate(params, prompt_tokens, cfg, max_new_tokens: int,
             nxt = jax.random.categorical(key_t, logits / temperature)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        return (nxt[:, None], k_cache, v_cache, pos + 1), token[:, 0]
+        return (nxt[:, None], k_cache, v_cache, pos + 1), nxt
 
-    keys = jax.random.split(key, max_new_tokens)
-    (last, _, _, _), toks = jax.lax.scan(
+    keys = jax.random.split(key, max_new_tokens - 1)
+    _, toks = jax.lax.scan(
         step, (first, k_cache, v_cache, jnp.int32(p)), keys)
-    new = jnp.concatenate([toks.T, last], axis=1)  # [b, max_new]
-    return jnp.concatenate([prompt_tokens, new[:, :max_new_tokens]],
-                           axis=1)
+    new = jnp.concatenate([first, toks.T], axis=1)  # [b, max_new]
+    return jnp.concatenate([prompt_tokens, new], axis=1)
 
 
 def greedy_generate(params, prompt_tokens, cfg, max_new_tokens: int):

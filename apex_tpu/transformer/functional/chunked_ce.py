@@ -26,15 +26,16 @@ import jax.numpy as jnp
 __all__ = ["chunked_lm_cross_entropy"]
 
 
-def _chunk_weights(weight, num_chunks):
+def _chunk_weights(weight, bias, num_chunks):
     h, v = weight.shape
     if v % num_chunks:
         raise ValueError(
             f"vocab {v} must divide into num_chunks={num_chunks}")
     vc = v // num_chunks
     w = weight.reshape(h, num_chunks, vc).transpose(1, 0, 2)  # [C, h, Vc]
+    b = bias.astype(jnp.float32).reshape(num_chunks, vc)      # [C, Vc]
     los = (jnp.arange(num_chunks) * vc).astype(jnp.int32)
-    return w, los, vc
+    return w, b, los, vc
 
 
 def _rank_offset(tp_axis, v_local):
@@ -53,33 +54,42 @@ def _vary(x, tp_axis):
     return make_varying(x, tp_axis)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def chunked_lm_cross_entropy(hidden, weight, labels, num_chunks=8,
-                             tp_axis=None):
-    """Per-token CE of ``hidden @ weight`` vs ``labels`` without the
-    ``[N, V]`` logits: ``hidden`` [N, h], ``weight`` [h, V] (the lm-head
-    kernel; pass ``embed.T`` for tied embeddings), ``labels`` [N] int.
-    Returns per-token losses [N] (fp32).
+                             tp_axis=None, bias=None):
+    """Per-token CE of ``hidden @ weight (+ bias)`` vs ``labels`` without
+    the ``[N, V]`` logits: ``hidden`` [N, h], ``weight`` [h, V] (the
+    lm-head kernel; pass ``embed.T`` for tied embeddings), ``labels``
+    [N] int, optional ``bias`` [V] (e.g. HF BERT's decoder bias — it
+    streams in the same vocab chunks). Returns per-token losses [N]
+    (fp32).
 
     ``tp_axis``: inside ``shard_map`` with a vocab-sharded weight
-    ([h, V/tp] per rank, Megatron layout), composes the chunked pass
-    with the vocab-parallel reduction — local online logsumexp per rank,
-    then pmax/psum across ranks (the vocab_parallel_cross_entropy math,
-    streamed). The backward psums the partial ``d_hidden`` the way the
-    column-parallel matmul transpose would."""
-    return _fwd(hidden, weight, labels, num_chunks, tp_axis)[0]
+    ([h, V/tp] per rank, Megatron layout; bias shards the same way),
+    composes the chunked pass with the vocab-parallel reduction — local
+    online logsumexp per rank, then pmax/psum across ranks (the
+    vocab_parallel_cross_entropy math, streamed). The backward psums the
+    partial ``d_hidden`` the way the column-parallel matmul transpose
+    would."""
+    if bias is None:
+        bias = jnp.zeros((weight.shape[1],), jnp.float32)
+    return _ce(hidden, weight, bias, labels, num_chunks, tp_axis)
 
 
-def _fwd(hidden, weight, labels, num_chunks, tp_axis):
-    w, los, vc = _chunk_weights(weight, num_chunks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ce(hidden, weight, bias, labels, num_chunks, tp_axis):
+    return _fwd(hidden, weight, bias, labels, num_chunks, tp_axis)[0]
+
+
+def _fwd(hidden, weight, bias, labels, num_chunks, tp_axis):
+    w, bch, los, vc = _chunk_weights(weight, bias, num_chunks)
     x32 = hidden.astype(jnp.float32)
     n = x32.shape[0]
     lo_rank = _rank_offset(tp_axis, weight.shape[1])
 
     def body(carry, inp):
         m, s, tgt = carry
-        w_c, lo = inp
-        logits = x32 @ w_c.astype(jnp.float32)           # [N, Vc]
+        w_c, b_c, lo = inp
+        logits = x32 @ w_c.astype(jnp.float32) + b_c      # [N, Vc]
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = (s * jnp.exp(m - m_new)
              + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
@@ -93,7 +103,7 @@ def _fwd(hidden, weight, labels, num_chunks, tp_axis):
     init = (_vary(jnp.full((n,), -jnp.inf, jnp.float32), tp_axis),
             _vary(jnp.zeros((n,), jnp.float32), tp_axis),
             _vary(jnp.zeros((n,), jnp.float32), tp_axis))
-    (m, s, tgt), _ = jax.lax.scan(body, init, (w, los))
+    (m, s, tgt), _ = jax.lax.scan(body, init, (w, bch, los))
     if tp_axis is not None:
         # vocab-parallel merge of the per-rank streams (the stable
         # cross-rank max/sum of tensor_parallel/cross_entropy.py)
@@ -102,20 +112,20 @@ def _fwd(hidden, weight, labels, num_chunks, tp_axis):
         tgt = jax.lax.psum(tgt, tp_axis)  # exactly one rank contributed
         m = m_g
     lse = jnp.log(s) + m
-    return lse - tgt, (hidden, weight, labels, lse)
+    return lse - tgt, (hidden, weight, bias, labels, lse)
 
 
 def _bwd(num_chunks, tp_axis, res, g):
-    hidden, weight, labels, lse = res
-    w, los, vc = _chunk_weights(weight, num_chunks)
+    hidden, weight, bias, labels, lse = res
+    w, bch, los, vc = _chunk_weights(weight, bias, num_chunks)
     x32 = hidden.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
     lo_rank = _rank_offset(tp_axis, weight.shape[1])
 
     def body(dx, inp):
-        w_c, lo = inp
+        w_c, b_c, lo = inp
         w32 = w_c.astype(jnp.float32)
-        logits = x32 @ w32                                # recompute [N, Vc]
+        logits = x32 @ w32 + b_c                          # recompute [N, Vc]
         p = jnp.exp(logits - lse[:, None])                # softmax slice
         idx = labels.astype(jnp.int32) - lo_rank - lo
         in_c = (idx >= 0) & (idx < vc)
@@ -125,16 +135,19 @@ def _bwd(num_chunks, tp_axis, res, g):
         d = (p - onehot) * g32[:, None]                   # [N, Vc]
         dx = dx + d @ w32.T
         dw_c = x32.T @ d                                  # [h, Vc]
-        return dx, dw_c
+        db_c = jnp.sum(d, axis=0)                         # [Vc]
+        return dx, (dw_c, db_c)
 
-    dx, dws = jax.lax.scan(body, _vary(jnp.zeros_like(x32), tp_axis),
-                           (w, los))
+    dx, (dws, dbs) = jax.lax.scan(
+        body, _vary(jnp.zeros_like(x32), tp_axis), (w, bch, los))
     if tp_axis is not None:
         # each rank's dx covers only its vocab shard's columns — the
         # column-parallel transpose is an allreduce
         dx = jax.lax.psum(dx, tp_axis)
     dweight = dws.transpose(1, 0, 2).reshape(weight.shape)
-    return (dx.astype(hidden.dtype), dweight.astype(weight.dtype), None)
+    dbias = dbs.reshape(bias.shape).astype(bias.dtype)
+    return (dx.astype(hidden.dtype), dweight.astype(weight.dtype), dbias,
+            None)
 
 
-chunked_lm_cross_entropy.defvjp(_fwd, _bwd)
+_ce.defvjp(_fwd, _bwd)

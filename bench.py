@@ -436,7 +436,9 @@ def bench_gpt2(extras):
     extras["gpt2_345m_tokens_per_sec"] = round(B * S / step_t)
     kind = jax.devices()[0].device_kind
     peak = _peak_flops(kind)
-    flops = B * S * 6 * n_params
+    # same PaLM accounting as bench_llama: 6N + attention's 12·L·h·S
+    flops = B * S * (6 * n_params
+                     + 12 * cfg.num_layers * cfg.hidden_size * S)
     if peak:
         extras["gpt2_345m_mfu"] = round(flops / step_t / peak, 3)
     print(f"gpt2-345m: {step_t*1e3:.1f} ms/step  "

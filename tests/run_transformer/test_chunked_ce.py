@@ -236,3 +236,49 @@ def test_gpt2_bert_tp_chunked_parity():
     np.testing.assert_allclose(run(bert, bcfg, bparams, bbatch, 4),
                                run(bert, bcfg, bparams, bbatch, None),
                                rtol=1e-5)
+
+
+def test_bias_parity():
+    """Optional decoder bias streams with the chunks (HF BERT import)."""
+    x, w, y = _data(dtype=jnp.float32)
+    bias = jax.random.normal(jax.random.PRNGKey(9), (w.shape[1],)) * 0.1
+
+    def naive_b(x, w, bias):
+        logits = x @ w + bias
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        return lse - jnp.take_along_axis(logits, y[:, None], axis=1)[:, 0]
+
+    want = naive_b(x, w, bias)
+    got = jax.jit(lambda x, w, b: chunked_lm_cross_entropy(
+        x, w, y, 8, bias=b))(x, w, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    wg = jax.grad(lambda x, w, b: jnp.mean(naive_b(x, w, b)),
+                  argnums=(0, 1, 2))(x, w, bias)
+    gg = jax.jit(jax.grad(
+        lambda x, w, b: jnp.mean(chunked_lm_cross_entropy(
+            x, w, y, 8, bias=b)), argnums=(0, 1, 2)))(x, w, bias)
+    for a, b_, n in zip(gg, wg, "xwb"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_bert_converted_bias_chunked_parity():
+    """A converted-checkpoint-style bert (with mlm_decoder_bias) must get
+    the SAME loss from the chunked and logits paths."""
+    from apex_tpu.models import bert
+
+    cfg = bert.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    params["mlm_decoder_bias"] = (
+        jax.random.normal(jax.random.PRNGKey(5), (cfg.vocab_size,)) * 0.1)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 4,
+                             cfg.vocab_size)
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(2), 0.3, (2, 32)).astype(jnp.float32)
+    batch = (tok, tok, mask)
+    base = bert.loss_fn(params, batch, cfg, tp_axis=None)
+    chunked = bert.loss_fn(params, batch, cfg, tp_axis=None,
+                           vocab_chunks=4)
+    np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
