@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from apex_tpu.models import llama as _llama
 from apex_tpu.transformer.functional.rope import apply_rotary_qk
 
-__all__ = ["greedy_generate", "generate"]
+__all__ = ["greedy_generate", "generate", "gpt2_generate"]
 
 
 def _split_heads(x, n, d):
@@ -106,10 +106,52 @@ def _logits(params, x, cfg):
     return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
 
 
+def _sample(logits, temperature, key):
+    if temperature:
+        return jax.random.categorical(key, logits / temperature)
+    return jnp.argmax(logits, axis=-1)
+
+
+def _autoregress(embed_step, decode_layer_fn, logits_fn, layers,
+                 k_cache, v_cache, logits0, prompt_tokens,
+                 max_new_tokens, temperature, key):
+    """The shared decode loop: max_new-1 scan steps, each consuming the
+    previous token and emitting the next (the final token needs no
+    decode pass)."""
+    key, key0 = jax.random.split(key)
+    first = _sample(logits0, temperature, key0)[:, None]
+
+    def step(carry, key_t):
+        token, kc, vc, pos = carry
+        x = embed_step(token, pos)
+
+        def body(h, layer):
+            lp, k1, v1 = layer
+            h, k1, v1 = decode_layer_fn(h, lp, k1, v1, pos)
+            return h, (k1, v1)
+
+        x, (kc, vc) = jax.lax.scan(body, x, (layers, kc, vc))
+        nxt = _sample(logits_fn(x)[:, 0], temperature, key_t)
+        return (nxt[:, None], kc, vc, pos + 1), nxt
+
+    p = prompt_tokens.shape[1]
+    keys = jax.random.split(key, max_new_tokens - 1)
+    _, toks = jax.lax.scan(
+        step, (first, k_cache, v_cache, jnp.int32(p)), keys)
+    new = jnp.concatenate([first, toks.T], axis=1)  # [b, max_new]
+    return jnp.concatenate([prompt_tokens, new], axis=1)
+
+
+def _check_sampling_args(temperature, key):
+    if temperature and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    return key if key is not None else jax.random.PRNGKey(0)
+
+
 def generate(params, prompt_tokens, cfg, max_new_tokens: int,
              temperature: float = 0.0,
              key: Optional[jax.Array] = None):
-    """Autoregressive decode: prompt [b, p] → tokens [b, p + new].
+    """Llama autoregressive decode: prompt [b, p] → tokens [b, p + new].
 
     Greedy at ``temperature=0`` (default); otherwise softmax sampling
     with ``key``. The prompt must be dense (no padding); cache length is
@@ -118,10 +160,7 @@ def generate(params, prompt_tokens, cfg, max_new_tokens: int,
     if cfg.moe:
         raise NotImplementedError("decode for MoE llama not implemented")
     b, p = prompt_tokens.shape
-    max_len = p + max_new_tokens
-    if temperature and key is None:
-        raise ValueError("temperature sampling needs a PRNG key")
-    key = key if key is not None else jax.random.PRNGKey(0)
+    key = _check_sampling_args(temperature, key)
 
     # ---- prefill: one full pass, caches for every layer
     positions = jnp.broadcast_to(jnp.arange(p), (b, p))
@@ -135,40 +174,110 @@ def generate(params, prompt_tokens, cfg, max_new_tokens: int,
     pad = [(0, 0), (0, 0), (0, max_new_tokens), (0, 0), (0, 0)]
     k_cache = jnp.pad(ks.astype(cfg.dtype), pad)  # [L, b, max_len, ...]
     v_cache = jnp.pad(vs.astype(cfg.dtype), pad)
-    key, key0 = jax.random.split(key)
     logits0 = _logits(params, x[:, -1:], cfg)[:, 0]
-    if temperature:
-        first = jax.random.categorical(key0, logits0 / temperature)[:, None]
-    else:
-        first = jnp.argmax(logits0, axis=-1)[:, None]  # [b, 1]
 
-    # ---- decode loop: max_new - 1 steps, each consuming the previous
-    # token and EMITTING the next (the final token needs no decode pass)
-    def step(carry, key_t):
-        token, k_cache, v_cache, pos = carry
-        x = _llama.embed(params, token, cfg, tp_axis=None)
-
-        def body(h, layer):
-            lp, kc, vc = layer
-            h, kc, vc = _decode_layer(h, lp, cfg, kc, vc, pos)
-            return h, (kc, vc)
-
-        x, (k_cache, v_cache) = jax.lax.scan(
-            body, x, (params["layers"], k_cache, v_cache))
-        logits = _logits(params, x, cfg)[:, 0]
-        if temperature:
-            nxt = jax.random.categorical(key_t, logits / temperature)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        return (nxt[:, None], k_cache, v_cache, pos + 1), nxt
-
-    keys = jax.random.split(key, max_new_tokens - 1)
-    _, toks = jax.lax.scan(
-        step, (first, k_cache, v_cache, jnp.int32(p)), keys)
-    new = jnp.concatenate([first, toks.T], axis=1)  # [b, max_new]
-    return jnp.concatenate([prompt_tokens, new], axis=1)
+    return _autoregress(
+        lambda token, pos: _llama.embed(params, token, cfg, tp_axis=None),
+        lambda h, lp, kc, vc, pos: _decode_layer(h, lp, cfg, kc, vc, pos),
+        lambda x: _logits(params, x, cfg),
+        params["layers"], k_cache, v_cache, logits0, prompt_tokens,
+        max_new_tokens, temperature, key)
 
 
 def greedy_generate(params, prompt_tokens, cfg, max_new_tokens: int):
     return generate(params, prompt_tokens, cfg, max_new_tokens,
                     temperature=0.0)
+
+
+# ------------------------------------------------------------------- gpt2
+
+
+def _gpt2_qkv(x, lp, cfg):
+    from apex_tpu.models import gpt2 as _gpt2
+
+    b, s, h = x.shape
+    n, d = cfg.num_heads, cfg.head_dim
+    qkv = (jnp.matmul(x, lp["wqkv"].reshape(h, -1).astype(x.dtype))
+           + lp["bqkv"].reshape(-1))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return (q.reshape(b, s, n, d), k.reshape(b, s, n, d),
+            v.reshape(b, s, n, d))
+
+
+def _gpt2_mlp(x, lp):
+    y = jnp.matmul(x, lp["wfc"].astype(x.dtype)) + lp["bfc"]
+    y = jax.nn.gelu(y, approximate=True)
+    return jnp.matmul(y, lp["wproj"].astype(x.dtype)) + lp["bproj"]
+
+
+def _gpt2_prefill_layer(x, lp, cfg):
+    from apex_tpu.models._common import layer_norm as _ln
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    b, s = x.shape[:2]
+    h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_eps)
+    q, k, v = _gpt2_qkv(h, lp, cfg)
+    o = flash_attention(q, k, v, causal=True, scale=cfg.head_dim ** -0.5)
+    x = x + (jnp.matmul(o.reshape(b, s, -1), lp["wo"].astype(x.dtype))
+             + lp["bo"])
+    h = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.ln_eps)
+    return x + _gpt2_mlp(h, lp), k, v
+
+
+def _gpt2_decode_layer(x, lp, cfg, k_cache, v_cache, pos):
+    from apex_tpu.models._common import layer_norm as _ln
+
+    h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_eps)
+    q, k, v = _gpt2_qkv(h, lp, cfg)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v.astype(v_cache.dtype), pos, axis=1)
+    o = _decode_attention(q, k_cache, v_cache, pos).astype(x.dtype)
+    x = x + jnp.matmul(o, lp["wo"].astype(x.dtype)) + lp["bo"]
+    h = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.ln_eps)
+    return x + _gpt2_mlp(h, lp), k_cache, v_cache
+
+
+def gpt2_generate(params, prompt_tokens, cfg, max_new_tokens: int,
+                  temperature: float = 0.0,
+                  key: Optional[jax.Array] = None):
+    """GPT-2 decode (learned positions, packed qkv, tied head)."""
+    from apex_tpu.models._common import layer_norm as _ln
+
+    b, p = prompt_tokens.shape
+    max_len = p + max_new_tokens
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"prompt + new tokens ({max_len}) exceeds "
+                         f"max_seq_len {cfg.max_seq_len}")
+    key = _check_sampling_args(temperature, key)
+
+    def embed(tokens, pos0):
+        x = jnp.take(params["embed"], tokens, axis=0)
+        s = tokens.shape[1]
+        wpe = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos0, s)
+        return (x + wpe[None]).astype(cfg.dtype)
+
+    def logits_fn(x):
+        x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_eps)
+        return jnp.matmul(
+            x, params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+
+    x = embed(prompt_tokens, 0)
+
+    def pre_body(h, lp):
+        h, k, v = _gpt2_prefill_layer(h, lp, cfg)
+        return h, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(pre_body, x, params["layers"])
+    pad = [(0, 0), (0, 0), (0, max_new_tokens), (0, 0), (0, 0)]
+    k_cache = jnp.pad(ks.astype(cfg.dtype), pad)
+    v_cache = jnp.pad(vs.astype(cfg.dtype), pad)
+    logits0 = logits_fn(x[:, -1:])[:, 0]
+
+    return _autoregress(
+        lambda token, pos: embed(token, pos),
+        lambda h, lp, kc, vc, pos: _gpt2_decode_layer(h, lp, cfg, kc, vc,
+                                                      pos),
+        logits_fn, params["layers"], k_cache, v_cache, logits0,
+        prompt_tokens, max_new_tokens, temperature, key)

@@ -71,3 +71,46 @@ def test_matches_hf_generate():
     got = np.asarray(gen.greedy_generate(params, jnp.asarray(prompt),
                                          cfg, 8))
     np.testing.assert_array_equal(got, want)
+
+
+def test_gpt2_greedy_matches_teacher_forcing():
+    from apex_tpu.models import gpt2
+
+    cfg = gpt2.tiny()
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    out = jax.jit(lambda p, t: gen.gpt2_generate(p, t, cfg, 6))(
+        params, prompt)
+    assert out.shape == (2, 14)
+
+    logits = gpt2.forward(params, out, cfg, tp_axis=None, remat=False)
+    preds = np.asarray(jnp.argmax(logits, axis=-1))
+    got = np.asarray(out)
+    for t in range(7, 13):
+        np.testing.assert_array_equal(
+            got[:, t + 1], preds[:, t],
+            err_msg=f"gpt2 cached decode diverged at position {t + 1}")
+
+
+@pytest.mark.slow
+def test_gpt2_matches_hf_generate():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.models import convert
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    params, cfg = convert.gpt2_from_hf(hf, dtype=jnp.float32)
+
+    prompt = np.random.default_rng(4).integers(0, 256, (2, 8))
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()
+    got = np.asarray(gen.gpt2_generate(params, jnp.asarray(prompt),
+                                       cfg, 8))
+    np.testing.assert_array_equal(got, want)
