@@ -44,14 +44,24 @@ def _rank_offset(tp_axis, v_local):
     return (jax.lax.axis_index(tp_axis) * v_local).astype(jnp.int32)
 
 
-def _vary(x, tp_axis):
-    """Mark a fresh scan carry varying over ``tp_axis`` (it becomes
-    rank-dependent inside the loop); no-op when the axis is unbound."""
-    if tp_axis is None:
-        return x
+def _carry_axes(tp_axis, *operands):
+    """Mesh axes the scan carries become varying over: every axis any
+    operand already varies over (e.g. 'cp'-sharded hidden states), plus
+    the explicit vocab-parallel axis."""
+    from apex_tpu.transformer.tensor_parallel.mappings import tree_vma
+
+    axes = set(tree_vma(*operands))
+    if tp_axis is not None:
+        axes.add(tp_axis)
+    return sorted(axes)
+
+
+def _vary(x, axes):
     from apex_tpu.transformer.tensor_parallel.mappings import make_varying
 
-    return make_varying(x, tp_axis)
+    for ax in axes:
+        x = make_varying(x, ax)
+    return x
 
 
 def chunked_lm_cross_entropy(hidden, weight, labels, num_chunks=8,
@@ -85,6 +95,7 @@ def _fwd(hidden, weight, bias, labels, num_chunks, tp_axis):
     x32 = hidden.astype(jnp.float32)
     n = x32.shape[0]
     lo_rank = _rank_offset(tp_axis, weight.shape[1])
+    axes = _carry_axes(tp_axis, hidden, weight, bias, labels)
 
     def body(carry, inp):
         m, s, tgt = carry
@@ -100,9 +111,9 @@ def _fwd(hidden, weight, bias, labels, num_chunks, tp_axis):
         tgt = jnp.where(in_c, tl, tgt)
         return (m_new, s, tgt), None
 
-    init = (_vary(jnp.full((n,), -jnp.inf, jnp.float32), tp_axis),
-            _vary(jnp.zeros((n,), jnp.float32), tp_axis),
-            _vary(jnp.zeros((n,), jnp.float32), tp_axis))
+    init = (_vary(jnp.full((n,), -jnp.inf, jnp.float32), axes),
+            _vary(jnp.zeros((n,), jnp.float32), axes),
+            _vary(jnp.zeros((n,), jnp.float32), axes))
     (m, s, tgt), _ = jax.lax.scan(body, init, (w, bch, los))
     if tp_axis is not None:
         # vocab-parallel merge of the per-rank streams (the stable
@@ -121,6 +132,7 @@ def _bwd(num_chunks, tp_axis, res, g):
     x32 = hidden.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
     lo_rank = _rank_offset(tp_axis, weight.shape[1])
+    axes = _carry_axes(tp_axis, hidden, weight, bias, labels, g)
 
     def body(dx, inp):
         w_c, b_c, lo = inp
@@ -139,7 +151,7 @@ def _bwd(num_chunks, tp_axis, res, g):
         return dx, (dw_c, db_c)
 
     dx, (dws, dbs) = jax.lax.scan(
-        body, _vary(jnp.zeros_like(x32), tp_axis), (w, bch, los))
+        body, _vary(jnp.zeros_like(x32), axes), (w, bch, los))
     if tp_axis is not None:
         # each rank's dx covers only its vocab shard's columns — the
         # column-parallel transpose is an allreduce

@@ -282,3 +282,31 @@ def test_bert_converted_bias_chunked_parity():
     chunked = bert.loss_fn(params, batch, cfg, tp_axis=None,
                            vocab_chunks=4)
     np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
+
+
+def test_llama_cp_chunked_parity():
+    """vocab_chunks composes with context parallelism: cp=2 sequence
+    shards + chunked CE equals the unsharded loss."""
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    cfg = llama.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    batch = (tok, jnp.roll(tok, -1, -1))
+    want = float(llama.loss_fn(params, batch, cfg, tp_axis=None,
+                               cp_axis=None, vocab_chunks=4))
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("cp",))
+
+    def fn(p, tokens, targets):
+        loss = llama.loss_fn(p, (tokens, targets), cfg, tp_axis=None,
+                             cp_axis="cp", vocab_chunks=4)
+        return jax.lax.pmean(loss, "cp")
+
+    got = float(jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(P(), P(None, "cp"), P(None, "cp")),
+        out_specs=P()))(params, *batch))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
