@@ -92,3 +92,11 @@ def test_llama_train_checkpoint_resume(tmp_path):
                "--checkpoint-dir", ckpt, "--resume")
     assert "=> resumed from step 3" in out
     assert "(decreased)" in out
+
+
+@pytest.mark.slow
+def test_hf_finetune():
+    out = _run("hf_finetune.py", "--steps", "12")
+    assert "imported llama" in out
+    assert "(decreased)" in out
+    assert "prompt " in out
