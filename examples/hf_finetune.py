@@ -1,6 +1,6 @@
 """Fine-tune an imported HuggingFace checkpoint, then sample from it —
 the interop loop in one script: ``transformers`` weights →
-``models.convert`` → bf16 DDP training with FusedAdam + chunked CE →
+``models.convert`` → fp32 DDP fine-tuning with FusedAdam + chunked CE →
 ``models.generate`` KV-cache decoding.
 
 Offline-friendly: with no checkpoint to download, a randomly initialized
@@ -44,7 +44,10 @@ def main():
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     import transformers

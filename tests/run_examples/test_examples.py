@@ -96,6 +96,8 @@ def test_llama_train_checkpoint_resume(tmp_path):
 
 @pytest.mark.slow
 def test_hf_finetune():
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     out = _run("hf_finetune.py", "--steps", "12")
     assert "imported llama" in out
     assert "(decreased)" in out

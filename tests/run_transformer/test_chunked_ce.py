@@ -287,7 +287,6 @@ def test_bert_converted_bias_chunked_parity():
 def test_llama_cp_chunked_parity():
     """vocab_chunks composes with context parallelism: cp=2 sequence
     shards + chunked CE equals the unsharded loss."""
-    import functools
     from jax.sharding import Mesh, PartitionSpec as P
     from jax import shard_map
 
