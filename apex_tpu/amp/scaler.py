@@ -22,6 +22,23 @@ import jax
 import jax.numpy as jnp
 
 
+def _promote_varying(x, axes):
+    """Mark ``x`` varying over the mesh axes in ``axes`` it isn't already
+    (no-op outside shard_map / for already-varying values), with the
+    pcast→pvary fallback for older jax."""
+    try:
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    except Exception:
+        have = frozenset()
+    missing = tuple(sorted(set(axes) - set(have)))
+    if not missing:
+        return x
+    try:
+        return jax.lax.pcast(x, missing, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, missing)
+
+
 class LossScaleState(NamedTuple):
     """Functional scaler state (carried through the jitted train step)."""
 
@@ -131,7 +148,8 @@ class LossScaler:
         )
 
 
-def scaled_update(tx, scaler: LossScaler, grads, opt_state, params, scaler_state):
+def scaled_update(tx, scaler: LossScaler, grads, opt_state, params,
+                  scaler_state, overflow_reduce_axes=()):
     """One amp step: unscale → overflow check → conditional optimizer update.
 
     The TPU-native equivalent of apex's ``scale_loss`` context epilogue +
@@ -139,9 +157,19 @@ def scaled_update(tx, scaler: LossScaler, grads, opt_state, params, scaler_state
     On overflow the optimizer state and params are left untouched via
     ``lax.cond`` — the whole step stays on device.
 
+    Inside ``shard_map``, pass every mesh axis name in
+    ``overflow_reduce_axes``: the overflow flag is psum-voted across them
+    so ALL ranks take the same cond branch (the in-graph analog of the
+    reference's NCCL-allreduced overflow buffer,
+    ref apex/amp/scaler.py:unscale_with_stashed + _amp_state master flag).
+
     Returns ``(updates, new_opt_state, new_scaler_state, overflow)``.
     """
     unscaled, overflow = scaler.unscale(grads, scaler_state)
+    if overflow_reduce_axes:
+        ovf = _promote_varying(overflow.astype(jnp.float32),
+                               overflow_reduce_axes)
+        overflow = jax.lax.psum(ovf, tuple(overflow_reduce_axes)) > 0
 
     def do_update(_):
         return tx.update(unscaled, opt_state, params)
@@ -155,15 +183,8 @@ def scaled_update(tx, scaler: LossScaler, grads, opt_state, params, scaler_state
     out_shapes = jax.eval_shape(do_update, None)
 
     def _match_vma(x, sd):
-        want = getattr(sd, "vma", frozenset()) or frozenset()
-        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
-        missing = tuple(sorted(want - have))
-        if missing:
-            try:
-                x = jax.lax.pcast(x, missing, to="varying")
-            except (AttributeError, TypeError):
-                x = jax.lax.pvary(x, missing)
-        return x
+        return _promote_varying(x, getattr(sd, "vma", frozenset())
+                                or frozenset())
 
     def skip(_):
         zeros = jax.tree_util.tree_map(

@@ -111,6 +111,30 @@ def distributed_fused_adam(
     return optax.GradientTransformation(init, update)
 
 
+def dist_adam_partition_specs(params, mesh_axes=("dp",)):
+    """PartitionSpecs for carrying :class:`DistAdamState` across jitted
+    ``shard_map`` steps (checkpoint/resume of the ZeRO shards).
+
+    The state is one flat fp32 shard per param-dtype bucket per rank; its
+    global encoding concatenates every rank's shard along dim 0 in mesh
+    order, so a round trip through ``out_specs`` then ``in_specs`` hands
+    each rank back exactly the shard it wrote. ``mesh_axes`` should name
+    the ZeRO axis plus any mesh axis the params may be sharded over (the
+    per-rank shards differ across those too). A bucket that happens to be
+    invariant over a listed axis is still fine: shard_map accepts an
+    out_spec naming an axis the value is invariant over, and the global
+    array just stores that bucket's identical blocks redundantly. Ref
+    apex/contrib/optimizers/distributed_fused_adam.py state_dict gather.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    keys = sorted({jnp.dtype(l.dtype).name
+                   for l in jax.tree_util.tree_leaves(params)})
+    shard = {k: P(tuple(mesh_axes)) for k in keys}
+    return DistAdamState(count=P(), master_shard=shard, mu_shard=shard,
+                         nu_shard=shard)
+
+
 class DistributedFusedAdam:
     """Class-shaped wrapper (ref distributed_fused_adam.py:42); functional
     state, explicit mesh usage."""
