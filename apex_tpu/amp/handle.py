@@ -53,8 +53,11 @@ class AmpHandle:
         return self.scaler.scale_loss(
             loss, scaler_state if scaler_state is not None else self.scaler_state)
 
-    def scaled_update(self, tx, grads, opt_state, params, scaler_state):
-        return _scaled_update(tx, self.scaler, grads, opt_state, params, scaler_state)
+    def scaled_update(self, tx, grads, opt_state, params, scaler_state,
+                      overflow_reduce_axes=()):
+        return _scaled_update(tx, self.scaler, grads, opt_state, params,
+                              scaler_state,
+                              overflow_reduce_axes=overflow_reduce_axes)
 
     # ---- stateful convenience (host-level loops) --------------------------
 

@@ -67,9 +67,11 @@ def distributed_fused_lamb(
     ``master_dtype`` controls the storage dtype of the sharded
     master/moment buffers (the reference's fp16-master memory knob;
     bf16 halves ZeRO state memory, the step math stays fp32).
-    ``fp32_reduce_scatter`` (ref DistributedFusedLAMB's flag of the same
-    name) reduces grads in fp32; False reduce-scatters in the gradient's
-    own dtype — half the ICI bytes, bf16 summation error."""
+    ``fp32_reduce_scatter`` reduces grads in fp32; False reduce-scatters
+    in the gradient's own dtype — half the ICI bytes, bf16 summation
+    error. (The closest reference analog is DistributedFusedAdam's
+    fp16 reduce-scatter path; DistributedFusedLAMB itself has no such
+    flag.)"""
     b1, b2 = betas
 
     def init(params):

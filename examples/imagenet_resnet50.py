@@ -20,7 +20,9 @@ similarly). Try::
 from __future__ import annotations
 
 import argparse
+import collections
 import os
+import threading
 import sys
 import time
 
@@ -33,6 +35,10 @@ import optax
 def parse_args():
     p = argparse.ArgumentParser(
         description="apex_tpu imagenet trainer (ref main_amp.py)")
+    p.add_argument("--val-data", default="", metavar="DIR",
+                   help="held-out shards for validation; without it the "
+                        "val metrics are measured on the TRAINING shards "
+                        "(a warning is printed)")
     p.add_argument("--data", default="", metavar="DIR",
                    help="dir of .npz shards (x,y); synthetic if empty")
     p.add_argument("--arch", "-a", default="tiny",
@@ -112,7 +118,8 @@ class ShardDataset:
         self.n_batches = n_batches
         self.seed = seed
         self.row = image_size * image_size * 3 + 1
-        self._cache = {}
+        self._cache = collections.OrderedDict()
+        self._cache_lock = threading.Lock()
         self.files = []
         if data_dir:
             self.files = sorted(
@@ -121,13 +128,26 @@ class ShardDataset:
             if not self.files:
                 raise FileNotFoundError(f"no .npz shards in {data_dir}")
 
+    # shard access is sequential/cyclic, so a tiny LRU suffices; unbounded
+    # caching would grow host memory to the whole dataset on an
+    # ImageNet-scale --data dir
+    _CACHE_SHARDS = 4
+
     def _shard(self, path):
         """Cache decompressed shards: np.load + array access per batch
-        would re-decompress the whole file on the prefetch hot path."""
-        if path not in self._cache:
+        would re-decompress the whole file on the prefetch hot path.
+        fill() runs on multiple prefetch worker threads — the lock keeps
+        the evicting LRU consistent (and the decompress single-flight)."""
+        with self._cache_lock:
+            if path in self._cache:
+                self._cache.move_to_end(path)
+                return self._cache[path]
             f = np.load(path)
-            self._cache[path] = (np.asarray(f["x"]), np.asarray(f["y"]))
-        return self._cache[path]
+            shard = (np.asarray(f["x"]), np.asarray(f["y"]))
+            self._cache[path] = shard
+            while len(self._cache) > self._CACHE_SHARDS:
+                self._cache.popitem(last=False)
+            return shard
 
     def fill(self, batch_idx, out):
         """Prefetch callback: writes batch ``batch_idx`` into ``out``
@@ -212,8 +232,15 @@ def main():
 
     ds = ShardDataset(args.data, args.steps_per_epoch, args.batch,
                       args.image_size, args.classes, seed=100)
-    val_ds = ShardDataset(args.data, 4, args.batch, args.image_size,
-                          args.classes, seed=9000)
+    # validation needs HELD-OUT shards (ref main_amp.py's separate val
+    # dir); measuring on the training shards inflates top-1/top-5 and
+    # corrupts best-checkpoint selection
+    if args.data and not args.val_data:
+        print("WARNING: no --val-data given; validation metrics are "
+              "measured on the TRAINING shards and overstate accuracy",
+              file=sys.stderr)
+    val_ds = ShardDataset(args.val_data or args.data, 4, args.batch,
+                          args.image_size, args.classes, seed=9000)
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(1), x0, train=False)

@@ -233,6 +233,38 @@ def train_curve(model_name, opt_level, tx_name, steps=50, ddp=False,
     return np.asarray(jax.device_get(losses), np.float64)
 
 
+def raw_fp32_curve(model_name, tx_name, steps=50, seed=0):
+    """Plain fp32 loop with NO amp machinery at all — no scaler, no
+    policy, no scaled_update, just grad → tx.update → apply_updates.
+    The ground truth the 'O0 is a complete no-op' contract is checked
+    against (an O0 run compared to another O0 run would only prove
+    determinism)."""
+    init, loss_fn, make_batch = get_model(model_name, "O0")
+    params, aux = init(jax.random.PRNGKey(seed))
+    tx = make_tx(tx_name)
+    opt_state = tx.init(params)
+    batches = [make_batch(jax.random.PRNGKey(1000 + i))
+               for i in range(N_BATCHES)]
+
+    def step_body(params, aux, opt_state, batch):
+        def fwd(p):
+            l, new_aux = loss_fn(p, aux, batch)
+            return l, (l, new_aux)
+
+        grads, (l, new_aux) = jax.grad(fwd, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_aux, opt_state, l
+
+    step = jax.jit(step_body)
+    losses = []
+    for i in range(steps):
+        params, aux, opt_state, l = step(params, aux, opt_state,
+                                         batches[i % N_BATCHES])
+        losses.append(l)
+    return np.asarray(jax.device_get(losses), np.float64)
+
+
 @functools.lru_cache(maxsize=None)
 def baseline_curve(model_name, tx_name, steps=50, ddp=False):
     """The fp32/O0 run every amp config is compared against

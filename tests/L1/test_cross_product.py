@@ -15,6 +15,7 @@ from tests.L1.l1_harness import (
     assert_decreased,
     assert_tracks,
     baseline_curve,
+    raw_fp32_curve,
     train_curve,
 )
 
@@ -82,9 +83,10 @@ def test_ddp_matches_single_o2():
 
 def test_o0_is_exact_fp32():
     """O0 through the amp machinery must be bit-identical to a plain
-    fp32 loop (amp disabled = complete no-op, ref frontend contract)."""
+    fp32 loop built WITHOUT amp — no scaler/policy/scaled_update (amp
+    disabled = complete no-op, ref frontend contract)."""
     a = train_curve("mlp", "O0", "adam", steps=10)
-    b = train_curve("mlp", "O0", "adam", steps=10)
+    b = raw_fp32_curve("mlp", "adam", steps=10)
     np.testing.assert_array_equal(a, b)
 
 
