@@ -1,9 +1,19 @@
-"""Profiling shim (TPU re-design of ``apex.pyprof``; ref apex/pyprof/*).
+"""Profiling (TPU re-design of ``apex.pyprof``; ref apex/pyprof/*).
 
-The reference wraps nvtx ranges + an nvprof parser. The TPU analog is
-``jax.profiler``: traces land in TensorBoard/Perfetto instead of nvprof.
-API mirrors the pyprof surface (``init``, ``nvtx.range_push/pop``,
-``wrap``) so reference-style instrumentation ports unchanged.
+The reference has three parts: nvtx instrumentation
+(apex/pyprof/nvtx/nvmarker.py), an nvprof-database parser
+(apex/pyprof/parse/parse.py) and a per-op flops/bytes report
+(apex/pyprof/prof/prof.py). The TPU analogs:
+
+- instrumentation (this module): ``jax.profiler`` annotations under the
+  pyprof API names (``init``, ``nvtx.range_push/pop``, ``wrap``) so
+  reference-style instrumentation ports unchanged; traces land in
+  TensorBoard/Perfetto instead of nvprof;
+- :mod:`apex_tpu.pyprof.parse` — xplane capture → per-op records with
+  exclusive-time attribution;
+- :mod:`apex_tpu.pyprof.prof` — records → per-op / per-category report
+  (flops, bytes and roofline bound merged from the capture when a
+  device plane is present). CLI: ``tools/trace_report.py``.
 """
 
 from __future__ import annotations
@@ -13,6 +23,9 @@ import functools
 from typing import Optional
 
 import jax
+
+from apex_tpu.pyprof import parse, prof  # noqa: F401 (re-export)
+from apex_tpu.pyprof.prof import Report  # noqa: F401
 
 _enabled = False
 _trace_dir: Optional[str] = None

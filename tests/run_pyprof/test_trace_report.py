@@ -1,0 +1,117 @@
+"""Trace-analysis half of pyprof (ref apex/pyprof/prof/prof.py +
+parse/parse.py): parse an xplane capture of one llama train step and
+attribute time to ops — the report must name the matmuls and the
+collectives and the attribution must be self-consistent."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from apex_tpu.pyprof import parse, prof
+
+
+@pytest.fixture(scope="module")
+def llama_capture(tmp_path_factory):
+    """One dp=2×tp=2 llama train step (grads pmean-synced over dp, TP
+    collectives over tp), traced on the CPU mesh."""
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = llama.tiny(num_layers=2, vocab_size=128, hidden_size=64,
+                     num_heads=4, num_kv_heads=2, intermediate_size=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tx = fused_adam(lr=1e-3)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    specs = llama.param_specs(cfg)
+
+    def step(p, opt_state, tokens):
+        def loss_fn(p):
+            l = llama.loss_fn(p, (tokens, tokens), cfg, tp_axis="tp",
+                              cp_axis=None)
+            return jax.lax.pmean(l, "dp")
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "dp"), grads)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return jax.tree_util.tree_map(jnp.add, p, updates), opt_state, loss
+
+    from apex_tpu.optimizers import opt_partition_specs
+
+    with mesh:
+        opt_state = tx.init(params)
+        opt_specs = opt_partition_specs(tx, params, specs)
+        jstep = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(specs, opt_specs, P("dp", None)),
+            out_specs=(specs, opt_specs, P())))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                    cfg.vocab_size)
+        out = jstep(params, opt_state, tokens)  # compile outside trace
+        jax.block_until_ready(out)
+        logdir = str(tmp_path_factory.mktemp("trace"))
+        with jax.profiler.trace(logdir):
+            out = jstep(params, opt_state, tokens)
+            jax.block_until_ready(out)
+    return logdir
+
+
+def test_parse_finds_hlo_ops(llama_capture):
+    paths = parse.find_xplane_paths(llama_capture)
+    assert paths, "capture produced no xplane file"
+    records = parse.parse_xspace(paths)
+    assert len(records) > 50
+    # exclusive time must be positive and never exceed inclusive
+    assert all(0 <= r.self_ps <= r.duration_ps for r in records)
+    assert any(r.self_ps > 0 for r in records)
+
+
+def test_report_names_matmul_and_collectives(llama_capture):
+    report = prof.Report.from_capture(llama_capture)
+    cats = report.by_category()
+    assert "matmul" in cats and cats["matmul"]["self_us"] > 0, (
+        f"no matmul attribution: {list(cats)}")
+    # tp row/column collectives + the dp grad pmean must show up
+    assert "collective" in cats and cats["collective"]["occurrences"] > 0, (
+        f"no collective attribution: {list(cats)}")
+    names = " ".join(o.name for o in report.ops)
+    assert "dot" in names
+    assert "psum" in names or "all-reduce" in names or "all_gather" in names
+
+
+def test_report_shares_and_serialization(llama_capture):
+    report = prof.Report.from_capture(llama_capture)
+    shares = [o.share for o in report.ops]
+    assert abs(sum(shares) - 1.0) < 1e-6
+    assert shares == sorted(shares, reverse=True)
+    d = report.to_dict(top=10)
+    assert len(d["ops"]) == 10
+    assert d["total_self_us"] > 0
+    table = report.format_table(top=5)
+    assert "TOTAL" in table and "category" in table
+    # no device plane on the CPU mesh: flops absent, utilization == 0
+    util = report.utilization(peak_tflops=197.0)
+    assert util["mfu"] == 0.0
+
+
+def test_classify_categories():
+    assert parse.classify("all-reduce.1") == "collective"
+    assert parse.classify("psum_invariant.7") == "collective"
+    assert parse.classify("ppermute.2") == "collective"
+    assert parse.classify("dot_general.3") == "matmul"
+    assert parse.classify("convolution.4") == "convolution"
+    assert parse.classify("copy.16") == "data-movement"
+    assert parse.classify("wrapped_reduce.2") == "reduction"
+    assert parse.classify("add_rsqrt_fusion") == "fusion-elementwise"
+    assert parse.is_container("while.5")
+    assert not parse.is_container("dot.1")

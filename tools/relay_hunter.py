@@ -205,6 +205,16 @@ def main():
                      "--out", os.path.join(REPO, "TPU_TRACE_r05")],
                     timeout=1200, log_path=BENCH_LOG, header="tpu_profile")
                 log_probe(event="profile", rc=rc_p)
+                if rc_p == 0:
+                    # per-op attribution from the fresh capture (host-side
+                    # analysis; does not touch the chip)
+                    rc_r, _ = run_child(
+                        [sys.executable, "tools/trace_report.py",
+                         os.path.join(REPO, "TPU_TRACE_r05"), "--json",
+                         os.path.join(REPO, "TRACE_REPORT_r05.json")],
+                        timeout=600, log_path=BENCH_LOG,
+                        header="trace_report")
+                    log_probe(event="trace_report", rc=rc_r)
                 return 0
             log_probe(event="partial_tpu_result", validate_rc=rc_v,
                       bench_rc=rc_b)

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Per-op attribution report from a jax.profiler capture.
+
+The trace-ANALYSIS half of pyprof (VERDICT r4 missing #2; reference
+capability apex/pyprof/prof/prof.py + apex/pyprof/parse/parse.py): turn
+an xplane capture (from ``tools/tpu_profile.py``, ``jax.profiler.trace``
+or ``apex_tpu.pyprof.start/stop``) into per-op and per-category
+time/flops attribution, plus MFU when the capture carries device-plane
+op metrics (i.e. on TPU).
+
+    python tools/trace_report.py /tmp/apex_tpu_trace
+    python tools/trace_report.py TPU_TRACE_r05 --peak-tflops 197 \
+        --json report.json --top 40
+
+Peak defaults to a v5e chip (197 bf16 TFLOP/s, 819 GB/s HBM).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir", help="trace logdir, run dir, or .xplane.pb")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="chip peak for MFU (default: v5e bf16)")
+    ap.add_argument("--peak-hbm-gbps", type=float, default=819.0)
+    ap.add_argument("--json", default="",
+                    help="also write the full report as JSON")
+    args = ap.parse_args()
+
+    from apex_tpu.pyprof.prof import Report
+
+    report = Report.from_capture(args.logdir)
+    if not report.ops:
+        print("no HLO op events in capture", file=sys.stderr)
+        return 1
+    print(report.format_table(top=args.top))
+
+    has_flops = any(o.flops for o in report.ops)
+    if has_flops:
+        util = report.utilization(args.peak_tflops, args.peak_hbm_gbps)
+        print(f"\nbusy {util['busy_s'] * 1e3:.2f} ms   "
+              f"{util['total_flops'] / 1e9:.2f} GFLOP   "
+              f"MFU {util['mfu'] * 100:.1f}%   "
+              f"HBM util {util.get('hbm_util', 0.0) * 100:.1f}%")
+    else:
+        print("\n(no per-op flops in this capture — host-only planes; "
+              "MFU needs a device-plane trace, i.e. a TPU run)")
+
+    if args.json:
+        payload = report.to_dict()
+        if has_flops:
+            payload["utilization"] = report.utilization(
+                args.peak_tflops, args.peak_hbm_gbps)
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
