@@ -15,6 +15,8 @@ from tests.L1.l1_harness import (
     assert_decreased,
     assert_tracks,
     baseline_curve,
+    llama_pp_tp_curve,
+    llama_single_curve,
     raw_fp32_curve,
     train_curve,
 )
@@ -88,6 +90,20 @@ def test_o0_is_exact_fp32():
     a = train_curve("mlp", "O0", "adam", steps=10)
     b = raw_fp32_curve("mlp", "adam", steps=10)
     np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_llama_pp_tp_amp_adam(opt_level):
+    """The flagship-parallelism leg: llama-tiny trained over a pp=2 x
+    tp=2 mesh (1F1B pipeline + tensor/sequence parallel + vocab-parallel
+    CE + amp) must track the single-device run of the same config over
+    the same data (ref tests/L1/common/main_amp.py distributed legs)."""
+    single = llama_single_curve(opt_level, steps=25)
+    meshed = llama_pp_tp_curve(opt_level, steps=25)
+    assert_decreased(single, f"llama/{opt_level}/single")
+    assert_decreased(meshed, f"llama/{opt_level}/pp2xtp2")
+    assert_tracks(meshed, single, 0.08,
+                  f"llama/{opt_level}/pp2xtp2-vs-single")
 
 
 # ------------------------------------------------------------- full matrix

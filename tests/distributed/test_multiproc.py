@@ -55,6 +55,156 @@ def test_launcher_two_processes_psum(tmp_path):
 
 
 @pytest.mark.slow
+def test_launcher_pipeline_across_processes(tmp_path):
+    """The collective 1F1B pipeline composed with the launcher (VERDICT
+    r4 next-step #8): a dp=2 x pp=4 mesh where 'dp' spans TWO processes
+    (the multi-host axis) and the pipeline's ppermute stage transfers run
+    on the 4 local devices of each process — grads cross the host
+    boundary via the dp pmean, the schedule crosses stages via ppermute,
+    and the loss must decrease in both processes."""
+    script = tmp_path / "pipe.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        from apex_tpu.parallel.multiproc import initialize_distributed
+
+        pid, nproc = initialize_distributed()
+        assert nproc == 2, nproc
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from apex_tpu.models import llama
+        from apex_tpu.optimizers import fused_adam
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            pipelined_forward,
+        )
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            _to_varying,
+        )
+
+        assert jax.device_count() == 8, jax.device_count()  # 2 procs x 4
+        dp, pp = 2, 4
+        mesh = Mesh(np.array(jax.devices()).reshape(dp, pp), ("dp", "pp"))
+
+        cfg = llama.tiny(num_layers=pp, num_heads=2, num_kv_heads=2,
+                         hidden_size=32, intermediate_size=64,
+                         vocab_size=64)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        stage_params = llama.split_stages(params, pp)
+        io_params = {k: v for k, v in params.items() if k != "layers"}
+        tx = fused_adam(lr=3e-3)
+
+        M, mb, s = 4, 2, 8
+        tok_np = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (M, mb * dp, s), 0, cfg.vocab_size))
+
+        def train_step(stage, io, opt_state, tokens, targets):
+            pp_rank = jax.lax.axis_index("pp")
+            pp_size = jax.lax.axis_size("pp")
+
+            def vary_all(t):
+                for ax in ("dp", "pp"):
+                    t = jax.tree_util.tree_map(
+                        lambda a, ax=ax: _to_varying(a, ax), t)
+                return t
+
+            def total_loss(trees):
+                stage, io = trees
+                stage = jax.tree_util.tree_map(lambda a: a[0], stage)
+                stage, io = vary_all(stage), vary_all(io)
+
+                def embed_mb(t):
+                    return llama.embed(io, t, cfg, tp_axis=None)
+
+                x_mb = vary_all(jax.vmap(embed_mb)(tokens))
+                positions = llama._positions(mb, s, None)
+
+                def stage_fn(sp, x):
+                    return llama.stage_fn(sp, x, cfg, positions,
+                                          tp_axis=None, cp_axis=None)
+
+                outs = pipelined_forward(stage_fn, stage, x_mb,
+                                         axis_name="pp", remat=True)
+
+                def mb_loss(o, t):
+                    logits = llama.lm_head(io, o, cfg, tp_axis=None)
+                    return jnp.mean(
+                        optax.softmax_cross_entropy_with_integer_labels(
+                            logits.astype(jnp.float32), t))
+
+                losses = jax.vmap(mb_loss)(outs, targets)
+                local = jnp.where(pp_rank == pp_size - 1,
+                                  jnp.mean(losses), 0.0)
+                return jax.lax.psum(local, "pp")
+
+            loss, (g_stage, g_io) = jax.value_and_grad(total_loss)(
+                (stage, io))
+            # dp grad mean crosses the PROCESS boundary; io grads are
+            # produced only by first/last stages -> psum over pp
+            pm = lambda g: jax.lax.pmean(_to_varying(g, "dp"), "dp")
+            g_stage = jax.tree_util.tree_map(pm, g_stage)
+            g_io = jax.tree_util.tree_map(
+                lambda g: pm(jax.lax.psum(_to_varying(g, "pp"), "pp")),
+                g_io)
+            grads = {"stage": g_stage, "io": g_io}
+            params_t = {"stage": stage, "io": io}
+            updates, opt_state = tx.update(grads, opt_state, params_t)
+            new = jax.tree_util.tree_map(jnp.add, params_t, updates)
+            loss = jax.lax.pmean(loss, "dp")
+            return new["stage"], new["io"], opt_state, loss
+
+        lp = llama.param_specs(cfg)["layers"]
+        stage_specs = {k: P("pp", *(None,) * (len(lp[k])))
+                       for k in lp}
+        io_specs = {"embed": P(), "final_norm": P(), "lm_head": P()}
+
+        from apex_tpu.optimizers import opt_partition_specs
+
+        with mesh:
+            opt_state = tx.init({"stage": stage_params, "io": io_params})
+            opt_specs = opt_partition_specs(
+                tx, {"stage": stage_params, "io": io_params},
+                {"stage": stage_specs, "io": io_specs})
+
+            step = jax.jit(shard_map(
+                train_step, mesh=mesh,
+                in_specs=(stage_specs, io_specs, opt_specs,
+                          P(None, "dp", None), P(None, "dp", None)),
+                out_specs=(stage_specs, io_specs, opt_specs, P())))
+
+            sh = NamedSharding(mesh, P(None, "dp", None))
+            tokens = jax.make_array_from_callback(
+                tok_np.shape, sh, lambda i: tok_np[i])
+            tgt_np = np.roll(tok_np, -1, axis=-1)
+            targets = jax.make_array_from_callback(
+                tgt_np.shape, sh, lambda i: tgt_np[i])
+
+            losses = []
+            for _ in range(15):
+                stage_params, io_params, opt_state, loss = step(
+                    stage_params, io_params, opt_state, tokens, targets)
+                losses.append(float(np.asarray(
+                    loss.addressable_shards[0].data)))
+        assert losses[-1] < losses[0], losses
+        print(f"proc {pid}: 1F1B dp(2-proc) x pp=4 loss "
+              f"{losses[0]:.4f} -> {losses[-1]:.4f} OK")
+    """))
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+         "--nprocs", "2", "--cpu", "--devices-per-proc", "4",
+         str(script)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-3000:])
+    assert proc.stdout.count("OK") >= 2, proc.stdout[-2000:]
+
+
+@pytest.mark.slow
 def test_launcher_model_training_across_processes(tmp_path):
     """A real train loop (fused Adam + vma-aware DDP sync) where the
     'dp' axis spans TWO processes: grads cross the host boundary, every
