@@ -57,6 +57,22 @@ def xla_flops(fn, *args):
     return float(ca.get("flops", 0.0))
 
 
+def measured_xla_bytes(fn, *args):
+    """Post-fusion 'bytes accessed' of the COMPILED fallback (r4 verdict:
+    replace the assumed XLA-side HBM bytes with a measured HLO stat).
+
+    The module is compiled by the CPU backend, whose fusion pipeline is
+    the available proxy for TPU's (no chip needed); inputs must be fp32 —
+    CPU upcasts bf16 compute, which would inflate the count. The returned
+    figure is the optimized module's HloCostAnalysis traffic, i.e. it
+    reflects the fusion decisions XLA actually made, not a pass-structure
+    guess."""
+    with pallas_config.force("off"):
+        ca = jax.jit(fn).lower(*args).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return float(ca.get("bytes accessed", 0.0))
+
+
 def roofline(flops, bytes_):
     return max(flops / PEAK_FLOPS, bytes_ / HBM_BW)
 
@@ -70,9 +86,9 @@ def study():
 
     rows = []
 
-    def add(name, flops, pallas_bytes, xla_bytes, note):
+    def add(name, flops, pallas_bytes, xla_bytes, note, meas_bytes=None):
         tp, tx = roofline(flops, pallas_bytes), roofline(flops, xla_bytes)
-        rows.append({
+        row = {
             "kernel": name,
             "flops_g": round(flops / 1e9, 2),
             "pallas_mb": round(pallas_bytes / 2**20, 1),
@@ -83,22 +99,34 @@ def study():
             "bound": "flops" if flops / PEAK_FLOPS > pallas_bytes / HBM_BW
                      else "memory",
             "note": note,
-        })
+        }
+        if meas_bytes is not None:
+            tm = roofline(flops, meas_bytes)
+            row["xla_meas_mb"] = round(meas_bytes / 2**20, 1)
+            row["predicted_speedup_measured"] = round(tm / tp, 2)
+        rows.append(row)
 
     # ---- layer norm fwd: x bf16 [ROWS, HIDDEN], w/b fp32
     x = jnp.ones((ROWS, HIDDEN), jnp.bfloat16)
+    xf = jnp.ones((ROWS, HIDDEN), jnp.float32)  # f32 twin for measurement
     w = jnp.ones((HIDDEN,), jnp.float32)
     b = jnp.zeros((HIDDEN,), jnp.float32)
     xb = ROWS * HIDDEN * BF2
     f = xla_flops(lambda x: layer_norm(x, w, b, (HIDDEN,)), x)
+    # measured post-fusion traffic: f32 twin (CPU would upcast bf16),
+    # halved to bf16-equivalent — the fusion STRUCTURE is dtype-free
+    m = measured_xla_bytes(lambda x: layer_norm(x, w, b, (HIDDEN,)), xf) / 2
     add("layer_norm_fwd", f,
         pallas_bytes=2 * xb,           # one pass: read x, write y
         xla_bytes=3 * xb,              # stat reduction pass + normalize pass
+        meas_bytes=m,
         note="fused Welford single pass vs reduce-then-normalize")
 
     # ---- layer norm fwd+bwd
     f = xla_flops(jax.grad(lambda x: jnp.sum(
         layer_norm(x, w, b, (HIDDEN,)).astype(jnp.float32))), x)
+    m = measured_xla_bytes(
+        jax.grad(lambda x: jnp.sum(layer_norm(x, w, b, (HIDDEN,)))), xf) / 2
     add("layer_norm_fwd_bwd", f,
         # fwd (2 passes incl. stat save) + bwd kernel: read x, dy, write
         # dx + dw/db partials in one pass
@@ -106,17 +134,24 @@ def study():
         # fwd 3 + bwd: two reduction couplings (dy·xhat terms) force
         # re-reads of x and dy before the dx pass: ~5 passes
         xla_bytes=8 * xb,
+        meas_bytes=m,
         note="bwd needs x, dy twice in XLA (reduction + dx) vs once")
 
     # ---- rms norm fwd
     f = xla_flops(lambda x: rms_norm(x, w, (HIDDEN,)), x)
+    m = measured_xla_bytes(lambda x: rms_norm(x, w, (HIDDEN,)), xf) / 2
     add("rms_norm_fwd", f, pallas_bytes=2 * xb, xla_bytes=3 * xb,
+        meas_bytes=m,
         note="same structure as LN, one stat instead of two")
 
     # ---- flash attention fwd (causal)
     q = jnp.ones((B, S, H, D), jnp.bfloat16)
+    qf = jnp.ones((B, S, H, D), jnp.float32)
     f = xla_flops(lambda q, k, v: flash_attention(q, k, v, causal=True),
                   q, q, q)
+    m = measured_xla_bytes(
+        lambda q, k, v: flash_attention(q, k, v, causal=True),
+        qf, qf, qf) / 2
     qkv = B * S * H * D * BF2           # one of q/k/v/o
     scores = B * H * S * S * BF2        # the S^2 materialization
     bq, _ = pallas_config.flash_blocks("fwd", S, S, D)
@@ -126,6 +161,7 @@ def study():
         # scores written (QK^T), read+written (softmax), read (PV):
         # 4 passes over the S^2 buffer + q/k/v/o — causality halves it
         xla_bytes=(4 * scores) // 2 + 4 * qkv,
+        meas_bytes=m,
         note=f"S^2 materialization vs streamed tiles (k/v reread x{reread})")
 
     # ---- flash attention fwd+bwd
@@ -134,6 +170,9 @@ def study():
                        .astype(jnp.float32))
 
     f = xla_flops(jax.grad(floss, argnums=(0, 1, 2)), q, q, q)
+    m = measured_xla_bytes(
+        jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True)), argnums=(0, 1, 2)), qf, qf, qf) / 2
     bqb, _ = pallas_config.flash_blocks("bwd", S, S, D)
     reread_b = S // bqb
     add("flash_fwd_bwd_causal", f,
@@ -143,23 +182,39 @@ def study():
         + (4 * qkv + 3 * reread_b * qkv),
         # XLA bwd re-materializes scores AND probs grads: ~8 S^2 passes
         xla_bytes=(8 * scores) // 2 + 8 * qkv,
+        meas_bytes=m,
         note="bwd recompute streams tiles vs dS/dP materialization")
 
     # ---- causal fused softmax [BH, SM_S, SM_S] bf16
     xs = jnp.ones((BH, SM_S, SM_S), jnp.bfloat16)
+    xsf = jnp.ones((BH, SM_S, SM_S), jnp.float32)
     f = xla_flops(lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0),
                   xs)
+    m = measured_xla_bytes(
+        lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0), xsf) / 2
     sb = BH * SM_S * SM_S * BF2
     add("causal_softmax", f,
         pallas_bytes=3 * sb,   # two-pass (max+sum, then normalize) + write
         xla_bytes=4 * sb,      # mask+max, exp+sum, normalize as 3 fusions
+        meas_bytes=m,
         note="two-pass k-blocked vs three XLA reduction fusions")
 
     # ---- flat-buffer fused adam (~350M params): g,p fp32 packed + m,v
+    from apex_tpu.optimizers import fused_adam
+
     n = 350e6
+    n_meas = 8 * 2**20  # fp32-native: measure small, scale linearly
+    txm = fused_adam(lr=1e-3, flat=True)
+    pm = {"w": jnp.ones((n_meas,), jnp.float32)}
+    stm = txm.init(pm)
+    gm = {"w": jnp.ones((n_meas,), jnp.float32)}
+    m = measured_xla_bytes(
+        lambda g, st, p: txm.update(g, st, p), gm, stm, pm)
+    m = m * (n / n_meas)
     adam_bytes = n * (4 * FP4 + 3 * FP4)  # read g,p,m,v; write d,m,v
     add("flat_adam", 13 * n,
         pallas_bytes=adam_bytes, xla_bytes=adam_bytes,
+        meas_bytes=m,
         note="pure elementwise chain: XLA fusion already traffic-optimal "
              "-> tie at best; r3 CPU race lost -> default XLA")
 
