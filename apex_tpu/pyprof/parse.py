@@ -64,7 +64,11 @@ CATEGORIES: Tuple[Tuple[str, str], ...] = (
      r"ragged-all-to-all)"),
     ("matmul", r"^(dot|cublas|gemm|matmul|dot_general)"),
     ("convolution", r"^(conv|convolution)"),
-    ("attention-kernel", r"(flash|attention|custom-call)"),
+    ("attention-kernel", r"(flash|attention)"),
+    # any other Pallas/Mosaic kernel lowers to an HLO custom-call
+    # (e.g. a fused-Adam or layer-norm kernel) — its own bucket, NOT
+    # attention
+    ("custom-kernel", r"custom-call"),
     ("rng", r"^(rng|threefry|random)"),
     ("gather-scatter", r"^(gather|scatter|dynamic-slice|dynamic-update)"),
     ("data-movement",

@@ -113,5 +113,10 @@ def test_classify_categories():
     assert parse.classify("copy.16") == "data-movement"
     assert parse.classify("wrapped_reduce.2") == "reduction"
     assert parse.classify("add_rsqrt_fusion") == "fusion-elementwise"
+    # a non-attention Pallas kernel (custom-call) must NOT be labeled
+    # attention
+    assert parse.classify("fused_adam_custom-call") == "custom-kernel"
+    assert parse.classify("custom-call.3") == "custom-kernel"
+    assert parse.classify("flash_fwd_custom-call") == "attention-kernel"
     assert parse.is_container("while.5")
     assert not parse.is_container("dot.1")
