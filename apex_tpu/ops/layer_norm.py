@@ -68,11 +68,26 @@ def _rms_fwd_kernel(eps, affine, x_ref, w_ref, y_ref, rstd_ref):
     rstd_ref[:] = rstd
 
 
-def _row_block(n_rows: int) -> int:
+# Scoped VMEM budget for a kernel's fp32 scratch. Mosaic's stack limit is
+# 16MB (validated on a v5e: the bwd kernel at block=256, h=4096 was rejected
+# at 20.23M); stay under it with headroom. `f32_temps` is the number of
+# block×h fp32 intermediates the kernel holds live (measured ~5 for bwd,
+# ~3 for fwd).
+_VMEM_SCRATCH_BUDGET = 12 * 1024 * 1024
+
+
+def _row_block(n_rows: int, h: int, f32_temps: int) -> int:
+    cap = _VMEM_SCRATCH_BUDGET // (h * 4 * f32_temps)
+    if cap < 8:
+        return 0  # even the smallest block busts VMEM — caller uses jnp
+    best = 8
     for cand in (_BLOCK_ROWS, 128, 64, 32, 16, 8):
+        if cand > cap:
+            continue
         if n_rows % cand == 0:
             return cand
-    return 0  # no clean split — caller pads
+        best = max(best, cand)
+    return best  # no clean split — caller pads
 
 
 def _pad_rows(x2, block):
@@ -85,7 +100,9 @@ def _pad_rows(x2, block):
 
 def _ln_fwd_pallas(x2, w, b, eps):
     affine = w is not None
-    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    block = _row_block(x2.shape[0], x2.shape[1], 3)
+    if not block:
+        return _ln_fwd_jnp(x2, w, b, eps)
     x2p, n = _pad_rows(x2, block)
     rows, h = x2p.shape
     grid = (rows // block,)
@@ -116,7 +133,9 @@ def _ln_fwd_pallas(x2, w, b, eps):
 
 def _rms_fwd_pallas(x2, w, eps):
     affine = w is not None
-    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    block = _row_block(x2.shape[0], x2.shape[1], 3)
+    if not block:
+        return _rms_fwd_jnp(x2, w, eps)
     x2p, n = _pad_rows(x2, block)
     rows, h = x2p.shape
     grid = (rows // block,)
@@ -195,9 +214,40 @@ def _rms_bwd_kernel(affine, x_ref, dy_ref, rstd_ref, *refs):
         dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
 
 
+def _ln_bwd_jnp(x2, w, mu, rstd, dy):
+    """Closed-form jnp backward (fallback + non-TPU path)."""
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = (x - mu) * rstd
+    gw = g * w.astype(jnp.float32).reshape(1, -1) if w is not None else g
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - m1 - xhat * m2)).astype(x2.dtype)
+    if w is None:
+        return dx
+    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
+    db = jnp.sum(g, axis=0).astype(w.dtype)
+    return dx, dw, db
+
+
+def _rms_bwd_jnp(x2, w, rstd, dy):
+    x = x2.astype(jnp.float32)
+    g = dy.astype(jnp.float32)
+    xhat = x * rstd
+    gw = g * w.astype(jnp.float32).reshape(1, -1) if w is not None else g
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - xhat * m2)).astype(x2.dtype)
+    if w is None:
+        return dx
+    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
+    return dx, dw
+
+
 def _ln_bwd_pallas(x2, w, mu, rstd, dy):
     affine = w is not None
-    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    block = _row_block(x2.shape[0], x2.shape[1], 5)
+    if not block:
+        return _ln_bwd_jnp(x2, w, mu, rstd, dy)
     x2p, n = _pad_rows(x2, block)
     dyp, _ = _pad_rows(dy, block)
     mup, _ = _pad_rows(mu, block)
@@ -238,7 +288,9 @@ def _ln_bwd_pallas(x2, w, mu, rstd, dy):
 
 def _rms_bwd_pallas(x2, w, rstd, dy):
     affine = w is not None
-    block = _row_block(x2.shape[0]) or _BLOCK_ROWS
+    block = _row_block(x2.shape[0], x2.shape[1], 5)
+    if not block:
+        return _rms_bwd_jnp(x2, w, rstd, dy)
     x2p, n = _pad_rows(x2, block)
     dyp, _ = _pad_rows(dy, block)
     rstdp, _ = _pad_rows(rstd, block)
@@ -318,16 +370,7 @@ def _layer_norm_affine_bwd(eps, res, dy):
     x2, w, mu, rstd = res
     if _use_pallas():
         return _ln_bwd_pallas(x2, w, mu, rstd, dy)
-    x = x2.astype(jnp.float32)
-    g = dy.astype(jnp.float32)
-    xhat = (x - mu) * rstd
-    gw = g * w.astype(jnp.float32).reshape(1, -1)
-    m1 = jnp.mean(gw, axis=-1, keepdims=True)
-    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
-    dx = (rstd * (gw - m1 - xhat * m2)).astype(x2.dtype)
-    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
-    db = jnp.sum(g, axis=0).astype(w.dtype)
-    return dx, dw, db
+    return _ln_bwd_jnp(x2, w, mu, rstd, dy)
 
 
 _layer_norm_affine.defvjp(_layer_norm_affine_fwd, _layer_norm_affine_bwd)
@@ -349,13 +392,7 @@ def _layer_norm_plain_bwd(eps, res, dy):
     x2, mu, rstd = res
     if _use_pallas():
         return (_ln_bwd_pallas(x2, None, mu, rstd, dy),)
-    x = x2.astype(jnp.float32)
-    g = dy.astype(jnp.float32)
-    xhat = (x - mu) * rstd
-    m1 = jnp.mean(g, axis=-1, keepdims=True)
-    m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
-    dx = (rstd * (g - m1 - xhat * m2)).astype(x2.dtype)
-    return (dx,)
+    return (_ln_bwd_jnp(x2, None, mu, rstd, dy),)
 
 
 _layer_norm_plain.defvjp(_layer_norm_plain_fwd, _layer_norm_plain_bwd)
@@ -377,14 +414,7 @@ def _rms_norm_affine_bwd(eps, res, dy):
     x2, w, rstd = res
     if _use_pallas():
         return _rms_bwd_pallas(x2, w, rstd, dy)
-    x = x2.astype(jnp.float32)
-    g = dy.astype(jnp.float32)
-    xhat = x * rstd
-    gw = g * w.astype(jnp.float32).reshape(1, -1)
-    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
-    dx = (rstd * (gw - xhat * m2)).astype(x2.dtype)
-    dw = jnp.sum(g * xhat, axis=0).astype(w.dtype)
-    return dx, dw
+    return _rms_bwd_jnp(x2, w, rstd, dy)
 
 
 _rms_norm_affine.defvjp(_rms_norm_affine_fwd, _rms_norm_affine_bwd)
@@ -406,12 +436,7 @@ def _rms_norm_plain_bwd(eps, res, dy):
     x2, rstd = res
     if _use_pallas():
         return (_rms_bwd_pallas(x2, None, rstd, dy),)
-    x = x2.astype(jnp.float32)
-    g = dy.astype(jnp.float32)
-    xhat = x * rstd
-    m2 = jnp.mean(g * xhat, axis=-1, keepdims=True)
-    dx = (rstd * (g - xhat * m2)).astype(x2.dtype)
-    return (dx,)
+    return (_rms_bwd_jnp(x2, None, rstd, dy),)
 
 
 _rms_norm_plain.defvjp(_rms_norm_plain_fwd, _rms_norm_plain_bwd)
