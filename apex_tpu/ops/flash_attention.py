@@ -476,7 +476,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
 
 def _use_pallas() -> bool:
-    return pallas_config.use_pallas()
+    return pallas_config.use_pallas("flash_attention")
 
 
 def _blocks(kind, q, k):

@@ -32,8 +32,8 @@ from apex_tpu.ops import pallas_config
 _BLOCK_ROWS = 256
 
 
-def _use_pallas() -> bool:
-    return pallas_config.use_pallas()
+def _use_pallas(kernel: str = "layer_norm") -> bool:
+    return pallas_config.use_pallas(kernel)
 
 
 # ---------------------------------------------------------------- kernels
@@ -400,19 +400,19 @@ _layer_norm_plain.defvjp(_layer_norm_plain_fwd, _layer_norm_plain_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _rms_norm_affine(x2, w, eps):
-    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    fwd = _rms_fwd_pallas if _use_pallas("rms_norm") else _rms_fwd_jnp
     return fwd(x2, w, eps)[0]
 
 
 def _rms_norm_affine_fwd(x2, w, eps):
-    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    fwd = _rms_fwd_pallas if _use_pallas("rms_norm") else _rms_fwd_jnp
     y, rstd = fwd(x2, w, eps)
     return y, (x2, w, rstd)
 
 
 def _rms_norm_affine_bwd(eps, res, dy):
     x2, w, rstd = res
-    if _use_pallas():
+    if _use_pallas("rms_norm"):
         return _rms_bwd_pallas(x2, w, rstd, dy)
     return _rms_bwd_jnp(x2, w, rstd, dy)
 
@@ -422,19 +422,19 @@ _rms_norm_affine.defvjp(_rms_norm_affine_fwd, _rms_norm_affine_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _rms_norm_plain(x2, eps):
-    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    fwd = _rms_fwd_pallas if _use_pallas("rms_norm") else _rms_fwd_jnp
     return fwd(x2, None, eps)[0]
 
 
 def _rms_norm_plain_fwd(x2, eps):
-    fwd = _rms_fwd_pallas if _use_pallas() else _rms_fwd_jnp
+    fwd = _rms_fwd_pallas if _use_pallas("rms_norm") else _rms_fwd_jnp
     y, rstd = fwd(x2, None, eps)
     return y, (x2, rstd)
 
 
 def _rms_norm_plain_bwd(eps, res, dy):
     x2, rstd = res
-    if _use_pallas():
+    if _use_pallas("rms_norm"):
         return (_rms_bwd_pallas(x2, None, rstd, dy),)
     return (_rms_bwd_jnp(x2, None, rstd, dy),)
 

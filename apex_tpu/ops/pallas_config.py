@@ -24,6 +24,50 @@ _MODE = "auto"  # auto | off | on | interpret
 _FLASH_BLOCKS = {"fwd": None, "bwd": None}
 _FLASH_DEFAULTS = {"fwd": (512, 512), "bwd": (256, 256)}
 
+# Per-kernel verdicts for 'auto' mode, set from the bench.py kernel race
+# on real hardware (VERDICT r2 item 2 / r4 next-step 2: a kernel slower
+# than its XLA fallback must lose its default). ``True``/``False`` pin
+# the auto decision on TPU; ``None`` keeps the backend heuristic
+# (Pallas iff TPU). ``force('on'/'off'/'interpret')`` still overrides,
+# so tests and the bench race reach both paths regardless.
+_KERNEL_AUTO = {
+    # measured on TPU v5 lite (docs/kernel_cost_study.md): the XLA-fused
+    # chain beats the Pallas flat-buffer kernel, keep the XLA default
+    "flat_adam": False,
+}
+
+
+def use_pallas(kernel: str | None = None) -> bool:
+    """Should fused ops take their Pallas path right now?
+
+    ``kernel`` (optional) names the caller ('layer_norm', 'rms_norm',
+    'flash_attention', 'fused_softmax', 'flat_adam') so measured
+    per-kernel verdicts from :data:`_KERNEL_AUTO` apply under 'auto'.
+    """
+    if _MODE == "off":
+        return False
+    if _MODE in ("on", "interpret"):
+        return True
+    on_tpu = jax.default_backend() == "tpu"
+    verdict = _KERNEL_AUTO.get(kernel) if kernel is not None else None
+    if verdict is not None:
+        return verdict and on_tpu
+    return on_tpu
+
+
+def set_kernel_auto(**verdicts) -> None:
+    """Pin per-kernel auto decisions (True/False) or restore the backend
+    heuristic (None). Used to apply measured race results."""
+    for kernel, v in verdicts.items():
+        if v is None:
+            _KERNEL_AUTO.pop(kernel, None)
+        else:
+            _KERNEL_AUTO[kernel] = bool(v)
+
+
+def kernel_auto() -> dict:
+    return dict(_KERNEL_AUTO)
+
 
 def out_struct(shape, dtype, *like):
     """``jax.ShapeDtypeStruct`` for a ``pallas_call`` out_shape that works
@@ -91,13 +135,6 @@ def flash_block_override(fwd=None, bwd=None):
         _FLASH_BLOCKS.update(prev)
 
 
-def use_pallas() -> bool:
-    """Should fused ops take their Pallas path right now?"""
-    if _MODE == "off":
-        return False
-    if _MODE in ("on", "interpret"):
-        return True
-    return jax.default_backend() == "tpu"
 
 
 def interpret() -> bool:

@@ -85,11 +85,12 @@ def fused_adam(
             # the flat update is a pure bandwidth-bound elementwise chain
             # that XLA already fuses to minimal HBM traffic, so the
             # Pallas kernel can at best tie — and lost the r3 CPU race
-            # (docs/kernel_cost_study.md). force('on')/use_kernel=True
-            # opts in; bench_kernels races both and flips this if the
-            # on-chip numbers ever disagree.
+            # (docs/kernel_cost_study.md). The verdict lives in
+            # pallas_config._KERNEL_AUTO['flat_adam'];
+            # force('on')/use_kernel=True opts in; bench_kernels races
+            # both and flips the table if on-chip numbers ever disagree.
             kernel_on = (use_kernel if use_kernel is not None
-                         else pallas_config.mode() in ("on", "interpret"))
+                         else pallas_config.use_pallas("flat_adam"))
             # Group by *param* dtype; grads may arrive in a different dtype
             # (e.g. fp32 grads over bf16 params) and are packed fp32 anyway.
             pbufs, meta = flatten_tree(params)

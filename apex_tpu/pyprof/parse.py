@@ -22,7 +22,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
-    "OpRecord", "classify", "find_xplane_paths", "parse_xspace",
+    "OpRecord", "classify", "short_name", "find_xplane_paths",
+    "parse_xspace", "step_times_us",
     "CATEGORIES",
 ]
 
@@ -51,6 +52,7 @@ class OpRecord:
     self_ps: int         # exclusive time (minus nested HLO children)
     flops: float = 0.0   # model flops, when the plane carries them (TPU)
     bytes_accessed: float = 0.0
+    line: str = ""       # xplane line ('XLA Ops', 'Async XLA Ops', ...)
 
 
 # Category → regexes over HLO op names. Two name families appear in
@@ -86,13 +88,32 @@ _CONTAINER = re.compile(r"^(while|call|conditional)")
 
 
 def classify(name: str) -> str:
-    base = name.lower()
+    base = short_name(name).lower()
     for cat, pat in _COMPILED:
         if pat.search(base):
             return cat
     # everything else is an elementwise chain: XLA names them
     # "<op>_<op>_fusion" / "fusion.N" / "wrapped_<op>" / bare op names
     return "fusion-elementwise"
+
+
+def short_name(name: str) -> str:
+    """Normalize an event name to the bare HLO op name.
+
+    Real TPU captures (r5) carry the full HLO text — e.g.
+    ``%slice-start.73 = (...) async-start(...), calls=...`` — whose
+    leading ``%`` defeated every ``^``-anchored category pattern and sent
+    async copies into the elementwise bucket. Strip the sigil and keep
+    the lhs identifier only."""
+    base = name.strip()
+    if base.startswith("%"):
+        base = base[1:]
+    for sep in (" = ", " "):
+        cut = base.find(sep)
+        if cut > 0:
+            base = base[:cut]
+            break
+    return base
 
 
 def is_container(name: str) -> bool:
@@ -143,28 +164,43 @@ def _line_records(plane_name, line, ev_names, stat_names) -> List[OpRecord]:
         for s in ev.stats:
             k = stat_names.get(s.metadata_id)
             if k in ("hlo_op", "hlo_module", "flops", "model_flops",
-                     "bytes_accessed", "bytes accessed"):
+                     "bytes_accessed", "bytes accessed",
+                     "device_offset_ps", "device_duration_ps"):
                 stats[k] = _stat_value(s, stat_names)
-        if "hlo_op" not in stats:
+        # Two event dialects (r5): CPU captures tag HLO events with an
+        # 'hlo_op' stat and use the event's own offset/duration; real TPU
+        # device planes name the event with the full HLO text and put
+        # timing in device_offset_ps/device_duration_ps stats instead.
+        # Name-only acceptance applies to DEVICE planes only — host
+        # planes name every TraceMe span (python frames etc.), which must
+        # stay excluded from HLO attribution.
+        named = (ev.metadata_id in ev_names
+                 and plane_name.startswith("/device:"))
+        if "hlo_op" not in stats and not named:
             continue
-        hlo_events.append((ev.offset_ps, ev.offset_ps + ev.duration_ps,
-                           ev, stats))
+        if "device_offset_ps" in stats or "device_duration_ps" in stats:
+            # a stat present with value 0 is a real zero, not "absent"
+            start = int(stats.get("device_offset_ps", 0))
+            dur = int(stats.get("device_duration_ps", 0))
+        else:
+            start, dur = ev.offset_ps, ev.duration_ps
+        hlo_events.append((start, start + dur, dur, ev, stats))
     hlo_events.sort(key=lambda t: (t[0], -t[1]))
 
     records = []
     stack: List[Tuple[int, int, list]] = []  # (start, end, child_ps box)
-    for start, end, ev, stats in hlo_events:
+    for start, end, dur, ev, stats in hlo_events:
         while stack and start >= stack[-1][1]:
             stack.pop()
         if stack:
-            stack[-1][2][0] += ev.duration_ps
+            stack[-1][2][0] += dur
         name = ev_names.get(ev.metadata_id) or str(stats.get("hlo_op", "?"))
         child_box = [0]
         stack.append((start, end, child_box))
-        records.append((ev, stats, name, child_box))
+        records.append((dur, stats, name, child_box))
 
     out = []
-    for ev, stats, name, child_box in records:
+    for dur, stats, name, child_box in records:
         flops = float(stats.get("model_flops", stats.get("flops", 0)) or 0)
         nbytes = float(stats.get("bytes_accessed",
                                  stats.get("bytes accessed", 0)) or 0)
@@ -173,12 +209,33 @@ def _line_records(plane_name, line, ev_names, stat_names) -> List[OpRecord]:
             program=str(stats.get("hlo_module", "")),
             plane=plane_name,
             category=classify(name),
-            duration_ps=ev.duration_ps,
-            self_ps=max(ev.duration_ps - child_box[0], 0),
+            duration_ps=dur,
+            self_ps=max(dur - child_box[0], 0),
             flops=flops,
             bytes_accessed=nbytes,
+            line=line.name,
         ))
     return out
+
+
+def step_times_us(paths: Iterable[str]) -> List[float]:
+    """Device step durations (us) from the 'Steps' line of the device
+    plane — the profiler's own step markers, the authoritative wall time
+    per train step (r5: 'XLA Ops' self-time sums exceed it because async
+    copies overlap compute)."""
+    xplane_pb2 = _xplane_pb2()
+    steps: List[float] = []
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            for line in plane.lines:
+                if line.name == "Steps":
+                    steps.extend(e.duration_ps / 1e6 for e in line.events)
+    return steps
 
 
 def parse_xspace(paths: Iterable[str]) -> List[OpRecord]:

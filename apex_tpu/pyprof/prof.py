@@ -28,6 +28,8 @@ from apex_tpu.pyprof.parse import (
     find_xplane_paths,
     is_container,
     parse_xspace,
+    short_name,
+    step_times_us,
 )
 
 __all__ = ["Report", "OpSummary", "xprof_hlo_stats"]
@@ -70,40 +72,70 @@ def xprof_hlo_stats(paths) -> Optional[List[Dict]]:
 class Report:
     """Aggregated per-op / per-category attribution for one capture."""
 
-    def __init__(self, ops: List[OpSummary], total_self_us: float):
+    def __init__(self, ops: List[OpSummary], total_self_us: float,
+                 steps_us: Optional[List[float]] = None,
+                 async_ops: Optional[List[OpSummary]] = None):
         self.ops = sorted(ops, key=lambda o: -o.self_us)
         self.total_self_us = total_self_us
+        # device step markers ('Steps' line): the authoritative wall time
+        self.steps_us = steps_us or []
+        # async-copy spans overlap compute — reported separately, never
+        # added into the exclusive-time total
+        self.async_ops = sorted(async_ops or [], key=lambda o: -o.self_us)
         for o in self.ops:
             o.share = o.self_us / total_self_us if total_self_us else 0.0
+        wall = sum(self.steps_us)
+        for o in self.async_ops:
+            o.share = o.total_us / wall if wall else 0.0
 
     # ------------------------------------------------------------ build
 
     @classmethod
-    def from_records(cls, records: List[OpRecord]) -> "Report":
-        by_key: Dict[tuple, OpSummary] = {}
-        for r in records:
-            if is_container(r.name):
-                continue  # a while/call span is its children's time
-            key = (r.name, r.program)
-            s = by_key.get(key)
-            if s is None:
-                s = by_key[key] = OpSummary(
-                    name=r.name, category=r.category, program=r.program,
-                    occurrences=0, self_us=0.0, total_us=0.0)
-            s.occurrences += 1
-            s.self_us += r.self_ps / 1e6
-            s.total_us += r.duration_ps / 1e6
-            s.flops += r.flops
-            s.bytes_accessed += r.bytes_accessed
-        total = sum(s.self_us for s in by_key.values())
-        return cls(list(by_key.values()), total)
+    def from_records(cls, records: List[OpRecord],
+                     steps_us: Optional[List[float]] = None) -> "Report":
+        """Attribution from a real TPU capture's device 'XLA Ops' line
+        when present (async copies split out; host python plane
+        excluded); otherwise — CPU CI captures with only host threadpool
+        lines — every HLO-tagged record counts, as before r5."""
+        device_ops = [r for r in records
+                      if r.plane.startswith("/device:")
+                      and r.line == "XLA Ops"]
+        async_recs = [r for r in records
+                      if r.plane.startswith("/device:")
+                      and r.line.startswith("Async")]
+        main = device_ops if device_ops else records
+
+        def aggregate(recs):
+            by_key: Dict[tuple, OpSummary] = {}
+            for r in recs:
+                if is_container(short_name(r.name)):
+                    continue  # a while/call span is its children's time
+                key = (short_name(r.name), r.program)
+                s = by_key.get(key)
+                if s is None:
+                    s = by_key[key] = OpSummary(
+                        name=key[0], category=r.category,
+                        program=r.program,
+                        occurrences=0, self_us=0.0, total_us=0.0)
+                s.occurrences += 1
+                s.self_us += r.self_ps / 1e6
+                s.total_us += r.duration_ps / 1e6
+                s.flops += r.flops
+                s.bytes_accessed += r.bytes_accessed
+            return list(by_key.values())
+
+        ops = aggregate(main)
+        total = sum(s.self_us for s in ops)
+        return cls(ops, total, steps_us=steps_us,
+                   async_ops=aggregate(async_recs))
 
     @classmethod
     def from_capture(cls, path: str) -> "Report":
         """Build from a logdir / run dir / .xplane.pb path, merging the
         native xprof per-op columns when the capture has a device plane."""
         paths = find_xplane_paths(path)
-        report = cls.from_records(parse_xspace(paths))
+        report = cls.from_records(parse_xspace(paths),
+                                  steps_us=step_times_us(paths))
         rows = xprof_hlo_stats(paths)
         if rows:
             report.merge_hlo_stats(rows)
@@ -147,16 +179,20 @@ class Report:
 
     def utilization(self, peak_tflops: float,
                     peak_hbm_gbps: Optional[float] = None) -> Dict:
-        """Achieved fraction of peak over the capture's busy time; only
-        meaningful when the capture carried per-op flops (device plane)."""
+        """Achieved fraction of peak; only meaningful when the capture
+        carried per-op flops (device plane). MFU divides by the step wall
+        time ('Steps' markers) when present — busy self-time would flatter
+        a step with idle gaps."""
         flops = sum(o.flops for o in self.ops)
-        t_s = self.total_self_us / 1e6
-        out = {"total_flops": flops, "busy_s": t_s,
-               "mfu": (flops / t_s / (peak_tflops * 1e12)) if t_s else 0.0}
+        busy_s = self.total_self_us / 1e6
+        wall_s = sum(self.steps_us) / 1e6 or busy_s
+        out = {"total_flops": flops, "busy_s": busy_s, "wall_s": wall_s,
+               "mfu": (flops / wall_s / (peak_tflops * 1e12))
+               if wall_s else 0.0}
         if peak_hbm_gbps:
             nbytes = sum(o.bytes_accessed for o in self.ops)
             out["hbm_util"] = (
-                nbytes / t_s / (peak_hbm_gbps * 1e9) if t_s else 0.0)
+                nbytes / wall_s / (peak_hbm_gbps * 1e9) if wall_s else 0.0)
         return out
 
     # ----------------------------------------------------------- output
@@ -182,12 +218,36 @@ class Report:
             lines.append(
                 f"{cat:<24} {c['self_us'] / 1e3:>10.3f} "
                 f"{c['share'] * 100:>6.1f}% {int(c['occurrences']):>6}")
+        if self.steps_us:
+            n = len(self.steps_us)
+            lines.append("")
+            lines.append(
+                f"steps: {n} x {sum(self.steps_us) / n / 1e3:.2f} ms "
+                f"(device wall, 'Steps' markers)")
+        if self.async_ops:
+            tot = sum(o.total_us for o in self.async_ops)
+            lines.append(
+                f"async copies (overlapped, not in totals): "
+                f"{tot / 1e3:.2f} ms across "
+                f"{sum(o.occurrences for o in self.async_ops)} spans; top:")
+            for o in self.async_ops[:5]:
+                lines.append(
+                    f"  {o.name[:44]:<44} {o.total_us / 1e3:>9.3f} ms "
+                    f"({o.share * 100:.0f}% of wall)")
         return "\n".join(lines)
 
     def to_dict(self, top: int = 0) -> Dict:
         ops = self.ops[:top] if top else self.ops
-        return {
+        out = {
             "total_self_us": self.total_self_us,
             "categories": self.by_category(),
             "ops": [dataclasses.asdict(o) for o in ops],
         }
+        if self.steps_us:
+            out["steps"] = {"n": len(self.steps_us),
+                            "mean_ms": sum(self.steps_us)
+                            / len(self.steps_us) / 1e3}
+        if self.async_ops:
+            a = self.async_ops[:top] if top else self.async_ops
+            out["async_ops"] = [dataclasses.asdict(o) for o in a]
+        return out

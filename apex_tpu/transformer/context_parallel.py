@@ -72,7 +72,7 @@ def ring_attention(
     """
     from apex_tpu.ops import pallas_config
 
-    if pallas_config.use_pallas():
+    if pallas_config.use_pallas("flash_attention"):
         b, s_local, h, d = q.shape
         h_kv = k.shape[2]
         if h % h_kv:

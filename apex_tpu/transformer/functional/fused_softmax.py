@@ -33,7 +33,7 @@ _MASK_FILL = -10000.0
 
 
 def _use_pallas() -> bool:
-    return pallas_config.use_pallas()
+    return pallas_config.use_pallas("fused_softmax")
 
 
 # ------------------------------------------------------------- jnp reference

@@ -73,56 +73,132 @@ def make_params(key, n_layers=24, hidden=1024, vocab=50304):
     return params
 
 
-def time_fn(fn, *args, iters=20, warmup=3, max_time_s=None):
-    """Warmup then time ``iters`` calls. ``max_time_s`` caps the TIMED
-    loop's wall clock: the last warmup call (synced) estimates the per-step
-    cost and ``iters`` shrinks to fit — the dispatch-bound baselines can
-    take tens of seconds per step through a remote device tunnel, and one
-    pass of a 2k-dispatch loop is a statistically fine sample. With
-    ``warmup=1`` the estimate includes compile time, which only makes the
-    shrink more conservative (the timed loop itself runs compile-free)."""
+def _sync(out):
+    """Force completion of ``out``'s producing computation by fetching one
+    element to the host.
+
+    ``jax.block_until_ready`` is a NO-OP over the axon remote backend
+    (measured r5: a 1.1-TFLOP matmul "completed" in 0.04 ms under
+    block_until_ready vs 5.6 ms true device time) — every r1-r4 timing
+    that trusted it on TPU was dispatch time, not device time. A host
+    fetch of a single element is the only sync that provably waits, and
+    because the TPU executes enqueued programs in order, syncing the LAST
+    output of a sequence syncs the whole sequence."""
     import jax
+    import numpy as np
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    # index (not ravel) one element: ravel() would dispatch a full-array
+    # reshape — on a sharded 16 GiB output that's a device-filling copy
+    return np.asarray(leaf if leaf.ndim == 0 else leaf[(0,) * leaf.ndim])
+
+
+def _fetch_cost(out):
+    """Measured cost of one ``_sync`` on an already-ready array — ~79 ms
+    through the tunnel (RTT + tiny-gather dispatch), ~0 locally. Timed
+    loops subtract it so the fetch doesn't masquerade as device time."""
+    _sync(out)
+    costs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(out)
+        costs.append(time.perf_counter() - t0)
+    return min(costs)
+
+
+def time_fn(fn, *args, iters=20, warmup=3, max_time_s=None):
+    """Warmup then time ``iters`` independent calls + ONE final sync
+    (in-order device execution ⇒ last-completion = all-complete), minus
+    the measured fetch constant. ``max_time_s`` caps the TIMED loop's
+    wall clock: the last warmup call (synced) estimates the per-step cost
+    and ``iters`` shrinks to fit — the dispatch-bound baselines can take
+    tens of seconds per step through a remote device tunnel, and one pass
+    of a 2k-dispatch loop is a statistically fine sample."""
     for _ in range(max(warmup, 1) - 1):
         out = fn(*args)
     t0 = time.perf_counter()
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     per_step = time.perf_counter() - t0
+    fetch = _fetch_cost(out)
     if max_time_s is not None:
         iters = max(1, min(iters, int(max_time_s / max(per_step, 1e-9))))
+    # sync every ~2s of enqueued work: async dispatch with NO sync lets
+    # the in-flight buffer queue grow until the device OOMs (observed r5:
+    # the 2k-dispatch eager loop exhausted HBM that a synced loop never
+    # touches), and deletion RPCs only flush at a sync point
+    sync_every = max(1, int(2.0 / max(per_step, 1e-9)))
+    n_syncs = 0
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+        if (i + 1) % sync_every == 0 and i + 1 < iters:
+            _sync(out)
+            n_syncs += 1
+    _sync(out)
+    n_syncs += 1
+    return max((time.perf_counter() - t0 - fetch * n_syncs), 1e-9) / iters
 
 
 def time_train_step(step, state, batch, iters=10):
     """Warm up once, then time ``iters`` chained calls of a jitted train
     step whose outputs are ``(*new_state, loss)`` and whose inputs are
     ``(*state, *batch)`` — the shared methodology for every model-level
-    bench (donated state threads through; loss is blocked on)."""
-    import jax
-
+    bench (donated state threads through). The final-step loss is fetched
+    to the host: it depends on the whole chain, so one fetch syncs all
+    ``iters`` steps; the fetch constant is subtracted."""
     out = step(*state, *batch)
-    jax.block_until_ready(out[-1])
+    _sync(out[-1])
+    fetch = _fetch_cost(out[-1])
     t0 = time.perf_counter()
     for _ in range(iters):
         out = step(*out[:-1], *batch)
-    jax.block_until_ready(out[-1])
-    return (time.perf_counter() - t0) / iters
+    _sync(out[-1])
+    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
 
 
 def time_chained(step, grads, state, params, iters=100):
     """Output-feeds-input timing: true serial device time per step."""
-    import jax
     p, s = step(grads, state, params)
-    jax.block_until_ready(p)
+    _sync(p)
+    fetch = _fetch_cost(p)
     t0 = time.perf_counter()
     for _ in range(iters):
         p, s = step(grads, s, p)
-    jax.block_until_ready(p)
-    return (time.perf_counter() - t0) / iters
+    _sync(p)
+    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
+
+
+def time_scanned(make_step, carry, chain, k=32, reps=3):
+    """Per-iteration device time of a sub-millisecond kernel.
+
+    Per-dispatch overhead through the tunnel is ~0.7 ms (measured r5), so
+    a chained host loop can't resolve kernels faster than that. Instead
+    run ``k`` iterations ON DEVICE under one ``lax.scan`` dispatch
+    (``chain(carry, step) -> carry`` threads the output back in so
+    nothing is dead-code-eliminated), time 1 rep and ``reps`` chained
+    reps of the SAME jitted scan, and take the slope — the fetch constant
+    and dispatch overhead cancel."""
+    import jax
+
+    step = make_step()
+
+    @jax.jit
+    def scan_k(c):
+        return jax.lax.scan(lambda c, _: (chain(c, step), None), c, None,
+                            length=k)[0]
+
+    out = scan_k(carry)       # compile + settle
+    _sync(out)
+    t0 = time.perf_counter()
+    out = scan_k(out)
+    _sync(out)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = scan_k(out)
+    _sync(out)
+    t_many = time.perf_counter() - t0
+    return max(t_many - t_one, 1e-9) / ((reps - 1) * k)
 
 
 def bench_fused_adam(cpu_mode, extras):
@@ -163,6 +239,9 @@ def bench_fused_adam(cpu_mode, extras):
         t = time_chained(
             fused_step, grads, state,
             jax.tree_util.tree_map(jnp.copy, params), iters=chained_iters)
+        # the executable pins the donated state/params copies; drop it
+        gc.collect()
+        jax.clear_caches()
         gc.collect()
         return t
 
@@ -192,6 +271,12 @@ def bench_fused_adam(cpu_mode, extras):
     eager_t = time_fn(eager_step, iters=eager_iters, warmup=1,
                       max_time_s=60.0)
     print(f"eager (op-by-op): {eager_t * 1e3:.3f} ms/step", file=sys.stderr)
+
+    # the eager bench's moments (2.8 GB at TPU sizing) are dead from here
+    # on — drop them before the per-tensor states allocate their own, or
+    # the two together tip a 16 GB chip over (observed r5)
+    del eager_step, mu, nu
+    gc.collect()
 
     # secondary, stricter baseline: one jitted dispatch per tensor (each
     # tensor's op chain fused, launches not amortized)
@@ -306,9 +391,13 @@ def bench_llama(extras):
                 f"remat={remat},B={B},chunks={chunks}: {repr(e)[:120]}")
             print(f"llama remat={remat} B={B} chunks={chunks} failed: {repr(e)[:200]}",
                   file=sys.stderr)
-            if not _is_oom(e):
+            # remote_compile HTTP 500 = the tunnel's compile helper died
+            # (observed r5 on the biggest rung — compile-time OOM server
+            # side); a cheaper rung can dodge that just like runtime OOM
+            if not (_is_oom(e) or "remote_compile" in repr(e)):
                 raise  # genuine bug: fail fast, don't recompile 3 rungs
             gc.collect()
+            jax.clear_caches()
     if step_t is None:
         raise RuntimeError(
             "all llama ladder configs failed: "
@@ -323,7 +412,14 @@ def bench_llama(extras):
     extras["llama_tokens_per_sec"] = round(B_used * S / step_t)
     extras["llama_tflops_per_sec"] = round(flops / step_t / 1e12, 1)
     if peak:
-        extras["llama_mfu"] = round(flops / step_t / peak, 3)
+        mfu = flops / step_t / peak
+        extras["llama_mfu"] = round(mfu, 3)
+        if mfu > 1.0:
+            # r5 first TPU run reported 330 "MFU" because
+            # block_until_ready is a no-op over the tunnel; never let an
+            # impossible number pass as a result again
+            extras["llama_mfu_suspect"] = (
+                "MFU>1 is impossible: timing failed to sync the device")
     extras["device_kind"] = kind
     print(f"llama: {step_t*1e3:.1f} ms/step  "
           f"{flops/step_t/1e12:.1f} TF/s on {kind}", file=sys.stderr)
@@ -509,26 +605,31 @@ def bench_kernels(extras):
     B, S, H, D = 4, 2048, 16, 128
     hidden = 4096
 
-    def timed(mode, make_fn, *args, iters=20):
-        with pallas_config.force(mode):
-            fn = jax.jit(make_fn())
-            return time_fn(fn, *args, iters=iters, warmup=2)
-
-    def compare(name, make_fn, *args, iters=20):
+    def compare(name, make_fn, carry, chain=None, k=32):
+        """Race compiled-Pallas vs XLA-fallback via on-device scan loops
+        (time_scanned): per-dispatch overhead through the tunnel is
+        ~0.7 ms, bigger than most of these kernels, so host-loop timing
+        would measure the tunnel."""
+        chain = chain or (lambda c, step: step(c))
+        res = {}
         try:
-            t_on = timed("on", make_fn, *args, iters=iters)
-            t_off = timed("off", make_fn, *args, iters=iters)
-            kern[name] = {"pallas_ms": round(t_on * 1e3, 3),
-                          "xla_ms": round(t_off * 1e3, 3),
-                          "pallas_speedup": round(t_off / t_on, 2)}
-            print(f"kernel {name}: pallas {t_on*1e3:.3f} ms  "
-                  f"xla {t_off*1e3:.3f} ms  ({t_off/t_on:.2f}x)",
+            for mode, field in (("on", "pallas_ms"), ("off", "xla_ms")):
+                with pallas_config.force(mode):
+                    res[field] = time_scanned(make_fn, carry, chain, k=k)
+            kern[name] = {
+                "pallas_ms": round(res["pallas_ms"] * 1e3, 3),
+                "xla_ms": round(res["xla_ms"] * 1e3, 3),
+                "pallas_speedup": round(res["xla_ms"] / res["pallas_ms"],
+                                        2)}
+            print(f"kernel {name}: pallas {res['pallas_ms']*1e3:.3f} ms  "
+                  f"xla {res['xla_ms']*1e3:.3f} ms  "
+                  f"({res['xla_ms']/res['pallas_ms']:.2f}x)",
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             kern[name] = {"error": repr(e)[:200]}
             print(f"kernel {name} FAILED: {repr(e)[:200]}", file=sys.stderr)
 
-    # --- layer norm / rms norm (fwd+bwd through custom_vjp)
+    # --- layer norm / rms norm (fwd, and fwd+bwd through custom_vjp)
     x = jax.random.normal(key, (B * S, hidden), jnp.bfloat16)
     w = jnp.ones((hidden,), jnp.float32)
     bb = jnp.zeros((hidden,), jnp.float32)
@@ -550,26 +651,32 @@ def bench_kernels(extras):
     k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
 
+    # carry (q,k,v); feed the output back as q so the scan isn't DCE'd
+    flash_chain = lambda c, step: (step(*c), c[1], c[2])  # noqa: E731
+
     compare("flash_fwd", lambda: lambda q, k, v: flash_attention(
-        q, k, v, causal=True), q, k, v, iters=10)
+        q, k, v, causal=True), (q, k, v), flash_chain, k=8)
 
     def flash_loss():
         return jax.grad(lambda q, k, v: jnp.sum(
             flash_attention(q, k, v, causal=True).astype(jnp.float32)),
             argnums=(0, 1, 2))
 
-    compare("flash_fwd_bwd", flash_loss, q, k, v, iters=10)
+    # grads (dq,dk,dv) have q/k/v's exact structure: chain them straight
+    compare("flash_fwd_bwd", flash_loss, (q, k, v),
+            lambda c, step: step(*c), k=8)
 
     # --- flash tile autotune (only meaningful when Pallas compiles)
     if "error" not in kern.get("flash_fwd_bwd", {"error": 1}):
-        def tune(kind, cands, make_fn, *args):
+        def tune(kind, cands, make_fn, carry, chain, k=8):
             best, best_t = None, None
             for cand in cands:
                 try:
                     with pallas_config.flash_block_override(**{kind: cand}):
                         with pallas_config.force("on"):
-                            t = time_fn(jax.jit(make_fn()), *args,
-                                        iters=10, warmup=2)
+                            t = time_scanned(make_fn, carry, chain, k=k)
+                    print(f"flash {kind} tile {cand}: {t*1e3:.3f} ms",
+                          file=sys.stderr)
                     if best_t is None or t < best_t:
                         best, best_t = cand, t
                 except Exception as e:  # noqa: BLE001
@@ -580,10 +687,10 @@ def bench_kernels(extras):
         fwd_best, fwd_t = tune(
             "fwd", [(512, 512), (256, 512), (512, 256), (1024, 512)],
             lambda: lambda q, k, v: flash_attention(q, k, v, causal=True),
-            q, k, v)
+            (q, k, v), flash_chain)
         bwd_best, bwd_t = tune(
             "bwd", [(256, 256), (512, 512), (128, 512), (512, 128)],
-            flash_loss, q, k, v)
+            flash_loss, (q, k, v), lambda c, step: step(*c))
         if fwd_best:
             kern["flash_tile_fwd"] = {"best": list(fwd_best),
                                       "ms": round(fwd_t * 1e3, 3)}
@@ -601,8 +708,9 @@ def bench_kernels(extras):
     # --- flat-buffer fused adam: Pallas kernel vs the XLA-fused chain
     # (the multi_tensor_adam.cu race on the packed ~350M-element buffer).
     # use_kernel=None defers to the pallas gate, so compare()'s
-    # force('on'/'off') toggles the path; trees ride as jit ARGUMENTS
-    # (a zero-arg closure would bake gigabytes in as constants)
+    # force('on'/'off') toggles the path; trees ride as scan CARRY
+    # (a closure would bake gigabytes in as constants). The carry applies
+    # each step's updates so the state stays numerically steady.
     from apex_tpu.optimizers import fused_adam as _fa
 
     fa_params = make_params(jax.random.PRNGKey(2))
@@ -610,8 +718,15 @@ def bench_kernels(extras):
         lambda p: jnp.full_like(p, 1e-3), fa_params)
     fa_tx = _fa(lr=1e-3, weight_decay=0.01, flat=True)
     fa_state = fa_tx.init(fa_params)
-    compare("flat_adam", lambda: lambda g, s, p: fa_tx.update(g, s, p)[0],
-            fa_grads, fa_state, fa_params, iters=10)
+
+    def adam_chain(c, step):
+        g, s, p = c
+        updates, s2 = step(g, s, p)
+        p2 = jax.tree_util.tree_map(jnp.add, p, updates)
+        return g, s2, p2
+
+    compare("flat_adam", lambda: lambda g, s, p: fa_tx.update(g, s, p),
+            (fa_grads, fa_state, fa_params), adam_chain, k=8)
 
     extras["kernels"] = kern
 
@@ -680,8 +795,9 @@ def worker():
     if not cpu_mode:
         # model-level + kernel benches are secondary evidence: never let
         # them kill the headline number, and stop starting new ones when
-        # the launcher's budget is near (leave ~4 min of headroom)
-        budget_s = 1100
+        # the launcher's budget is near (leave ~7 min of headroom for the
+        # one in flight — kernel-race compiles are ~30s each)
+        budget_s = 2300
         # priority order under the budget: kernels (VERDICT r2 item 2)
         # must not be crowded out by the newer bert config
         for fn in (bench_llama, bench_resnet, bench_kernels, bench_bert,
@@ -698,6 +814,15 @@ def worker():
             except Exception as e:  # noqa: BLE001
                 print(f"{fn.__name__} failed: {e!r}", file=sys.stderr)
                 extras[fn.__name__ + "_error"] = repr(e)[:200]
+            finally:
+                # free the bench's device memory before the next one: the
+                # jit executable cache pins donated-in buffers, so without
+                # this a 0.9B-param llama bench starves everything after
+                # it (r5 first TPU run: kernels/bert/gpt2 all
+                # RESOURCE_EXHAUSTED behind llama's leftovers)
+                gc.collect()
+                jax.clear_caches()
+                gc.collect()
         # final line (the launcher takes the LAST parseable line)
         emit()
 
@@ -808,7 +933,10 @@ def launcher():
     for attempt in range(len(delays) + 1):
         if skip_tpu:
             break
-        line = _run_worker(env, timeout=1500, errors=errors)
+        # 2700s: with real host-fetch syncs (block_until_ready is a no-op
+        # over the tunnel) an honest full TPU bench is ~25-35 min; 1500s
+        # killed the r5 worker mid-kernel-race
+        line = _run_worker(env, timeout=2700, errors=errors)
         if line is not None:
             print(line)
             return 0

@@ -1,0 +1,66 @@
+"""Per-kernel dispatch table (pallas_config._KERNEL_AUTO).
+
+The bench kernel race on real hardware pins per-kernel verdicts; 'auto'
+mode must honor them, while force('on'/'off'/'interpret') must override
+so tests and the race itself can still reach both paths.
+"""
+
+import jax
+
+from apex_tpu.ops import pallas_config
+
+
+def test_force_overrides_table():
+    with pallas_config.force("on"):
+        assert pallas_config.use_pallas("flat_adam")
+    with pallas_config.force("interpret"):
+        assert pallas_config.use_pallas("flat_adam")
+    with pallas_config.force("off"):
+        assert not pallas_config.use_pallas("layer_norm")
+
+
+def test_auto_honors_verdict():
+    on_tpu = jax.default_backend() == "tpu"
+    with pallas_config.force("auto"):
+        # flat_adam lost the race: off under auto everywhere
+        assert pallas_config.use_pallas("flat_adam") is False
+        # unlisted kernels keep the backend heuristic
+        assert pallas_config.use_pallas("layer_norm") == on_tpu
+        assert pallas_config.use_pallas() == on_tpu
+
+
+def test_set_kernel_auto_roundtrip():
+    on_tpu = jax.default_backend() == "tpu"
+    prev = pallas_config.kernel_auto()
+    try:
+        pallas_config.set_kernel_auto(layer_norm=False, rms_norm=True)
+        with pallas_config.force("auto"):
+            assert pallas_config.use_pallas("layer_norm") is False
+            # True pins auto-on, but never off-backend: Pallas still
+            # requires a TPU to compile
+            assert pallas_config.use_pallas("rms_norm") == on_tpu
+        pallas_config.set_kernel_auto(layer_norm=None, rms_norm=None)
+        with pallas_config.force("auto"):
+            assert pallas_config.use_pallas("layer_norm") == on_tpu
+    finally:
+        pallas_config.set_kernel_auto(
+            **{k: None for k in pallas_config.kernel_auto()})
+        pallas_config.set_kernel_auto(**prev)
+
+
+def test_fused_adam_flat_defers_to_table():
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import fused_adam
+
+    params = {"w": jnp.ones((64,), jnp.float32)}
+    grads = {"w": jnp.full((64,), 1e-3, jnp.float32)}
+    tx = fused_adam(lr=1e-3, flat=True)
+    state = tx.init(params)
+    # auto: table says off -> XLA chain; interpret: kernel body runs.
+    # Both must agree numerically.
+    with pallas_config.force("auto"):
+        d_auto, _ = tx.update(grads, state, params)
+    with pallas_config.force("interpret"):
+        d_kern, _ = tx.update(grads, state, params)
+    assert jnp.allclose(d_auto["w"], d_kern["w"], atol=1e-6)
