@@ -3,8 +3,13 @@
 
 TPU-first design notes:
 - The joint is the broadcast add f[:, :, None] + g[:, None, :] with optional
-  relu/dropout — one XLA fusion (the reference's "packed" path exists to
-  skip padding on GPU; fixed shapes + masking is the TPU-friendly layout).
+  relu/dropout — one XLA fusion. The reference's "packed" layout (valid
+  rows only, offsets from cumsum(f_len*g_len)) is supported on both ends
+  for API parity — pack_output gathers valid rows out of the padded
+  joint, packed_input gathers them back onto the padded lattice — but as
+  a LAYOUT, not a compute saving: packing skips don't-care math on GPU,
+  while on TPU the fixed-shape lattice is the fast path and dynamic
+  shapes would force recompiles.
 - The loss's alpha recursion is reformulated so the inner (label) dimension
   runs as a ``lax.associative_scan`` in the log semiring: each time-frame
   row is a first-order linear recurrence
@@ -33,13 +38,21 @@ _NEG_INF = -1e30
 
 def transducer_joint(f, g, f_len=None, g_len=None, pack_output: bool = False,
                      relu: bool = False, dropout: float = 0.0,
-                     dropout_rng=None):
+                     dropout_rng=None, batch_offset=None,
+                     packed_batch: int = 0):
     """h[b, t, u, :] = f[b, t, :] + g[b, u, :] (ref TransducerJoint.forward).
 
-    ``pack_output`` is accepted for API parity and ignored: TPU kernels
-    want fixed shapes; padding is masked in the loss instead.
+    ``pack_output=True`` returns the reference's packed layout
+    ``[packed_batch, H]`` — batch b's valid ``f_len[b] x g_len[b]`` block
+    flattened row-major at offset ``batch_offset[b-1]`` (``batch_offset``
+    is the reference's INCLUSIVE ``cumsum(f_len * g_len)``). On GPU the
+    reference packs to SKIP computing don't-care positions; fixed shapes
+    being the TPU-friendly layout, this computes the full padded joint in
+    one fusion and gathers the valid rows, so the output (and therefore
+    everything downstream, e.g. a packed loss) is layout-compatible with
+    the reference. ``packed_batch`` must be a static int (the gather's
+    output shape).
     """
-    del f_len, g_len, pack_output
     h = f[:, :, None, :] + g[:, None, :, :]
     if relu:
         h = jax.nn.relu(h)
@@ -48,7 +61,27 @@ def transducer_joint(f, g, f_len=None, g_len=None, pack_output: bool = False,
             raise ValueError("dropout > 0 requires dropout_rng")
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, h.shape)
         h = jnp.where(keep, h / (1.0 - dropout), 0.0)
-    return h
+    if not pack_output:
+        return h
+    if batch_offset is None or not packed_batch:
+        raise ValueError(
+            "pack_output=True requires batch_offset and packed_batch")
+    if f_len is None or g_len is None:
+        raise ValueError("pack_output=True requires f_len and g_len")
+    b_of, t_of, u_of = _packed_row_coords(
+        jnp.arange(packed_batch), batch_offset, f_len * g_len, g_len)
+    return h[b_of, t_of, u_of]
+
+
+def _packed_row_coords(rows, batch_offset, block_len, g_len):
+    """(b, t, u) for each packed row index (reference packed layout)."""
+    starts = batch_offset - block_len            # inclusive cumsum -> start
+    b = jnp.clip(
+        jnp.searchsorted(batch_offset, rows, side="right"), 0,
+        batch_offset.shape[0] - 1)
+    local = jnp.clip(rows - starts[b], 0, jnp.maximum(block_len[b] - 1, 0))
+    g = jnp.maximum(g_len[b], 1)
+    return b, local // g, local % g
 
 
 class TransducerJoint:
@@ -63,9 +96,10 @@ class TransducerJoint:
 
     def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
                  packed_batch=0, dropout_rng=None):
-        del batch_offset, packed_batch
         return transducer_joint(f, g, f_len, g_len, self.pack_output,
-                                self.relu, self.dropout_prob, dropout_rng)
+                                self.relu, self.dropout_prob, dropout_rng,
+                                batch_offset=batch_offset,
+                                packed_batch=packed_batch)
 
 
 # -------------------------------------------------------------------- loss
@@ -95,16 +129,40 @@ def _row_recurrence(prev_term, emit_row):
 
 
 def transducer_loss(logits, targets, f_len, y_len, blank_idx: int = 0,
-                    packed_input: bool = False):
+                    packed_input: bool = False, batch_offset=None,
+                    max_f_len: Optional[int] = None):
     """Negative log-likelihood per batch element (ref TransducerLoss).
 
     logits: [B, T, U+1, V] joint outputs; targets [B, U] label ids;
     f_len [B] valid time frames; y_len [B] valid labels.
+
+    ``packed_input=True`` accepts the reference's packed layout instead:
+    logits ``[N, V]`` with batch b's ``f_len[b] x (y_len[b]+1)`` block at
+    offset ``batch_offset[b-1]`` (``batch_offset`` = inclusive
+    ``cumsum(f_len * (y_len+1))``, ref transducer.py:101) and
+    ``max_f_len`` the padded T. The packed rows are gathered back to the
+    padded lattice — packing skips don't-care compute on GPU; on TPU the
+    static-shape lattice IS the fast path, and the gather keeps the
+    reference's calling convention working end-to-end (grads flow back
+    to the packed rows through the gather).
     """
     if packed_input:
-        raise NotImplementedError(
-            "packed input is a GPU memory optimization; pass padded "
-            "[B, T, U+1, V] logits (mask via f_len/y_len)")
+        if batch_offset is None or max_f_len is None:
+            raise ValueError(
+                "packed_input=True requires batch_offset and max_f_len")
+        U = targets.shape[1]
+        T, U1 = int(max_f_len), U + 1
+        g_len = y_len + 1
+        t_idx = jnp.arange(T)[None, :, None]
+        u_idx = jnp.arange(U1)[None, None, :]
+        starts = (batch_offset - f_len * g_len)[:, None, None]
+        rows = starts + t_idx * g_len[:, None, None] + u_idx
+        valid = ((t_idx < f_len[:, None, None])
+                 & (u_idx < g_len[:, None, None]))
+        rows = jnp.where(valid, rows, 0)
+        # [B, T, U+1, V]; invalid positions read row 0 and are zeroed —
+        # the lattice only consumes (t, u) inside the valid region
+        logits = jnp.where(valid[..., None], logits[rows], 0.0)
     B, T, U1, V = logits.shape
     lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     blank = lp[..., blank_idx]                       # [B, T, U+1]
@@ -153,6 +211,8 @@ class TransducerLoss:
 
     def __call__(self, x, label, f_len, y_len, blank_idx=0,
                  batch_offset=None, max_f_len=None, debug_list=None):
-        del batch_offset, max_f_len, debug_list
+        del debug_list
         return transducer_loss(x, label, f_len, y_len, blank_idx,
-                               self.packed_input)
+                               self.packed_input,
+                               batch_offset=batch_offset,
+                               max_f_len=max_f_len)
