@@ -16,6 +16,7 @@ reference does (sync_batchnorm.py:87).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -46,6 +47,11 @@ class SyncBatchNorm(nn.Module):
     axis_name: Optional[str] = "data"
     group_size: Optional[int] = None  # stats groups of N consecutive ranks
     dtype: Any = jnp.float32
+    # flax.linen.BatchNorm conversion fidelity (convert_syncbn_model):
+    # None defers to ``affine`` / the call-time argument respectively
+    use_scale: Optional[bool] = None
+    use_bias: Optional[bool] = None
+    use_running_average: Optional[bool] = None
 
     def _group_merge(self, axis_name, local_count, local_mean, local_m2):
         """Merge (count, mean, M2) within groups of ``group_size``
@@ -71,7 +77,11 @@ class SyncBatchNorm(nn.Module):
         return total_count, mean, m2
 
     @nn.compact
-    def __call__(self, x, use_running_average: bool = False):
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        if use_running_average is None:
+            # flax BatchNorm semantics: the module field supplies the
+            # default when the call site doesn't pass one
+            use_running_average = bool(self.use_running_average)
         axis_name = self.process_group or self.axis_name
         ch_axis = (x.ndim - 1) if (self.channel_last or x.ndim == 2) else 1
         reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
@@ -121,30 +131,86 @@ class SyncBatchNorm(nn.Module):
         shape = stat_shape
         y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
             var.reshape(shape) + self.eps)
-        if self.affine:
+        scale_on = (self.affine if self.use_scale is None
+                    else self.use_scale)
+        bias_on = self.affine if self.use_bias is None else self.use_bias
+        if scale_on:
             weight = self.param("scale", nn.initializers.ones, (c,), self.dtype)
+            y = y * weight.astype(jnp.float32).reshape(shape)
+        if bias_on:
             bias = self.param("bias", nn.initializers.zeros, (c,), self.dtype)
-            y = y * weight.astype(jnp.float32).reshape(shape) + \
-                bias.astype(jnp.float32).reshape(shape)
+            y = y + bias.astype(jnp.float32).reshape(shape)
         return y.astype(x.dtype)
 
 
-def convert_syncbn_model(module, process_group=None, channel_last=False):
-    """Best-effort analog of ``apex.parallel.convert_syncbn_model``
-    (ref apex/parallel/__init__.py:create convert function).
+def convert_syncbn_model(module, process_group=None, channel_last=None):
+    """Analog of ``apex.parallel.convert_syncbn_model`` (ref
+    apex/parallel/__init__.py): recursively replace every
+    ``flax.linen.BatchNorm`` in a module tree with :class:`SyncBatchNorm`.
 
-    flax modules are immutable dataclasses, so generic recursive surgery is
-    not possible; a ``flax.linen.BatchNorm`` instance is converted directly,
-    and model classes in ``apex_tpu.models`` accept a ``sync_bn=True``
-    argument for the same effect at construction time.
-    """
-    if isinstance(module, nn.BatchNorm):
+    flax modules are frozen dataclasses, so the "surgery" is a functional
+    rebuild: dataclass fields (including lists/tuples/dicts of
+    submodules) are walked and modules containing conversions are
+    ``clone()``d. Like the reference, a tree with no BatchNorm passes
+    through unchanged. Limitation vs torch's in-place mutation: children
+    created inside ``setup()``/``__call__`` bodies are invisible to
+    dataclass traversal — declare them as attributes (flax's own
+    convention) or construct with ``sync_bn=True`` where the model
+    supports it (``apex_tpu.models.resnet`` / ``dcgan``).
+
+    ``channel_last=None`` infers the channel axis from each BatchNorm's
+    ``axis`` field (flax default -1 → channel-last)."""
+
+    def convert_bn(bn):
+        if channel_last is None:
+            # only axis == -1 (flax default, channel-last for any rank)
+            # and axis == 1 (torch-style NCHW) map onto SyncBatchNorm's
+            # two layouts rank-independently; anything else would
+            # silently normalize the wrong axis
+            if bn.axis in (-1, None):
+                ch_last = True
+            elif bn.axis == 1:
+                ch_last = False
+            else:
+                raise ValueError(
+                    f"cannot infer channel layout from BatchNorm axis="
+                    f"{bn.axis}; pass channel_last= explicitly")
+        else:
+            ch_last = channel_last
         return SyncBatchNorm(
-            eps=module.epsilon, momentum=1.0 - module.momentum,
-            process_group=process_group, channel_last=channel_last)
-    if isinstance(module, SyncBatchNorm):
-        return module
-    raise NotImplementedError(
-        "convert_syncbn_model can convert flax BatchNorm instances; for "
-        "whole models, construct them with sync_bn=True "
-        "(see apex_tpu.models.resnet / dcgan).")
+            eps=bn.epsilon, momentum=1.0 - bn.momentum,
+            affine=bn.use_scale or bn.use_bias,
+            use_scale=bn.use_scale, use_bias=bn.use_bias,
+            use_running_average=bn.use_running_average,
+            process_group=process_group,
+            channel_last=ch_last,
+            dtype=bn.param_dtype)
+
+    def walk(v):
+        if isinstance(v, SyncBatchNorm):
+            return v
+        if isinstance(v, nn.BatchNorm):
+            return convert_bn(v)
+        if isinstance(v, nn.Module):
+            changes = {}
+            for f in dataclasses.fields(v):
+                if f.name in ("parent", "name"):
+                    continue
+                old = getattr(v, f.name, None)
+                new = walk(old)
+                if new is not old:
+                    changes[f.name] = new
+            return v.clone(**changes) if changes else v
+        if isinstance(v, (list, tuple)):
+            items = [walk(i) for i in v]
+            if all(a is b for a, b in zip(items, v)):
+                return v
+            return type(v)(items)
+        if isinstance(v, dict):
+            items = {k: walk(i) for k, i in v.items()}
+            if all(items[k] is v[k] for k in v):
+                return v
+            return items
+        return v
+
+    return walk(module)

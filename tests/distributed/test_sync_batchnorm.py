@@ -1,5 +1,6 @@
 """SyncBatchNorm tests (mirrors ref tests/distributed/synced_batchnorm/
 test_batchnorm1d_multigpu_sync.py intent: stats over the global batch)."""
+import dataclasses
 
 import flax.linen as nn
 import jax
@@ -95,8 +96,55 @@ def test_convert_from_flax_batchnorm():
     converted = convert_syncbn_model(nn.BatchNorm(momentum=0.9, epsilon=1e-3))
     assert isinstance(converted, SyncBatchNorm)
     assert converted.eps == 1e-3
-    with pytest.raises(NotImplementedError):
-        convert_syncbn_model(nn.Dense(3))
+    assert converted.momentum == pytest.approx(0.1)
+    # a BN-free module passes through unchanged (reference semantics)
+    dense = nn.Dense(3)
+    assert convert_syncbn_model(dense) is dense
+
+
+def test_convert_recurses_module_tree():
+    """Whole-model surgery: BatchNorms declared as dataclass fields —
+    directly, in containers, and nested — all become SyncBatchNorm."""
+
+    class Block(nn.Module):
+        norm: nn.Module = dataclasses.field(
+            default_factory=lambda: nn.BatchNorm(momentum=0.95))
+        width: int = 8
+
+        @nn.compact
+        def __call__(self, x):
+            return self.norm(nn.Dense(self.width)(x),
+                             use_running_average=False)
+
+    class Net(nn.Module):
+        blocks: tuple = ()
+        head_norm: nn.Module = None
+        extras: dict = dataclasses.field(default_factory=dict)
+
+        @nn.compact
+        def __call__(self, x):
+            for b in self.blocks:
+                x = b(x)
+            if self.head_norm is not None:
+                x = self.head_norm(x, use_running_average=False)
+            return x
+
+    net = Net(blocks=(Block(), Block()),
+              head_norm=nn.BatchNorm(epsilon=1e-4),
+              extras={"aux": Block()})
+    out = convert_syncbn_model(net, process_group="data")
+    assert isinstance(out.head_norm, SyncBatchNorm)
+    assert out.head_norm.eps == 1e-4
+    assert out.head_norm.process_group == "data"
+    assert all(isinstance(b.norm, SyncBatchNorm) for b in out.blocks)
+    assert isinstance(out.extras["aux"].norm, SyncBatchNorm)
+    assert out.extras["aux"].norm.momentum == pytest.approx(0.05)
+    # converted tree still trains/applies end to end
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+    variables = out.init(jax.random.PRNGKey(1), x)
+    y, _ = out.apply(variables, x, mutable=["batch_stats"])
+    assert y.shape == (4, 8)
+    assert np.isfinite(np.asarray(y)).all()
 
 
 def test_syncbn_nhwc_default_matches_flax_batchnorm():
@@ -182,3 +230,25 @@ def test_syncbn_group_size_must_divide():
             return y
         jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
                           out_specs=P("data")))(x)
+
+
+def test_convert_preserves_bn_config():
+    """Conversion fidelity: use_scale/use_bias/use_running_average and
+    channel axis carry over (r5 review findings)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 3))
+    # scale-only affine: converted params must NOT grow a bias
+    c = convert_syncbn_model(nn.BatchNorm(use_scale=True, use_bias=False))
+    v = c.init(jax.random.PRNGKey(1), x)
+    assert "scale" in v["params"] and "bias" not in v["params"]
+    # eval-configured norm stays in running-stats mode with no call arg
+    c2 = convert_syncbn_model(nn.BatchNorm(use_running_average=True))
+    v2 = c2.init(jax.random.PRNGKey(1), x)
+    y = c2.apply(v2, x * 7.0)  # running stats are (0,1) -> identity
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 7.0,
+                               rtol=1e-5)
+    # un-inferable channel axis is refused, not silently wrong
+    with pytest.raises(ValueError, match="channel layout"):
+        convert_syncbn_model(nn.BatchNorm(axis=3))
+    converted = convert_syncbn_model(nn.BatchNorm(axis=3),
+                                     channel_last=True)
+    assert converted.channel_last is True
