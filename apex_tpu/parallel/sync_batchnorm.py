@@ -52,6 +52,9 @@ class SyncBatchNorm(nn.Module):
     use_scale: Optional[bool] = None
     use_bias: Optional[bool] = None
     use_running_average: Optional[bool] = None
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
+    result_dtype: Any = None  # None = return in x.dtype (flax: bn.dtype)
 
     def _group_merge(self, axis_name, local_count, local_mean, local_m2):
         """Merge (count, mean, M2) within groups of ``group_size``
@@ -79,8 +82,11 @@ class SyncBatchNorm(nn.Module):
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
         if use_running_average is None:
-            # flax BatchNorm semantics: the module field supplies the
-            # default when the call site doesn't pass one
+            # the module field supplies the default when the call site
+            # doesn't pass one. Divergence from flax (which RAISES when
+            # both are None): both-None means training mode here, matching
+            # the reference apex SyncBatchNorm, whose implicit
+            # module.training default is train
             use_running_average = bool(self.use_running_average)
         axis_name = self.process_group or self.axis_name
         ch_axis = (x.ndim - 1) if (self.channel_last or x.ndim == 2) else 1
@@ -135,12 +141,12 @@ class SyncBatchNorm(nn.Module):
                     else self.use_scale)
         bias_on = self.affine if self.use_bias is None else self.use_bias
         if scale_on:
-            weight = self.param("scale", nn.initializers.ones, (c,), self.dtype)
+            weight = self.param("scale", self.scale_init, (c,), self.dtype)
             y = y * weight.astype(jnp.float32).reshape(shape)
         if bias_on:
-            bias = self.param("bias", nn.initializers.zeros, (c,), self.dtype)
+            bias = self.param("bias", self.bias_init, (c,), self.dtype)
             y = y + bias.astype(jnp.float32).reshape(shape)
-        return y.astype(x.dtype)
+        return y.astype(self.result_dtype or x.dtype)
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=None):
@@ -182,7 +188,11 @@ def convert_syncbn_model(module, process_group=None, channel_last=None):
             affine=bn.use_scale or bn.use_bias,
             use_scale=bn.use_scale, use_bias=bn.use_bias,
             use_running_average=bn.use_running_average,
+            scale_init=bn.scale_init, bias_init=bn.bias_init,
+            result_dtype=bn.dtype,
             process_group=process_group,
+            # a BN already syncing over its own axis keeps that axis
+            axis_name=getattr(bn, "axis_name", None) or "data",
             channel_last=ch_last,
             dtype=bn.param_dtype)
 
@@ -205,6 +215,8 @@ def convert_syncbn_model(module, process_group=None, channel_last=None):
             items = [walk(i) for i in v]
             if all(a is b for a, b in zip(items, v)):
                 return v
+            if hasattr(v, "_fields"):          # NamedTuple
+                return type(v)(*items)
             return type(v)(items)
         if isinstance(v, dict):
             items = {k: walk(i) for k, i in v.items()}

@@ -799,9 +799,26 @@ def worker():
         # one in flight — kernel-race compiles are ~30s each)
         budget_s = 2300
         # priority order under the budget: kernels (VERDICT r2 item 2)
-        # must not be crowded out by the newer bert config
-        for fn in (bench_llama, bench_resnet, bench_kernels, bench_bert,
-                   bench_gpt2, bench_allreduce):
+        # must not be crowded out by the newer bert config.
+        # BENCH_ONLY=kernels,bert runs a subset — for short relay windows
+        # where the full ~30 min suite wouldn't fit.
+        only = {s.strip() for s in os.environ.get("BENCH_ONLY", "").split(",")
+                if s.strip()}
+        secondary = (bench_llama, bench_resnet, bench_kernels, bench_bert,
+                     bench_gpt2, bench_allreduce)
+        if only:
+            names = {fn.__name__.removeprefix("bench_") for fn in secondary}
+            unknown = only - names
+            if unknown:
+                # a typo must not silently burn a scarce relay window
+                extras["bench_only_unknown"] = sorted(unknown)
+                print(f"BENCH_ONLY entries not recognized: "
+                      f"{sorted(unknown)} (valid: {sorted(names)})",
+                      file=sys.stderr)
+            secondary = tuple(
+                fn for fn in secondary
+                if fn.__name__.removeprefix("bench_") in only)
+        for fn in secondary:
             spent = time.perf_counter() - t_worker
             if spent > budget_s:
                 extras[fn.__name__ + "_skipped"] = (

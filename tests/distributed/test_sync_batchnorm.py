@@ -252,3 +252,41 @@ def test_convert_preserves_bn_config():
     converted = convert_syncbn_model(nn.BatchNorm(axis=3),
                                      channel_last=True)
     assert converted.channel_last is True
+
+
+def test_convert_preserves_inits_axisname_dtype():
+    """r5 review round 2: scale_init/bias_init, axis_name, and the
+    computation dtype must survive conversion; NamedTuple containers."""
+    import typing
+
+    zero_gamma = nn.BatchNorm(scale_init=nn.initializers.zeros)
+    c = convert_syncbn_model(zero_gamma)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 3))
+    v = c.init(jax.random.PRNGKey(1), x)
+    np.testing.assert_array_equal(np.asarray(v["params"]["scale"]),
+                                  np.zeros(3, np.float32))
+    # a BN already syncing over its own axis keeps it
+    c2 = convert_syncbn_model(nn.BatchNorm(axis_name="batch"))
+    assert c2.axis_name == "batch"
+    # computation dtype carries (flax returns bn.dtype)
+    c3 = convert_syncbn_model(nn.BatchNorm(dtype=jnp.bfloat16))
+    y = c3.apply(c3.init(jax.random.PRNGKey(1), x), x,
+                 use_running_average=True)
+    assert y.dtype == jnp.bfloat16
+
+    class Towers(typing.NamedTuple):
+        a: typing.Any
+        b: typing.Any
+
+    class Net(nn.Module):
+        towers: Towers = None
+
+        @nn.compact
+        def __call__(self, x):
+            return self.towers.a(self.towers.b(x))
+
+    out = convert_syncbn_model(
+        Net(towers=Towers(a=nn.BatchNorm(), b=nn.Dense(3))))
+    assert isinstance(out.towers, Towers)
+    assert isinstance(out.towers.a, SyncBatchNorm)
+    assert out.towers.b is not None
