@@ -12,6 +12,8 @@ than only on real hardware (round-1 gap: VERDICT.md weak #2).
 from __future__ import annotations
 
 import contextlib
+import json
+import os
 
 import jax
 
@@ -36,6 +38,30 @@ _KERNEL_AUTO = {
     "flat_adam": False,
 }
 
+# every kernel that consults use_pallas(<name>); a verdict for anything
+# else is a typo that would silently never be consulted
+KNOWN_KERNELS = frozenset(
+    {"flash_attention", "layer_norm", "rms_norm", "fused_softmax",
+     "flat_adam"})
+
+
+def _load_env_overrides():
+    """APEX_TPU_KERNEL_AUTO='{"layer_norm": false}' pins per-kernel auto
+    verdicts at import time — the deployment knob for applying a
+    bench_kernels race result without editing source."""
+    raw = os.environ.get("APEX_TPU_KERNEL_AUTO")
+    if not raw:
+        return
+    try:
+        table = json.loads(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"APEX_TPU_KERNEL_AUTO is not valid JSON: {raw!r}") from e
+    if not isinstance(table, dict):
+        raise ValueError("APEX_TPU_KERNEL_AUTO must be a JSON object of "
+                         "kernel name -> bool|null")
+    set_kernel_auto(**table)
+
 
 def use_pallas(kernel: str | None = None) -> bool:
     """Should fused ops take their Pallas path right now?
@@ -57,12 +83,27 @@ def use_pallas(kernel: str | None = None) -> bool:
 
 def set_kernel_auto(**verdicts) -> None:
     """Pin per-kernel auto decisions (True/False) or restore the backend
-    heuristic (None). Used to apply measured race results."""
+    heuristic (None). Used to apply measured race results.
+
+    Strict on both axes: a typo'd kernel name would be stored but never
+    consulted, and a stringly value ("false" via yaml/k8s templating)
+    would bool() to the OPPOSITE of the intent — both raise instead."""
+    unknown = set(verdicts) - KNOWN_KERNELS
+    if unknown:
+        raise ValueError(f"unknown kernel name(s) {sorted(unknown)}; "
+                         f"valid: {sorted(KNOWN_KERNELS)}")
     for kernel, v in verdicts.items():
+        if v is not None and not isinstance(v, bool):
+            raise ValueError(
+                f"verdict for {kernel!r} must be true/false/null, "
+                f"got {v!r}")
         if v is None:
             _KERNEL_AUTO.pop(kernel, None)
         else:
-            _KERNEL_AUTO[kernel] = bool(v)
+            _KERNEL_AUTO[kernel] = v
+
+
+_load_env_overrides()
 
 
 def kernel_auto() -> dict:

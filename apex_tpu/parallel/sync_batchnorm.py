@@ -183,6 +183,21 @@ def convert_syncbn_model(module, process_group=None, channel_last=None):
                     f"{bn.axis}; pass channel_last= explicitly")
         else:
             ch_last = channel_last
+        groups = getattr(bn, "axis_index_groups", None)
+        group_size = None
+        if groups is not None:
+            # SyncBatchNorm models subgroups as consecutive-rank blocks of
+            # one size; map exactly that shape, refuse anything else
+            # rather than silently syncing over the whole axis
+            sizes = {len(g) for g in groups}
+            flat = [r for g in groups for r in g]
+            if len(sizes) == 1 and flat == list(range(len(flat))):
+                group_size = sizes.pop()
+            else:
+                raise ValueError(
+                    f"cannot map axis_index_groups={groups!r} onto "
+                    f"group_size (needs equal-size consecutive-rank "
+                    f"blocks); construct SyncBatchNorm directly")
         return SyncBatchNorm(
             eps=bn.epsilon, momentum=1.0 - bn.momentum,
             affine=bn.use_scale or bn.use_bias,
@@ -193,6 +208,7 @@ def convert_syncbn_model(module, process_group=None, channel_last=None):
             process_group=process_group,
             # a BN already syncing over its own axis keeps that axis
             axis_name=getattr(bn, "axis_name", None) or "data",
+            group_size=group_size,
             channel_last=ch_last,
             dtype=bn.param_dtype)
 

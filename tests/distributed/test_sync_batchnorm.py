@@ -290,3 +290,14 @@ def test_convert_preserves_inits_axisname_dtype():
     assert isinstance(out.towers, Towers)
     assert isinstance(out.towers.a, SyncBatchNorm)
     assert out.towers.b is not None
+
+
+def test_convert_axis_index_groups():
+    """Consecutive equal-size rank groups map onto group_size; anything
+    else is refused rather than silently syncing the whole axis."""
+    c = convert_syncbn_model(
+        nn.BatchNorm(axis_name="data", axis_index_groups=[[0, 1], [2, 3]]))
+    assert c.group_size == 2
+    with pytest.raises(ValueError, match="axis_index_groups"):
+        convert_syncbn_model(
+            nn.BatchNorm(axis_name="data", axis_index_groups=[[0, 2], [1, 3]]))

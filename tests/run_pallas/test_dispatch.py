@@ -64,3 +64,24 @@ def test_fused_adam_flat_defers_to_table():
     with pallas_config.force("interpret"):
         d_kern, _ = tx.update(grads, state, params)
     assert jnp.allclose(d_auto["w"], d_kern["w"], atol=1e-6)
+
+
+def test_env_override_loading():
+    import json as _json
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from apex_tpu.ops import pallas_config as pc\n"
+        "print(_sorted := sorted(pc.kernel_auto().items()))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**__import__('os').environ,
+             "APEX_TPU_KERNEL_AUTO": _json.dumps(
+                 {"layer_norm": False, "flat_adam": None})},
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    # flat_adam's built-in verdict deleted by null; layer_norm pinned off
+    assert "('layer_norm', False)" in out.stdout
+    assert "flat_adam" not in out.stdout

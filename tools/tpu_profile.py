@@ -31,8 +31,12 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--vocab-chunks", type=int, default=0,
                     help="stream the lm-head CE in N slices (0 = off)")
-    ap.add_argument("--remat", default="dots",
-                    choices=["none", "dots", "full"])
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "dots", "full"],
+                    help="default 'none' matches bench.py's surviving "
+                         "ladder rung (no-remat B=4 fits a v5e) so the "
+                         "profile explains the bench number; pass 'dots' "
+                         "to compare with r5's TPU_TRACE_r05 capture")
     ap.add_argument("--force", action="store_true",
                     help="profile even on a non-TPU backend")
     ap.add_argument("--cpu", action="store_true",
