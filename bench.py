@@ -946,20 +946,22 @@ def launcher():
             errors.append("axon relay 127.0.0.1:8083 connection refused "
                           "(local relay down; PJRT client would retry "
                           "forever)")
-    delays = [20]
-    for attempt in range(len(delays) + 1):
+    # attempt 1 gets the full honest-bench budget (2700s: with real
+    # host-fetch syncs a full TPU bench is ~25-35 min; 1500s killed the
+    # r5 worker mid-kernel-race). A first attempt that produced NO JSON
+    # at all usually means init/compile trouble, so the retry is shorter
+    # — it exists to catch a flapping relay, not to rerun everything.
+    timeouts = [2700, 1500]
+    for attempt, timeout_s in enumerate(timeouts):
         if skip_tpu:
             break
-        # 2700s: with real host-fetch syncs (block_until_ready is a no-op
-        # over the tunnel) an honest full TPU bench is ~25-35 min; 1500s
-        # killed the r5 worker mid-kernel-race
-        line = _run_worker(env, timeout=2700, errors=errors)
+        line = _run_worker(env, timeout=timeout_s, errors=errors)
         if line is not None:
             print(line)
             return 0
-        if attempt < len(delays):
-            print(f"retrying in {delays[attempt]}s...", file=sys.stderr)
-            time.sleep(delays[attempt])
+        if attempt + 1 < len(timeouts):
+            print("retrying in 20s...", file=sys.stderr)
+            time.sleep(20)
 
     print("TPU attempts exhausted; falling back to CPU", file=sys.stderr)
     env["BENCH_FORCE_CPU"] = "1"
