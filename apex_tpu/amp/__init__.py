@@ -4,13 +4,18 @@ Ref: apex/amp/__init__.py. See frontend.py for the O0-O3 → TPU mapping.
 """
 
 from apex_tpu.amp.frontend import (
+    O0,
+    O1,
+    O2,
+    O3,
     Policy,
     Properties,
     initialize,
+    opt_levels,
     state_dict,
     load_state_dict,
 )
-from apex_tpu.amp.handle import AmpHandle
+from apex_tpu.amp.handle import AmpHandle, NoOpHandle
 from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
 from apex_tpu.amp import lists
 from apex_tpu.amp.amp import (
@@ -27,7 +32,9 @@ from apex_tpu.amp.amp import (
 
 __all__ = [
     "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
-    "AmpHandle", "LossScaler", "LossScaleState", "scaled_update", "lists",
+    "O0", "O1", "O2", "O3", "opt_levels",
+    "AmpHandle", "NoOpHandle", "LossScaler", "LossScaleState",
+    "scaled_update", "lists",
     "amp_call", "casting", "current_policy", "half_function",
     "float_function", "promote_function", "register_half_function",
     "register_float_function", "register_promote_function",

@@ -49,18 +49,90 @@ class Properties:
 
 
 def _opt_level_props(opt_level: str, half) -> Properties:
-    if opt_level == "O0":
-        return Properties(True, "O0", jnp.float32, False, None, False, 1.0)
-    if opt_level == "O1":
-        return Properties(True, "O1", None, True, None, None, "dynamic")
-    if opt_level == "O2":
-        return Properties(True, "O2", half, False, True, True, "dynamic")
-    if opt_level == "O3":
-        return Properties(True, "O3", half, False, False, False, 1.0)
-    raise ValueError(
-        f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', "
-        "'O2', 'O3'. Note that in `O0`, `O1`, etc., the prefix O is the letter "
-        "O, not the number zero.")
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', "
+            "'O1', 'O2', 'O3'. Note that in `O0`, `O1`, etc., the prefix O "
+            "is the letter O, not the number zero.")
+    return opt_levels[opt_level](Properties(), half)
+
+
+class O0:
+    """Pure fp32 training (ref frontend.py O0 descriptor)."""
+
+    brief = "O0: pure FP32 training.\n"
+    more = ("Params stay fp32, no boundary casting, no loss scaling — the "
+            "ground-truth baseline every other level is compared against.\n")
+
+    def __call__(self, properties, half=jnp.bfloat16):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_jax_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O1:
+    """Boundary casting, fp32 weights (ref frontend.py O1 descriptor)."""
+
+    brief = "O1: insert automatic casts at op boundaries.\n"
+    more = ("Weights stay fp32; MXU-friendly ops run in bf16 via the "
+            "op-policy tables (apex_tpu/amp/lists.py) — the XLA analog of "
+            "the reference's torch-function patching. The safest way to "
+            "try mixed precision.\n")
+
+    def __call__(self, properties, half=jnp.bfloat16):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_jax_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O2:
+    """Half weights + fp32 master weights (ref frontend.py O2)."""
+
+    brief = "O2: 'almost half' — half model, fp32 master weights.\n"
+    more = ("Params are cast to the half dtype (norm params stay fp32), "
+            "the optimizer keeps fp32 master weights, dynamic loss "
+            "scaling guards the update.\n")
+
+    def __call__(self, properties, half=jnp.bfloat16):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = half
+        properties.patch_jax_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O3:
+    """Pure half training (ref frontend.py O3)."""
+
+    brief = "O3: pure half-precision training.\n"
+    more = ("Everything in the half dtype, no master weights, no loss "
+            "scaling — the speed-of-light baseline for perf comparisons.\n")
+
+    def __call__(self, properties, half=jnp.bfloat16):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = half
+        properties.patch_jax_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O0": O0(), "O1": O1(), "O2": O2(), "O3": O3()}
 
 
 @dataclasses.dataclass(frozen=True)

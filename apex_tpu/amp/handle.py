@@ -16,6 +16,7 @@ exit. JAX gradients are functional, so the handle exposes both:
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 from typing import Optional
 
 import jax
@@ -144,6 +145,64 @@ class AmpHandle:
 
             opt.step = step
 
+    # ---- reference-parity surface (ref handle.py AmpHandle) ---------------
+
+    @property
+    def is_active(self) -> bool:
+        """ref handle.py:179 — True while amp is enabled."""
+        return bool(self.props.enabled)
+
+    @property
+    def verbose(self) -> bool:
+        """ref handle.py verbose flag (initialize(verbosity=...))."""
+        from apex_tpu.amp._amp_state import _amp_state
+        return getattr(_amp_state, "verbosity", 1) > 1
+
+    # The reference caches casted tensors to dodge repeated fp16 copies
+    # (handle.py cache/has_cache/remove_cache). Under XLA the compilation
+    # cache plays that role — casts are fused into the jitted program and
+    # never re-materialized — so the cache is always empty here; the API
+    # exists so reference-shaped training loops run unchanged.
+
+    @property
+    def cache(self) -> dict:
+        return {}
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def remove_cache(self) -> None:
+        return None
+
+    _clear_cache = remove_cache
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        """ref handle.py:188 — attach amp's unscale/skip/regrow protocol
+        to one optimizer and return it (ours patches ``step`` in place
+        via :meth:`attach`; ``num_loss`` is accepted for parity — each
+        loss shares the one in-graph scaler)."""
+        del num_loss
+        self.attach([optimizer])
+        return optimizer
+
+    @contextlib.contextmanager
+    def disable_casts(self):
+        """ref handle.py:164 — a region where mixed precision is off:
+        the policy's compute/param dtype is fp32 inside the context, so
+        ``cast_to_compute`` upcasts half inputs to fp32 instead of
+        casting to the half dtype (apex semantics: with casts disabled,
+        ops run at fp32). Only affects traces made INSIDE the region — a
+        step already jitted against the old policy keeps its baked-in
+        casts, exactly like a torch function captured before unpatching."""
+        prev = self.policy
+        self.policy = dataclasses.replace(
+            prev, compute_dtype=jnp.float32, param_dtype=jnp.float32)
+        try:
+            yield
+        finally:
+            self.policy = prev
+
     # ---- checkpointing -----------------------------------------------------
 
     def state_dict(self) -> dict:
@@ -151,3 +210,52 @@ class AmpHandle:
 
     def load_state_dict(self, d: dict) -> None:
         self.scaler_state = self.scaler.load_state_dict(d)
+
+
+class NoOpHandle:
+    """ref handle.py:254 — the handle used when amp is disabled: every
+    operation is the identity."""
+
+    @property
+    def is_active(self) -> bool:
+        return False
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        yield loss
+
+    def scale(self, loss, scaler_state=None):
+        return loss
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        del num_loss
+        return optimizer
+
+    @contextlib.contextmanager
+    def disable_casts(self):
+        yield
+
+    # same parity surface as AmpHandle — a loop handed either handle
+    # must not AttributeError when amp is toggled off
+    @property
+    def verbose(self) -> bool:
+        return False
+
+    @property
+    def cache(self) -> dict:
+        return {}
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def remove_cache(self) -> None:
+        return None
+
+    _clear_cache = remove_cache
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict) -> None:
+        del d

@@ -56,14 +56,14 @@ class SyncBatchNorm(nn.Module):
     bias_init: Any = nn.initializers.zeros
     result_dtype: Any = None  # None = return in x.dtype (flax: bn.dtype)
 
-    def _group_merge(self, axis_name, local_count, local_mean, local_m2):
+    def _group_merge(self, axis_name, g, local_count, local_mean,
+                     local_m2):
         """Merge (count, mean, M2) within groups of ``group_size``
         consecutive ranks (ref distributed/synced_batchnorm/test_groups.py;
         the reference builds NCCL subgroups). shard_map's psum does not
         support axis_index_groups, so gather the tiny per-channel stats and
         reduce this rank's group slice locally — Chan's merge unchanged."""
         n = jax.lax.axis_size(axis_name)
-        g = self.group_size
         if n % g:
             raise ValueError(f"group_size={g} must divide axis size {n}")
         start = (jax.lax.axis_index(axis_name) // g) * g
@@ -89,6 +89,13 @@ class SyncBatchNorm(nn.Module):
             # module.training default is train
             use_running_average = bool(self.use_running_average)
         axis_name = self.process_group or self.axis_name
+        group_size = self.group_size
+        if isinstance(axis_name, tuple):
+            # create_syncbn_process_group's (axis_name, group_size) pair,
+            # passed straight through process_group= like the reference's
+            # group object
+            axis_name, tuple_size = axis_name
+            group_size = tuple_size if group_size is None else group_size
         ch_axis = (x.ndim - 1) if (self.channel_last or x.ndim == 2) else 1
         reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
         c = x.shape[ch_axis]
@@ -113,9 +120,10 @@ class SyncBatchNorm(nn.Module):
                 jnp.square(x32 - local_mean.reshape(stat_shape)),
                 axis=reduce_axes)
             try:
-                if self.group_size is not None:
+                if group_size is not None:
                     total_count, mean, m2 = self._group_merge(
-                        axis_name, local_count, local_mean, local_m2)
+                        axis_name, group_size, local_count, local_mean,
+                        local_m2)
                 else:
                     total_count = jax.lax.psum(local_count, axis_name)
                     mean = jax.lax.psum(local_count * local_mean,

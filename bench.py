@@ -948,9 +948,12 @@ def launcher():
                           "forever)")
     # attempt 1 gets the full honest-bench budget (2700s: with real
     # host-fetch syncs a full TPU bench is ~25-35 min; 1500s killed the
-    # r5 worker mid-kernel-race). A first attempt that produced NO JSON
-    # at all usually means init/compile trouble, so the retry is shorter
-    # — it exists to catch a flapping relay, not to rerun everything.
+    # r5 worker mid-kernel-race). The retry only runs when attempt 1
+    # produced NO JSON at all — a timeout with the headline in stdout is
+    # salvaged and returned, so reaching attempt 2 means init/early
+    # failure. 1500s is enough for its job: the headline lands ~4 min in
+    # and a timeout at 1500s STILL salvages it; the secondary benches are
+    # bonus on a retry, not the goal.
     timeouts = [2700, 1500]
     for attempt, timeout_s in enumerate(timeouts):
         if skip_tpu:

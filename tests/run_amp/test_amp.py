@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
 from apex_tpu.amp import LossScaler
@@ -264,3 +266,81 @@ def test_attach_multiple_optimizers_keeps_each_tx():
                                np.ones(4) - 0.05, rtol=1e-6)
     assert not np.allclose(np.asarray(opt1.params["w"]),
                            np.asarray(opt2.params["w"]))
+
+
+class TestReferenceParitySurface:
+    """ref apex/amp/{frontend,handle}.py exports: O0-O3 descriptors,
+    opt_levels, handle.is_active / wrap_optimizer / disable_casts,
+    NoOpHandle; apex.parallel.create_syncbn_process_group."""
+
+    def test_opt_level_descriptors(self):
+        from apex_tpu.amp import O0, O2, opt_levels, Properties
+
+        assert set(opt_levels) == {"O0", "O1", "O2", "O3"}
+        for name, desc in opt_levels.items():
+            assert desc.brief.startswith(name)
+            p = desc(Properties())
+            assert p.opt_level == name and p.enabled
+        p2 = opt_levels["O2"](Properties())
+        assert p2.master_weights and p2.loss_scale == "dynamic"
+        assert p2.cast_model_type == jnp.bfloat16
+        assert opt_levels["O0"](Properties()).loss_scale == 1.0
+        # the class objects themselves are exported (ref frontend.py)
+        assert isinstance(opt_levels["O0"], O0)
+        assert isinstance(opt_levels["O2"], O2)
+
+    def test_handle_parity_methods(self):
+        from apex_tpu import amp
+
+        handle = amp.initialize(opt_level="O2")
+        assert handle.is_active
+        with handle.disable_casts():
+            assert handle.policy.compute_dtype == jnp.float32
+            x = handle.policy.cast_to_compute(
+                {"w": jnp.ones((2,), jnp.float32)})
+            assert x["w"].dtype == jnp.float32
+        # restored on exit
+        assert handle.policy.compute_dtype == jnp.bfloat16
+
+    def test_noop_handle(self):
+        from apex_tpu.amp import NoOpHandle
+
+        h = NoOpHandle()
+        assert not h.is_active
+        with h.scale_loss(3.5) as s:
+            assert s == 3.5
+        with h.disable_casts():
+            pass
+        marker = object()
+        assert h.wrap_optimizer(marker) is marker
+        assert h.state_dict() == {}
+
+    def test_create_syncbn_process_group(self):
+        from apex_tpu.parallel import (SyncBatchNorm,
+                                       create_syncbn_process_group)
+
+        assert create_syncbn_process_group(0, world_size=8) is None
+        assert create_syncbn_process_group(8, world_size=8) is None
+        grp = create_syncbn_process_group(2, world_size=8)
+        assert grp == ("data", 2)
+        with pytest.raises(ValueError):
+            create_syncbn_process_group(3, world_size=8)
+        # the pair threads through process_group= like the ref group obj
+        bn = SyncBatchNorm(affine=False, process_group=grp)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 6)) * 2 + \
+            jnp.arange(16)[:, None] * 1.0
+        variables = bn.init(jax.random.PRNGKey(1), x[:2])
+
+        def f(xl):
+            y, _ = bn.apply(variables, xl, mutable=["batch_stats"])
+            return y
+
+        y = np.asarray(jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P("data")))(x))
+        xs = np.asarray(x)
+        for g in range(4):
+            blk = xs[g * 4:(g + 1) * 4]
+            want = (blk - blk.mean(0)) / np.sqrt(blk.var(0) + 1e-5)
+            np.testing.assert_allclose(y[g * 4:(g + 1) * 4], want,
+                                       rtol=2e-4, atol=2e-4)
