@@ -86,6 +86,37 @@ class FP16_Optimizer:
         self.model_params = model
         return model
 
+    def clip_master_grads(self, grads, max_norm, norm_type=2):
+        """ref fp16_optimizer.py clip_master_grads — clip the (unscaled,
+        fp32) master gradients to ``max_norm`` and return the pre-clip
+        global norm. Functional divergence from the reference: grads are
+        not stored on the optimizer, so pass the tree that will go to
+        ``step`` and use the returned clipped tree:
+
+            grads, norm = opt.clip_master_grads(grads, 1.0)
+            opt.step(grads=grads)
+        """
+        from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+        inv = 1.0 / self.loss_scaler.loss_scale
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+        clipped, norm = clip_grad_norm_(grads32, max_norm,
+                                        norm_type=norm_type)
+        # re-apply the scale: step() divides by it again
+        rescaled = jax.tree_util.tree_map(
+            lambda g: g * self.loss_scaler.loss_scale, clipped)
+        return rescaled, norm
+
+    def inspect_master_grad_data(self):
+        """ref fp16_optimizer.py inspect_master_grad_data — grads are
+        functional here (never stored), so there is nothing to inspect;
+        returns None like the reference does before backward()."""
+        if self.verbose:
+            print("FP16_Optimizer is functional: gradients are passed to "
+                  "step(), not stored; inspect them at the call site")
+        return None
+
     def zero_grad(self, set_to_none=True):
         return None
 

@@ -127,6 +127,13 @@ class MultiTensorApply:
     def __init__(self, chunk_size: int = 2048 * 32):
         self.chunk_size = chunk_size  # accepted for parity; XLA needs no chunking
 
+    @classmethod
+    def check_avail(cls):
+        """ref multi_tensor_apply.py check_avail — the reference raises
+        when the amp_C extension is missing; the XLA path is always
+        compiled in, so this never raises."""
+        return None
+
     def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
         del noop_flag_buffer
         n_in = getattr(op, "n_input_lists", len(tensor_lists))

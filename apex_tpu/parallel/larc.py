@@ -99,6 +99,16 @@ class LARC:
     def state(self):
         return self._state
 
+    @property
+    def param_groups(self):
+        """ref LARC.py param_groups — proxied to the wrapped optimizer
+        so schedulers that poke group['lr'] keep working."""
+        return self.optim.param_groups
+
+    @param_groups.setter
+    def param_groups(self, value):
+        self.optim.param_groups = value
+
     def step(self, grads=None, closure=None):
         loss = closure() if closure is not None else None
         if grads is None:

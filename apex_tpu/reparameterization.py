@@ -108,3 +108,32 @@ def remove_weight_norm(params, name: str = "", dim: int = 0):
     """Collapse (g, v) back to plain weights (ref __init__.py:64)."""
     del name
     return compute_weights(params, dim)
+
+
+def apply_reparameterization(params, reparameterization=None, name: str = "",
+                             dim: int = 0, hook_child: bool = True):
+    """ref reparameterization/__init__.py:67 — apply a reparameterization
+    (WeightNorm is the only one the reference ships, and the default) to
+    one named weight or every eligible weight. Functional: returns the
+    transformed params tree instead of installing forward hooks
+    (``hook_child`` is accepted for parity; there are no hooks to place)."""
+    del hook_child
+    if reparameterization is not None and reparameterization is not WeightNorm:
+        raise ValueError(
+            f"unknown reparameterization {reparameterization!r}; "
+            "WeightNorm is the supported kind (as in the reference)")
+    return apply_weight_norm(params, name=name, dim=dim)
+
+
+def remove_reparameterization(params, reparameterization=None, name: str = "",
+                              remove_all: bool = False):
+    """ref reparameterization/__init__.py:99 — collapse (g, v) pairs back
+    to plain weights. ``remove_all``/``name`` narrow which weights in the
+    reference; the functional tree walk collapses every pair it finds, so
+    both spellings converge here."""
+    del remove_all
+    if reparameterization is not None and reparameterization is not WeightNorm:
+        raise ValueError(
+            f"unknown reparameterization {reparameterization!r}; "
+            "WeightNorm is the supported kind (as in the reference)")
+    return remove_weight_norm(params, name=name)

@@ -382,3 +382,32 @@ class FusedScaleMaskSoftmax:
     def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
         del mask, b, np_
         return _pallas_ok(sq, sk)
+
+    @staticmethod
+    def get_batch_per_block(sq, sk, b, np_):
+        """ref fused_softmax.py get_batch_per_block — rows of the
+        (b*np, sq, sk) batch one CUDA thread block handles. The Pallas
+        analog is rows per kernel block: the grid tiles (rows, sq) and
+        each program consumes a whole sk row, so the answer is the row
+        tile — useful only for parity asserts, the TPU grid is chosen
+        inside the kernels."""
+        del sk, b, np_
+        return max(1, min(128, sq))
+
+    def forward_fused_softmax(self, input, mask=None):
+        """ref fused_softmax.py:181 — force the fused (Pallas) path,
+        like the reference forces its CUDA kernel; requires a TPU (or
+        ``pallas_config.force('interpret')`` above this call in tests)."""
+        from apex_tpu.ops import pallas_config
+
+        mode = "interpret" if pallas_config.mode() == "interpret" else "on"
+        with pallas_config.force(mode):
+            return self(input, mask)
+
+    def forward_torch_softmax(self, input, mask=None):
+        """ref fused_softmax.py:186 — the unfused reference path (jnp
+        fallback, named for parity with the torch implementation)."""
+        from apex_tpu.ops import pallas_config
+
+        with pallas_config.force("off"):
+            return self(input, mask)

@@ -240,3 +240,53 @@ def is_last_rank() -> bool:
 def print_rank_last(message):
     if is_last_rank():
         print(message, flush=True)
+
+
+def report_memory(name: str) -> str:
+    """ref pipeline_parallel/utils.py report_memory — print device memory
+    stats. CUDA's allocated/cached split maps onto the PJRT
+    ``memory_stats`` of the local device: bytes in use, peak, and limit
+    (absent on backends that don't report, e.g. the CPU mesh)."""
+    import jax
+
+    dev = jax.local_devices()[0]
+    stats = dev.memory_stats() or {}
+    giga = 1024.0 ** 3
+    parts = [f"[{name}] memory on {dev.platform}:{dev.id}"]
+    for key, label in (("bytes_in_use", "in use"),
+                       ("peak_bytes_in_use", "peak"),
+                       ("bytes_limit", "limit")):
+        if key in stats:
+            parts.append(f"{label} {stats[key] / giga:.3f} GiB")
+    line = " | ".join(parts)
+    print(line, flush=True)
+    return line
+
+
+def print_params_min_max_norm(optimizer, iteration: int) -> None:
+    """ref pipeline_parallel/utils.py print_params_min_max_norm — per-param
+    (iteration, rank, index, min, max, norm) lines. Accepts a
+    FusedOptimizer-shaped object (``.params``) or a bare params tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer import parallel_state
+
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        param_is_not_tensor_parallel_duplicate)
+
+    params = getattr(optimizer, "params", optimizer)
+    try:
+        rank = parallel_state.get_tensor_model_parallel_rank()
+    except Exception:  # outside an initialized mesh
+        rank = 0
+    index = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        index += 1
+        mp = int(param_is_not_tensor_parallel_duplicate(leaf))
+        x = leaf.astype(jnp.float32)
+        print(f"iteration, rank, index, model-parallel, min, max, norm: "
+              f"{iteration} {rank} {index} {mp} "
+              f"{float(x.min()):.6e} {float(x.max()):.6e} "
+              f"{float(jnp.linalg.norm(x.ravel())):.6e}  {jax.tree_util.keystr(path)}",
+              flush=True)

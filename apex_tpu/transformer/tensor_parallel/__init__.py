@@ -11,7 +11,9 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     VocabParallelEmbedding,
     column_parallel_linear,
     linear_with_grad_accumulation_and_async_allreduce,
+    copy_tensor_model_parallel_attributes,
     param_is_not_tensor_parallel_duplicate,
+    set_defaults_if_not_set_tensor_model_parallel_attributes,
     param_partition_specs,
     row_parallel_linear,
     set_tensor_model_parallel_attributes,
@@ -57,7 +59,9 @@ __all__ = [
     "row_parallel_linear",
     "vocab_parallel_embedding",
     "linear_with_grad_accumulation_and_async_allreduce",
+    "copy_tensor_model_parallel_attributes",
     "param_is_not_tensor_parallel_duplicate",
+    "set_defaults_if_not_set_tensor_model_parallel_attributes",
     "param_partition_specs",
     "set_tensor_model_parallel_attributes",
     "copy_to_tensor_model_parallel_region",

@@ -80,6 +80,21 @@ def param_is_not_tensor_parallel_duplicate(param) -> bool:
     return bool(names) and TP in tuple(names)
 
 
+def set_defaults_if_not_set_tensor_model_parallel_attributes(tensor):
+    """API-parity no-op (ref layers.py:79): jax arrays carry partition
+    metadata in ``nn.Partitioned`` boxes / PartitionSpecs, not as
+    settable attributes, and the default (replicated) needs no marker."""
+    del tensor
+
+
+def copy_tensor_model_parallel_attributes(destination_tensor,
+                                          source_tensor):
+    """API-parity no-op (ref layers.py:88): partition metadata travels
+    with the ``nn.Partitioned`` box itself when a tree is mapped, so
+    there is nothing to copy onto a raw array."""
+    del destination_tensor, source_tensor
+
+
 class ColumnParallelLinear(nn.Module):
     """Y = X·A with A split column-wise over tp (ref layers.py:377).
 
