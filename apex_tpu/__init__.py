@@ -11,6 +11,23 @@ Reference capability surface: /root/reference (NVIDIA Apex); see SURVEY.md §2
 for the component-by-component mapping.
 """
 
+import logging
+
+
+class RankInfoFormatter(logging.Formatter):
+    """ref apex/__init__.py:28 — logging formatter injecting the current
+    (tp, pp, dp, ...) rank tuple into every record; pairs with
+    ``transformer.log_util.set_logging_level`` for multi-rank runs."""
+
+    def format(self, record):
+        from apex_tpu.transformer.parallel_state import get_rank_info
+        try:
+            record.rank_info = get_rank_info()
+        except Exception:  # outside an initialized mesh
+            record.rank_info = "-"
+        return super().format(record)
+
+
 from apex_tpu import amp
 from apex_tpu import optimizers
 from apex_tpu import normalization
@@ -28,6 +45,7 @@ from apex_tpu import rnn
 __version__ = "0.1.0"
 
 __all__ = [
+    "RankInfoFormatter",
     "amp",
     "optimizers",
     "normalization",

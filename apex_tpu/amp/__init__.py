@@ -16,6 +16,7 @@ from apex_tpu.amp.frontend import (
     load_state_dict,
 )
 from apex_tpu.amp.handle import AmpHandle, NoOpHandle
+from apex_tpu.amp._amp_state import master_params
 from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
 from apex_tpu.amp import lists
 from apex_tpu.amp.amp import (
@@ -33,7 +34,8 @@ from apex_tpu.amp.amp import (
 __all__ = [
     "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
     "O0", "O1", "O2", "O3", "opt_levels",
-    "AmpHandle", "NoOpHandle", "LossScaler", "LossScaleState",
+    "AmpHandle", "NoOpHandle", "master_params",
+    "LossScaler", "LossScaleState",
     "scaled_update", "lists",
     "amp_call", "casting", "current_policy", "half_function",
     "float_function", "promote_function", "register_half_function",

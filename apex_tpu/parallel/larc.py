@@ -81,10 +81,36 @@ class LARC:
         inner_tx = optimizer.tx
         if wd and optimizer._tx_factory is not None:
             inner_tx = optimizer._tx_factory(weight_decay=0.0)
+        self._inner_tx = inner_tx
+        self._built_lr, self._built_wd = lr, wd
         self._tx = larc(inner_tx, lr=lr, trust_coefficient=trust_coefficient,
                         clip=clip, eps=eps, weight_decay=wd)
         self._state = LARCState(inner=optimizer.state,
                                 count=jnp.zeros((), jnp.int32))
+        self._jit_step = jax.jit(self._functional_step)
+
+    def _refresh_hparams(self):
+        """Honor scheduler-style pokes of ``param_groups[0]['lr']``
+        (and weight_decay): larc() bakes both into its closure, so a
+        change rebuilds the transformation. A float-lr poke therefore
+        recompiles — for per-step schedules pass an optax schedule as
+        the inner optimizer's lr instead."""
+        group = self.optim.param_groups[0] if self.optim.param_groups else {}
+        lr = group.get("lr", self._built_lr)
+        wd = group.get("weight_decay", self._built_wd)
+        if lr == self._built_lr and wd == self._built_wd:
+            return
+        self._built_lr, self._built_wd = lr, wd
+        # the inner transform bakes its own lr too — rebuild it when the
+        # optimizer exposes a factory (larc's lr only sets the clip ratio)
+        if self.optim._tx_factory is not None:
+            overrides = {"lr": lr}
+            if wd:
+                overrides["weight_decay"] = 0.0  # larc owns weight decay
+            self._inner_tx = self.optim._tx_factory(**overrides)
+        self._tx = larc(self._inner_tx, lr=lr,
+                        trust_coefficient=self.trust_coefficient,
+                        clip=self.clip, eps=self.eps, weight_decay=wd)
         self._jit_step = jax.jit(self._functional_step)
 
     def _functional_step(self, grads, state, params):
@@ -113,6 +139,7 @@ class LARC:
         loss = closure() if closure is not None else None
         if grads is None:
             raise ValueError("pass grads to step()")
+        self._refresh_hparams()
         self.optim.params, self._state = self._jit_step(
             grads, self._state, self.optim.params)
         self.optim.state = self._state.inner

@@ -272,6 +272,8 @@ def print_params_min_max_norm(optimizer, iteration: int) -> None:
 
     from apex_tpu.transformer import parallel_state
 
+    import flax.linen as nn
+
     from apex_tpu.transformer.tensor_parallel.layers import (
         param_is_not_tensor_parallel_duplicate)
 
@@ -281,9 +283,15 @@ def print_params_min_max_norm(optimizer, iteration: int) -> None:
     except Exception:  # outside an initialized mesh
         rank = 0
     index = 0
-    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+    # stop at Partitioned boxes: flattening through them would strip the
+    # .names metadata the model-parallel flag reads
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, nn.Partitioned))[0]
+    for path, leaf in flat:
         index += 1
         mp = int(param_is_not_tensor_parallel_duplicate(leaf))
+        if isinstance(leaf, nn.Partitioned):
+            leaf = leaf.value
         x = leaf.astype(jnp.float32)
         print(f"iteration, rank, index, model-parallel, min, max, norm: "
               f"{iteration} {rank} {index} {mp} "

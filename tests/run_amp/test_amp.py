@@ -344,3 +344,22 @@ class TestReferenceParitySurface:
             want = (blk - blk.mean(0)) / np.sqrt(blk.var(0) + 1e-5)
             np.testing.assert_allclose(y[g * 4:(g + 1) * 4], want,
                                        rtol=2e-4, atol=2e-4)
+
+    def test_master_params_and_rank_formatter(self):
+        import logging
+
+        import apex_tpu
+        from apex_tpu.optimizers import FusedSGD
+
+        opt = FusedSGD({"w": jnp.ones((3,))}, lr=0.1)
+        leaves = list(amp.master_params(opt))
+        assert len(leaves) == 1 and leaves[0].shape == (3,)
+        # O2-style master tree wins when present
+        opt.master_params = {"w": jnp.zeros((3,), jnp.float32)}
+        assert float(list(amp.master_params(opt))[0].sum()) == 0.0
+
+        rec = logging.LogRecord("t", logging.INFO, __file__, 1, "m", (),
+                                None)
+        out = apex_tpu.RankInfoFormatter("%(rank_info)s %(message)s")\
+            .format(rec)
+        assert out.endswith(" m")

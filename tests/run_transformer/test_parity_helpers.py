@@ -75,7 +75,16 @@ def test_larc_param_groups_proxy():
 
     opt = LARC(FusedSGD({"w": jnp.ones((4,))}, lr=0.1, momentum=0.9))
     assert opt.param_groups is opt.optim.param_groups
-    opt.param_groups[0]["lr"] = 0.05  # scheduler-style poke must not raise
+    g = {"w": jnp.full((4,), 0.1)}
+    opt.step(grads=g)
+    w_after_1 = np.asarray(opt.params["w"]).copy()
+    # scheduler-style poke must actually change the step size
+    opt.param_groups[0]["lr"] = 0.0
+    w_before = np.asarray(opt.params["w"]).copy()
+    opt.step(grads=g)
+    np.testing.assert_allclose(np.asarray(opt.params["w"]), w_before,
+                               atol=1e-7)  # lr=0 -> params frozen
+    del w_after_1
 
 
 def test_reparameterization_names_roundtrip():
