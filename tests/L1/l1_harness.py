@@ -119,6 +119,31 @@ def _resnet_adapter(half=False):
     return init, loss, make_batch
 
 
+def _moe_llama_adapter():
+    """Mixtral-style MoE llama (4 experts, top-2): the routed-expert
+    training path through the L1 amp x optimizer matrix. Single-device
+    (ep_axis=None) — expert sharding is exercised by the dryruns; L1
+    checks the amp curves."""
+    from apex_tpu.models import llama
+
+    cfg = llama.tiny(num_layers=2, num_experts=4,
+                     moe_capacity_factor=2.0)
+
+    def init(key):
+        return llama.init_params(key, cfg), None
+
+    def loss(params, aux, batch):
+        return llama.loss_fn(params, batch, cfg, tp_axis=None,
+                             cp_axis=None, ep_axis=None,
+                             remat=False), aux
+
+    def make_batch(key):
+        tokens = jax.random.randint(key, (4, 32), 0, cfg.vocab_size)
+        return tokens, jnp.roll(tokens, -1, axis=-1)
+
+    return init, loss, make_batch
+
+
 def get_model(name, opt_level):
     if name == "mlp":
         return _mlp_adapter()
@@ -126,6 +151,8 @@ def get_model(name, opt_level):
         return _gpt2_adapter()
     if name == "bert":
         return _bert_adapter()
+    if name == "moe":
+        return _moe_llama_adapter()
     if name == "resnet":
         # the flax module's compute dtype is a model attribute, the
         # L1 analog of the reference rebuilding resnet under amp

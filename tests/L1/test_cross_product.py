@@ -58,6 +58,14 @@ def test_models_o2_adam(model):
     _check(model, "O2", "adam")
 
 
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_moe_llama_adam(opt_level):
+    """Routed-expert (Mixtral-style) training through the amp matrix:
+    the router's top-k dispatch + aux balance loss must track the fp32
+    curve like the dense models do."""
+    _check("moe", opt_level, "adam", steps=30)
+
+
 @pytest.mark.parametrize("loss_scale", [1.0, 128.0, "dynamic"])
 def test_o2_loss_scale_variants(loss_scale):
     """run_test.sh's loss_scales axis: static 1.0 / static 128 / dynamic
