@@ -102,3 +102,11 @@ def test_hf_finetune():
     assert "imported llama" in out
     assert "(decreased)" in out
     assert "prompt " in out
+
+
+@pytest.mark.slow
+def test_long_context():
+    out = _run("long_context.py", "--cp", "4", "--dp", "2",
+               "--seq", "128", "--steps", "6")
+    assert "parity: " in out and "OK" in out  # sharded == single-device
+    assert "(decreased)" in out
