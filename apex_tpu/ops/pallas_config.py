@@ -45,22 +45,42 @@ KNOWN_KERNELS = frozenset(
      "flat_adam"})
 
 
+def _env_json(name: str, shape_hint: str):
+    """Parse an env var as a JSON object, or None when unset."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        table = json.loads(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} is not valid JSON: {raw!r}") from e
+    if not isinstance(table, dict):
+        raise ValueError(f"{name} must be a JSON object of {shape_hint}")
+    return table
+
+
 def _load_env_overrides():
     """APEX_TPU_KERNEL_AUTO='{"layer_norm": false}' pins per-kernel auto
     verdicts at import time — the deployment knob for applying a
     bench_kernels race result without editing source."""
-    raw = os.environ.get("APEX_TPU_KERNEL_AUTO")
-    if not raw:
+    table = _env_json("APEX_TPU_KERNEL_AUTO", "kernel name -> bool|null")
+    if table is not None:
+        set_kernel_auto(**table)
+
+
+def _load_flash_tile_overrides():
+    """APEX_TPU_FLASH_TILES='{"fwd": [512, 512], "bwd": [256, 128]}'
+    pins flash-attention tiles at import — the deployment knob for the
+    bench autotuner's measured winners ("auto" or null restores the
+    per-shape picker). null maps to "auto" (set_flash_blocks treats
+    None as keep-current, which is not what a JSON null means here)."""
+    table = _env_json(
+        "APEX_TPU_FLASH_TILES",
+        "'fwd'/'bwd' -> [block_q, block_k] | \"auto\" | null")
+    if table is None:
         return
-    try:
-        table = json.loads(raw)
-    except ValueError as e:
-        raise ValueError(
-            f"APEX_TPU_KERNEL_AUTO is not valid JSON: {raw!r}") from e
-    if not isinstance(table, dict):
-        raise ValueError("APEX_TPU_KERNEL_AUTO must be a JSON object of "
-                         "kernel name -> bool|null")
-    set_kernel_auto(**table)
+    set_flash_blocks(**{k: ("auto" if v is None else v)
+                        for k, v in table.items()})
 
 
 def use_pallas(kernel: str | None = None) -> bool:
@@ -101,9 +121,6 @@ def set_kernel_auto(**verdicts) -> None:
             _KERNEL_AUTO.pop(kernel, None)
         else:
             _KERNEL_AUTO[kernel] = v
-
-
-_load_env_overrides()
 
 
 def kernel_auto() -> dict:
@@ -154,15 +171,28 @@ def flash_blocks(kind: str, sq: int, sk: int, d: int) -> tuple:
     return min(bq, max(sq, 1)), min(bk, max(sk, 1))
 
 
-def set_flash_blocks(fwd=None, bwd=None) -> None:
+def set_flash_blocks(fwd=None, bwd=None, **bad) -> None:
     """Override flash-attention tiles globally. ``None`` keeps the current
-    setting; pass a (block_q, block_k) tuple to pin, or 'auto' to restore
-    per-shape auto picking."""
+    setting; pass a (block_q, block_k) pair to pin, or 'auto' to restore
+    per-shape auto picking. Strictly validated — a yaml/k8s templating
+    slip like ``[true, 512]`` must error, not pin block_q=1."""
+    if bad:
+        raise ValueError(f"unknown flash tile kind(s) {sorted(bad)}; "
+                         "valid: ['bwd', 'fwd']")
     for kind, val in (("fwd", fwd), ("bwd", bwd)):
         if val is None:
             continue
-        _FLASH_BLOCKS[kind] = None if val == "auto" else (int(val[0]),
-                                                          int(val[1]))
+        if val == "auto":
+            _FLASH_BLOCKS[kind] = None
+            continue
+        ok = (isinstance(val, (list, tuple)) and len(val) == 2
+              and all(isinstance(v, int) and not isinstance(v, bool)
+                      and v > 0 for v in val))
+        if not ok:
+            raise ValueError(
+                f"flash tile {kind!r} must be a 2-int list/tuple of "
+                f"positive sizes, 'auto', or None; got {val!r}")
+        _FLASH_BLOCKS[kind] = (val[0], val[1])
 
 
 @contextlib.contextmanager
@@ -200,3 +230,7 @@ def force(new_mode: str):
         yield
     finally:
         _MODE = prev
+
+
+_load_env_overrides()
+_load_flash_tile_overrides()

@@ -85,3 +85,32 @@ def test_env_override_loading():
     # flat_adam's built-in verdict deleted by null; layer_norm pinned off
     assert "('layer_norm', False)" in out.stdout
     assert "flat_adam" not in out.stdout
+
+
+def test_flash_tiles_env_override():
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from apex_tpu.ops import pallas_config as pc\n"
+        "print('fwd', pc.flash_blocks('fwd', 4096, 4096, 128))\n"
+        "print('bwd', pc.flash_blocks('bwd', 4096, 4096, 128))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "APEX_TPU_FLASH_TILES": _json.dumps(
+            {"fwd": [1024, 256], "bwd": "auto"})},
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "fwd (1024, 256)" in out.stdout
+    assert "bwd (256, 256)" in out.stdout  # auto default at this shape
+
+    for payload in ('{"fwd": "big"}', '{"fwd": [true, 512]}',
+                    '{"fwd": [512]}'):
+        bad = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "APEX_TPU_FLASH_TILES": payload},
+            capture_output=True, text=True, timeout=120)
+        assert bad.returncode != 0 and "2-int" in bad.stderr, payload
