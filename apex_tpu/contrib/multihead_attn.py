@@ -21,6 +21,11 @@ from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
 
 
+def _inverted_dropout(probs, p, rng):
+    keep = jax.random.bernoulli(rng, 1.0 - p, probs.shape)
+    return jnp.where(keep, probs / (1.0 - p), 0.0)
+
+
 def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale,
                       dropout_p=0.0, dropout_rng=None):
     """[b, s, h, d] attention with torch-style masks (ref
@@ -52,9 +57,7 @@ def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale,
             scores = scores + attn_mask[None, None, :, :] / scale
     probs = scaled_masked_softmax(scores, mask, scale).astype(v.dtype)
     if dropout_p > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        probs = _inverted_dropout(probs, dropout_p, dropout_rng)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -153,3 +156,62 @@ class EncdecMultiheadAttn(nn.Module):
         if self.include_norm_add:
             o = o + query
         return o
+
+
+def mask_softmax_dropout(inputs, pad_mask=None, *, heads: int,
+                         mask_additive: bool = False,
+                         dropout_prob: float = 0.0,
+                         is_training: bool = True, dropout_rng=None):
+    """Fused mask → softmax → dropout on attention scores (ref
+    contrib/multihead_attn/mask_softmax_dropout_func.py MaskSoftmaxDropout;
+    csrc fast_mask_softmax_dropout kernels).
+
+    ``inputs``: scores ``[b*heads, sq, sk]`` (the reference kernels'
+    layout). ``pad_mask``: per-batch key mask ``[b, 1, sk]`` (or
+    anything broadcastable to ``[b, 1, sq, sk]``) — boolean True = masked
+    when ``mask_additive=False``, additive float (-inf = masked) when
+    True. Returns the dropped probabilities in the input layout; one XLA
+    fusion on TPU, matching the reference's single fused kernel.
+    """
+    bh, sq, sk = inputs.shape
+    if bh % heads:
+        raise ValueError(f"leading dim {bh} not divisible by heads={heads}")
+    b = bh // heads
+    x = inputs.reshape(b, heads, sq, sk)
+    if pad_mask is not None:
+        # align then broadcast (not reshape): [b,1,sk] is per-batch key
+        # padding (the reference layout), [sq,sk] a batch-shared score
+        # mask, [b,1,sq,sk] already aligned
+        pm = jnp.asarray(pad_mask)
+        if pm.ndim == 3:      # [b, 1, sk] -> [b, 1, 1, sk]
+            pm = pm[:, :, None, :]
+        elif pm.ndim == 2:    # [sq, sk] -> [1, 1, sq, sk]
+            pm = pm[None, None]
+        pm = jnp.broadcast_to(pm, (b, 1, sq, sk))
+        if mask_additive:
+            # stay fp32 through the softmax: a downcast to fp16 would
+            # overflow the conventional -1e9 fill to -inf/NaN
+            x32 = x.astype(jnp.float32) + pm.astype(jnp.float32)
+            probs = scaled_masked_softmax(x32, None).astype(inputs.dtype)
+        else:
+            probs = scaled_masked_softmax(x, pm != 0)
+    else:
+        probs = scaled_masked_softmax(x, None)
+    if dropout_prob > 0.0 and is_training:
+        if dropout_rng is None:
+            raise ValueError("dropout_prob > 0 requires dropout_rng")
+        probs = _inverted_dropout(probs, dropout_prob, dropout_rng)
+    return probs.reshape(bh, sq, sk)
+
+
+class MaskSoftmaxDropout:
+    """ref mask_softmax_dropout_func.py MaskSoftmaxDropout (Function.apply
+    shape): ``op(is_training, heads, inputs, pad_mask, mask_additive,
+    dropout_prob)``."""
+
+    def __call__(self, is_training, heads, inputs, pad_mask, mask_additive,
+                 dropout_prob, dropout_rng=None):
+        return mask_softmax_dropout(
+            inputs, pad_mask, heads=heads, mask_additive=mask_additive,
+            dropout_prob=dropout_prob, is_training=is_training,
+            dropout_rng=dropout_rng)

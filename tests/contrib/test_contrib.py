@@ -610,3 +610,50 @@ def test_fmha_varlen_pallas_kernel_matches():
     want2 = _varlen_reference(q, k, v, seqlens2)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(want2),
                                rtol=1e-4, atol=1e-5)
+
+
+class TestMaskSoftmaxDropout:
+    """ref contrib/multihead_attn/mask_softmax_dropout_func.py — the
+    standalone fused mask+softmax+dropout op."""
+
+    def test_bool_and_additive_masks_agree(self):
+        from apex_tpu.contrib.multihead_attn import (MaskSoftmaxDropout,
+                                                     mask_softmax_dropout)
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 16))
+        pm = jnp.zeros((2, 1, 16), bool).at[:, :, 12:].set(True)
+        out = mask_softmax_dropout(x, pm, heads=2)
+        assert out.shape == (4, 8, 16)
+        # masked keys get zero probability; rows renormalize
+        assert float(jnp.abs(out[:, :, 12:]).sum()) == 0.0
+        np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-5)
+        additive = jnp.where(pm, -1e9, 0.0)
+        out2 = mask_softmax_dropout(x, additive, heads=2,
+                                    mask_additive=True)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                                   atol=1e-5)
+        # Function.apply-shaped class wrapper
+        out3 = MaskSoftmaxDropout()(True, 2, x, pm, False, 0.0)
+        np.testing.assert_allclose(np.asarray(out3), np.asarray(out),
+                                   atol=1e-6)
+
+    def test_dropout_and_grads(self):
+        from apex_tpu.contrib.multihead_attn import mask_softmax_dropout
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))
+        rng = jax.random.PRNGKey(2)
+        out = mask_softmax_dropout(x, None, heads=2, dropout_prob=0.5,
+                                   dropout_rng=rng)
+        zeros = float((out == 0).mean())
+        assert 0.2 < zeros < 0.8  # ~half dropped
+        # eval mode: dropout off regardless of prob
+        out_eval = mask_softmax_dropout(x, None, heads=2,
+                                        dropout_prob=0.5,
+                                        is_training=False)
+        np.testing.assert_allclose(np.asarray(out_eval.sum(-1)), 1.0,
+                                   rtol=1e-5)
+        g = jax.grad(lambda x: jnp.sum(mask_softmax_dropout(
+            x, None, heads=2) ** 2))(x)
+        assert np.isfinite(np.asarray(g)).all()
+        with pytest.raises(ValueError, match="divisible"):
+            mask_softmax_dropout(x, None, heads=3)
