@@ -7,6 +7,7 @@ from apex_tpu.contrib import (
     fmha,
     focal_loss,
     groupbn,
+    halo_exchangers,
     layer_norm,
     multihead_attn,
     optimizers,
@@ -18,6 +19,7 @@ from apex_tpu.contrib import (
 
 __all__ = [
     "bottleneck", "clip_grad", "conv_bias_relu", "fmha", "focal_loss",
-    "groupbn", "layer_norm", "multihead_attn", "optimizers", "peer_memory",
+    "groupbn", "halo_exchangers", "layer_norm", "multihead_attn",
+    "optimizers", "peer_memory",
     "sparsity", "transducer", "xentropy",
 ]

@@ -1,0 +1,129 @@
+"""Halo-exchange strategy family (ref apex/contrib/bottleneck/
+halo_exchangers.py — HaloExchanger{NoComm,AllGather,SendRecv,Peer}).
+
+The reference offers four transports for the same edge exchange (NCCL
+all_gather, NCCL send/recv pairs, CUDA peer-to-peer memory, and a
+no-comm debug mode). On a TPU mesh the transport is XLA's choice — the
+strategies collapse to two real programs (`ppermute` neighbor shifts vs
+`all_gather` + slice) plus the no-comm identity, all with identical
+semantics: each rank receives its left neighbor's right edge and its
+right neighbor's left edge. Boundary ranks receive zeros (ppermute) /
+their own wrapped edge is never used by the bottleneck consumer, which
+only reads interior halos — same contract as the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "HaloExchanger", "HaloExchangerNoComm", "HaloExchangerAllGather",
+    "HaloExchangerSendRecv", "HaloExchangerPeer",
+    "left_right_halo_exchange",
+]
+
+
+def left_right_halo_exchange(left_output_halo, right_output_halo,
+                             axis_name: str = "spatial"):
+    """(left_input_halo, right_input_halo) — the neighbor shift every
+    exchanger implements (ref halo_exchangers.py:24,38,74,95):
+
+    - ``left_input_halo``  = LEFT  neighbor's ``right_output_halo``
+    - ``right_input_halo`` = RIGHT neighbor's ``left_output_halo``
+
+    Rank 0's left input and rank n-1's right input are zeros.
+    Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+    """
+    n = jax.lax.axis_size(axis_name)
+    to_right = [(i, i + 1) for i in range(n - 1)]
+    to_left = [(i, i - 1) for i in range(1, n)]
+    left_input = jax.lax.ppermute(right_output_halo, axis_name, to_right)
+    right_input = jax.lax.ppermute(left_output_halo, axis_name, to_left)
+    return left_input, right_input
+
+
+class HaloExchanger:
+    """Base (ref halo_exchangers.py:11): holds the mesh axis standing in
+    for the reference's (spatial_group_size, rank) pair."""
+
+    def __init__(self, spatial_group_size=None, rank=None,
+                 axis_name: str = "spatial"):
+        del spatial_group_size, rank  # mesh axis carries both on TPU
+        self.axis_name = axis_name
+
+    def left_right_halo_exchange(self, left_output_halo,
+                                 right_output_halo):
+        raise NotImplementedError
+
+
+class HaloExchangerNoComm(HaloExchanger):
+    """ref halo_exchangers.py:20 — no communication: each rank's own
+    edges come straight back swapped (single-rank/debug mode)."""
+
+    def __init__(self, world_size=None, spatial_group_size=None, rank=None,
+                 comm=None, axis_name: str = "spatial"):
+        super().__init__(spatial_group_size, rank, axis_name)
+        del world_size, comm
+
+    def left_right_halo_exchange(self, left_output_halo,
+                                 right_output_halo):
+        return right_output_halo, left_output_halo
+
+
+class HaloExchangerAllGather(HaloExchanger):
+    """ref halo_exchangers.py:31 — gather every rank's edges, pick the
+    neighbors'. More traffic than the shift but one collective."""
+
+    def __init__(self, world_size=None, spatial_group_size=None, rank=None,
+                 comm=None, axis_name: str = "spatial"):
+        super().__init__(spatial_group_size, rank, axis_name)
+        del world_size, comm
+
+    def left_right_halo_exchange(self, left_output_halo,
+                                 right_output_halo):
+        ax = self.axis_name
+        n = jax.lax.axis_size(ax)
+        rank = jax.lax.axis_index(ax)
+        rights = jax.lax.all_gather(right_output_halo, ax)  # [n, ...]
+        lefts = jax.lax.all_gather(left_output_halo, ax)
+        # neighbor picks, with boundary ranks zeroed to match ppermute
+        left_input = jnp.where(
+            rank > 0,
+            jax.lax.dynamic_index_in_dim(
+                rights, jnp.maximum(rank - 1, 0), 0, keepdims=False),
+            jnp.zeros_like(right_output_halo))
+        right_input = jnp.where(
+            rank < n - 1,
+            jax.lax.dynamic_index_in_dim(
+                lefts, jnp.minimum(rank + 1, n - 1), 0, keepdims=False),
+            jnp.zeros_like(left_output_halo))
+        return left_input, right_input
+
+
+class HaloExchangerSendRecv(HaloExchanger):
+    """ref halo_exchangers.py:64 — pairwise neighbor transfer; the
+    ppermute shift IS send/recv on the ICI torus."""
+
+    def __init__(self, world_size=None, spatial_group_size=None, rank=None,
+                 comm=None, axis_name: str = "spatial"):
+        super().__init__(spatial_group_size, rank, axis_name)
+        del world_size, comm
+
+    def left_right_halo_exchange(self, left_output_halo,
+                                 right_output_halo):
+        return left_right_halo_exchange(left_output_halo,
+                                        right_output_halo, self.axis_name)
+
+
+class HaloExchangerPeer(HaloExchangerSendRecv):
+    """ref halo_exchangers.py:81 — CUDA peer-memory transport; on TPU the
+    direct-neighbor ICI hop is exactly the ppermute shift, so this is
+    SendRecv with the reference's extra knobs accepted."""
+
+    def __init__(self, world_size=None, spatial_group_size=None, rank=None,
+                 comm=None, peer_pool=None, explicit_nhwc=False, numSM=1,
+                 axis_name: str = "spatial"):
+        super().__init__(world_size, spatial_group_size, rank, comm,
+                         axis_name=axis_name)
+        del peer_pool, explicit_nhwc, numSM

@@ -657,3 +657,46 @@ class TestMaskSoftmaxDropout:
         assert np.isfinite(np.asarray(g)).all()
         with pytest.raises(ValueError, match="divisible"):
             mask_softmax_dropout(x, None, heads=3)
+
+
+class TestHaloExchangers:
+    """ref contrib/bottleneck/halo_exchangers.py: every transport must
+    produce the same neighbor shift."""
+
+    def test_sendrecv_allgather_agree(self):
+        from apex_tpu.contrib.halo_exchangers import (
+            HaloExchangerAllGather, HaloExchangerNoComm,
+            HaloExchangerPeer, HaloExchangerSendRecv)
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("spatial",))
+        # per-rank distinct edges: [4, rows, C]
+        left = jnp.arange(4 * 2 * 3, dtype=jnp.float32).reshape(4, 2, 3)
+        right = left + 100.0
+
+        def run(exchanger):
+            def f(le, re):
+                li, ri = exchanger.left_right_halo_exchange(le[0], re[0])
+                return li[None], ri[None]
+            return shard_map(f, mesh=mesh,
+                             in_specs=(P("spatial"), P("spatial")),
+                             out_specs=(P("spatial"), P("spatial")))(
+                                 left, right)
+
+        li_sr, ri_sr = run(HaloExchangerSendRecv())
+        li_ag, ri_ag = run(HaloExchangerAllGather())
+        li_peer, ri_peer = run(HaloExchangerPeer())
+        np.testing.assert_allclose(np.asarray(li_sr), np.asarray(li_ag))
+        np.testing.assert_allclose(np.asarray(ri_sr), np.asarray(ri_ag))
+        np.testing.assert_allclose(np.asarray(li_sr), np.asarray(li_peer))
+        # rank r's left input = rank r-1's right edge; rank 0 zeros
+        np.testing.assert_allclose(np.asarray(li_sr[0]), 0.0)
+        np.testing.assert_allclose(np.asarray(li_sr[1:]),
+                                   np.asarray(right[:-1]))
+        # rank r's right input = rank r+1's left edge; last rank zeros
+        np.testing.assert_allclose(np.asarray(ri_sr[:-1]),
+                                   np.asarray(left[1:]))
+        np.testing.assert_allclose(np.asarray(ri_sr[-1]), 0.0)
+        # no-comm: swapped self-edges, no collective
+        li_nc, ri_nc = run(HaloExchangerNoComm())
+        np.testing.assert_allclose(np.asarray(li_nc), np.asarray(right))
+        np.testing.assert_allclose(np.asarray(ri_nc), np.asarray(left))
