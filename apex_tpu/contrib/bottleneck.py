@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from apex_tpu.contrib.peer_memory import halo_exchange_1d
 from apex_tpu.models.resnet import Bottleneck  # re-export (ref Bottleneck)
 
-__all__ = ["Bottleneck", "SpatialBottleneck"]
+__all__ = ["Bottleneck", "FrozenBatchNorm2d", "SpatialBottleneck"]
 
 
 class SpatialBottleneck(nn.Module):
@@ -71,3 +71,44 @@ class SpatialBottleneck(nn.Module):
             residual = bn()(conv(self.features * 4, (1, 1),
                                  self.strides)(residual), train)
         return nn.relu(y + residual)
+
+
+class FrozenBatchNorm2d(nn.Module):
+    """BatchNorm with FIXED statistics and affine params (ref
+    bottleneck.py FrozenBatchNorm2d — detection backbones freeze BN and
+    fold it into a per-channel scale/bias).
+
+    The four buffers live in the ``frozen`` variable collection (never
+    touched by optimizers); load them from a checkpoint and the module is
+    the affine map ``x * scale + bias`` with
+    ``scale = weight * rsqrt(running_var + eps)``,
+    ``bias = bias_param - running_mean * scale`` — one fused multiply-add
+    at inference, exactly the reference's folded form.
+    """
+
+    n: int
+    eps: float = 1e-5
+
+    def _scale_bias(self, nhwc: bool):
+        """The folded (scale, bias), broadcast-shaped — the ONE place the
+        fold formula lives."""
+        w = self.variable("frozen", "weight", lambda: jnp.ones((self.n,)))
+        b = self.variable("frozen", "bias", lambda: jnp.zeros((self.n,)))
+        rm = self.variable("frozen", "running_mean",
+                           lambda: jnp.zeros((self.n,)))
+        rv = self.variable("frozen", "running_var",
+                           lambda: jnp.ones((self.n,)))
+        scale = w.value * jax.lax.rsqrt(rv.value + self.eps)
+        bias = b.value - rm.value * scale
+        shape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        return scale.reshape(shape), bias.reshape(shape)
+
+    @nn.compact
+    def get_scale_bias(self, nhwc: bool = True):
+        """(scale, bias) reshaped for broadcast (ref get_scale_bias)."""
+        return self._scale_bias(nhwc)
+
+    @nn.compact
+    def __call__(self, x, nhwc: bool = True):
+        scale, bias = self._scale_bias(nhwc)
+        return x * scale.astype(x.dtype) + bias.astype(x.dtype)

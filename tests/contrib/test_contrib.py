@@ -700,3 +700,32 @@ class TestHaloExchangers:
         li_nc, ri_nc = run(HaloExchangerNoComm())
         np.testing.assert_allclose(np.asarray(li_nc), np.asarray(right))
         np.testing.assert_allclose(np.asarray(ri_nc), np.asarray(left))
+
+
+def test_frozen_batchnorm2d():
+    """ref bottleneck.py FrozenBatchNorm2d: fixed stats fold to one
+    scale/bias affine."""
+    from apex_tpu.contrib.bottleneck import FrozenBatchNorm2d
+
+    bn = FrozenBatchNorm2d(3)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 3))
+    v = bn.init(jax.random.PRNGKey(1), x)
+    # identity up to eps at default buffers
+    np.testing.assert_allclose(np.asarray(bn.apply(v, x)), np.asarray(x),
+                               rtol=1e-4, atol=1e-4)
+    v2 = {"frozen": {"weight": jnp.full((3,), 2.0),
+                     "bias": jnp.ones((3,)),
+                     "running_mean": jnp.full((3,), 0.5),
+                     "running_var": jnp.full((3,), 4.0)}}
+    y = bn.apply(v2, x)
+    want = (np.asarray(x) - 0.5) / np.sqrt(4.0 + 1e-5) * 2.0 + 1.0
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5)
+    scale, bias = bn.apply(v2, method="get_scale_bias", nhwc=True)
+    assert scale.shape == (1, 1, 1, 3)
+    np.testing.assert_allclose(np.asarray(scale[0, 0, 0]),
+                               2.0 / np.sqrt(4.0 + 1e-5), rtol=1e-6)
+    # NCHW layout broadcast
+    xc = jnp.moveaxis(x, -1, 1)
+    yc = bn.apply(v2, xc, nhwc=False)
+    np.testing.assert_allclose(np.asarray(jnp.moveaxis(yc, 1, -1)), want,
+                               rtol=1e-5)
