@@ -494,8 +494,12 @@ def bench_resnet(extras):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), bs, opt_state, loss
 
+    # 30 iters: a ResNet step is ~10-20 ms, so at the default 10 the
+    # ~79 ms tunnel fetch constant would be ~40% of the measured total
+    # and its jitter would dominate the per-step error
     step_t = time_train_step(
-        train_step, (params, batch_stats, opt_state), (x, labels))
+        train_step, (params, batch_stats, opt_state), (x, labels),
+        iters=30)
     extras["resnet50_step_ms"] = round(step_t * 1e3, 2)
     extras["resnet50_images_per_sec"] = round(B / step_t)
     print(f"resnet50: {step_t*1e3:.1f} ms/step  {B/step_t:.0f} im/s",
