@@ -1,0 +1,51 @@
+"""Automatic mixed precision (TPU re-design of ``apex.amp``).
+
+Ref: apex/amp/__init__.py. See frontend.py for the O0-O3 → TPU mapping.
+"""
+
+from apex_tpu.amp.frontend import (
+    O0,
+    O1,
+    O2,
+    O3,
+    Policy,
+    Properties,
+    initialize,
+    opt_levels,
+    state_dict,
+    load_state_dict,
+)
+from apex_tpu.amp.handle import AmpHandle, NoOpHandle
+from apex_tpu.amp._amp_state import master_params
+from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
+from apex_tpu.amp import lists
+from apex_tpu.amp.amp import (
+    amp_call,
+    casting,
+    current_policy,
+    float_function,
+    half_function,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
+
+__all__ = [
+    "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
+    "O0", "O1", "O2", "O3", "opt_levels",
+    "AmpHandle", "NoOpHandle", "master_params",
+    "LossScaler", "LossScaleState",
+    "scaled_update", "lists",
+    "amp_call", "casting", "current_policy", "half_function",
+    "float_function", "promote_function", "register_half_function",
+    "register_float_function", "register_promote_function",
+]
+
+
+def scale_loss(loss, optimizers=None):
+    """Module-level ``amp.scale_loss`` parity (ref apex/amp/handle.py:40)."""
+    from apex_tpu.amp._amp_state import _amp_state
+    if _amp_state.handle is None:
+        raise RuntimeError("amp.initialize must be called before amp.scale_loss")
+    return _amp_state.handle.scale_loss(loss, optimizers)

@@ -1,0 +1,49 @@
+"""Process-level amp registry (ref apex/amp/_amp_state.py).
+
+Holds the active :class:`~apex_tpu.amp.handle.AmpHandle` so module-level
+``amp.state_dict()`` / ``amp.load_state_dict()`` work like the reference.
+"""
+
+from __future__ import annotations
+
+
+class AmpState:
+    def __init__(self):
+        self.handle = None
+        self.opt_properties = None
+        self.verbosity = 1
+
+
+_amp_state = AmpState()
+
+
+def maybe_print(s: str, verbose: bool = False) -> None:
+    if _amp_state.verbosity > (0 if verbose else 1) or (verbose and _amp_state.verbosity > 0):
+        print(s)
+
+
+def warn_or_err(msg: str) -> None:
+    raise RuntimeError("\n".join(["", msg]))
+
+
+def master_params(optimizer):
+    """ref _amp_state.py:60 — iterate the (master, fp32 when O2) param
+    leaves owned by ``optimizer``. Works on a FusedOptimizer (yields the
+    master tree's leaves when amp attached fp32 masters, else the model
+    params), an FP16_Optimizer wrapper (whose masters live on the inner
+    optimizer), or a bare params tree."""
+    import jax
+
+    tree = getattr(optimizer, "master_params", None)
+    if tree is None and hasattr(optimizer, "optimizer"):
+        # FP16_Optimizer shape: the wrapped optimizer's params ARE the
+        # fp32 masters
+        tree = getattr(optimizer.optimizer, "params", None)
+    if tree is None:
+        tree = getattr(optimizer, "params", optimizer)
+    if tree is optimizer and not isinstance(
+            tree, (dict, list, tuple)) and not hasattr(tree, "shape"):
+        raise TypeError(
+            f"master_params: {type(optimizer).__name__} carries no "
+            "params/master_params tree")
+    yield from jax.tree_util.tree_leaves(tree)

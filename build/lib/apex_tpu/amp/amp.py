@@ -1,0 +1,145 @@
+"""O1 boundary casting — the mechanism behind the casting lists.
+
+TPU re-design of apex/amp/amp.py:1-177 (half/float/promote function
+registration) + apex/amp/wrap.py (cast-before-call wrappers). The reference
+monkeypatches torch functions at ``amp.initialize`` time; under XLA nothing
+can (or should) be patched, so the same classification
+(:mod:`apex_tpu.amp.lists`) is applied *at the call boundary*:
+library entry points (mlp, fused_dense, xentropy, multihead_attn) route
+their calls through :func:`amp_call`, which casts floating-point array
+arguments per the active O1 policy. With no active policy every wrapper is
+an exact identity, so O0 code traces to the unchanged jaxpr.
+
+Casting decisions are made at *trace* time (they read the process-global
+amp handle), so — as with every JAX configuration — ``amp.initialize``
+must run before the first jit trace of the functions it should affect.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+from apex_tpu.amp._amp_state import _amp_state
+
+_policy_override = None
+
+
+def current_policy():
+    """The active O1 policy, or None when boundary casting is off.
+
+    An explicit :func:`casting` context beats the process-global handle;
+    the handle applies only when its opt level enables function patching
+    (O1 — ``patch_jax_functions``).
+    """
+    if _policy_override is not None:
+        return _policy_override
+    h = _amp_state.handle
+    if (h is not None and h.props.enabled and h.props.patch_jax_functions):
+        return h.policy
+    return None
+
+
+@contextlib.contextmanager
+def casting(policy):
+    """Force an O1 policy for the duration (tests / local overrides)."""
+    global _policy_override
+    prev = _policy_override
+    _policy_override = policy
+    try:
+        yield
+    finally:
+        _policy_override = prev
+
+
+def _is_float_array(x) -> bool:
+    return (hasattr(x, "dtype") and hasattr(x, "astype")
+            and jnp.issubdtype(x.dtype, jnp.floating))
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float_array(x) else x, tree)
+
+
+def _widest_float_dtype(trees) -> Optional[jnp.dtype]:
+    dtype = None
+    for leaf in jax.tree_util.tree_leaves(trees):
+        if _is_float_array(leaf):
+            dtype = leaf.dtype if dtype is None else jnp.promote_types(
+                dtype, leaf.dtype)
+    return dtype
+
+
+def _cast_call(category, fn, args, kwargs):
+    policy = current_policy()
+    if policy is None:
+        return fn(*args, **kwargs)
+    if category == "compute":
+        dtype = policy.compute_dtype
+    elif category == "fp32":
+        dtype = jnp.float32
+    else:  # promote: widest floating input wins (ref tensor_overrides CASTS)
+        dtype = _widest_float_dtype((args, kwargs))
+        if dtype is None:
+            return fn(*args, **kwargs)
+    return fn(*_cast_tree(args, dtype), **_cast_tree(kwargs, dtype))
+
+
+def amp_call(op_name: str, fn, *args, **kwargs):
+    """Call ``fn`` with inputs cast per the O1 policy and the op's
+    classification in :mod:`apex_tpu.amp.lists` (the wrap.py analog)."""
+    return _cast_call(lists.classify(op_name), fn, args, kwargs)
+
+
+def _wrap(fn, category):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return _cast_call(category, fn, args, kwargs)
+
+    wrapper.__wrapped_amp_category__ = category
+    return wrapper
+
+
+def half_function(fn):
+    """Inputs cast to the compute (bf16/fp16) dtype under O1
+    (ref apex/amp/amp.py:half_function)."""
+    return _wrap(fn, "compute")
+
+
+def float_function(fn):
+    """Inputs forced to fp32 under O1 (ref amp.py:float_function)."""
+    return _wrap(fn, "fp32")
+
+
+def promote_function(fn):
+    """Inputs widened to the widest floating input dtype under O1
+    (ref amp.py:promote_function)."""
+    return _wrap(fn, "promote")
+
+
+def _register(module, name, category):
+    fn = getattr(module, name)
+    if getattr(fn, "__wrapped_amp_category__", None) == category:
+        return  # idempotent
+    setattr(module, name, _wrap(fn, category))
+
+
+def register_half_function(module, function_name):
+    """Wrap ``module.function_name`` for compute-precision casting
+    (ref amp.py:register_half_function — but only apex_tpu's own modules
+    can be registered; jax itself is never patched)."""
+    _register(module, function_name, "compute")
+
+
+def register_float_function(module, function_name):
+    _register(module, function_name, "fp32")
+
+
+def register_promote_function(module, function_name):
+    _register(module, function_name, "promote")

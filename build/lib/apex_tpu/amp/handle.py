@@ -1,0 +1,261 @@
+"""AmpHandle + ``scale_loss`` — TPU re-design of ``apex.amp.handle``.
+
+Ref: apex/amp/handle.py. The reference's ``with amp.scale_loss(loss, opt)``
+multiplies the loss, then unscales grads and maybe skips ``opt.step()`` on
+exit. JAX gradients are functional, so the handle exposes both:
+
+- the **functional protocol** (use inside jit):
+  ``scaled = handle.scale_loss(loss, sstate)`` →
+  ``grads = jax.grad(...)`` →
+  ``updates, opt_state, sstate, overflow = handle.scaled_update(tx, grads, ...)``
+- a **stateful convenience** mirroring apex: a ``with handle.scale_loss(loss)
+  as scaled:`` context (host-level loop only) whose scaler state lives on the
+  handle, plus FusedOptimizer integration via :meth:`attach`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import Policy, Properties
+from apex_tpu.amp.scaler import LossScaler, scaled_update as _scaled_update
+
+
+class AmpHandle:
+    def __init__(self, props: Properties, min_loss_scale=None,
+                 max_loss_scale=2.0 ** 24, half_dtype=jnp.bfloat16):
+        self.props = props
+        compute = half_dtype if props.opt_level in ("O1", "O2", "O3") else jnp.float32
+        param = props.cast_model_type or jnp.float32
+        self.policy = Policy(
+            param_dtype=param,
+            compute_dtype=compute if props.enabled else jnp.float32,
+            output_dtype=jnp.float32,
+            keep_batchnorm_fp32=bool(props.keep_batchnorm_fp32)
+            if props.keep_batchnorm_fp32 is not None else True,
+        )
+        self.scaler = LossScaler(
+            loss_scale=props.loss_scale if props.enabled else 1.0,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+            enabled=props.enabled and props.loss_scale != 1.0,
+        )
+        self.scaler_state = self.scaler.init()
+        self._optimizers = []
+
+    # ---- functional protocol ----------------------------------------------
+
+    def scale(self, loss, scaler_state=None):
+        return self.scaler.scale_loss(
+            loss, scaler_state if scaler_state is not None else self.scaler_state)
+
+    def scaled_update(self, tx, grads, opt_state, params, scaler_state,
+                      overflow_reduce_axes=()):
+        return _scaled_update(tx, self.scaler, grads, opt_state, params,
+                              scaler_state,
+                              overflow_reduce_axes=overflow_reduce_axes)
+
+    # ---- stateful convenience (host-level loops) --------------------------
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        """``with handle.scale_loss(loss) as scaled_loss:`` (ref handle.py:40).
+
+        Yields the scaled loss; the matching unscale+skip runs inside the
+        attached optimizer's ``step`` (see :meth:`attach`).
+        """
+        yield self.scale(loss)
+
+    def attach(self, optimizers):
+        """Patch FusedOptimizer.step to unscale, skip-on-overflow, advance the
+        dynamic scale, and (O2) keep fp32 master weights — the
+        ``_process_optimizer`` analog (ref apex/amp/_process_optimizer.py).
+
+        The whole amp step is jitted ONCE per optimizer with the scaler state
+        as a traced argument, so repeated ``step`` calls hit the compilation
+        cache and the loss scale evolves on device.
+        """
+        if not isinstance(optimizers, (list, tuple)):
+            optimizers = [optimizers]
+        for opt in optimizers:
+            if any(o is opt for o in self._optimizers):
+                continue
+            self._optimizers.append(opt)
+            scaler = self.scaler
+            tx = opt.tx
+            use_master = bool(self.props.master_weights)
+            if use_master:
+                # fp32 master copy; the model params stay in their (half) dtype
+                # and are re-materialized from the master each step
+                # (ref _process_optimizer.py master param setup).
+                opt.master_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(jnp.float32), opt.params)
+                # moments must match the master tree's dtype/shape
+                opt.state = tx.init(opt.master_params)
+
+            import optax as _optax
+
+            # NB: bind per-optimizer values as defaults — jit traces lazily at
+            # the first step() call, which can happen after this loop has
+            # moved on to the next optimizer.
+            def amp_step(grads, state, params, master, scaler_state,
+                         tx=tx, use_master=use_master, scaler=scaler):
+                unscaled, overflow = scaler.unscale(grads, scaler_state)
+                opt_params = master if use_master else params
+                g32 = (jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), unscaled)
+                    if use_master else unscaled)
+
+                def do(_):
+                    updates, new_state = tx.update(g32, state, opt_params)
+                    return _optax.apply_updates(opt_params, updates), new_state
+
+                new_opt_params, new_state = jax.lax.cond(
+                    overflow, lambda _: (opt_params, state), do, None)
+                if use_master:
+                    new_params = jax.tree_util.tree_map(
+                        lambda m, p: m.astype(p.dtype), new_opt_params, params)
+                    new_master = new_opt_params
+                else:
+                    new_params, new_master = new_opt_params, master
+                new_sstate = scaler.update(scaler_state, overflow)
+                return new_params, new_master, new_state, new_sstate, overflow
+
+            jitted = jax.jit(amp_step)
+            handle = self
+
+            def step(grads=None, closure=None, _opt=opt, _jitted=jitted,
+                     _use_master=use_master):
+                loss = closure() if closure is not None else None
+                if grads is None:
+                    raise ValueError("pass grads to step()")
+                (_opt.params, master, _opt.state,
+                 handle.scaler_state, _) = _jitted(
+                    grads, _opt.state, _opt.params,
+                    getattr(_opt, "master_params", _opt.params),
+                    handle.scaler_state)
+                if _use_master:
+                    _opt.master_params = master
+                return loss if loss is not None else _opt.params
+
+            opt.step = step
+
+    # ---- reference-parity surface (ref handle.py AmpHandle) ---------------
+
+    @property
+    def is_active(self) -> bool:
+        """ref handle.py:179 — True while amp is enabled."""
+        return bool(self.props.enabled)
+
+    @property
+    def verbose(self) -> bool:
+        """ref handle.py verbose flag (initialize(verbosity=...))."""
+        from apex_tpu.amp._amp_state import _amp_state
+        return getattr(_amp_state, "verbosity", 1) > 1
+
+    # The reference caches casted tensors to dodge repeated fp16 copies
+    # (handle.py cache/has_cache/remove_cache). Under XLA the compilation
+    # cache plays that role — casts are fused into the jitted program and
+    # never re-materialized — so the cache is always empty here; the API
+    # exists so reference-shaped training loops run unchanged.
+
+    @property
+    def cache(self) -> dict:
+        return {}
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def remove_cache(self) -> None:
+        return None
+
+    _clear_cache = remove_cache
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        """ref handle.py:188 — attach amp's unscale/skip/regrow protocol
+        to one optimizer and return it (ours patches ``step`` in place
+        via :meth:`attach`; ``num_loss`` is accepted for parity — each
+        loss shares the one in-graph scaler)."""
+        del num_loss
+        self.attach([optimizer])
+        return optimizer
+
+    @contextlib.contextmanager
+    def disable_casts(self):
+        """ref handle.py:164 — a region where mixed precision is off:
+        the policy's compute/param dtype is fp32 inside the context, so
+        ``cast_to_compute`` upcasts half inputs to fp32 instead of
+        casting to the half dtype (apex semantics: with casts disabled,
+        ops run at fp32). Only affects traces made INSIDE the region — a
+        step already jitted against the old policy keeps its baked-in
+        casts, exactly like a torch function captured before unpatching."""
+        prev = self.policy
+        self.policy = dataclasses.replace(
+            prev, compute_dtype=jnp.float32, param_dtype=jnp.float32)
+        try:
+            yield
+        finally:
+            self.policy = prev
+
+    # ---- checkpointing -----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return self.scaler.state_dict(self.scaler_state)
+
+    def load_state_dict(self, d: dict) -> None:
+        self.scaler_state = self.scaler.load_state_dict(d)
+
+
+class NoOpHandle:
+    """ref handle.py:254 — the handle used when amp is disabled: every
+    operation is the identity."""
+
+    @property
+    def is_active(self) -> bool:
+        return False
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer=None):
+        yield loss
+
+    def scale(self, loss, scaler_state=None):
+        return loss
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        del num_loss
+        return optimizer
+
+    @contextlib.contextmanager
+    def disable_casts(self):
+        yield
+
+    # same parity surface as AmpHandle — a loop handed either handle
+    # must not AttributeError when amp is toggled off
+    @property
+    def verbose(self) -> bool:
+        return False
+
+    @property
+    def cache(self) -> dict:
+        return {}
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def remove_cache(self) -> None:
+        return None
+
+    _clear_cache = remove_cache
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict) -> None:
+        del d

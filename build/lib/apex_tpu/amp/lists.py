@@ -1,0 +1,42 @@
+"""Op-category precision tables — TPU re-design of ``apex.amp.lists``.
+
+Ref: apex/amp/lists/{functional_overrides,torch_overrides,tensor_overrides}.py.
+
+The reference monkeypatches torch functions at O1 so MXU-friendly ops run
+fp16 and range-sensitive ops run fp32. Under XLA nothing can (or should) be
+patched — casting is decided where the op is *called*. These tables encode
+the same classification for JAX ops; ``Policy.run_fp32`` /
+``Policy.cast_to_compute`` (frontend.py) and the fused kernels consume them:
+every apex_tpu fused kernel (layer_norm, softmax, cross-entropy) already
+computes fp32 internally regardless of storage dtype, which is exactly the
+behavior the FP32_FUNCS list enforces on GPU.
+"""
+
+# MXU-friendly: run in compute (bf16/fp16) precision — ref functional_overrides.py FP16_FUNCS
+COMPUTE_PRECISION_OPS = frozenset({
+    "dot", "dot_general", "conv", "conv_general_dilated", "einsum", "matmul",
+    "dense", "linear", "attention_qk", "attention_av",
+})
+
+# Range-sensitive: force fp32 math — ref functional_overrides.py FP32_FUNCS
+FP32_OPS = frozenset({
+    "softmax", "log_softmax", "layer_norm", "rms_norm", "batch_norm",
+    "group_norm", "cross_entropy", "nll_loss", "mse_loss", "cosine_similarity",
+    "exp", "log", "pow", "sum", "mean", "var", "std", "norm", "cumsum",
+    "erf", "erfinv", "softplus", "sigmoid_focal_loss",
+})
+
+# Type-promotion ops: widest input dtype wins — ref tensor_overrides.py CASTS
+PROMOTE_OPS = frozenset({
+    "add", "sub", "mul", "div", "where", "concatenate", "stack", "maximum",
+    "minimum",
+})
+
+
+def classify(op_name: str) -> str:
+    """Return 'compute', 'fp32', or 'promote' for an op name."""
+    if op_name in COMPUTE_PRECISION_OPS:
+        return "compute"
+    if op_name in FP32_OPS:
+        return "fp32"
+    return "promote"

@@ -1,0 +1,198 @@
+"""Loss scaling — TPU re-design of ``apex.amp.scaler.LossScaler``.
+
+Ref: apex/amp/scaler.py (+ apex/fp16_utils/loss_scaler.py).
+
+The CUDA scaler syncs an overflow flag to the host every step
+(``overflow = scale_check.item()``) and skips ``optimizer.step()`` in Python.
+Here the whole protocol is in-graph: the overflow check is a fused
+``isfinite`` reduction, the skip is a ``lax.cond``/``where``, and the
+dynamic-scale automaton (halve on overflow, double every ``scale_window``
+clean steps) updates as traced arithmetic — zero host syncs per step.
+
+bf16 training on TPU usually needs no loss scaling (bf16 has fp32's
+exponent range); the scaler exists for fp16 parity and for gradient-range
+safety nets. ``LossScaler(enabled=False)`` compiles to nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _promote_varying(x, axes):
+    """Mark ``x`` varying over the mesh axes in ``axes`` it isn't already
+    (no-op outside shard_map / for already-varying values), with the
+    pcast→pvary fallback for older jax."""
+    try:
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    except Exception:
+        have = frozenset()
+    missing = tuple(sorted(set(axes) - set(have)))
+    if not missing:
+        return x
+    try:
+        return jax.lax.pcast(x, missing, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, missing)
+
+
+class LossScaleState(NamedTuple):
+    """Functional scaler state (carried through the jitted train step)."""
+
+    loss_scale: jax.Array      # f32 scalar
+    unskipped: jax.Array       # i32: clean steps since last rescale (ref scaler.py:_unskipped)
+    overflows: jax.Array       # i32: total overflow count (diagnostics)
+
+
+class LossScaler:
+    """Static + dynamic loss scaling with in-graph overflow skip.
+
+    ``dynamic=True`` mirrors apex's default dynamic scaler
+    (init 2**16, x2 growth every 2000 unskipped steps, /2 on overflow).
+    """
+
+    def __init__(self, loss_scale="dynamic", init_scale=2.0 ** 16,
+                 scale_factor=2.0, scale_window=2000,
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24, enabled=True,
+                 backoff_factor=None):
+        self.dynamic = loss_scale == "dynamic"
+        self._static_scale = 1.0 if self.dynamic else float(loss_scale)
+        self.init_scale = init_scale if self.dynamic else self._static_scale
+        self.scale_factor = scale_factor
+        # apex default: backoff is symmetric (1/growth); torch-GradScaler
+        # style asymmetric backoff is supported via an explicit factor
+        self.backoff_factor = (1.0 / scale_factor if backoff_factor is None
+                               else backoff_factor)
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+        self.enabled = enabled
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale if self.enabled else 1.0, jnp.float32),
+            unskipped=jnp.zeros([], jnp.int32),
+            overflows=jnp.zeros([], jnp.int32),
+        )
+
+    # ---- in-graph protocol -------------------------------------------------
+
+    def scale_loss(self, loss, state: LossScaleState):
+        """Ref apex/amp/handle.py:scale_loss — multiply before backward."""
+        if not self.enabled:
+            return loss
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(self, grads, state: LossScaleState):
+        """Unscale grads and detect inf/nan in one fused pass.
+
+        Returns ``(unscaled_grads, overflow)``; overflow is a traced bool
+        (ref apex/amp/scaler.py:unscale + axpby_check_overflow).
+        """
+        if not self.enabled:
+            return grads, jnp.zeros([], jnp.bool_)
+        inv = 1.0 / state.loss_scale
+        unscaled = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        leaves = jax.tree_util.tree_leaves(unscaled)
+        finite = jnp.array(True)
+        for l in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l)))
+        return unscaled, jnp.logical_not(finite)
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Dynamic-scale automaton (ref apex/amp/scaler.py:update_scale)."""
+        if not self.enabled or not self.dynamic:
+            return state
+        halved = state.loss_scale * self.backoff_factor
+        if self.min_loss_scale is not None:  # ref default: no floor
+            halved = jnp.maximum(halved, self.min_loss_scale)
+        new_scale = jnp.where(
+            overflow,
+            halved,
+            jnp.where(
+                state.unskipped + 1 >= self.scale_window,
+                jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale),
+                state.loss_scale,
+            ),
+        )
+        new_unskipped = jnp.where(
+            overflow | (state.unskipped + 1 >= self.scale_window),
+            0, state.unskipped + 1).astype(jnp.int32)
+        return LossScaleState(
+            loss_scale=new_scale,
+            unskipped=new_unskipped,
+            overflows=state.overflows + overflow.astype(jnp.int32),
+        )
+
+    def loss_scale(self, state: LossScaleState):
+        return state.loss_scale
+
+    # ---- checkpointing (ref apex/amp/frontend.py:state_dict) --------------
+
+    def state_dict(self, state: LossScaleState) -> dict:
+        return {
+            "loss_scale": jax.device_get(state.loss_scale).item(),
+            "unskipped": jax.device_get(state.unskipped).item(),
+            "overflows": jax.device_get(state.overflows).item(),
+        }
+
+    def load_state_dict(self, d: dict) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            overflows=jnp.asarray(d.get("overflows", 0), jnp.int32),
+        )
+
+
+def scaled_update(tx, scaler: LossScaler, grads, opt_state, params,
+                  scaler_state, overflow_reduce_axes=()):
+    """One amp step: unscale → overflow check → conditional optimizer update.
+
+    The TPU-native equivalent of apex's ``scale_loss`` context epilogue +
+    patched ``optimizer.step`` skip (ref apex/amp/_process_optimizer.py).
+    On overflow the optimizer state and params are left untouched via
+    ``lax.cond`` — the whole step stays on device.
+
+    Inside ``shard_map``, pass every mesh axis name in
+    ``overflow_reduce_axes``: the overflow flag is psum-voted across them
+    so ALL ranks take the same cond branch (the in-graph analog of the
+    reference's NCCL-allreduced overflow buffer,
+    ref apex/amp/scaler.py:unscale_with_stashed + _amp_state master flag).
+
+    Returns ``(updates, new_opt_state, new_scaler_state, overflow)``.
+    """
+    unscaled, overflow = scaler.unscale(grads, scaler_state)
+    if overflow_reduce_axes:
+        ovf = _promote_varying(overflow.astype(jnp.float32),
+                               overflow_reduce_axes)
+        overflow = jax.lax.psum(ovf, tuple(overflow_reduce_axes)) > 0
+
+    def do_update(_):
+        return tx.update(unscaled, opt_state, params)
+
+    # both cond branches must produce identical avals; derive the skip
+    # branch's zeros from the update branch's output shapes/dtypes (updates
+    # may be in grad dtype while params are in model dtype). Under
+    # shard_map the update branch's avals can be VARYING over mesh axes
+    # (e.g. grads a custom_vjp kernel left per-device local) — match each
+    # leaf's vma or lax.cond rejects the branches with a type error.
+    out_shapes = jax.eval_shape(do_update, None)
+
+    def _match_vma(x, sd):
+        return _promote_varying(x, getattr(sd, "vma", frozenset())
+                                or frozenset())
+
+    def skip(_):
+        zeros = jax.tree_util.tree_map(
+            lambda sd: _match_vma(jnp.zeros(sd.shape, sd.dtype), sd),
+            out_shapes[0])
+        kept = jax.tree_util.tree_map(_match_vma, opt_state, out_shapes[1])
+        return zeros, kept
+
+    updates, new_opt_state = jax.lax.cond(overflow, skip, do_update, None)
+    new_scaler_state = scaler.update(scaler_state, overflow)
+    return updates, new_opt_state, new_scaler_state, overflow

@@ -1,0 +1,23 @@
+"""apex.contrib parity surface (ref apex/contrib/__init__.py)."""
+
+from apex_tpu.contrib import (
+    bottleneck,
+    clip_grad,
+    conv_bias_relu,
+    fmha,
+    focal_loss,
+    groupbn,
+    layer_norm,
+    multihead_attn,
+    optimizers,
+    peer_memory,
+    sparsity,
+    transducer,
+    xentropy,
+)
+
+__all__ = [
+    "bottleneck", "clip_grad", "conv_bias_relu", "fmha", "focal_loss",
+    "groupbn", "layer_norm", "multihead_attn", "optimizers", "peer_memory",
+    "sparsity", "transducer", "xentropy",
+]

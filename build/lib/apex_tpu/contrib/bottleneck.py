@@ -1,0 +1,73 @@
+"""Fused ResNet bottleneck (ref apex/contrib/bottleneck/bottleneck.py
+Bottleneck/SpatialBottleneck).
+
+The CUDA version hand-fuses conv+bn+relu chains and, for
+SpatialBottleneck, overlaps halo exchange with the 3x3 conv. On TPU the
+plain Bottleneck IS :class:`apex_tpu.models.resnet.Bottleneck` (XLA fuses
+the chain); SpatialBottleneck adds the ppermute halo exchange from
+:mod:`apex_tpu.contrib.peer_memory` around the spatially-sharded 3x3.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.peer_memory import halo_exchange_1d
+from apex_tpu.models.resnet import Bottleneck  # re-export (ref Bottleneck)
+
+__all__ = ["Bottleneck", "SpatialBottleneck"]
+
+
+class SpatialBottleneck(nn.Module):
+    """Bottleneck whose feature map is H-sharded across ``axis_name``
+    (ref bottleneck.py SpatialBottleneck: spatial group + halo exchange).
+
+    The 3x3 conv needs one halo row from each neighbour; the exchange rides
+    ICI via ppermute, then the conv runs on the padded slab and the halo
+    rows are dropped again.
+
+    Downsampling always uses the v1 placement (stride on the first 1x1 —
+    the reference's spatial path forces ``stride_1x1`` too), so for parity
+    with a non-sharded model build its blocks with
+    ``Bottleneck(stride_1x1=True)``.
+    """
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    axis_name: str = "spatial"
+    sync_bn: bool = False
+    bn_axis: Optional[str] = "data"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from apex_tpu.models._common import BatchNorm
+
+        conv = lambda f, k, s=(1, 1): nn.Conv(  # noqa: E731
+            f, k, strides=s, use_bias=False, dtype=x.dtype)
+        bn = lambda: BatchNorm(sync=self.sync_bn, axis_name=self.bn_axis)  # noqa: E731
+
+        residual = x
+        # Downsampling stride lives on the first 1x1 (the reference's
+        # spatial path forces stride_1x1, bottleneck.py SpatialBottleneck):
+        # a strided per-shard 3x3 would break the residual-add shape and the
+        # global stride phase across H-shards.
+        y = nn.relu(bn()(conv(self.features, (1, 1), self.strides)(x),
+                         train))
+        # 3x3 on the H-sharded slab: pad a 1-row halo, exchange, conv VALID
+        pad = [(0, 0)] * y.ndim
+        pad[1] = (1, 1)
+        y_h = jnp.pad(y, pad)
+        y_h = halo_exchange_1d(y_h, 1, self.axis_name, h_dim=1)
+        y = nn.Conv(self.features, (3, 3), strides=(1, 1),
+                    use_bias=False, padding=((0, 0), (1, 1)),
+                    dtype=x.dtype)(y_h)
+        y = nn.relu(bn()(y, train))
+        y = bn()(conv(self.features * 4, (1, 1))(y), train)
+        if residual.shape != y.shape:
+            residual = bn()(conv(self.features * 4, (1, 1),
+                                 self.strides)(residual), train)
+        return nn.relu(y + residual)

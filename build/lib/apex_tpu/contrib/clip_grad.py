@@ -1,0 +1,39 @@
+"""Fused gradient clipping (apex master's apex/contrib/clip_grad — absent
+from this reference snapshot but part of the apex surface; semantics follow
+torch.nn.utils.clip_grad_norm_ with the multi-tensor fused norm).
+
+Delegates to :func:`apex_tpu.fp16_utils.fp16util.clip_grad_norm` (one
+implementation of the global-norm clip) and adds the torch-style
+``error_if_nonfinite`` check.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils.fp16util import clip_grad_norm as _clip_grad_norm
+
+
+def clip_grad_norm_(parameters, max_norm: float,
+                    norm_type: Union[float, int] = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Returns ``(clipped_grads, total_norm)`` — functional: the input tree
+    is not mutated (there is no ``.grad`` storage on TPU)."""
+    clipped, total_norm = _clip_grad_norm(parameters, max_norm,
+                                          float(norm_type))
+    if error_if_nonfinite:
+        # traced check is impossible without host sync; mirror torch by
+        # checking eagerly when the value is concrete
+        try:
+            if not bool(jnp.isfinite(total_norm)):
+                raise RuntimeError(
+                    f"the total norm of order {norm_type} is non-finite")
+        except jax.errors.TracerBoolConversionError:
+            pass
+    return clipped, total_norm
+
+
+clip_grad_norm = clip_grad_norm_

@@ -1,0 +1,42 @@
+"""Fused conv+bias(+relu/+mask) ops (ref apex/contrib/conv_bias_relu/
+conv_bias_relu.py via cudnn fused runner). XLA fuses the epilogue into the
+conv on TPU; these entry points pin the exact semantics (NHWC, bias over
+channels, optional residual mask).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, weight, padding, stride):
+    """NHWC conv; weight [kh, kw, cin, cout] (TPU-native layout)."""
+    return jax.lax.conv_general_dilated(
+        x, weight, window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def ConvBias(x, weight, bias, padding: int = 0, stride: int = 1):
+    """ref ConvBias_ (conv_bias_relu.py:56)."""
+    return _conv(x, weight, padding, stride) + bias
+
+
+def ConvBiasReLU(x, weight, bias, padding: int = 0, stride: int = 1):
+    """ref ConvBiasReLU_ (conv_bias_relu.py:12)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding: int = 0, stride: int = 1):
+    """ref ConvBiasMaskReLU_ (conv_bias_relu.py:34): masked residual add
+    before the relu."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride) * mask)
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, padding: int = 0,
+                            stride: int = 1):
+    """ref conv_bias_relu.py ConvFrozenScaleBiasReLU_: conv then frozen-BN
+    affine then relu."""
+    return jax.nn.relu(_conv(x, weight, padding, stride) * scale + bias)

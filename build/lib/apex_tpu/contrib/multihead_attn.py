@@ -1,0 +1,155 @@
+"""Multi-head attention modules (ref apex/contrib/multihead_attn/
+{self,encdec}_multihead_attn.py and *_norm_add variants).
+
+The reference offers fused qkv gemms + fused softmax + (optionally) a
+fused residual-add+layernorm prologue. Here each module is a flax module
+over the same packed-projection layout, with the Pallas flash attention in
+the middle and the fused LN from apex_tpu.normalization for the norm-add
+variants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.transformer.functional.fused_softmax import scaled_masked_softmax
+
+
+def _masked_attention(q, k, v, key_padding_mask, attn_mask, scale,
+                      dropout_p=0.0, dropout_rng=None):
+    """[b, s, h, d] attention with torch-style masks (ref
+    self_multihead_attn.py:144-156):
+
+    - ``key_padding_mask`` [b, sk], True = pad: padded KEYS are excluded
+      from every query's softmax.
+    - ``attn_mask`` [sq, sk], bool (True = masked) or additive float
+      (-inf = masked), applied to every batch/head.
+    - ``dropout_p``/``dropout_rng``: inverted dropout on the softmax
+      probabilities (ref self_multihead_attn_func.py:100 fused dropout).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    b, _, sq, sk = scores.shape
+    mask = None  # built lazily: all-additive masks need no bool mask at all
+    if key_padding_mask is not None:
+        mask = jnp.broadcast_to(key_padding_mask[:, None, None, :],
+                                (b, 1, sq, sk))
+    if attn_mask is not None:
+        if jnp.issubdtype(attn_mask.dtype, jnp.integer):
+            # torch-style byte/int mask (nonzero = masked): treat as bool
+            # rather than silently ADDING it to the scores
+            attn_mask = attn_mask != 0
+        if attn_mask.dtype == jnp.bool_:
+            am = jnp.broadcast_to(attn_mask[None, None, :, :],
+                                  (b, 1, sq, sk))
+            mask = am if mask is None else mask | am
+        else:  # additive float mask: fold into the (scaled) scores
+            scores = scores + attn_mask[None, None, :, :] / scale
+    probs = scaled_masked_softmax(scores, mask, scale).astype(v.dtype)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """ref self_multihead_attn.py:27 (impl='fast').
+
+    Input [s, b, h] (torch MHA layout). ``include_norm_add`` prepends
+    residual-add + layernorm (ref self_multihead_attn_norm_add).
+    """
+
+    hidden_dim: int
+    heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+
+    @nn.compact
+    def __call__(self, query, key_padding_mask=None, attn_mask=None,
+                 is_training: bool = True, deterministic: Optional[bool] = None):
+        s, b, h = query.shape
+        d = h // self.heads
+        x = query
+        if self.include_norm_add:
+            w = self.param("lyr_nrm_gamma_weights", nn.initializers.ones, (h,))
+            bta = self.param("lyr_nrm_beta_weights", nn.initializers.zeros, (h,))
+            x = fused_layer_norm_affine(x, w, bta, (h,))
+        if self.separate_qkv_params:
+            q = nn.Dense(h, use_bias=self.bias, name="q_proj")(x)
+            k = nn.Dense(h, use_bias=self.bias, name="k_proj")(x)
+            v = nn.Dense(h, use_bias=self.bias, name="v_proj")(x)
+        else:
+            qkv = nn.Dense(3 * h, use_bias=self.bias, name="qkv_proj")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads_first(t):
+            return t.transpose(1, 0, 2).reshape(b, s, self.heads, d)
+
+        # dropout applies to the softmax PROBS (ref
+        # self_multihead_attn_func.py:100), not the output projection
+        det = (not is_training) if deterministic is None else deterministic
+        drop = 0.0 if det else self.dropout
+        rng = self.make_rng("dropout") if drop > 0.0 else None
+        if key_padding_mask is not None or attn_mask is not None:
+            o = _masked_attention(heads_first(q), heads_first(k),
+                                  heads_first(v), key_padding_mask,
+                                  attn_mask, d ** -0.5,
+                                  dropout_p=drop, dropout_rng=rng)
+        else:
+            o = flash_attention(heads_first(q), heads_first(k),
+                                heads_first(v), causal=False,
+                                scale=d ** -0.5, dropout_p=drop,
+                                dropout_key=rng, deterministic=det)
+        o = o.reshape(b, s, h).transpose(1, 0, 2)
+        o = nn.Dense(h, use_bias=self.bias, name="out_proj")(o)
+        if self.include_norm_add:
+            o = o + query  # fused residual add (ref *_norm_add backward)
+        return o
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """ref encdec_multihead_attn.py: q from decoder, k/v from encoder."""
+
+    hidden_dim: int
+    heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+
+    @nn.compact
+    def __call__(self, query, key, is_training: bool = True,
+                 deterministic: Optional[bool] = None):
+        sq, b, h = query.shape
+        sk = key.shape[0]
+        d = h // self.heads
+        x = query
+        if self.include_norm_add:
+            w = self.param("lyr_nrm_gamma_weights", nn.initializers.ones, (h,))
+            bta = self.param("lyr_nrm_beta_weights", nn.initializers.zeros, (h,))
+            x = fused_layer_norm_affine(x, w, bta, (h,))
+        q = nn.Dense(h, use_bias=self.bias, name="q_proj")(x)
+        kv = nn.Dense(2 * h, use_bias=self.bias, name="kv_proj")(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        q4 = q.transpose(1, 0, 2).reshape(b, sq, self.heads, d)
+        k4 = k.transpose(1, 0, 2).reshape(b, sk, self.heads, d)
+        v4 = v.transpose(1, 0, 2).reshape(b, sk, self.heads, d)
+        det = (not is_training) if deterministic is None else deterministic
+        drop = 0.0 if det else self.dropout
+        rng = self.make_rng("dropout") if drop > 0.0 else None
+        o = flash_attention(q4, k4, v4, causal=False, scale=d ** -0.5,
+                            dropout_p=drop, dropout_key=rng,
+                            deterministic=det)
+        o = o.reshape(b, sq, h).transpose(1, 0, 2)
+        o = nn.Dense(h, use_bias=self.bias, name="out_proj")(o)
+        if self.include_norm_add:
+            o = o + query
+        return o

@@ -1,0 +1,17 @@
+"""apex.contrib.optimizers parity (ref apex/contrib/optimizers/)."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    distributed_fused_adam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (
+    DistributedFusedLAMB,
+    distributed_fused_lamb,
+)
+from apex_tpu.contrib.optimizers.fp16_optimizer import FP16_Optimizer
+
+__all__ = [
+    "DistributedFusedAdam", "distributed_fused_adam",
+    "DistributedFusedLAMB", "distributed_fused_lamb",
+    "FP16_Optimizer",
+]

@@ -1,0 +1,154 @@
+"""ZeRO-style distributed fused Adam (ref apex/contrib/optimizers/
+distributed_fused_adam.py DistributedFusedAdam).
+
+The reference shards optimizer state across the process group,
+reduce-scatters gradients, steps the local shard, and all-gathers updated
+params. TPU-first translation over a 'dp' mesh axis inside shard_map:
+
+    grads --psum_scatter('dp')--> local grad shard (flat buffer)
+    local fp32 master/m/v shard --adam_step--> local new master shard
+    --all_gather('dp')--> full updated params
+
+One flat fp32 buffer per dtype keeps the scatter/gather contiguous (the
+multi_tensor_apply layout) and divides evenly across the axis by padding.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import _math
+from apex_tpu.ops.flat import flatten_tree, unflatten_tree
+from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+
+class DistAdamState(NamedTuple):
+    count: jax.Array
+    master_shard: dict   # key -> local fp32 param shard [pad_size / n]
+    mu_shard: dict
+    nu_shard: dict
+
+
+def _pad_to(x, k):
+    pad = (-x.size) % k
+    return jnp.pad(x, (0, pad)) if pad else x
+
+
+def distributed_fused_adam(
+    lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+    adam_w_mode: bool = True, bias_correction: bool = True,
+    axis_name: str = "dp",
+) -> optax.GradientTransformation:
+    """optax-style transform; MUST run inside shard_map with ``axis_name``
+    bound. Each replica passes the FULL grads; state is sharded."""
+    b1, b2 = betas
+
+    def axis_n():
+        return jax.lax.axis_size(axis_name)
+
+    def init(params):
+        n = axis_n()
+        r = jax.lax.axis_index(axis_name)
+        bufs, meta = flatten_tree(params)
+        master, mu, nu = {}, {}, {}
+        for k, buf in bufs.items():
+            flat = _to_varying(_pad_to(buf.astype(jnp.float32), n), axis_name)
+            shard = jax.lax.dynamic_slice_in_dim(
+                flat, r * (flat.size // n), flat.size // n)
+            master[k] = shard
+            mu[k] = jnp.zeros_like(shard)
+            nu[k] = jnp.zeros_like(shard)
+        return DistAdamState(jnp.zeros([], jnp.int32), master, mu, nu)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("distributed_fused_adam requires params")
+        n = axis_n()
+        r = jax.lax.axis_index(axis_name)
+        count = state.count + 1
+        step = count.astype(jnp.float32)
+        pbufs, pmeta = flatten_tree(params)
+        # pack grads in the PARAM buckets (grads may differ in dtype, e.g.
+        # fp32 grads over bf16 params): same leaf order, cast to fp32
+        _, _, pspecs = pmeta
+        g_leaves = jax.tree_util.tree_leaves(grads)
+
+        new_master, new_mu, new_nu, out_bufs = {}, {}, {}, {}
+        for k, (idxs, spec) in pspecs.items():
+            gbuf = jnp.concatenate(
+                [g_leaves[i].ravel().astype(jnp.float32) for i in idxs])
+            gflat = _to_varying(_pad_to(gbuf, n), axis_name)
+            # mean-reduce + scatter: each rank owns 1/n of the gradient
+            gshard = jax.lax.psum_scatter(
+                gflat, axis_name, scatter_dimension=0, tiled=True) / n
+            delta, m, v = _math.adam_step(
+                gshard, state.master_shard[k], state.mu_shard[k],
+                state.nu_shard[k], lr=lr if not callable(lr) else lr(state.count),
+                b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+                adam_w_mode=adam_w_mode, step=step,
+                bias_correction=bias_correction)
+            master = state.master_shard[k] + delta
+            new_master[k], new_mu[k], new_nu[k] = master, m, v
+            # gather updated shards in ONE variant->invariant collective:
+            # psum of rank-offset-placed shards == all_gather, and the psum
+            # output is vma-invariant (no extra claim pass needed)
+            pad_size = master.size * n
+            placed = jnp.zeros((pad_size,), master.dtype)
+            placed = jax.lax.dynamic_update_slice_in_dim(
+                placed, master, r * master.size, 0)
+            full = jax.lax.psum(placed, axis_name)
+            out_bufs[k] = full[:pbufs[k].size].astype(pbufs[k].dtype)
+
+        new_params = unflatten_tree(out_bufs, pmeta)
+        updates = jax.tree_util.tree_map(
+            lambda np_, p: np_ - p, new_params, params)
+        return updates, DistAdamState(count, new_master, new_mu, new_nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def dist_adam_partition_specs(params, mesh_axes=("dp",)):
+    """PartitionSpecs for carrying :class:`DistAdamState` across jitted
+    ``shard_map`` steps (checkpoint/resume of the ZeRO shards).
+
+    The state is one flat fp32 shard per param-dtype bucket per rank; its
+    global encoding concatenates every rank's shard along dim 0 in mesh
+    order, so a round trip through ``out_specs`` then ``in_specs`` hands
+    each rank back exactly the shard it wrote. ``mesh_axes`` should name
+    the ZeRO axis plus any mesh axis the params may be sharded over (the
+    per-rank shards differ across those too). A bucket that happens to be
+    invariant over a listed axis is still fine: shard_map accepts an
+    out_spec naming an axis the value is invariant over, and the global
+    array just stores that bucket's identical blocks redundantly. Ref
+    apex/contrib/optimizers/distributed_fused_adam.py state_dict gather.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    keys = sorted({jnp.dtype(l.dtype).name
+                   for l in jax.tree_util.tree_leaves(params)})
+    shard = {k: P(tuple(mesh_axes)) for k in keys}
+    return DistAdamState(count=P(), master_shard=shard, mu_shard=shard,
+                         nu_shard=shard)
+
+
+class DistributedFusedAdam:
+    """Class-shaped wrapper (ref distributed_fused_adam.py:42); functional
+    state, explicit mesh usage."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 adam_w_mode=True, axis_name: str = "dp", **unused):
+        self.tx = distributed_fused_adam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode, bias_correction=bias_correction,
+            axis_name=axis_name)
+        self.params = params
+        self.state = None  # init must run inside shard_map
+
+    def init(self, params=None):
+        self.state = self.tx.init(params if params is not None else self.params)
+        return self.state

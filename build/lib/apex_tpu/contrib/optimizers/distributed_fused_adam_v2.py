@@ -1,0 +1,19 @@
+"""DistributedFusedAdam v2 (ref apex/contrib/optimizers/
+distributed_fused_adam_v2.py).
+
+The reference's v2/v3 differ from v1 only in NCCL overlap strategy
+(flat-buffer layout + reduction-pipelining knobs: dwu_num_blocks,
+dwu_num_chunks, revert_method...). Under XLA the collective schedule is the
+compiler's, so the TPU implementation is shared; the v2/v3 names exist for
+import parity and accept (and ignore) the scheduling knobs.
+"""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    distributed_fused_adam,
+)
+
+DistributedFusedAdamV2 = DistributedFusedAdam
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedAdamV2",
+           "distributed_fused_adam"]

@@ -1,0 +1,13 @@
+"""DistributedFusedAdam v3 (ref apex/contrib/optimizers/
+distributed_fused_adam_v3.py). See distributed_fused_adam_v2 — the NCCL
+pipelining variants collapse to one XLA implementation on TPU."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+    distributed_fused_adam,
+)
+
+DistributedFusedAdamV3 = DistributedFusedAdam
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedAdamV3",
+           "distributed_fused_adam"]

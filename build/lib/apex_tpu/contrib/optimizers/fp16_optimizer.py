@@ -1,0 +1,23 @@
+"""contrib FP16_Optimizer (ref apex/contrib/optimizers/fp16_optimizer.py).
+
+The contrib variant differs from ``apex.fp16_utils.FP16_Optimizer`` only in
+assuming a flat-grad fused inner optimizer (it was written for the contrib
+FusedAdam/FusedSGD). On TPU both share one implementation — the fp16_utils
+version already keeps fp32 masters over a fused optax transform — so this
+module re-exports it under the contrib name with the contrib defaults
+(dynamic loss scale on by default, ref fp16_optimizer.py:25).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer as _Base
+
+
+class FP16_Optimizer(_Base):
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=True, dynamic_loss_args=None,
+                 verbose=False):
+        super().__init__(init_optimizer, static_loss_scale=static_loss_scale,
+                         dynamic_loss_scale=dynamic_loss_scale,
+                         dynamic_loss_args=dynamic_loss_args,
+                         verbose=verbose)

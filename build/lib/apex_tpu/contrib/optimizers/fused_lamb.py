@@ -1,0 +1,6 @@
+"""contrib FusedLAMB (ref apex/contrib/optimizers/fused_lamb.py — legacy
+duplicate of apex.optimizers.FusedLAMB). Shared TPU implementation."""
+
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb
+
+__all__ = ["FusedLAMB", "fused_lamb"]

@@ -1,0 +1,7 @@
+"""contrib FusedSGD (ref apex/contrib/optimizers/fused_sgd.py — legacy
+duplicate of apex.optimizers.FusedSGD). The TPU FusedSGD already accepts
+the legacy knobs (materialize_master_grads), so this is a pure re-export."""
+
+from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd
+
+__all__ = ["FusedSGD", "fused_sgd"]

@@ -1,0 +1,92 @@
+"""Peer-memory halo exchange (ref apex/contrib/peer_memory/
+{peer_memory,peer_halo_exchanger_1d}.py).
+
+The reference moves conv halos between GPUs through cudaIpc peer mappings.
+On TPU, neighbour transfer IS the ICI collective: a ``ppermute`` pair sends
+the top/bottom halo rows to the adjacent rank on the spatial axis. The
+PeerMemoryPool (raw device allocations) has no TPU analog — XLA owns
+buffers — so the pool here is a thin facade kept for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class PeerMemoryPool:
+    """API-parity facade (ref peer_memory.py PeerMemoryPool): on TPU there
+    are no raw peer mappings to pre-allocate; allocate() hands back shaped
+    zeros so reference-ported code keeps running."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks=None):
+        self.peer_ranks = peer_ranks
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last, dynamic):
+        del channels_last, dynamic
+        return [jnp.zeros(shape, dtype)]
+
+    def reset(self):
+        pass
+
+
+def halo_exchange_1d(y, half_halo: int, axis_name: str = "spatial",
+                     h_dim: int = 1):
+    """Exchange ``half_halo`` rows with spatial neighbours over the mesh
+    axis (ref peer_halo_exchanger_1d.py:14 __call__, H_split=True).
+
+    y: [N, H_local(+2*half_halo), W, C] with halo margins already in place;
+    returns y with the margins filled from the neighbours' edge rows.
+    Boundary ranks keep their margins (zero/garbage) like the reference,
+    which only exchanges interior halos.
+    """
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    hh = half_halo
+    y = _to_varying(y, axis_name)
+
+    def take(lo, hi):
+        idx = [slice(None)] * y.ndim
+        idx[h_dim] = slice(lo, hi)
+        return y[tuple(idx)]
+
+    # my interior edge rows (just inside the halo margins)
+    top_edge = take(hh, 2 * hh)           # goes to previous rank's bottom margin
+    bot_edge = take(-2 * hh, -hh)         # goes to next rank's top margin
+
+    up = [(i, i - 1) for i in range(1, n)]      # send towards rank 0
+    down = [(i, i + 1) for i in range(n - 1)]   # send towards rank n-1
+    from_next = jax.lax.ppermute(top_edge, axis_name, up)
+    from_prev = jax.lax.ppermute(bot_edge, axis_name, down)
+
+    idx_top = [slice(None)] * y.ndim
+    idx_top[h_dim] = slice(0, hh)
+    idx_bot = [slice(None)] * y.ndim
+    idx_bot[h_dim] = slice(y.shape[h_dim] - hh, y.shape[h_dim])
+
+    y = y.at[tuple(idx_top)].set(
+        jnp.where(rank > 0, from_prev, take(0, hh)))
+    y = y.at[tuple(idx_bot)].set(
+        jnp.where(rank < n - 1, from_next, take(-hh, None)))
+    return y
+
+
+class PeerHaloExchanger1d:
+    """ref peer_halo_exchanger_1d.py:5."""
+
+    def __init__(self, rank=None, peer_group_size=None, peer_pool=None,
+                 half_halo: int = 1, axis_name: str = "spatial"):
+        del rank, peer_group_size, peer_pool
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+
+    def __call__(self, y, H_split: bool = True, explicit_nhwc: bool = True,
+                 numSM: int = 1, diagnostics: bool = False):
+        del explicit_nhwc, numSM, diagnostics
+        h_dim = 1 if H_split else 2
+        return halo_exchange_1d(y, self.half_halo, self.axis_name, h_dim)

@@ -1,0 +1,218 @@
+"""RNN-T transducer joint + loss (ref apex/contrib/transducer/
+{transducer.py} TransducerJoint / TransducerLoss, csrc transducer kernels).
+
+TPU-first design notes:
+- The joint is the broadcast add f[:, :, None] + g[:, None, :] with optional
+  relu/dropout — one XLA fusion. The reference's "packed" layout (valid
+  rows only, offsets from cumsum(f_len*g_len)) is supported on both ends
+  for API parity — pack_output gathers valid rows out of the padded
+  joint, packed_input gathers them back onto the padded lattice — but as
+  a LAYOUT, not a compute saving: packing skips don't-care math on GPU,
+  while on TPU the fixed-shape lattice is the fast path and dynamic
+  shapes would force recompiles.
+- The loss's alpha recursion is reformulated so the inner (label) dimension
+  runs as a ``lax.associative_scan`` in the log semiring: each time-frame
+  row is a first-order linear recurrence
+      alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+                              alpha[t, u-1] + emit[t, u-1])
+  whose scan element is the affine map X -> E*X + A, composed associatively
+  as (log_m, log_a) pairs. The outer time loop is a ``lax.scan``. That
+  turns the classic O(T·U) sequential lattice into O(T) steps of O(log U)
+  depth — the TPU answer to the reference's warp-parallel CUDA DP.
+- Gradients fall out of AD through the scans (exact), so there is no
+  hand-written backward kernel to keep in sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------- joint
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, pack_output: bool = False,
+                     relu: bool = False, dropout: float = 0.0,
+                     dropout_rng=None, batch_offset=None,
+                     packed_batch: int = 0):
+    """h[b, t, u, :] = f[b, t, :] + g[b, u, :] (ref TransducerJoint.forward).
+
+    ``pack_output=True`` returns the reference's packed layout
+    ``[packed_batch, H]`` — batch b's valid ``f_len[b] x g_len[b]`` block
+    flattened row-major at offset ``batch_offset[b-1]`` (``batch_offset``
+    is the reference's INCLUSIVE ``cumsum(f_len * g_len)``). On GPU the
+    reference packs to SKIP computing don't-care positions; fixed shapes
+    being the TPU-friendly layout, this computes the full padded joint in
+    one fusion and gathers the valid rows, so the output (and therefore
+    everything downstream, e.g. a packed loss) is layout-compatible with
+    the reference. ``packed_batch`` must be a static int (the gather's
+    output shape).
+    """
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout), 0.0)
+    if not pack_output:
+        return h
+    if batch_offset is None or not packed_batch:
+        raise ValueError(
+            "pack_output=True requires batch_offset and packed_batch")
+    if f_len is None or g_len is None:
+        raise ValueError("pack_output=True requires f_len and g_len")
+    b_of, t_of, u_of = _packed_row_coords(
+        jnp.arange(packed_batch), batch_offset, f_len * g_len, g_len)
+    return h[b_of, t_of, u_of]
+
+
+def _packed_row_coords(rows, batch_offset, block_len, g_len):
+    """(b, t, u) for each packed row index (reference packed layout)."""
+    starts = batch_offset - block_len            # inclusive cumsum -> start
+    b = jnp.clip(
+        jnp.searchsorted(batch_offset, rows, side="right"), 0,
+        batch_offset.shape[0] - 1)
+    local = jnp.clip(rows - starts[b], 0, jnp.maximum(block_len[b] - 1, 0))
+    g = jnp.maximum(g_len[b], 1)
+    return b, local // g, local % g
+
+
+class TransducerJoint:
+    """ref transducer.py:10 TransducerJoint."""
+
+    def __init__(self, pack_output=False, relu=False, dropout=False,
+                 dropout_prob=0.0, probe=None):
+        del probe
+        self.pack_output = pack_output
+        self.relu = relu
+        self.dropout_prob = dropout_prob if dropout else 0.0
+
+    def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
+                 packed_batch=0, dropout_rng=None):
+        return transducer_joint(f, g, f_len, g_len, self.pack_output,
+                                self.relu, self.dropout_prob, dropout_rng,
+                                batch_offset=batch_offset,
+                                packed_batch=packed_batch)
+
+
+# -------------------------------------------------------------------- loss
+
+
+def _row_recurrence(prev_term, emit_row):
+    """Solve alpha_row[u] = logaddexp(prev_term[u], alpha_row[u-1] +
+    emit_row[u-1]) for all u via associative_scan in the log semiring.
+
+    Element = affine map X -> M*X + A with (log_m, log_a); composition
+    (applied left-to-right) is (lm1+lm2, logaddexp(la1 + lm2, la2)).
+    """
+    u1 = prev_term.shape[-1]
+    # shift emit right: multiplier entering position u is emit[u-1]
+    log_m = jnp.concatenate(
+        [jnp.full(emit_row.shape[:-1] + (1,), _NEG_INF), emit_row[..., :-1]],
+        axis=-1)
+    log_a = prev_term
+
+    def combine(x, y):
+        lm1, la1 = x
+        lm2, la2 = y
+        return lm1 + lm2, jnp.logaddexp(la1 + lm2, la2)
+
+    _, alpha = jax.lax.associative_scan(combine, (log_m, log_a), axis=-1)
+    return alpha
+
+
+def transducer_loss(logits, targets, f_len, y_len, blank_idx: int = 0,
+                    packed_input: bool = False, batch_offset=None,
+                    max_f_len: Optional[int] = None):
+    """Negative log-likelihood per batch element (ref TransducerLoss).
+
+    logits: [B, T, U+1, V] joint outputs; targets [B, U] label ids;
+    f_len [B] valid time frames; y_len [B] valid labels.
+
+    ``packed_input=True`` accepts the reference's packed layout instead:
+    logits ``[N, V]`` with batch b's ``f_len[b] x (y_len[b]+1)`` block at
+    offset ``batch_offset[b-1]`` (``batch_offset`` = inclusive
+    ``cumsum(f_len * (y_len+1))``, ref transducer.py:101) and
+    ``max_f_len`` the padded T. The packed rows are gathered back to the
+    padded lattice — packing skips don't-care compute on GPU; on TPU the
+    static-shape lattice IS the fast path, and the gather keeps the
+    reference's calling convention working end-to-end (grads flow back
+    to the packed rows through the gather).
+    """
+    if packed_input:
+        if batch_offset is None or max_f_len is None:
+            raise ValueError(
+                "packed_input=True requires batch_offset and max_f_len")
+        U = targets.shape[1]
+        T, U1 = int(max_f_len), U + 1
+        g_len = y_len + 1
+        t_idx = jnp.arange(T)[None, :, None]
+        u_idx = jnp.arange(U1)[None, None, :]
+        starts = (batch_offset - f_len * g_len)[:, None, None]
+        rows = starts + t_idx * g_len[:, None, None] + u_idx
+        valid = ((t_idx < f_len[:, None, None])
+                 & (u_idx < g_len[:, None, None]))
+        rows = jnp.where(valid, rows, 0)
+        # [B, T, U+1, V]; invalid positions read row 0 and are zeroed —
+        # the lattice only consumes (t, u) inside the valid region
+        logits = jnp.where(valid[..., None], logits[rows], 0.0)
+    B, T, U1, V = logits.shape
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    blank = lp[..., blank_idx]                       # [B, T, U+1]
+    emit = jnp.take_along_axis(
+        lp[:, :, :-1, :], targets[:, None, :, None], axis=-1)[..., 0]
+    # emit[b, t, u] = lp[t, u, targets[u]]; pad back to U+1 with -inf
+    emit = jnp.concatenate(
+        [emit, jnp.full((B, T, 1), _NEG_INF)], axis=2)   # [B, T, U+1]
+    # labels beyond y_len can never be emitted
+    u_pos = jnp.arange(U1)[None, :]
+    emit = jnp.where(u_pos[None] < y_len[:, None, None], emit, _NEG_INF)
+
+    alpha0 = jnp.full((B, U1), _NEG_INF).at[:, 0].set(0.0)
+    alpha0 = _row_recurrence(
+        alpha0.at[:, 1:].set(_NEG_INF).at[:, 0].set(0.0), emit[:, 0])
+
+    def step(alpha_prev, inputs):
+        blank_prev, emit_row = inputs  # blank at t-1, emit at t
+        prev_term = alpha_prev + blank_prev
+        alpha = _row_recurrence(prev_term, emit_row)
+        return alpha, alpha
+
+    blanks_t = jnp.moveaxis(blank[:, :-1], 1, 0)    # [T-1, B, U+1]
+    emits_t = jnp.moveaxis(emit[:, 1:], 1, 0)
+    _, alphas = jax.lax.scan(step, alpha0, (blanks_t, emits_t))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, U+1]
+    alphas = jnp.moveaxis(alphas, 0, 1)             # [B, T, U+1]
+
+    # ll = alpha[f_len-1, y_len] + blank[f_len-1, y_len]
+    t_idx = jnp.clip(f_len - 1, 0, T - 1)
+    a_final = jnp.take_along_axis(
+        alphas, t_idx[:, None, None].repeat(U1, axis=2), axis=1)[:, 0]
+    b_final = jnp.take_along_axis(
+        blank, t_idx[:, None, None].repeat(U1, axis=2), axis=1)[:, 0]
+    ll = jnp.take_along_axis(a_final + b_final, y_len[:, None], axis=1)[:, 0]
+    return -ll
+
+
+class TransducerLoss:
+    """ref transducer.py TransducerLoss (Function.apply shape)."""
+
+    def __init__(self, fuse_softmax_backward=True, opt=1,
+                 packed_input=False):
+        del fuse_softmax_backward, opt
+        self.packed_input = packed_input
+
+    def __call__(self, x, label, f_len, y_len, blank_idx=0,
+                 batch_offset=None, max_f_len=None, debug_list=None):
+        del debug_list
+        return transducer_loss(x, label, f_len, y_len, blank_idx,
+                               self.packed_input,
+                               batch_offset=batch_offset,
+                               max_f_len=max_f_len)

@@ -1,0 +1,81 @@
+"""Fused label-smoothing cross entropy (ref apex/contrib/xentropy/
+softmax_xentropy.py SoftmaxCrossEntropyLoss).
+
+One fused pass computes per-token losses with label smoothing and
+padding-idx masking; the backward reuses the saved log-sum-exp the way the
+CUDA kernel reuses ``max_log_sum_exp``. On a vocab-sharded mesh use
+:func:`apex_tpu.transformer.tensor_parallel.cross_entropy.
+vocab_parallel_cross_entropy`, which implements the same smoothing math
+distributed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
+                               half_to_float=False):
+    """Per-token losses [N]; logits [N, V] (ref softmax_xentropy.py:5).
+
+    ``smoothing``: eps mass spread uniformly over the vocab;
+    tokens equal to ``padding_idx`` contribute 0 loss.
+    """
+    return _fwd(logits, labels, smoothing, padding_idx, half_to_float)[0]
+
+
+def _fwd_math(logits, labels, smoothing, padding_idx, half_to_float):
+    compute = logits.astype(jnp.float32) if half_to_float else logits
+    m = jnp.max(compute, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(compute - m), axis=-1)) + m[..., 0]
+    target_logit = jnp.take_along_axis(compute, labels[..., None],
+                                       axis=-1)[..., 0]
+    nll = lse - target_logit
+    if smoothing > 0.0:
+        mean_logit = jnp.mean(compute, axis=-1)
+        smooth_loss = lse - mean_logit
+        loss = (1.0 - smoothing) * nll + smoothing * smooth_loss
+    else:
+        loss = nll
+    pad = labels == padding_idx
+    return jnp.where(pad, 0.0, loss), lse, pad
+
+
+def _fwd(logits, labels, smoothing, padding_idx, half_to_float):
+    loss, lse, pad = _fwd_math(logits, labels, smoothing, padding_idx,
+                               half_to_float)
+    return loss, (logits, labels, lse, pad, smoothing, half_to_float)
+
+
+def _bwd(res, g):
+    logits, labels, lse, pad, smoothing, half_to_float = res
+    compute = logits.astype(jnp.float32) if half_to_float else logits
+    v = compute.shape[-1]
+    softmax = jnp.exp(compute - lse[..., None])
+    onehot = jax.nn.one_hot(labels, v, dtype=softmax.dtype)
+    target_term = (1.0 - smoothing) * onehot + smoothing / v
+    d = (softmax - target_term) * jnp.where(pad, 0.0, g)[..., None]
+    return (d.astype(logits.dtype), None, None, None, None)
+
+
+softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
+
+# O1 boundary cast: cross-entropy is range-sensitive → forced fp32 under an
+# active O1 policy (lists.py FP32_OPS; ref functional_overrides FP32_FUNCS)
+from apex_tpu.amp.amp import float_function as _float_function  # noqa: E402
+
+softmax_cross_entropy_loss = _float_function(softmax_cross_entropy_loss)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class-shaped entry (the reference exposes the autograd.Function
+    directly; apply == __call__)."""
+
+    apply = staticmethod(softmax_cross_entropy_loss)
+
+    def __call__(self, logits, labels, smoothing=0.0, padding_idx=0,
+                 half_to_float=False):
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          padding_idx, half_to_float)

@@ -1,0 +1,34 @@
+"""Distributed communication backend (SURVEY.md §2 #54 — the NCCL analog).
+
+The reference's comms layer is torch.distributed process groups over NCCL.
+On TPU the transport is XLA collectives over ICI/DCN and the "process
+group" is a named mesh axis; multi-host init is ``jax.distributed``.
+This module is the process-group-shaped surface over that machinery.
+"""
+
+from apex_tpu.distributed.backend import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    init_process_group,
+    is_initialized,
+    new_group,
+    reduce_scatter,
+    ReduceOp,
+)
+from apex_tpu.distributed.divergence import (
+    DivergenceMonitor,
+    assert_replicas_equal,
+    replica_divergence,
+)
+
+__all__ = [
+    "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
+    "get_rank", "get_world_size", "init_process_group", "is_initialized",
+    "new_group", "reduce_scatter", "ReduceOp",
+    "DivergenceMonitor", "assert_replicas_equal", "replica_divergence",
+]

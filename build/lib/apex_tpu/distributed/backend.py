@@ -1,0 +1,153 @@
+"""torch.distributed-shaped API over XLA collectives + jax.distributed.
+
+Two layers, mirroring how the reference splits host coordination from
+device collectives:
+
+- HOST side: :func:`init_process_group` wraps ``jax.distributed.initialize``
+  (the NCCL-bootstrap analog — rendezvous, health, failure detection are
+  owned by the JAX runtime over DCN).
+- DEVICE side: the collectives take ``group`` = a mesh axis name (or tuple
+  of names) and must run inside ``shard_map``/``pjit`` where the axis is
+  bound — the analog of issuing NCCL ops on a process group's stream; XLA
+  schedules them on ICI and overlaps with compute.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+_INITIALIZED = False
+Group = Union[str, Sequence[str]]
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "product"
+
+
+def init_process_group(backend: str = "ici", init_method: Optional[str] = None,
+                       world_size: Optional[int] = None,
+                       rank: Optional[int] = None, **kw):
+    """Multi-host bootstrap (ref torch.distributed.init_process_group).
+
+    On a single-host run (the common test path) this is a no-op success;
+    multi-host passes coordinator address/process counts through to
+    ``jax.distributed.initialize``.
+    """
+    global _INITIALIZED
+    del backend
+    if world_size is not None and world_size > 1 and init_method:
+        addr = init_method.replace("tcp://", "")
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=world_size,
+                                   process_id=rank, **kw)
+    _INITIALIZED = True
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is None:
+        return jax.device_count()
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    try:
+        n = 1
+        for a in axes:
+            n *= jax.lax.axis_size(a)
+        return n
+    except NameError:
+        return jax.device_count()
+
+
+def get_rank(group: Optional[Group] = None):
+    if group is None:
+        return jax.process_index()
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    r = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        r = r * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return r
+
+
+def new_group(axis_name: str) -> str:
+    """Groups ARE mesh axes; kept for call-site parity."""
+    return axis_name
+
+
+def _vary_group(x, group: Group):
+    """pvary over EVERY axis of the group — a tuple group's collective
+    needs the value varying over all of its axes, not just the first."""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    for ax in axes:
+        x = _to_varying(x, ax)
+    return x
+
+
+def all_reduce(x, op: ReduceOp = ReduceOp.SUM, group: Group = "dp"):
+    x = _vary_group(x, group)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        y = jax.lax.psum(x, group)
+        if op == ReduceOp.AVG:
+            y = y / get_world_size(group)
+        return y
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, group)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, group)
+    if op == ReduceOp.PRODUCT:
+        # exact and sign-correct for any dtype (exp(psum(log)) would NaN on
+        # negatives); PRODUCT is never bandwidth-critical, so the gather is
+        # fine
+        return jnp.prod(jax.lax.all_gather(x, group, axis=0), axis=0)
+    raise ValueError(op)
+
+
+def all_gather(x, group: Group = "dp", axis: int = 0, tiled: bool = True):
+    x = _vary_group(x, group)
+    return jax.lax.all_gather(x, group, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, group: Group = "dp", axis: int = 0,
+                   op: ReduceOp = ReduceOp.SUM):
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError("reduce_scatter supports SUM/AVG")
+    x = _vary_group(x, group)
+    y = jax.lax.psum_scatter(x, group, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVG:
+        y = y / get_world_size(group)
+    return y
+
+
+def all_to_all(x, group: Group = "cp", split_axis: int = 0,
+               concat_axis: int = 0):
+    x = _vary_group(x, group)
+    return jax.lax.all_to_all(x, group, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, src: int = 0, group: Group = "dp"):
+    """Every rank gets rank ``src``'s value (psum of the masked value —
+    variant→invariant, so the result is replicated like NCCL bcast).
+    ``src`` is the COMPOSITE rank for tuple groups (get_rank's order)."""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    rank = get_rank(group)
+    contrib = jnp.where(rank == src, _vary_group(x, group),
+                        jnp.zeros_like(x))
+    return jax.lax.psum(contrib, axes if len(axes) > 1 else axes[0])
+
+
+def barrier(group: Group = "dp"):
+    """Collective no-op fence (NCCL barrier analog): a tiny psum every rank
+    must reach. Returns the axis size so the dependency is real."""
+    return jax.lax.psum(jnp.ones(()), group)

@@ -1,0 +1,193 @@
+"""Replica-divergence detection — the TPU analog of race detection.
+
+CUDA race detection guards against unsynchronized writes; in SPMD there
+are no shared-memory races, but the equivalent silent failure exists:
+values that SHOULD be identical on every rank of an axis (replicated
+params after a dp step, the loss scaler state, RNG-derived masks) drift
+apart — from a missed grad allreduce, nondeterministic reductions, or a
+flaky interconnect — and training silently diverges long before NaNs.
+
+These helpers run IN-GRAPH (no host sync): a rank's fingerprint is
+compared against the axis-wide min/max, so a pair of scalar collectives
+verifies agreement across the whole axis. Detection is EXACT: the digest
+is an integer hash of the raw bits (position-weighted uint32 wraparound
+arithmetic), so a single 1-ulp drift in a billion-parameter tree flips
+the digest — a float accumulator would drown that delta in rounding. A
+secondary f32 magnitude digest sizes the drift for logging.
+
+- :func:`replica_divergence` — traced scalar: 0.0 iff every rank's tree
+  is bit-identical; otherwise the spread of the magnitude digest
+  (floored at a tiny positive value so exact detection is never lost).
+- :func:`assert_replicas_equal` — hard in-graph check; callers branch on
+  the returned traced bool (the amp scaler's overflow-skip pattern).
+- :class:`DivergenceMonitor` — periodic wrapper for train loops: the
+  digest computes every ``every`` steps (lax.cond-gated — the scalar
+  collectives run unconditionally to keep SPMD analysis simple).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    make_varying,
+    tree_vma,
+)
+
+Axes = Union[str, Sequence[str]]
+
+
+def _axes_tuple(axis_name: Axes):
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _spread(h, mag, axis_name: Axes) -> jax.Array:
+    """Axis-wide digest comparison: exact integer hash decides WHETHER
+    replicas diverge; the f32 magnitude spread (floored to stay nonzero)
+    estimates HOW MUCH."""
+    h_hi = h_lo = h.astype(jnp.int32)
+    m_hi = m_lo = mag
+    for ax in _axes_tuple(axis_name):
+        h_hi = jax.lax.pmax(make_varying(h_hi, ax), ax)
+        h_lo = jax.lax.pmin(make_varying(h_lo, ax), ax)
+        m_hi = jax.lax.pmax(make_varying(m_hi, ax), ax)
+        m_lo = jax.lax.pmin(make_varying(m_lo, ax), ax)
+    return jnp.where(h_hi != h_lo,
+                     jnp.maximum(jnp.abs(m_hi - m_lo),
+                                 jnp.float32(1e-30)), 0.0)
+
+
+def _leaf_bits(leaf) -> jax.Array:
+    """Raw bits of a leaf as a flat uint32 vector (exact, dtype-agnostic)."""
+    x = leaf.ravel()
+    if x.dtype.itemsize == 4:
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    if x.dtype.itemsize == 2:
+        return jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    if x.dtype.itemsize == 1:
+        return jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+    # 8-byte dtypes bitcast to a trailing pair of u32 words
+    return jax.lax.bitcast_convert_type(x, jnp.uint32).ravel()
+
+
+def _fingerprint(tree):
+    """(exact_hash uint32, magnitude f32) digest of a pytree.
+
+    The hash multiplies each element's bits by an odd position constant
+    (bijective in uint32) and sums with wraparound — exact integer math,
+    so bitwise-identical trees agree and any single-bit drift disagrees
+    (up to a ~2^-32 collision). The magnitude digest is a cheap f32 sum
+    for sizing the drift; it plays no part in detection.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    h = jnp.zeros((), jnp.uint32)
+    mag = jnp.zeros((), jnp.float32)
+    for i, leaf in enumerate(leaves):
+        bits = _leaf_bits(leaf)
+        pos = jax.lax.iota(jnp.uint32, bits.size)
+        w = pos * jnp.uint32(2654435761) + jnp.uint32(2 * i + 1)
+        h = h + jnp.sum(bits * (2 * w + 1))  # odd multiplier: bijective
+        mag = mag + jnp.sum(leaf.astype(jnp.float32))
+    return h, mag
+
+
+def replica_divergence(tree, axis_name: Axes) -> jax.Array:
+    """Traced scalar: 0.0 iff every rank on ``axis_name`` holds a
+    bit-identical copy of ``tree``; otherwise the spread of the f32
+    magnitude digest, floored at a tiny positive value (exact integer
+    detection decides WHETHER, the float spread estimates HOW MUCH).
+
+    Runs inside ``shard_map`` with the axis bound. Cost: four scalar
+    collectives plus one pass over the tree.
+    """
+    h, mag = _fingerprint(tree)
+    return _spread(h, mag, axis_name)
+
+
+def assert_replicas_equal(tree, axis_name: Axes, atol: float = 0.0):
+    """In-graph divergence check. Returns ``(ok, divergence)`` — ``ok`` is
+    a traced bool, identical on every rank, suitable for ``lax.cond`` (the
+    same pattern the amp scaler uses for overflow skips) or for poisoning
+    the loss (``loss = jnp.where(ok, loss, jnp.nan)``) so the failure is
+    visible at the host without a per-step sync."""
+    div = replica_divergence(tree, axis_name)
+    return div <= atol, div
+
+
+class DivergenceState(NamedTuple):
+    step: jax.Array        # i32 steps seen
+    checks: jax.Array      # i32 checks performed
+    max_divergence: jax.Array  # f32 worst spread observed
+    diverged: jax.Array    # bool latch
+
+
+class DivergenceMonitor:
+    """Periodic replicated-state checker for jitted train loops.
+
+    ``state = monitor.init()``; inside the (shard_mapped) train step:
+    ``state = monitor.update(state, params, axis_name='dp')`` — every
+    ``every`` steps it fingerprints ``params`` across the axis and latches
+    any disagreement. Read ``state.diverged`` / ``state.max_divergence``
+    at the host whenever convenient (e.g. with checkpoint cadence).
+    """
+
+    def __init__(self, every: int = 100, atol: float = 0.0):
+        self.every = every
+        self.atol = atol
+
+    def init(self) -> DivergenceState:
+        return DivergenceState(
+            step=jnp.zeros((), jnp.int32),
+            checks=jnp.zeros((), jnp.int32),
+            max_divergence=jnp.zeros((), jnp.float32),
+            diverged=jnp.zeros((), jnp.bool_),
+        )
+
+    def update(self, state: DivergenceState, tree,
+               axis_name: Axes = "dp",
+               force: Optional[jax.Array] = None) -> DivergenceState:
+        step = state.step + 1
+        due = (step % self.every) == 0
+        if force is not None:
+            # a rank-local force would make the cond predicate differ
+            # across ranks and latch a false positive (one rank digests,
+            # the others produce zeros) — make it axis-uniform: ANY rank
+            # forcing forces everyone
+            f = force.astype(jnp.int32)
+            for ax in _axes_tuple(axis_name):
+                f = jax.lax.pmax(make_varying(f, ax), ax)
+            due = jnp.logical_or(due, f > 0)
+
+        # the expensive full-tree digest only computes on due steps
+        # (lax.cond with no collectives inside); the cheap SCALAR
+        # collectives in _spread run unconditionally — `due` is uniform
+        # across the axis (step-derived, or pmax'd force), so both
+        # branches agree axis-wide and the off-step zeros trivially match
+        def digest(_):
+            return _fingerprint(tree)
+
+        def skip(_):
+            # fresh zeros must match the digest branch's vma (the union
+            # of the tree leaves' varying axes) or the cond types disagree
+            h0 = jnp.zeros((), jnp.uint32)
+            m0 = jnp.zeros((), jnp.float32)
+            for ax in sorted(tree_vma(tree)):
+                h0 = make_varying(h0, ax)
+                m0 = make_varying(m0, ax)
+            return h0, m0
+
+        h, mag = jax.lax.cond(due, digest, skip, None)
+        div = _spread(h, mag, axis_name)
+        bad = div > self.atol
+        return DivergenceState(
+            step=step,
+            checks=state.checks + due.astype(jnp.int32),
+            max_divergence=jnp.where(
+                due, jnp.maximum(state.max_divergence, div),
+                state.max_divergence),
+            diverged=jnp.logical_or(state.diverged,
+                                    jnp.logical_and(due, bad)),
+        )

@@ -1,0 +1,25 @@
+"""apex.fp16_utils parity surface (ref apex/fp16_utils/__init__.py)."""
+
+from apex_tpu.fp16_utils.fp16util import (
+    BN_convert_float,
+    network_to_half,
+    prep_param_lists,
+    model_grads_to_master_grads,
+    master_params_to_model_params,
+    tofp16,
+    to_python_float,
+    clip_grad_norm,
+    convert_module,
+    convert_network,
+    FP16Model,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer
+from apex_tpu.fp16_utils.loss_scaler import LossScaler, DynamicLossScaler
+
+__all__ = [
+    "BN_convert_float", "network_to_half", "prep_param_lists",
+    "model_grads_to_master_grads", "master_params_to_model_params",
+    "tofp16", "to_python_float", "clip_grad_norm", "convert_module",
+    "convert_network", "FP16Model", "FP16_Optimizer", "LossScaler",
+    "DynamicLossScaler",
+]

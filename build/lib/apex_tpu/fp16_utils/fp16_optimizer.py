@@ -1,0 +1,140 @@
+"""FP16_Optimizer (ref apex/fp16_utils/fp16_optimizer.py).
+
+Master-weight mixed precision around a fused optimizer: the model tree is
+half precision, the wrapped optimizer steps fp32 masters, and the updated
+masters are cast back into the model tree. Overflow (from the loss scaler)
+skips the step and only adjusts the scale — the reference's control flow
+(fp16_optimizer.py:step) runs on host; here the whole step is jittable when
+used with static scaling, and host-driven with DynamicLossScaler for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+
+class FP16_Optimizer:
+    """Wrap a :class:`apex_tpu.optimizers.FusedOptimizer`
+    (ref fp16_optimizer.py:26).
+
+    The wrapped optimizer's ``params`` become the fp32 masters; ``step``
+    takes the HALF-precision grads, unscales, checks overflow, steps masters
+    and returns the refreshed half model tree.
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        self.model_params, master = prep_param_lists(init_optimizer.params)
+        self.optimizer.params = master
+        self.optimizer.state = self.optimizer.tx.init(master)
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.verbose = verbose
+        self._step_jit = jax.jit(self._master_step)
+
+    # -- functional core ----------------------------------------------------
+
+    def _master_step(self, grads32, state, master, model_params):
+        new_master, new_state = self.optimizer._functional_step(
+            grads32, state, master)
+        model = master_params_to_model_params(model_params, new_master)
+        return new_master, new_state, model
+
+    # -- apex-shaped API ----------------------------------------------------
+
+    def scale_loss(self, loss):
+        return loss * self.loss_scaler.loss_scale
+
+    def backward(self, loss):  # parity shim: scaling happens in scale_loss
+        return self.scale_loss(loss)
+
+    def step(self, grads=None, closure=None):
+        if grads is None:
+            raise ValueError("pass grads (pytree matching params) to step()")
+        del closure
+        grads32 = model_grads_to_master_grads(grads)
+        inv = 1.0 / self.loss_scaler.loss_scale
+        grads32 = jax.tree_util.tree_map(lambda g: g * inv, grads32)
+        self.overflow = self.loss_scaler.has_overflow(grads32)
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            if self.verbose:
+                print(f"OVERFLOW! Skipping step, reducing loss scale to "
+                      f"{self.loss_scaler.loss_scale}")
+            return self.model_params
+        master, state, model = self._step_jit(
+            grads32, self.optimizer.state, self.optimizer.params,
+            self.model_params)
+        self.optimizer.params = master
+        self.optimizer.state = state
+        self.model_params = model
+        return model
+
+    def clip_master_grads(self, grads, max_norm, norm_type=2):
+        """ref fp16_optimizer.py clip_master_grads — clip the (unscaled,
+        fp32) master gradients to ``max_norm`` and return the pre-clip
+        global norm. Functional divergence from the reference: grads are
+        not stored on the optimizer, so pass the tree that will go to
+        ``step`` and use the returned clipped tree:
+
+            grads, norm = opt.clip_master_grads(grads, 1.0)
+            opt.step(grads=grads)
+        """
+        from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+        inv = 1.0 / self.loss_scaler.loss_scale
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+        clipped, norm = clip_grad_norm_(grads32, max_norm,
+                                        norm_type=norm_type)
+        # re-apply the scale: step() divides by it again
+        rescaled = jax.tree_util.tree_map(
+            lambda g: g * self.loss_scaler.loss_scale, clipped)
+        return rescaled, norm
+
+    def inspect_master_grad_data(self):
+        """ref fp16_optimizer.py inspect_master_grad_data — grads are
+        functional here (never stored), so there is nothing to inspect;
+        returns None like the reference does before backward()."""
+        if self.verbose:
+            print("FP16_Optimizer is functional: gradients are passed to "
+                  "step(), not stored; inspect them at the call site")
+        return None
+
+    def zero_grad(self, set_to_none=True):
+        return None
+
+    def update_master_grads(self):  # parity no-op: done inside step()
+        return None
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def state_dict(self):
+        return {
+            "optimizer_state": self.optimizer.state_dict(),
+            "cur_scale": self.loss_scaler.cur_scale,
+            "overflow": self.overflow,
+        }
+
+    def load_state_dict(self, d):
+        self.optimizer.load_state_dict(d["optimizer_state"])
+        self.loss_scaler.cur_scale = d["cur_scale"]
+        self.overflow = d.get("overflow", False)

@@ -1,0 +1,156 @@
+"""Half-precision utilities (TPU re-design of ``apex.fp16_utils.fp16util``).
+
+The reference mutates torch modules in place (``network_to_half``,
+``BN_convert_float`` — ref apex/fp16_utils/fp16util.py:13-60). TPU-native
+training is functional over param pytrees, so every helper here maps trees:
+"the model" is (apply_fn, params), and half-precision means a low-precision
+COPY of the params with fp32 masters kept for the update
+(ref fp16util.py:98 prep_param_lists).
+
+bf16-first: ``half_dtype`` defaults to bfloat16 (TPU's native half) but
+fp16 is supported for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_FLOAT_KINDS = ("f",)  # jnp.floating leaves only
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tofp16(params, half_dtype=jnp.bfloat16):
+    """Cast every floating leaf to half (ref fp16util.py:13 tofp16)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(half_dtype) if _is_float(p) else p, params)
+
+
+def BN_convert_float(params, is_batchnorm: Optional[Callable] = None):
+    """Keep batchnorm leaves fp32 (ref fp16util.py:20). In a pytree the
+    batchnorm params are identified by ``is_batchnorm(path_str)`` (default:
+    any path segment named bn/batchnorm/batch_stats/BatchNorm...)."""
+    if is_batchnorm is None:
+        import re
+
+        def is_batchnorm(path: str) -> bool:
+            return re.search(
+                r"(^|[\[\]'/._])(bn\d*|batchnorm\d*|batch_stats|"
+                r"batchnorm|syncbatchnorm)([\]\['/._]|$)",
+                path.lower()) is not None
+
+    def fix(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if _is_float(leaf) and is_batchnorm(name):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, params)
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Half-cast params, batchnorm kept fp32 (ref fp16util.py:37)."""
+    return BN_convert_float(tofp16(params, half_dtype))
+
+
+def convert_module(params, dtype):
+    """Cast a (sub)tree's float leaves to ``dtype`` (ref fp16util.py:42)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if _is_float(p) else p, params)
+
+
+def convert_network(params, dtype):
+    """ref fp16util.py:56 — batchnorm stays fp32."""
+    return BN_convert_float(convert_module(params, dtype))
+
+
+class FP16Model:
+    """Wrap (apply_fn, params) to run in half precision with fp32-held
+    batchnorm (ref fp16util.py:72 FP16Model: casts inputs to half, runs the
+    half network)."""
+
+    def __init__(self, apply_fn: Callable, params, half_dtype=jnp.bfloat16):
+        self.apply_fn = apply_fn
+        self.half_dtype = half_dtype
+        self.params = network_to_half(params, half_dtype)
+
+    def __call__(self, *inputs, **kw):
+        cast = [x.astype(self.half_dtype) if _is_float(x) else x
+                for x in inputs]
+        return self.apply_fn(self.params, *cast, **kw)
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """(model_params_half, master_params_fp32) (ref fp16util.py:98).
+
+    ``flat_master=True`` concatenates the master copy into ONE fp32 vector
+    (ref uses _flatten_dense_tensors), the layout the flat fused optimizers
+    consume.
+    """
+    master = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if _is_float(p) else p, params)
+    if flat_master:
+        leaves = [l.ravel() for l in jax.tree_util.tree_leaves(master)
+                  if _is_float(l)]
+        master = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads, master_params=None,
+                                flat_master: bool = False):
+    """Upcast grads to fp32 (+flatten when the master is flat)
+    (ref fp16util.py:131)."""
+    g32 = jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) if _is_float(g) else g, model_grads)
+    if flat_master:
+        leaves = [l.ravel() for l in jax.tree_util.tree_leaves(g32)
+                  if _is_float(l)]
+        return jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+    return g32
+
+
+def master_params_to_model_params(model_params, master_params,
+                                  flat_master: bool = False):
+    """Copy updated fp32 masters back into the half model tree
+    (ref fp16util.py:150). Returns the NEW model tree (functional)."""
+    if flat_master:
+        leaves, treedef = jax.tree_util.tree_flatten(model_params)
+        out, off = [], 0
+        for l in leaves:
+            if _is_float(l):
+                n = l.size
+                out.append(master_params[off:off + n].reshape(l.shape)
+                           .astype(l.dtype))
+                off += n
+            else:
+                out.append(l)
+        return jax.tree_util.tree_unflatten(treedef, out)
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if _is_float(p) else p,
+        master_params, model_params)
+
+
+def to_python_float(t):
+    """ref fp16util.py:184 (handles 0-d arrays and python scalars)."""
+    return float(jnp.asarray(t).reshape(()))
+
+
+def clip_grad_norm(grads, max_norm: float, norm_type: float = 2.0):
+    """Global-norm clip over a pytree; returns (clipped, total_norm)
+    (ref fp16util.py uses torch.nn.utils.clip_grad_norm_)."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads) if _is_float(g)]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(
+            jnp.stack([jnp.sum(jnp.abs(g) ** norm_type) for g in leaves])
+        ) ** (1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: g * scale.astype(g.dtype) if _is_float(g) else g, grads)
+    return clipped, total

@@ -1,0 +1,67 @@
+"""Host-driven loss scalers (ref apex/fp16_utils/loss_scaler.py).
+
+The reference's fp16_utils scalers are the OLD pre-amp API: the scaler is a
+Python object whose ``update_scale(overflow)`` runs on host between steps
+(unlike :mod:`apex_tpu.amp.scaler`, which is the in-graph functional design).
+Kept for API parity; both delegate the math to the same rules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _has_overflow(grads) -> bool:
+    leaves = jax.tree_util.tree_leaves(grads)
+    for l in leaves:
+        if not bool(jnp.all(jnp.isfinite(l))):
+            return True
+    return False
+
+
+class LossScaler:
+    """Static scaler (ref loss_scaler.py:10). ``scale_gradient`` divides."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = float(scale)
+
+    def has_overflow(self, params):  # parity: static scaler never overflows
+        del params
+        return False
+
+    def update_scale(self, overflow):
+        del overflow
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g / self.cur_scale, grads)
+
+    def backward(self, loss_fn_or_loss):
+        """Scale a loss value (the reference calls scaled_loss.backward())."""
+        return loss_fn_or_loss * self.cur_scale
+
+
+class DynamicLossScaler(LossScaler):
+    """ref loss_scaler.py:47 — host-side dynamic scaling."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, grads) -> bool:
+        return _has_overflow(grads)
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

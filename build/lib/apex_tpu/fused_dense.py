@@ -1,0 +1,120 @@
+"""Fused dense layers (TPU re-design of ``apex.fused_dense``;
+ref apex/fused_dense/fused_dense.py, csrc/fused_dense_cuda.cu).
+
+The CUDA path fuses gemm+bias (and gemm+bias+gelu+gemm+bias) via cublasLt
+epilogues. XLA performs the same fusion on TPU from plain jnp expressions,
+so these are thin, numerically-defined entry points with the reference's
+API; ``fused_dense_gelu_dense_function`` uses a custom_vjp that saves
+``gelu_in`` and ``output1`` exactly like the reference's backward
+(ref fused_dense.py:34-46) instead of rematerializing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense_function(input, weight, bias):
+    """gemm + bias; weight is (in, out) (ref FusedDenseFunc)."""
+    return jnp.matmul(input, weight) + bias
+
+
+def dense_no_bias_function(input, weight):
+    return jnp.matmul(input, weight)
+
+
+@jax.custom_vjp
+def fused_dense_gelu_dense_function(input, weight1, bias1, weight2, bias2):
+    """dense → gelu → dense (ref FusedDenseGeluDenseFunc)."""
+    gelu_in = jnp.matmul(input, weight1) + bias1
+    output1 = jax.nn.gelu(gelu_in, approximate=False)
+    return jnp.matmul(output1, weight2) + bias2
+
+
+def _fdgd_fwd(input, weight1, bias1, weight2, bias2):
+    gelu_in = jnp.matmul(input, weight1) + bias1
+    output1 = jax.nn.gelu(gelu_in, approximate=False)
+    output2 = jnp.matmul(output1, weight2) + bias2
+    return output2, (input, weight1, weight2, gelu_in, output1)
+
+
+def _fdgd_bwd(res, g):
+    input, weight1, weight2, gelu_in, output1 = res
+    # second gemm
+    d_output1 = jnp.matmul(g, weight2.T)
+    d_weight2 = jnp.einsum("...i,...o->io", output1, g)
+    d_bias2 = jnp.sum(g, axis=tuple(range(g.ndim - 1)))
+    # gelu (exact erf form) backward
+    _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t, approximate=False), gelu_in)
+    d_gelu_in = gelu_vjp(d_output1)[0]
+    # first gemm
+    d_input = jnp.matmul(d_gelu_in, weight1.T)
+    d_weight1 = jnp.einsum("...i,...o->io", input, d_gelu_in)
+    d_bias1 = jnp.sum(d_gelu_in, axis=tuple(range(d_gelu_in.ndim - 1)))
+    return d_input, d_weight1, d_bias1, d_weight2, d_bias2
+
+
+fused_dense_gelu_dense_function.defvjp(_fdgd_fwd, _fdgd_bwd)
+
+# O1 boundary casts: gemm(+gelu) chains are MXU work → compute dtype
+from apex_tpu.amp.amp import half_function as _half_function  # noqa: E402
+
+fused_dense_function = _half_function(fused_dense_function)
+dense_no_bias_function = _half_function(dense_no_bias_function)
+fused_dense_gelu_dense_function = _half_function(fused_dense_gelu_dense_function)
+
+
+class FusedDense:
+    """apex-shaped module (ref fused_dense.py:66 FusedDense). Weights are
+    stored (in, out); ``.params`` is the optimizer-ready pytree."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 seed: int = 0, dtype=jnp.float32):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        k = jax.random.PRNGKey(seed)
+        kw, kb = jax.random.split(k)
+        bound = 1.0 / in_features ** 0.5
+        self.params = {"weight": jax.random.uniform(
+            kw, (in_features, out_features), dtype, -bound, bound)}
+        if bias:
+            self.params["bias"] = jax.random.uniform(
+                kb, (out_features,), dtype, -bound, bound)
+
+    def __call__(self, x, params=None):
+        p = params if params is not None else self.params
+        if self.use_bias:
+            return fused_dense_function(x, p["weight"], p["bias"])
+        return dense_no_bias_function(x, p["weight"])
+
+
+class FusedDenseGeluDense:
+    """ref fused_dense.py:84 FusedDenseGeluDense."""
+
+    def __init__(self, in_features: int, intermediate_features: int,
+                 out_features: int, bias: bool = True, seed: int = 0,
+                 dtype=jnp.float32):
+        if not bias:
+            raise ValueError(
+                "FusedDenseGeluDense requires bias=True (ref fused_dense.py:88)")
+        k = jax.random.PRNGKey(seed)
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        b1 = 1.0 / in_features ** 0.5
+        b2 = 1.0 / intermediate_features ** 0.5
+        self.params = {
+            "weight1": jax.random.uniform(
+                k1, (in_features, intermediate_features), dtype, -b1, b1),
+            "bias1": jax.random.uniform(
+                k2, (intermediate_features,), dtype, -b1, b1),
+            "weight2": jax.random.uniform(
+                k3, (intermediate_features, out_features), dtype, -b2, b2),
+            "bias2": jax.random.uniform(
+                k4, (out_features,), dtype, -b2, b2),
+        }
+
+    def __call__(self, x, params=None):
+        p = params if params is not None else self.params
+        return fused_dense_gelu_dense_function(
+            x, p["weight1"], p["bias1"], p["weight2"], p["bias2"])

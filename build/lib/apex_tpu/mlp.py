@@ -1,0 +1,130 @@
+"""Fused MLP (TPU re-design of ``apex.mlp``; ref apex/mlp/mlp.py:26 MLP,
+csrc/mlp.cpp / mlp_cuda).
+
+The CUDA extension fuses the whole dense-bias-activation chain into one
+kernel launch sequence with a single workspace. Under XLA one jitted call
+already compiles the chain into fused HLO (gemm + bias + act per layer, no
+intermediate round-trips beyond the gemm outputs), so the value here is the
+API and the activation semantics (none | relu | sigmoid, ref mlp.py:40-47),
+plus a ``custom_vjp`` that recomputes activations in the backward pass the
+way mlp_cuda's backward reuses its saved outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+_ACTIVATIONS = ("none", "relu", "sigmoid")
+
+
+def _act(y, activation: str):
+    if activation == "relu":
+        return jax.nn.relu(y)
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(y)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def mlp_function(bias: bool, activation: str, x, *weights_and_biases):
+    """Functional fused MLP (ref mlp.py:24 ``mlp_function``).
+
+    ``weights_and_biases``: ``w0, b0, w1, b1, ...`` when ``bias`` else
+    ``w0, w1, ...``; weights are ``(in, out)``. Activation applies to every
+    layer except the last (ref mlp.py MlpFunction/C++ semantics: hidden
+    layers activated, output layer linear).
+    """
+    return _forward(bias, activation, x, weights_and_biases)
+
+
+def _forward(bias, activation, x, wb):
+    step = 2 if bias else 1
+    n = len(wb) // step
+    y = x
+    for i in range(n):
+        w = wb[i * step]
+        y = jnp.matmul(y, w)
+        if bias:
+            y = y + wb[i * step + 1]
+        if i < n - 1:
+            y = _act(y, activation)
+    return y
+
+
+def _mlp_fwd(bias, activation, x, *wb):
+    # save only inputs/params; hidden activations are recomputed in bwd
+    # (remat — trades FLOPs for HBM exactly like jax.checkpoint)
+    return _forward(bias, activation, x, wb), (x, wb)
+
+
+def _mlp_bwd(bias, activation, res, g):
+    x, wb = res
+
+    def f(x, *wb):
+        return _forward(bias, activation, x, wb)
+
+    _, vjp = jax.vjp(f, x, *wb)
+    return vjp(g)
+
+
+mlp_function.defvjp(_mlp_fwd, _mlp_bwd)
+
+# O1 boundary cast: the matmul chain is MXU work → compute dtype
+# (consumes amp/lists.py via amp_call's classification; ref apex registers
+# mlp through amp.half_function the same way)
+from apex_tpu.amp.amp import half_function as _half_function  # noqa: E402
+
+mlp_function = _half_function(mlp_function)
+
+
+class MLP:
+    """apex-shaped MLP container (ref mlp.py:26).
+
+    ``mlp_sizes`` e.g. ``[1024, 1024, 1024]`` builds two layers. Parameters
+    live in ``.params`` (a pytree usable with the functional optimizers);
+    ``__call__(x[, params])`` runs the fused chain.
+    """
+
+    def __init__(self, mlp_sizes: Sequence[int], bias: bool = True,
+                 activation: str = "relu", seed: int = 0,
+                 dtype=jnp.float32):
+        if activation not in _ACTIVATIONS:
+            raise TypeError(
+                f"activation must be one of {_ACTIVATIONS}, got {activation}")
+        self.mlp_sizes = list(mlp_sizes)
+        self.num_layers = len(mlp_sizes) - 1
+        self.bias = bias
+        self.activation = activation
+        self.params = self._init(jax.random.PRNGKey(seed), dtype)
+
+    def _init(self, key, dtype):
+        # ref mlp.py reset_parameters: kaiming-uniform-ish over fan_in
+        params = []
+        for i in range(self.num_layers):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            key, kw, kb = jax.random.split(key, 3)
+            bound = 1.0 / fan_in ** 0.5
+            layer = {"w": jax.random.uniform(
+                kw, (fan_in, fan_out), dtype, -bound, bound)}
+            if self.bias:
+                layer["b"] = jax.random.uniform(
+                    kb, (fan_out,), dtype, -bound, bound)
+            params.append(layer)
+        return params
+
+    def _flat(self, params):
+        flat = []
+        for layer in params:
+            flat.append(layer["w"])
+            if self.bias:
+                flat.append(layer["b"])
+        return flat
+
+    def __call__(self, x, params: Optional[list] = None):
+        p = params if params is not None else self.params
+        return mlp_function(self.bias, self.activation, x, *self._flat(p))

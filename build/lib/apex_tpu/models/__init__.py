@@ -1,0 +1,6 @@
+"""Model zoo for the reference's benchmark configs (SURVEY.md §2 #52):
+llama (flagship), gpt2, bert, resnet, mlp, dcgan."""
+
+from apex_tpu.models import bert, dcgan, gpt2, llama, mlp, resnet
+
+__all__ = ["bert", "dcgan", "gpt2", "llama", "mlp", "resnet"]

@@ -1,0 +1,60 @@
+"""DCGAN generator/discriminator — the reference's mixed-precision GAN
+example (ref examples/dcgan/main_amp.py), exercising amp with MULTIPLE
+models/optimizers/losses (the amp.initialize list-of-models path).
+
+NHWC flax modules; transposed convs for G, strided convs for D.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.models._common import BatchNorm
+
+
+class Generator(nn.Module):
+    latent_dim: int = 100
+    width: int = 64
+    out_channels: int = 3
+    sync_bn: bool = False
+    axis_name: Optional[str] = "data"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        """z [b, latent] → image [b, 32, 32, c] in (-1, 1)."""
+        w = self.width
+        x = nn.Dense(4 * 4 * w * 4, dtype=self.dtype)(z.astype(self.dtype))
+        x = x.reshape(x.shape[0], 4, 4, w * 4)
+        for mult in (2, 1):
+            x = nn.relu(BatchNorm(sync=self.sync_bn, axis_name=self.axis_name)(
+                x, train))
+            x = nn.ConvTranspose(w * mult, (4, 4), strides=(2, 2),
+                                 dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(sync=self.sync_bn, axis_name=self.axis_name)(x, train))
+        x = nn.ConvTranspose(self.out_channels, (4, 4), strides=(2, 2),
+                             dtype=self.dtype)(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    width: int = 64
+    sync_bn: bool = False
+    axis_name: Optional[str] = "data"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        """image [b, 32, 32, c] → logit [b]."""
+        x = x.astype(self.dtype)
+        for i, mult in enumerate((1, 2, 4)):
+            x = nn.Conv(self.width * mult, (4, 4), strides=(2, 2),
+                        dtype=self.dtype)(x)
+            if i > 0:
+                x = BatchNorm(sync=self.sync_bn, axis_name=self.axis_name)(x, train)
+            x = nn.leaky_relu(x, 0.2)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1, dtype=jnp.float32)(x)[:, 0]

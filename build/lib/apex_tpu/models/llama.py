@@ -1,0 +1,487 @@
+"""Llama model family (flagship) — TP/SP/CP/PP-composable functional model.
+
+Role in the framework: the reference (NVIDIA Apex) ships no model zoo, but
+its headline benchmarks run Megatron-style transformers built from its
+primitives (ColumnParallelLinear/RowParallelLinear, FusedRMSNorm, fused
+softmax/RoPE — ref apex/transformer/tensor_parallel/layers.py,
+apex/normalization/fused_layer_norm.py, apex/transformer/functional/).
+This module is the TPU-native assembly of those same primitives into the
+Llama-3 architecture (RMSNorm pre-norm, SwiGLU, GQA, RoPE).
+
+Design: pure-functional param pytrees with stacked per-layer weights
+([L, ...] leading dim, consumed by ``lax.scan``) so the whole depth compiles
+as one rolled loop (fast compile, remat-friendly). Every collective degrades
+to a no-op when its mesh axis is unbound, so the SAME code runs single-chip,
+under tp-only shard_map, and as one pipeline stage:
+
+- tp:   column/row-parallel projections, vocab-parallel embedding + CE
+- sp:   ``sequence_parallel=True`` switches tp collectives to
+        reduce_scatter/all_gather over the sequence dim
+- cp:   ring attention over the 'cp' axis; RoPE uses global positions
+- pp:   :func:`stage_fn` applies a contiguous slice of layers — feed it to
+        ``pipeline_parallel.schedules``
+- ep:   ``num_experts > 0`` swaps the dense SwiGLU MLP for Mixtral-style
+        top-k routed experts (apex_tpu.transformer.moe); experts shard
+        over the 'ep' axis, the router replicates. The load-balancing aux
+        loss is returned by :func:`loss_fn`; the pipeline ``stage_fn``
+        path drops it (documented — activations are the only pp payload).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models._common import fan_in_normal
+
+from apex_tpu.normalization.fused_layer_norm import fused_rms_norm_affine
+from apex_tpu.transformer.context_parallel import (
+    context_parallel_positions,
+    ring_attention,
+)
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.transformer.functional.rope import apply_rotary_qk
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    _axis_bound,
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    tie_embeddings: bool = False
+    # Mixtral-style MoE: 0 = dense SwiGLU; >0 routes tokens through that
+    # many SwiGLU experts (top-k, capacity-dropped) over the 'ep' axis
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama3_8b(**over) -> LlamaConfig:
+    return LlamaConfig(**over)
+
+
+def flagship_0p9b(**over) -> LlamaConfig:
+    """The single-chip benchmark config (bench.py's Llama MFU model and
+    tools/tpu_profile.py's traced model — one definition so the profile
+    always explains the bench number)."""
+    kw = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+              num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+              dtype=jnp.bfloat16)
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+def tiny(**over) -> LlamaConfig:
+    """Test-scale config (tp/cp-divisible heads)."""
+    kw = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128, dtype=jnp.float32,
+    )
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+def init_params(key, cfg: LlamaConfig):
+    """Full (unsharded) parameter pytree; layer weights stacked on dim 0.
+
+    Shard for tp with ``P(None, 'tp')`` on column kernels (wq/wk/wv/wg/wu),
+    ``P(None, 'tp', None)`` on row kernels' input dim (wo/wd), ``P('tp',)``
+    on the embedding's vocab dim and the lm head's output dim.
+    """
+    h, i, d = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    nq, nkv, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    dt = cfg.dtype
+
+    ks = jax.random.split(key, 10)
+
+    def norm(k, *shape, fan_in=None):
+        return fan_in_normal(k, *shape, fan_in=fan_in, dtype=dt)
+
+    layers = {
+        "attn_norm": jnp.ones((L, h), dt),
+        "wq": norm(ks[1], L, h, nq * d),
+        "wk": norm(ks[2], L, h, nkv * d),
+        "wv": norm(ks[3], L, h, nkv * d),
+        "wo": norm(ks[4], L, nq * d, h),
+        "mlp_norm": jnp.ones((L, h), dt),
+    }
+    if cfg.moe:
+        E = cfg.num_experts
+        layers.update({
+            "router": (jax.random.normal(ks[9], (L, h, E)) * 0.02
+                       ).astype(dt),
+            "wg": norm(ks[5], L, E, h, i),
+            "wu": norm(ks[6], L, E, h, i),
+            "wd": norm(ks[7], L, E, i, h),
+        })
+    else:
+        layers.update({
+            "wg": norm(ks[5], L, h, i),
+            "wu": norm(ks[6], L, h, i),
+            "wd": norm(ks[7], L, i, h),
+        })
+    params = {
+        "embed": norm(ks[0], cfg.vocab_size, h, fan_in=h),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(ks[8], h, cfg.vocab_size, fan_in=h)
+    return params
+
+
+def _rmsnorm(x, w, eps):
+    return fused_rms_norm_affine(x, w, (x.shape[-1],), eps=eps)
+
+
+def _attention(x, lp, cfg: LlamaConfig, positions, tp_axis, cp_axis,
+               sequence_parallel):
+    """GQA attention on [b, s_local, h]; q/k/v heads tp-sharded, sequence
+    cp-sharded (ring attention when 'cp' is bound)."""
+    b = x.shape[0]
+    d = cfg.head_dim
+    tp = jax.lax.axis_size(tp_axis) if _axis_bound(tp_axis) else 1
+    if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads}")
+    nq, nkv = cfg.num_heads // tp, cfg.num_kv_heads // tp
+
+    # x arrives sequence-FULL (decoder_layer gathers once in sp mode), so
+    # the qkv projections never re-gather.
+    q = column_parallel_linear(x, lp["wq"], gather_output=False,
+                               axis_name=tp_axis)
+    k = column_parallel_linear(x, lp["wk"], gather_output=False,
+                               axis_name=tp_axis)
+    v = column_parallel_linear(x, lp["wv"], gather_output=False,
+                               axis_name=tp_axis)
+    s_full = q.shape[1]
+    q = q.reshape(b, s_full, nq, d)
+    k = k.reshape(b, s_full, nkv, d)
+    v = v.reshape(b, s_full, nkv, d)
+
+    q, k = apply_rotary_qk(q, k, positions=positions, base=cfg.rope_theta)
+
+    if _axis_bound(cp_axis):
+        # ring_attention is GQA-aware: k/v circulate at nkv heads
+        o = ring_attention(q, k, v, axis_name=cp_axis, causal=True)
+    else:
+        # GQA-aware flash attention: online softmax, no [s, s] matrix in
+        # HBM fwd or bwd (jnp fallback off-TPU is the same math)
+        o = flash_attention(q, k, v, causal=True, scale=d ** -0.5)
+
+    o = o.reshape(b, s_full, nq * d)
+    return row_parallel_linear(o, lp["wo"], input_is_parallel=True,
+                               sequence_parallel_enabled=sequence_parallel,
+                               axis_name=tp_axis, seq_dim=1)
+
+
+def _mlp(x, lp, tp_axis, sequence_parallel):
+    # x arrives sequence-full (see decoder_layer); no per-gemm gather.
+    g = column_parallel_linear(x, lp["wg"], gather_output=False,
+                               axis_name=tp_axis)
+    u = column_parallel_linear(x, lp["wu"], gather_output=False,
+                               axis_name=tp_axis)
+    return row_parallel_linear(jax.nn.silu(g) * u, lp["wd"],
+                               input_is_parallel=True,
+                               sequence_parallel_enabled=sequence_parallel,
+                               axis_name=tp_axis, seq_dim=1)
+
+
+def _moe_cfg(cfg: LlamaConfig):
+    from apex_tpu.transformer.moe import MoEConfig
+
+    return MoEConfig(hidden_size=cfg.hidden_size,
+                     ffn_hidden_size=cfg.intermediate_size,
+                     num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                     capacity_factor=cfg.moe_capacity_factor)
+
+
+def _moe_mlp(x, lp, cfg: LlamaConfig, ep_axis, tp_axis, sequence_parallel):
+    """Mixtral-style routed SwiGLU experts in place of the dense MLP.
+
+    x arrives sequence-full and tp-replicated (every tp rank computes the
+    same routing — experts shard over 'ep', orthogonal to tp; grads of the
+    expert weights are therefore tp-identical). Returns (y, aux); in sp
+    mode y is scattered back to the sequence-sharded stream.
+    """
+    from apex_tpu.transformer.moe import expert_parallel_apply
+
+    def expert_fn(p, tokens):  # [E_local, C', h] -> [E_local, C', h]
+        g = jnp.einsum("ech,ehf->ecf", tokens,
+                       p["wg"].astype(tokens.dtype))
+        u = jnp.einsum("ech,ehf->ecf", tokens,
+                       p["wu"].astype(tokens.dtype))
+        return jnp.einsum("ecf,efh->ech", jax.nn.silu(g) * u,
+                          p["wd"].astype(tokens.dtype))
+
+    y, aux = expert_parallel_apply(
+        expert_fn, {"wg": lp["wg"], "wu": lp["wu"], "wd": lp["wd"]}, x,
+        lp["router"], _moe_cfg(cfg), ep_axis=ep_axis)
+    if sequence_parallel:
+        y = scatter_to_sequence_parallel_region(y, tp_axis, seq_dim=1)
+    return y, aux
+
+
+def decoder_layer(x, lp, cfg: LlamaConfig, positions,
+                  tp_axis: Optional[str] = "tp",
+                  cp_axis: Optional[str] = "cp",
+                  sequence_parallel: bool = False,
+                  ep_axis: Optional[str] = "ep"):
+    """One pre-norm block on a single layer's (unstacked) params ``lp``.
+    Returns ``(x, aux)`` — aux is the MoE load-balancing loss (0 dense).
+
+    In sp mode the residual stream (and the norms) stay sequence-sharded;
+    each half-block all-gathers the normed input ONCE for its column gemms
+    and reduce-scatters the row-gemm output (Megatron sequence-parallel
+    comm pattern: 2 gathers + 2 scatters per layer, not one per gemm).
+    """
+
+    def to_full(h):
+        if sequence_parallel:
+            return gather_from_sequence_parallel_region(h, tp_axis, seq_dim=1)
+        return h
+
+    h = to_full(_rmsnorm(x, lp["attn_norm"], cfg.rms_eps))
+    x = x + _attention(h, lp, cfg, positions, tp_axis, cp_axis,
+                       sequence_parallel)
+    h = to_full(_rmsnorm(x, lp["mlp_norm"], cfg.rms_eps))
+    if cfg.moe:
+        y, aux = _moe_mlp(h, lp, cfg, ep_axis, tp_axis, sequence_parallel)
+    else:
+        y, aux = _mlp(h, lp, tp_axis, sequence_parallel), jnp.zeros(
+            (), jnp.float32)
+    return x + y, aux
+
+
+def _positions(b, s_local, cp_axis):
+    if _axis_bound(cp_axis):
+        pos = context_parallel_positions(s_local, cp_axis)
+    else:
+        pos = jnp.arange(s_local)
+    return jnp.broadcast_to(pos[None, :], (b, s_local))
+
+
+def run_layers(x, stacked, cfg: LlamaConfig, positions,
+               tp_axis="tp", cp_axis="cp", sequence_parallel=False,
+               remat=True, ep_axis: Optional[str] = "ep"):
+    """Scan a stacked [L, ...] layer pytree over the residual stream.
+    Returns ``(x, aux)`` — aux sums the per-layer MoE balance losses.
+
+    ``remat``: False = save all activations; True = full per-layer
+    recompute; ``"dots"`` = recompute only elementwise/norm chains while
+    keeping matmul outputs resident
+    (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) — the
+    usual best memory/MFU trade on TPU, where the recompute that hurts is
+    the MXU work, not the VPU chains."""
+
+    def body(h, lp):
+        # aux rides the scan's stacked outputs, not the carry — a fresh
+        # zero carry would need its vma hand-matched under shard_map
+        return decoder_layer(h, lp, cfg, positions, tp_axis, cp_axis,
+                             sequence_parallel, ep_axis)
+
+    if cfg.moe and _axis_bound(ep_axis):
+        # the MoE all_to_all makes the stream ep-varying; the carry must
+        # start that way or the scan's vma check trips
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            _to_varying,
+        )
+
+        x = _to_varying(x, ep_axis)
+    if remat:
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if remat == "dots" else None)
+        body = jax.checkpoint(body, policy=policy)
+    x, auxs = jax.lax.scan(body, x, stacked)
+    return x, jnp.sum(auxs)
+
+
+def embed(params, tokens, cfg: LlamaConfig, tp_axis="tp",
+          sequence_parallel=False):
+    x = vocab_parallel_embedding(tokens, params["embed"], axis_name=tp_axis)
+    x = x.astype(cfg.dtype)
+    if sequence_parallel:
+        x = scatter_to_sequence_parallel_region(x, tp_axis, seq_dim=1)
+    return x
+
+
+def lm_head_weight(params, cfg: LlamaConfig):
+    """The [h, vocab] classifier kernel (embed.T when tied)."""
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def lm_head(params, x, cfg: LlamaConfig, tp_axis="tp",
+            sequence_parallel=False):
+    """Final norm + vocab-sharded logits [b, s, vocab/tp] (fp32)."""
+    if sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    w = lm_head_weight(params, cfg)
+    # vocab-sharded output: plain local gemm, no gather (CE is vocab-parallel)
+    return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
+
+
+def hidden_states(params, tokens, cfg: LlamaConfig,
+                  tp_axis: Optional[str] = "tp",
+                  cp_axis: Optional[str] = "cp",
+                  sequence_parallel: bool = False, remat: bool = True,
+                  ep_axis: Optional[str] = "ep"):
+    """The shared model trunk: embed + all decoder layers (pre-final-norm).
+    tokens [b, s_local] → (hidden [b, s_local, h], moe aux loss). Both
+    loss paths (lm_head logits, chunked CE) consume this, so model
+    changes land in each exactly once."""
+    b, s = tokens.shape
+    positions = _positions(b, s, cp_axis)
+    x = embed(params, tokens, cfg, tp_axis, sequence_parallel)
+    return run_layers(x, params["layers"], cfg, positions, tp_axis,
+                      cp_axis, sequence_parallel, remat, ep_axis)
+
+
+def forward_with_aux(params, tokens, cfg: LlamaConfig,
+                     tp_axis: Optional[str] = "tp",
+                     cp_axis: Optional[str] = "cp",
+                     sequence_parallel: bool = False, remat: bool = True,
+                     ep_axis: Optional[str] = "ep"):
+    """tokens [b, s_local] → (vocab-sharded logits, moe aux loss)."""
+    x, aux = hidden_states(params, tokens, cfg, tp_axis, cp_axis,
+                           sequence_parallel, remat, ep_axis)
+    return lm_head(params, x, cfg, tp_axis, sequence_parallel), aux
+
+
+def forward(params, tokens, cfg: LlamaConfig,
+            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
+            sequence_parallel: bool = False, remat: bool = True,
+            ep_axis: Optional[str] = "ep"):
+    """tokens [b, s_local] → vocab-sharded logits [b, s_local, v_local]."""
+    return forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
+                            sequence_parallel, remat, ep_axis)[0]
+
+
+def loss_fn(params, batch, cfg: LlamaConfig,
+            tp_axis: Optional[str] = "tp", cp_axis: Optional[str] = "cp",
+            sequence_parallel: bool = False, remat: bool = True,
+            ep_axis: Optional[str] = "ep",
+            vocab_chunks: Optional[int] = None):
+    """Next-token CE (+ MoE balance aux when cfg.moe);
+    ``batch = (tokens, targets)`` both [b, s_local].
+
+    ``vocab_chunks``: stream the lm-head + CE in that many vocab slices
+    so the fp32 ``[b·s, vocab]`` logits — the largest live buffer of an
+    LLM step — are never materialized (functional/chunked_ce.py). With a
+    bound ``tp_axis`` the per-rank streams merge vocab-parallel."""
+    tokens, targets = batch
+    if vocab_chunks:
+        from apex_tpu.transformer.functional.chunked_ce import (
+            chunked_lm_cross_entropy,
+        )
+
+        x, aux = hidden_states(params, tokens, cfg, tp_axis, cp_axis,
+                               sequence_parallel, remat, ep_axis)
+        if sequence_parallel:
+            x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
+        x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+        losses = chunked_lm_cross_entropy(
+            x.reshape(-1, x.shape[-1]), lm_head_weight(params, cfg),
+            targets.reshape(-1), vocab_chunks,
+            tp_axis=tp_axis if _axis_bound(tp_axis) else None)
+        return jnp.mean(losses) + aux
+    logits, aux = forward_with_aux(params, tokens, cfg, tp_axis, cp_axis,
+                                   sequence_parallel, remat, ep_axis)
+    losses = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    return jnp.mean(losses) + aux
+
+
+def param_specs(cfg: LlamaConfig, tp_axis: str = "tp",
+                ep_axis: str = "ep"):
+    """PartitionSpec pytree matching :func:`init_params` (tp sharding):
+    column kernels split the output dim, row kernels the input dim, the
+    embedding/head split the vocab dim, norms replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tp_axis
+    layer_specs = {
+        "attn_norm": P(), "mlp_norm": P(),
+        "wq": P(None, None, t), "wk": P(None, None, t),
+        "wv": P(None, None, t), "wo": P(None, t, None),
+    }
+    if cfg.moe:
+        # experts shard over ep_axis (orthogonal to tp); router replicates
+        e = ep_axis
+        layer_specs.update({
+            "router": P(),
+            "wg": P(None, e, None, None),
+            "wu": P(None, e, None, None),
+            "wd": P(None, e, None, None),
+        })
+    else:
+        layer_specs.update({
+            "wg": P(None, None, t), "wu": P(None, None, t),
+            "wd": P(None, t, None),
+        })
+    specs = {
+        "embed": P(t, None),
+        "layers": layer_specs,
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, t)
+    return specs
+
+
+# ------------------------------------------------------------- pipeline view
+
+
+def stage_fn(stage_params, x, cfg: LlamaConfig, positions,
+             tp_axis="tp", cp_axis=None, sequence_parallel=False,
+             ep_axis: Optional[str] = "ep"):
+    """Apply one pipeline stage's stacked layer slice to the residual
+    stream — plug into ``pipeline_parallel.schedules`` (embedding/head live
+    outside via :func:`embed`/:func:`lm_head` on the first/last stage).
+    The MoE aux loss is dropped here: the pipeline transports activations
+    only — train MoE stages with the aux folded in via :func:`loss_fn`
+    style accounting outside pp, or accept routing without the balance
+    regularizer under pp."""
+    x, _ = run_layers(x, stage_params, cfg, positions, tp_axis, cp_axis,
+                      sequence_parallel, remat=False, ep_axis=ep_axis)
+    return x
+
+
+def split_stages(params, n_stages: int):
+    """Reshape stacked [L, ...] layers into [n_stages, L/n_stages, ...] for
+    ``shard_map`` with ``in_specs=P('pp', ...)``."""
+    def r(x):
+        return x.reshape(n_stages, x.shape[0] // n_stages, *x.shape[1:])
+
+    return jax.tree_util.tree_map(r, params["layers"])

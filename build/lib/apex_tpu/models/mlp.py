@@ -1,0 +1,65 @@
+"""Simple MLP — the reference's `apex.mlp.MLP` benchmark model and the O1
+"simple" example config (ref apex/mlp/mlp.py, examples/simple).
+
+The fused forward lives in :mod:`apex_tpu.mlp` (dense-bias-act chain); this
+module is the model-zoo wrapper used by tests/bench/examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models._common import fan_in_normal
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    sizes: Sequence[int] = (784, 1024, 1024, 10)
+    activation: str = "relu"  # relu | sigmoid | none (ref mlp.py activation)
+    bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+
+def init_params(key, cfg: MLPConfig):
+    ks = jax.random.split(key, len(cfg.sizes) - 1)
+    layers = []
+    for k, fan_in, fan_out in zip(ks, cfg.sizes[:-1], cfg.sizes[1:]):
+        w = fan_in_normal(k, fan_in, fan_out, dtype=cfg.dtype)
+        layer = {"w": w}
+        if cfg.bias:
+            layer["b"] = jnp.zeros((fan_out,), cfg.dtype)
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def _act(x, name: str):
+    if name == "relu":
+        return jax.nn.relu(x)
+    if name == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if name == "none":
+        return x
+    raise ValueError(f"unknown activation {name!r} (relu|sigmoid|none)")
+
+
+def forward(params, x, cfg: MLPConfig):
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        x = jnp.matmul(x, layer["w"])
+        if "b" in layer:
+            x = x + layer["b"]
+        if i < n - 1:
+            x = _act(x, cfg.activation)
+    return x
+
+
+def loss_fn(params, batch, cfg: MLPConfig):
+    """Softmax CE on integer labels; ``batch = (x, y)``."""
+    x, y = batch
+    logits = forward(params, x, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))

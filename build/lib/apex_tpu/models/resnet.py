@@ -1,0 +1,100 @@
+"""ResNet family — the reference's imagenet example model (ResNet-50 with
+amp O2 + DDP + optional SyncBatchNorm; ref examples/imagenet/main_amp.py,
+apex/parallel/sync_batchnorm.py).
+
+Flax linen modules (convs are stateful-ish with BN running stats, so the
+module abstraction earns its keep here, unlike the functional transformer
+families). NHWC layout — the TPU-native conv layout XLA tiles best.
+``sync_bn=True`` swaps plain BatchNorm for the cross-replica Welford
+:class:`apex_tpu.parallel.SyncBatchNorm` over the 'data'/'dp' mesh axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.models._common import BatchNorm
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck (the reference's contrib/bottleneck fused
+    block is the CUDA fusion of exactly this; XLA fuses it on TPU).
+
+    ``stride_1x1`` moves the downsampling stride from the 3x3 (ResNet
+    v1.5, the default here) onto the first 1x1 (v1 — ref
+    contrib/bottleneck/bottleneck.py ``stride_1x1``). The spatially-sharded
+    :class:`apex_tpu.contrib.bottleneck.SpatialBottleneck` always uses the
+    v1 placement (a strided per-shard 3x3 would break the halo phase), so
+    build the plain block with ``stride_1x1=True`` when parity with the
+    spatial variant matters.
+    """
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    sync_bn: bool = False
+    axis_name: Optional[str] = "data"
+    stride_1x1: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = partial(BatchNorm, sync=self.sync_bn, axis_name=self.axis_name)
+        conv = partial(nn.Conv, use_bias=False, dtype=x.dtype)
+        residual = x
+        s1 = self.strides if self.stride_1x1 else (1, 1)
+        s3 = (1, 1) if self.stride_1x1 else self.strides
+        y = conv(self.features, (1, 1), strides=s1)(x)
+        y = nn.relu(bn()(y, train))
+        y = conv(self.features, (3, 3), strides=s3)(y)
+        y = nn.relu(bn()(y, train))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = bn()(y, train)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=self.strides)(residual)
+            residual = bn()(residual, train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    sync_bn: bool = False
+    axis_name: Optional[str] = "data"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(sync=self.sync_bn,
+                               axis_name=self.axis_name)(x, train))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(self.width * 2 ** i, strides,
+                               self.sync_bn, self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+def tiny(**kw) -> ResNet:
+    """Test-scale: one block per stage, width 8, fp32."""
+    kw.setdefault("stage_sizes", (1, 1))
+    kw.setdefault("width", 8)
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("dtype", jnp.float32)
+    return ResNet(**kw)

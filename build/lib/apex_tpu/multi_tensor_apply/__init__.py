@@ -1,0 +1,17 @@
+"""Multi-tensor fused elementwise ops (TPU re-design of ``apex.multi_tensor_apply``).
+
+Ref: apex/multi_tensor_apply/multi_tensor_apply.py + csrc/multi_tensor_*.cu.
+On TPU there are no per-tensor kernel launches to amortize: a list of tensors
+is packed into one flat buffer and the op compiles to a single fused XLA
+kernel, which is the same end state the CUDA chunking machinery fights for.
+"""
+
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_l2norm_mp,
+    multi_tensor_l2norm_scale,
+)

@@ -1,0 +1,150 @@
+"""Fused multi-tensor elementwise ops.
+
+Functional equivalents of the reference CUDA kernels:
+
+- ``multi_tensor_scale``        ref csrc/multi_tensor_scale_kernel.cu
+- ``multi_tensor_axpby``        ref csrc/multi_tensor_axpby_kernel.cu
+- ``multi_tensor_l2norm``       ref csrc/multi_tensor_l2norm_kernel.cu
+- ``multi_tensor_l2norm_mp``    ref csrc/multi_tensor_l2norm_kernel_mp.cu
+- ``multi_tensor_l2norm_scale`` ref csrc/multi_tensor_l2norm_scale_kernel.cu
+
+Semantics notes vs the reference:
+- The CUDA kernels write into an ``overflow_buf`` int flag when they see
+  inf/nan. Here every op *returns* a boolean ``overflow`` scalar (computed in
+  the same fused pass), which callers fold into jit-compatible control flow
+  (``lax.cond`` / ``jnp.where``) instead of a host-side check.
+- Chunking is irrelevant under XLA (one executable regardless of tensor
+  count), so chunk_size is accepted and ignored by the applier shim.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flat import FlatSpec, flatten_tensors, unflatten_tensors
+
+
+def _flat(tensors: Sequence[jax.Array]):
+    return flatten_tensors(tensors)
+
+
+def _nonfinite(x: jax.Array) -> jax.Array:
+    return jnp.logical_not(jnp.all(jnp.isfinite(x)))
+
+
+def multi_tensor_scale(src_list, scale, out_dtype=None):
+    """out[i] = src[i] * scale, plus overflow flag.
+
+    Ref csrc/multi_tensor_scale_kernel.cu (used by amp unscale + O2 master-grad
+    copy). ``out_dtype`` supports the fp16<-fp32 copy-with-scale use.
+    """
+    flat, spec = _flat(src_list)
+    scaled = flat.astype(jnp.float32) * scale
+    overflow = _nonfinite(scaled)
+    out = scaled.astype(out_dtype or spec.dtype)
+    return unflatten_tensors(out, FlatSpec(spec.shapes, out.dtype, spec.offsets, spec.sizes, spec.total)), overflow
+
+
+def multi_tensor_axpby(x_list, y_list, a=1.0, b=1.0, out_dtype=None):
+    """out[i] = a*x[i] + b*y[i] with overflow detection.
+
+    Ref csrc/multi_tensor_axpby_kernel.cu (used by amp master-grad blending).
+    """
+    fx, spec = _flat(x_list)
+    fy, _ = _flat(y_list)
+    out = a * fx.astype(jnp.float32) + b * fy.astype(jnp.float32)
+    overflow = _nonfinite(out)
+    out = out.astype(out_dtype or spec.dtype)
+    return unflatten_tensors(out, FlatSpec(spec.shapes, out.dtype, spec.offsets, spec.sizes, spec.total)), overflow
+
+
+def multi_tensor_l2norm(tensor_list, per_tensor=False):
+    """Global (and optionally per-tensor) L2 norm in one fused pass.
+
+    Ref csrc/multi_tensor_l2norm_kernel.cu. Returns
+    ``(global_norm, per_tensor_norms | None)`` as fp32 scalars.
+    """
+    flat, spec = _flat(tensor_list)
+    sq = jnp.square(flat.astype(jnp.float32))
+    total = jnp.sqrt(jnp.sum(sq))
+    if not per_tensor:
+        return total, None
+    seg_ids = jnp.repeat(
+        jnp.arange(len(spec.sizes)), jnp.asarray(spec.sizes), total_repeat_length=spec.total
+    )
+    per = jnp.sqrt(jax.ops.segment_sum(sq, seg_ids, num_segments=len(spec.sizes)))
+    return total, per
+
+
+def multi_tensor_l2norm_mp(tensor_list, per_tensor=False):
+    """Mixed-precision variant: accumulates in fp32 regardless of input dtype.
+
+    Ref csrc/multi_tensor_l2norm_kernel_mp.cu. Identical accumulation here
+    (we always accumulate fp32), kept as a distinct entry point for parity.
+    """
+    return multi_tensor_l2norm(tensor_list, per_tensor=per_tensor)
+
+
+def multi_tensor_l2norm_scale(src_list, scale, per_tensor=False):
+    """Fused l2norm + scale in one pass (ref csrc/multi_tensor_l2norm_scale_kernel.cu)."""
+    flat, spec = _flat(src_list)
+    f32 = flat.astype(jnp.float32)
+    scaled = f32 * scale
+    norm = jnp.sqrt(jnp.sum(jnp.square(scaled)))
+    overflow = _nonfinite(scaled)
+    per = None
+    if per_tensor:
+        seg_ids = jnp.repeat(
+            jnp.arange(len(spec.sizes)), jnp.asarray(spec.sizes), total_repeat_length=spec.total
+        )
+        per = jnp.sqrt(jax.ops.segment_sum(jnp.square(scaled), seg_ids, num_segments=len(spec.sizes)))
+    out = unflatten_tensors(scaled.astype(spec.dtype), spec)
+    return out, norm, per, overflow
+
+
+class MultiTensorApply:
+    """API-parity shim for ``apex.multi_tensor_apply.multi_tensor_applier``.
+
+    Ref apex/multi_tensor_apply/multi_tensor_apply.py: callable taking
+    ``(op, overflow_buf, tensor_lists, *args)``. Chunking is a no-op under
+    XLA and the overflow flag is *returned* by the op instead of written
+    into ``overflow_buf``.
+
+    Apex's calling convention passes input and output lists together in
+    ``tensor_lists`` (scale: ``[src, dst]``; axpby: ``[x, y, out]``). JAX
+    arrays are immutable, so the trailing output lists cannot be written
+    in place — they are accepted for parity, ignored, and the results
+    returned. Each functional op declares how many leading lists are
+    inputs via its ``n_input_lists`` attribute.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size  # accepted for parity; XLA needs no chunking
+
+    @classmethod
+    def check_avail(cls):
+        """ref multi_tensor_apply.py check_avail — the reference raises
+        when the amp_C extension is missing; the XLA path is always
+        compiled in, so this never raises."""
+        return None
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args):
+        del noop_flag_buffer
+        n_in = getattr(op, "n_input_lists", len(tensor_lists))
+        return op(*tensor_lists[:n_in], *args)
+
+
+# Leading-input-list counts for the apex [inputs..., outputs...] convention.
+multi_tensor_scale.n_input_lists = 1          # [src, dst]
+multi_tensor_axpby.n_input_lists = 2          # [x, y, out]
+multi_tensor_l2norm.n_input_lists = 1
+multi_tensor_l2norm_mp.n_input_lists = 1
+multi_tensor_l2norm_scale.n_input_lists = 1
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -1,0 +1,137 @@
+"""FusedLayerNorm / FusedRMSNorm — TPU re-design of ``apex.normalization``.
+
+Ref: apex/normalization/fused_layer_norm.py (+ csrc/layer_norm_cuda_kernel.cu).
+
+Two API layers, mirroring the reference:
+- functional: ``fused_layer_norm[_affine]``, ``fused_rms_norm[_affine]`` and
+  the ``mixed_dtype_*`` variants (ref fused_layer_norm.py:168-203);
+- modules: ``FusedLayerNorm`` / ``FusedRMSNorm`` (flax.linen, ref :204/:300)
+  and the Megatron-style ``MixedFusedLayerNorm`` / ``MixedFusedRMSNorm``
+  (ref :398/:420) which keep fp32 affine params under bf16 activations.
+
+The compute path is the single-pass Pallas kernel in
+``apex_tpu/ops/layer_norm.py`` (fp32 statistics regardless of input dtype,
+like the CUDA kernel's float accumulators).
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops import layer_norm as _ops
+
+Shape = Union[int, Sequence[int]]
+
+
+def _canon(normalized_shape: Shape):
+    if isinstance(normalized_shape, numbers.Integral):
+        return (int(normalized_shape),)
+    return tuple(int(s) for s in normalized_shape)
+
+
+# ----------------------------------------------------------- functional API
+
+
+def fused_layer_norm_affine(input, weight, bias, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:168."""
+    return _ops.layer_norm(input, weight, bias, _canon(normalized_shape), eps)
+
+
+def fused_layer_norm(input, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:174."""
+    return _ops.layer_norm(input, None, None, _canon(normalized_shape), eps)
+
+
+def mixed_dtype_fused_layer_norm_affine(input, weight, bias, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:180 — bf16 input, fp32 affine."""
+    return _ops.layer_norm(input, weight, bias, _canon(normalized_shape), eps)
+
+
+def fused_rms_norm_affine(input, weight, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:186."""
+    return _ops.rms_norm(input, weight, _canon(normalized_shape), eps)
+
+
+def fused_rms_norm(input, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:192."""
+    return _ops.rms_norm(input, None, _canon(normalized_shape), eps)
+
+
+def mixed_dtype_fused_rms_norm_affine(input, weight, normalized_shape, eps=1e-6):
+    """Ref apex/normalization/fused_layer_norm.py:198."""
+    return _ops.rms_norm(input, weight, _canon(normalized_shape), eps)
+
+
+def manual_rms_norm(input, normalized_shape, weight, eps):
+    """Unfused reference path (ref apex/normalization/fused_layer_norm.py:16)."""
+    dims = tuple(range(-len(_canon(normalized_shape)), 0))
+    variance = jnp.mean(jnp.square(input.astype(jnp.float32)), axis=dims, keepdims=True)
+    out = (input.astype(jnp.float32) * (1.0 / jnp.sqrt(variance + eps))).astype(input.dtype)
+    if weight is not None:
+        out = weight * out
+    return out
+
+
+# ---------------------------------------------------------------- modules
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm module with the fused kernel (ref fused_layer_norm.py:204).
+
+    Args mirror ``torch.nn.LayerNorm`` / apex: ``normalized_shape``, ``eps``,
+    ``elementwise_affine``. ``memory_efficient`` recomputes in backward via
+    jax.checkpoint composability (statistics are always re-materialized from
+    (mu, rstd), so the default is already activation-light).
+    """
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+            return fused_layer_norm_affine(x, weight, bias, shape, self.eps)
+        return fused_layer_norm(x, shape, self.eps)
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm module with the fused kernel (ref fused_layer_norm.py:300)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+            return fused_rms_norm_affine(x, weight, shape, self.eps)
+        return fused_rms_norm(x, shape, self.eps)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Megatron variant: fp32 affine params under low-precision activations
+    (ref fused_layer_norm.py:398). In flax this is simply param_dtype=fp32
+    with the kernel handling the dtype mix."""
+
+    param_dtype: jnp.dtype = jnp.float32
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """Ref fused_layer_norm.py:420."""
+
+    param_dtype: jnp.dtype = jnp.float32

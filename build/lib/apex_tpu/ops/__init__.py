@@ -1,0 +1,12 @@
+"""Kernel layer: Pallas TPU kernels + flat-buffer fused tree ops.
+
+TPU analog of the reference's ``csrc/`` CUDA kernels (see SURVEY.md §2).
+"""
+
+from apex_tpu.ops.flat import (
+    FlatSpec,
+    flatten_tensors,
+    unflatten_tensors,
+    flatten_tree,
+    unflatten_tree,
+)

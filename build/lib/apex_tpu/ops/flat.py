@@ -1,0 +1,108 @@
+"""Flat-buffer packing: the TPU analog of Apex's tensor flattening.
+
+The reference relies on ``csrc/flatten_unflatten.cpp`` (torch's
+``_flatten_dense_tensors``) plus the ``multi_tensor_apply`` chunking machinery
+(``csrc/multi_tensor_apply.cuh``) so that elementwise updates over hundreds of
+small tensors become a handful of kernel launches. On TPU the same goal —
+one fused pass over all parameters — is met by packing leaves into a single
+1-D buffer per dtype and letting XLA/Pallas run one fused elementwise kernel
+over it.
+
+Everything here is jit-compatible: specs are static python metadata, pack and
+unpack are pure functions of arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static metadata describing how a list of arrays packs into one buffer."""
+
+    shapes: tuple  # tuple of shape-tuples
+    dtype: Any
+    offsets: tuple  # start offset of each leaf in the flat buffer
+    sizes: tuple
+    total: int
+
+    @staticmethod
+    def of(tensors: Sequence[jax.Array]) -> "FlatSpec":
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = {jnp.dtype(t.dtype) for t in tensors}
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"flatten_tensors requires a uniform dtype, got {dtypes}; "
+                "split into per-dtype lists first (as the reference does with "
+                "its g_16/g_32 lists)."
+            )
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        offsets = tuple(int(o) for o in np.cumsum((0,) + sizes)[:-1])
+        return FlatSpec(
+            shapes=shapes,
+            dtype=dtypes.pop() if dtypes else jnp.float32,
+            offsets=offsets,
+            sizes=sizes,
+            total=int(sum(sizes)),
+        )
+
+
+def flatten_tensors(tensors: Sequence[jax.Array], spec: FlatSpec | None = None):
+    """Pack a list of same-dtype arrays into one 1-D buffer.
+
+    Mirrors ``apex.parallel.distributed.flatten`` /
+    ``csrc/flatten_unflatten.cpp:flatten`` but stays inside jit (the concat
+    compiles to one fused copy).
+    """
+    if spec is None:
+        spec = FlatSpec.of(tensors)
+    if not tensors:
+        return jnp.zeros((0,), dtype=spec.dtype), spec
+    flat = jnp.concatenate([jnp.ravel(t) for t in tensors])
+    return flat, spec
+
+
+def unflatten_tensors(flat: jax.Array, spec: FlatSpec):
+    """Inverse of :func:`flatten_tensors` (ref csrc/flatten_unflatten.cpp:unflatten)."""
+    return [
+        jax.lax.dynamic_slice_in_dim(flat, off, size).reshape(shape)
+        for off, size, shape in zip(spec.offsets, spec.sizes, spec.shapes)
+    ]
+
+
+def flatten_tree(tree):
+    """Pack an arbitrary pytree into per-dtype flat buffers.
+
+    Returns ``(buffers, (treedef, leaf_dtypes, specs))`` where ``buffers`` is a
+    dict mapping dtype name -> 1-D buffer. Used by the flat-path optimizers to
+    run a single fused update per dtype regardless of how many parameters the
+    model has (the multi-tensor-apply analog).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    by_dtype: dict[str, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+    buffers = {}
+    specs = {}
+    for name, idxs in by_dtype.items():
+        buf, spec = flatten_tensors([leaves[i] for i in idxs])
+        buffers[name] = buf
+        specs[name] = (tuple(idxs), spec)
+    return buffers, (treedef, len(leaves), specs)
+
+
+def unflatten_tree(buffers, meta):
+    """Inverse of :func:`flatten_tree`."""
+    treedef, n_leaves, specs = meta
+    leaves: list = [None] * n_leaves
+    for name, (idxs, spec) in specs.items():
+        parts = unflatten_tensors(buffers[name], spec)
+        for i, part in zip(idxs, parts):
+            leaves[i] = part
+    return jax.tree_util.tree_unflatten(treedef, leaves)

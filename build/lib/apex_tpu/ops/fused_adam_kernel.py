@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the flat-buffer fused Adam update.
+
+The reference's ``csrc/multi_tensor_adam.cu`` is ONE kernel over chunked
+tensor lists; the TPU flat path packs the whole model into a 1-D buffer
+per dtype, and this kernel is the single fused elementwise pass over it
+(SURVEY §1 kernel layer: "fused adam/lamb on flat buffers"). XLA's own
+fusion of the jnp chain is the fallback and the baseline ``bench.py``
+races this kernel against — elementwise chains are XLA's home turf, so
+the kernel must EARN its default (``use_kernel=None`` defers to the
+pallas gate; the bench reports both).
+
+Layout: the 1-D buffer pads to a (rows, 1024) fp32-tileable slab and the
+grid walks row blocks; traced scalars (lr_t and the bias-correction
+denominators — step-dependent) ride a (1, 4) block, static hyperparams
+close over the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops import pallas_config
+
+_COLS = 1024
+_BLOCK_ROWS = 512
+
+
+def _adam_kernel(b1, b2, eps, weight_decay, adam_w_mode, bias_correction,
+                 sc_ref, g_ref, p_ref, m_ref, v_ref,
+                 d_ref, mo_ref, vo_ref):
+    lr_t = sc_ref[0, 0]
+    c1 = sc_ref[0, 1]
+    c2 = sc_ref[0, 2]
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    if bias_correction:
+        m_hat = m / c1
+        v_hat = v / c2
+    else:
+        m_hat, v_hat = m, v
+    update = m_hat / (jnp.sqrt(v_hat) + eps)
+    if adam_w_mode and weight_decay:
+        update = update + weight_decay * p
+    d_ref[...] = (-lr_t * update).astype(d_ref.dtype)
+    mo_ref[...] = m
+    vo_ref[...] = v
+
+
+def _pad_to_slab(x, block_rows):
+    n = x.size
+    per = _COLS * block_rows
+    rows = -(-n // _COLS)
+    rows = -(-rows // block_rows) * block_rows
+    pad = rows * _COLS - n
+    if pad:
+        x = jnp.pad(x.ravel(), (0, pad))
+    return x.reshape(rows, _COLS), n
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "b1", "b2", "eps", "weight_decay", "adam_w_mode", "bias_correction",
+    "interpret"))
+def adam_flat_pallas(g, p, m, v, lr_t, step, *, b1, b2, eps, weight_decay,
+                     adam_w_mode, bias_correction, interpret=False):
+    """One fused Adam pass over 1-D buffers.
+
+    ``g``/``m``/``v`` fp32, ``p`` any float dtype; ``lr_t``/``step``
+    traced scalars. Returns ``(delta, m', v')`` with delta in p's dtype.
+    """
+    block = _BLOCK_ROWS if g.size >= _COLS * _BLOCK_ROWS else 8
+    g2, n = _pad_to_slab(g.astype(jnp.float32), block)
+    p2, _ = _pad_to_slab(p, block)
+    m2, _ = _pad_to_slab(m, block)
+    v2, _ = _pad_to_slab(v, block)
+    rows = g2.shape[0]
+    step = step.astype(jnp.float32)
+    scalars = jnp.stack([
+        jnp.asarray(lr_t, jnp.float32),
+        1.0 - b1 ** step if bias_correction else jnp.float32(1.0),
+        1.0 - b2 ** step if bias_correction else jnp.float32(1.0),
+        jnp.float32(0.0),
+    ]).reshape(1, 4)
+
+    row_spec = pl.BlockSpec((block, _COLS), lambda i: (i, 0))
+    sc_spec = pl.BlockSpec((1, 4), lambda i: (0, 0))
+    d2, mo2, vo2 = pl.pallas_call(
+        functools.partial(_adam_kernel, b1, b2, eps, weight_decay,
+                          adam_w_mode, bias_correction),
+        grid=(rows // block,),
+        in_specs=[sc_spec, row_spec, row_spec, row_spec, row_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[
+            pallas_config.out_struct((rows, _COLS), p.dtype, g, p, m, v),
+            pallas_config.out_struct((rows, _COLS), jnp.float32, g, p, m, v),
+            pallas_config.out_struct((rows, _COLS), jnp.float32, g, p, m, v),
+        ],
+        interpret=interpret,
+    )(scalars, g2, p2, m2, v2)
+    return (d2.ravel()[:n], mo2.ravel()[:n], vo2.ravel()[:n])

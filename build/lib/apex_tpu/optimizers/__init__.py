@@ -1,0 +1,29 @@
+"""Fused optimizers (TPU re-design of ``apex.optimizers``).
+
+Each optimizer exists in two forms:
+- a functional, optax-compatible transform (``fused_adam(...)``) for jitted
+  functional training loops — the native TPU path;
+- an apex-style stateful class (``FusedAdam(params, ...)``) for drop-in
+  familiarity with the reference API (ref apex/optimizers/__init__.py).
+"""
+
+from apex_tpu.optimizers._base import opt_partition_specs
+from apex_tpu.optimizers.fused_adam import FusedAdam, fused_adam
+from apex_tpu.optimizers.fused_sgd import FusedSGD, fused_sgd
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, fused_lamb
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad, fused_adagrad
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, fused_novograd
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    FusedMixedPrecisionLamb,
+    fused_mixed_precision_lamb,
+)
+
+__all__ = [
+    "opt_partition_specs",
+    "FusedAdam", "fused_adam",
+    "FusedSGD", "fused_sgd",
+    "FusedLAMB", "fused_lamb",
+    "FusedAdagrad", "fused_adagrad",
+    "FusedNovoGrad", "fused_novograd",
+    "FusedMixedPrecisionLamb", "fused_mixed_precision_lamb",
+]

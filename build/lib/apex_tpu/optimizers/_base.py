@@ -1,0 +1,221 @@
+"""Stateful optimizer shim over functional (optax-style) transforms.
+
+The reference optimizers subclass ``torch.optim.Optimizer`` (mutable state,
+``.step()``). TPU-native training is functional — the transform's ``update``
+runs inside the user's jitted train step. ``FusedOptimizer`` wraps a
+transform with an apex-flavoured stateful API for drop-in familiarity and for
+the eager-ish scripting path; serious training should use the transform
+directly (``tx.init`` / ``tx.update``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import optax
+
+
+class FusedOptimizer:
+    """Apex-style stateful wrapper: holds params + opt state, ``step(grads)``.
+
+    Unlike torch there are no ``.grad`` attributes: gradients are passed to
+    ``step`` explicitly (a pytree matching params). ``zero_grad`` exists for
+    API parity and is a no-op (ref e.g. apex/optimizers/fused_adam.py:85
+    ``zero_grad``).
+    """
+
+    def __init__(self, params, tx: optax.GradientTransformation, defaults: dict,
+                 tx_factory: Optional[Callable] = None):
+        self.defaults = dict(defaults)
+        self.tx = tx
+        # rebuild hook: tx_factory(**overrides) -> GradientTransformation with
+        # the same hyperparams except the overrides (used by e.g. LARC to zero
+        # the inner weight decay, ref apex/parallel/LARC.py step()).
+        self._tx_factory = tx_factory
+        self.params = params
+        self.state = tx.init(params)
+        self._jit_step = jax.jit(self._functional_step)
+        # torch-style param groups: group 0 aliases (params, state) above;
+        # groups added later carry their own transform + state. Hyperparams
+        # in these dicts are LIVE: mutating param_groups[i]['lr'] (the
+        # torch LR-scheduler idiom) rebuilds that group's transform at the
+        # next step() via tx_factory.
+        self.param_groups = [{"params": params, **self.defaults}]
+        self._group_hparams = [dict(self.defaults)]
+        self._extra_groups = []
+
+    def _functional_step(self, grads, state, params):
+        updates, new_state = self.tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state
+
+    def add_param_group(self, group: dict) -> None:
+        """Add a parameter group with its own hyperparameters (ref
+        torch.optim.Optimizer.add_param_group; tested by the reference's
+        L0/run_amp/test_add_param_group.py).
+
+        ``group`` is ``{"params": pytree, **hyperparam_overrides}``; unknown
+        hyperparameters are rejected. With extra groups present, ``step``
+        takes a sequence of grad pytrees, one per group in order.
+        """
+        if not isinstance(group, dict) or "params" not in group:
+            raise ValueError("param group must be a dict with a 'params' key")
+        overrides = {k: v for k, v in group.items() if k != "params"}
+        unknown = set(overrides) - set(self.defaults)
+        if unknown:
+            raise ValueError(f"unknown hyperparameters for this optimizer: "
+                             f"{sorted(unknown)}")
+        if overrides and self._tx_factory is None:
+            raise ValueError(
+                "this optimizer does not support per-group overrides")
+        tx = self._tx_factory(**overrides) if overrides else self.tx
+        gparams = group["params"]
+        self._extra_groups.append({
+            "params": gparams, "state": tx.init(gparams), "tx": tx,
+            "jit_step": jax.jit(
+                lambda g, s, p, _tx=tx: self._group_step(_tx, g, s, p)),
+        })
+        self.param_groups.append({**self.defaults, **group})
+        self._group_hparams.append({**self.defaults, **overrides})
+
+    @staticmethod
+    def _group_step(tx, grads, state, params):
+        updates, new_state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def _sync_group_hparams(self) -> None:
+        """Honor torch-style in-place edits of ``param_groups[i]`` (e.g. an
+        LR scheduler writing ``group['lr']``): rebuild the affected group's
+        transform with the new hyperparameters. State layouts are shared
+        across hyperparam values, so the existing state carries over."""
+        for i, pg in enumerate(self.param_groups):
+            current = {k: pg[k] for k in self.defaults if k in pg}
+            if current == self._group_hparams[i]:
+                continue
+            if self._tx_factory is None:
+                raise ValueError(
+                    "param_groups hyperparameters changed but this "
+                    "optimizer has no tx_factory to rebuild from")
+            changed = {k: v for k, v in current.items()
+                       if v != self.defaults.get(k)}
+            tx = self._tx_factory(**changed)
+            # the carried state is only valid if the rebuilt transform has
+            # the same state LAYOUT (a tx_factory whose overrides toggle
+            # state structure, e.g. momentum on/off, would silently
+            # mismatch at the next jit step)
+            group_params = self.param_groups[i]["params"]
+            old_state = (self.state if i == 0
+                         else self._extra_groups[i - 1]["state"])
+            new_struct = jax.tree_util.tree_structure(
+                jax.eval_shape(tx.init, group_params))
+            old_struct = jax.tree_util.tree_structure(old_state)
+            if new_struct != old_struct:
+                raise ValueError(
+                    f"param_groups[{i}] hyperparameter change altered the "
+                    f"optimizer state structure ({old_struct} -> "
+                    f"{new_struct}); carried state cannot be reused — "
+                    f"rebuild the optimizer instead")
+            if i == 0:
+                self.tx = tx
+                self._jit_step = jax.jit(self._functional_step)
+            else:
+                grp = self._extra_groups[i - 1]
+                grp["tx"] = tx
+                grp["jit_step"] = jax.jit(
+                    lambda g, s, p, _tx=tx: self._group_step(_tx, g, s, p))
+            self._group_hparams[i] = current
+
+    def step(self, grads=None, closure: Optional[Callable] = None):
+        """Apply one fused update. Returns the new params (also stored on
+        self). With extra param groups, ``grads`` is a sequence of pytrees
+        (one per group) and the returned params are a list in group order."""
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError(
+                "apex_tpu optimizers are functional: pass grads to step() "
+                "(there is no .grad attribute to read on TPU)."
+            )
+        self._sync_group_hparams()
+        if not self._extra_groups:
+            self.params, self.state = self._jit_step(
+                grads, self.state, self.params)
+            self.param_groups[0]["params"] = self.params
+            return loss if loss is not None else self.params
+        if not isinstance(grads, (list, tuple)):
+            raise ValueError(
+                f"optimizer has {1 + len(self._extra_groups)} param groups: "
+                "pass a list of grad trees, one per group")
+        grads = list(grads)
+        if len(grads) != 1 + len(self._extra_groups):
+            raise ValueError(
+                f"expected {1 + len(self._extra_groups)} grad trees "
+                f"(one per param group), got {len(grads)}")
+        self.params, self.state = self._jit_step(
+            grads[0], self.state, self.params)
+        for g, grp in zip(grads[1:], self._extra_groups):
+            grp["params"], grp["state"] = grp["jit_step"](
+                g, grp["state"], grp["params"])
+        all_params = [self.params] + [g["params"] for g in self._extra_groups]
+        self.param_groups[0]["params"] = self.params
+        for pg, grp in zip(self.param_groups[1:], self._extra_groups):
+            pg["params"] = grp["params"]
+        return loss if loss is not None else all_params
+
+    def zero_grad(self, set_to_none: bool = True):  # noqa: ARG002 - parity no-op
+        return None
+
+    def state_dict(self) -> dict:
+        d = {"state": self.state, "defaults": self.defaults}
+        if self._extra_groups:
+            d["group_states"] = [g["state"] for g in self._extra_groups]
+        return d
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        new_state = state_dict["state"]
+        have = jax.tree_util.tree_structure(self.state)
+        got = jax.tree_util.tree_structure(new_state)
+        if have != got:
+            raise ValueError(
+                f"loaded optimizer state structure {got} does not match "
+                f"current optimizer structure {have}")
+        self.state = new_state
+        group_states = state_dict.get("group_states", [])
+        if len(group_states) != len(self._extra_groups):
+            raise ValueError(
+                f"loaded state has {len(group_states)} extra param groups, "
+                f"optimizer has {len(self._extra_groups)}")
+        for i, (grp, s) in enumerate(zip(self._extra_groups, group_states)):
+            have = jax.tree_util.tree_structure(grp["state"])
+            got = jax.tree_util.tree_structure(s)
+            if have != got:
+                raise ValueError(
+                    f"loaded state for param group {i + 1} has structure "
+                    f"{got}, optimizer has {have}")
+            grp["state"] = s
+        self.defaults.update(state_dict.get("defaults", {}))
+
+
+def opt_partition_specs(tx, params, param_specs):
+    """PartitionSpec tree for ``tx.init(params)`` state whose moment trees
+    mirror the param sharding (the Fused* ``(count, mu, nu)`` NamedTuples;
+    any other state replicates). The standard companion to sharding a
+    fused optimizer's state under ``shard_map``/``jit``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shapes = jax.eval_shape(tx.init, params)
+    specs = jax.tree_util.tree_map(
+        lambda _: P(), shapes,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    if hasattr(specs, "_replace") and hasattr(specs, "mu"):
+        # flat=True Fused* state packs mu/nu into dtype-keyed flat buffers
+        # whose tree structure does NOT mirror the params; grafting
+        # param_specs onto them would build a structure-mismatched spec
+        # tree that fails much later inside jit/shard_map. Leave flat
+        # moment buffers replicated (P()) instead.
+        mirrors = (jax.tree_util.tree_structure(shapes.mu)
+                   == jax.tree_util.tree_structure(params))
+        if mirrors:
+            specs = specs._replace(mu=param_specs, nu=param_specs)
+    return specs

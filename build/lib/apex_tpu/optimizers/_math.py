@@ -1,0 +1,146 @@
+"""Fused optimizer update math.
+
+Each function is the elementwise body the reference implements as a CUDA
+multi-tensor kernel (csrc/multi_tensor_{adam,lamb,sgd,novograd,adagrad}*.cu),
+expressed over arrays so it can run either per-leaf (tree mode — preserves
+shardings, XLA fuses the chain per leaf) or over a packed flat buffer (flat
+mode — one kernel for the whole model, the multi-tensor-apply end state).
+
+All state math is fp32; params may be any float dtype (cast in/out at the
+edges, matching the mixed-precision kernels' fp32 math on fp16 storage).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def adam_step(g, p, m, v, *, lr, b1, b2, eps, weight_decay, adam_w_mode, step, bias_correction):
+    """One Adam/AdamW update. Ref csrc/multi_tensor_adam.cu (ADAM_MODE_0/1).
+
+    Returns (delta, new_m, new_v) with delta = new_p - p in fp32.
+    """
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if not adam_w_mode and weight_decay:  # L2 mode: decay folded into the gradient
+        g32 = g32 + weight_decay * p32
+    m = b1 * m + (1.0 - b1) * g32
+    v = b2 * v + (1.0 - b2) * jnp.square(g32)
+    if bias_correction:
+        m_hat = m / (1.0 - b1 ** step)
+        v_hat = v / (1.0 - b2 ** step)
+    else:
+        m_hat, v_hat = m, v
+    update = m_hat / (jnp.sqrt(v_hat) + eps)
+    if adam_w_mode and weight_decay:
+        update = update + weight_decay * p32
+    return -lr * update, m, v
+
+
+def adagrad_step(g, p, h, *, lr, eps, weight_decay, adagrad_w_mode):
+    """One Adagrad update. Ref csrc/multi_tensor_adagrad.cu (MODE_0 = L2, MODE_1 = decoupled)."""
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if not adagrad_w_mode and weight_decay:
+        g32 = g32 + weight_decay * p32
+    h = h + jnp.square(g32)
+    update = g32 / (jnp.sqrt(h) + eps)
+    if adagrad_w_mode and weight_decay:
+        update = update + weight_decay * p32
+    return -lr * update, h
+
+
+def sgd_step(g, p, buf, *, lr, momentum, dampening, nesterov, weight_decay,
+             wd_after_momentum, first_run):
+    """One (momentum) SGD update. Ref csrc/multi_tensor_sgd_kernel.cu.
+
+    ``first_run`` seeds the momentum buffer with the raw gradient the way the
+    reference's ``get_momentums`` first-touch path does.
+    """
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if weight_decay and not wd_after_momentum:
+        g32 = g32 + weight_decay * p32
+    if momentum:
+        buf = jnp.where(first_run, g32, momentum * buf + (1.0 - dampening) * g32)
+        d = g32 + momentum * buf if nesterov else buf
+    else:
+        d = g32
+    if weight_decay and wd_after_momentum:
+        d = d + weight_decay * p32
+    return -lr * d, buf
+
+
+def lamb_moments(g, p, m, v, *, b1, b2, grad_averaging, clip_coeff, weight_decay, adam_w_mode):
+    """LAMB stage 1: clipped-grad moment update (ref csrc/multi_tensor_lamb.cu).
+
+    In L2 mode (MOMENT_MODE_0) the decay enters the gradient *before* the
+    moments, so it flows into m, v, and the trust-ratio numerator.
+    """
+    g32 = g.astype(jnp.float32) * clip_coeff
+    if not adam_w_mode and weight_decay:
+        g32 = g32 + weight_decay * p.astype(jnp.float32)
+    beta1_coeff = (1.0 - b1) if grad_averaging else 1.0
+    m = b1 * m + beta1_coeff * g32
+    v = b2 * v + (1.0 - b2) * jnp.square(g32)
+    return m, v
+
+
+def lamb_update_direction(p, m, v, *, b1, b2, eps, weight_decay, adam_w_mode, step, bias_correction):
+    """LAMB raw update direction u (before the trust-ratio scaling).
+
+    AdamW mode (MOMENT_MODE_1) adds decoupled decay here; L2 mode already
+    folded decay into the moments in :func:`lamb_moments`.
+    """
+    if bias_correction:
+        m_hat = m / (1.0 - b1 ** step)
+        v_hat = v / (1.0 - b2 ** step)
+    else:
+        m_hat, v_hat = m, v
+    u = m_hat / (jnp.sqrt(v_hat) + eps)
+    if adam_w_mode and weight_decay:
+        u = u + weight_decay * p.astype(jnp.float32)
+    return u
+
+
+def lamb_trust_ratio(p_norm, u_norm, *, weight_decay, use_nvlamb):
+    """Per-tensor trust ratio (ref csrc/multi_tensor_lamb.cu reduction epilogue)."""
+    ratio = jnp.where(
+        (p_norm > 0.0) & (u_norm > 0.0), p_norm / jnp.maximum(u_norm, 1e-30), 1.0
+    )
+    if not use_nvlamb and not weight_decay:
+        # NVLAMB off: parameters with no weight decay skip the adaptive rate.
+        ratio = jnp.ones_like(ratio)
+    return ratio
+
+
+def novograd_step(g, p, m, v_norm, *, lr, b1, b2, eps, weight_decay,
+                  grad_averaging, reg_inside_moment, step, bias_correction, norm_type):
+    """One NovoGrad update. Ref csrc/multi_tensor_novograd.cu.
+
+    ``v_norm`` is a per-tensor scalar EMA of the gradient *norm* (the
+    reference stores the norm, not its square, to unify L2/Linf handling:
+    L2 blends root-of-squares ``sqrt(b2*v^2 + (1-b2)*n^2)``, Linf blends
+    linearly — ref csrc/multi_tensor_novograd.cu norm comment). With
+    ``bias_correction`` both moments are corrected: m by ``(1-b1^t)`` and
+    the norm by ``sqrt(1-b2^t)``.
+    """
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if norm_type == 0:
+        gnorm = jnp.max(jnp.abs(g32))
+        v_new = b2 * v_norm + (1.0 - b2) * gnorm
+    else:
+        gnorm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        v_new = jnp.sqrt(b2 * jnp.square(v_norm) + (1.0 - b2) * jnp.square(gnorm))
+    v_hat = v_new / jnp.sqrt(1.0 - b2 ** step) if bias_correction else v_new
+    scaled = g32 / (v_hat + eps)
+    if weight_decay and reg_inside_moment:
+        scaled = scaled + weight_decay * p32
+    beta1_coeff = (1.0 - b1) if grad_averaging else 1.0
+    m = b1 * m + beta1_coeff * scaled
+    m_hat = m / (1.0 - b1 ** step) if bias_correction else m
+    update = m_hat
+    if weight_decay and not reg_inside_moment:
+        update = update + weight_decay * p32
+    return -lr * update, m, v_new

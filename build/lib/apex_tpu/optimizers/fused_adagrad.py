@@ -1,0 +1,62 @@
+"""FusedAdagrad — TPU re-design of ``apex.optimizers.FusedAdagrad``.
+
+Ref: apex/optimizers/fused_adagrad.py + csrc/multi_tensor_adagrad.cu.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import _math
+from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedAdagradState(NamedTuple):
+    count: jax.Array
+    sum: Any  # accumulated squared gradients ("h" in the kernel)
+
+
+def fused_adagrad(
+    lr: ScalarOrSchedule = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+    def init(params):
+        h = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedAdagradState(count=jnp.zeros([], jnp.int32), sum=h)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        lr_t = _lr_at(lr, state.count)  # optax convention: schedule sees pre-increment count
+        kw = dict(lr=lr_t, eps=eps, weight_decay=weight_decay,
+                  adagrad_w_mode=adagrad_w_mode)
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        h_leaves = jax.tree_util.tree_leaves(state.sum)
+        results = [_math.adagrad_step(g, p, h, **kw)
+                   for g, p, h in zip(g_leaves, p_leaves, h_leaves)]
+        updates = treedef.unflatten(
+            [r[0].astype(p.dtype) for r, p in zip(results, p_leaves)])
+        h = treedef.unflatten([r[1] for r in results])
+        return updates, FusedAdagradState(count=count, sum=h)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedAdagrad(FusedOptimizer):
+    """Stateful apex-style API (ref apex/optimizers/fused_adagrad.py:43)."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False):
+        del set_grad_none
+        kw = dict(lr=lr, eps=eps, weight_decay=weight_decay,
+                  adagrad_w_mode=adagrad_w_mode)
+        super().__init__(params, fused_adagrad(**kw),
+                         dict(lr=lr, eps=eps, weight_decay=weight_decay),
+                         tx_factory=lambda **ov: fused_adagrad(**{**kw, **ov}))

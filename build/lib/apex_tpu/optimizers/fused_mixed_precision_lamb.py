@@ -1,0 +1,80 @@
+"""FusedMixedPrecisionLamb — TPU re-design of
+``apex.optimizers.FusedMixedPrecisionLamb``.
+
+Ref: apex/optimizers/fused_mixed_precision_lamb.py. The reference keeps fp32
+master weights plus a reduced-precision model copy, with lr/step living on
+device for sync-free execution. Here the fp32 master lives *inside the
+optimizer state*; ``update`` runs LAMB on the master and returns deltas in
+the model's (possibly bf16/fp16) dtype. lr/step are traced scalars, so the
+whole step is sync-free by construction under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import ScalarOrSchedule
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+
+class FusedMPLambState(NamedTuple):
+    master: Any  # fp32 master params
+    inner: Any   # FusedLAMBState over the master tree
+
+
+def fused_mixed_precision_lamb(
+    lr: ScalarOrSchedule = 1e-3,
+    bias_correction: bool = True,
+    betas=(0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    adam_w_mode: bool = True,
+    grad_averaging: bool = True,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    reduced_precision_dtype=None,
+) -> optax.GradientTransformation:
+    del reduced_precision_dtype  # model dtype is whatever params carry
+    inner_tx = fused_lamb(lr=lr, bias_correction=bias_correction, betas=betas,
+                          eps=eps, weight_decay=weight_decay,
+                          adam_w_mode=adam_w_mode, grad_averaging=grad_averaging,
+                          max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb)
+
+    def init(params):
+        master = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+        return FusedMPLambState(master=master, inner=inner_tx.init(master))
+
+    def update(grads, state, params=None):
+        g32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        deltas, inner = inner_tx.update(g32, state.inner, state.master)
+        master = optax.apply_updates(state.master, deltas)
+        # model-precision update = round(master) - old model params
+        updates = jax.tree_util.tree_map(
+            lambda new_m, p: new_m.astype(p.dtype) - p, master, params)
+        return updates, FusedMPLambState(master=master, inner=inner)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedMixedPrecisionLamb(FusedOptimizer):
+    """Stateful apex-style API (ref apex/optimizers/fused_mixed_precision_lamb.py:10)."""
+
+    def __init__(self, params, lr=1e-3, step=0, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01, amsgrad=False,
+                 adam_w_mode=True, grad_averaging=True, max_grad_norm=1.0,
+                 use_nvlamb=False, reduced_precision_dtype=None):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        del step
+        tx = fused_mixed_precision_lamb(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb, reduced_precision_dtype=reduced_precision_dtype)
+        super().__init__(params, tx, dict(lr=lr, betas=betas, eps=eps,
+                                          weight_decay=weight_decay))

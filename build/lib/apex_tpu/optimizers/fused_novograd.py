@@ -1,0 +1,104 @@
+"""FusedNovoGrad — TPU re-design of ``apex.optimizers.FusedNovoGrad``.
+
+Ref: apex/optimizers/fused_novograd.py + csrc/multi_tensor_novograd.cu.
+
+The second moment is a per-tensor scalar EMA of the gradient *norm* (the
+reference stores the norm, not its square, to unify L2 / Linf handling;
+see fused_novograd.py:160). ``init_zero=False`` seeds it with the first
+step's norm so the first blend is a no-op, matching fused_novograd.py:168.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import _math
+from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jax.Array
+    mu: Any
+    v_norm: Any  # per-tensor scalar norm EMA
+
+
+def fused_novograd(
+    lr: ScalarOrSchedule = 1e-3,
+    bias_correction: bool = True,
+    betas=(0.95, 0.98),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+    reg_inside_moment: bool = False,
+    norm_type: int = 2,
+    init_zero: bool = False,
+) -> optax.GradientTransformation:
+    if norm_type not in (0, 2):
+        raise RuntimeError("FusedNovoGrad only support l2/inf norm now.")
+    b1, b2 = betas
+
+    def init(params):
+        return FusedNovoGradState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            v_norm=jax.tree_util.tree_map(lambda p: jnp.zeros([], jnp.float32), params),
+        )
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        step = count.astype(jnp.float32)
+        lr_t = _lr_at(lr, state.count)  # optax convention: schedule sees pre-increment count
+
+        def leaf(g, p, m, v):
+            g32 = g.astype(jnp.float32)
+            if norm_type == 0:
+                gnorm = jnp.max(jnp.abs(g32))
+            else:
+                gnorm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            # first step with init_zero=False: v <- gnorm (blend is a no-op)
+            v_eff = v if init_zero else jnp.where(state.count == 0, gnorm, v)
+            d, m, v_new = _math.novograd_step(
+                g, p, m, v_eff, lr=lr_t, b1=b1, b2=b2, eps=eps,
+                weight_decay=weight_decay, grad_averaging=grad_averaging,
+                reg_inside_moment=reg_inside_moment, step=step,
+                bias_correction=bias_correction, norm_type=norm_type)
+            return d.astype(p.dtype), m, v_new
+
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        m_leaves = jax.tree_util.tree_leaves(state.mu)
+        v_leaves = jax.tree_util.tree_leaves(state.v_norm)
+        results = [leaf(g, p, m, v)
+                   for g, p, m, v in zip(g_leaves, p_leaves, m_leaves, v_leaves)]
+        updates = treedef.unflatten([r[0] for r in results])
+        mu = treedef.unflatten([r[1] for r in results])
+        v = treedef.unflatten([r[2] for r in results])
+        return updates, FusedNovoGradState(count=count, mu=mu, v_norm=v)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedNovoGrad(FusedOptimizer):
+    """Stateful apex-style API (ref apex/optimizers/fused_novograd.py:67)."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True, betas=(0.95, 0.98),
+                 eps=1e-8, weight_decay=0.0, amsgrad=False, reg_inside_moment=False,
+                 grad_averaging=True, norm_type=2, init_zero=False,
+                 set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        del set_grad_none
+        kw = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                  eps=eps, weight_decay=weight_decay,
+                  grad_averaging=grad_averaging,
+                  reg_inside_moment=reg_inside_moment,
+                  norm_type=norm_type, init_zero=init_zero)
+        super().__init__(params, fused_novograd(**kw),
+                         dict(lr=lr, betas=betas, eps=eps,
+                              weight_decay=weight_decay),
+                         tx_factory=lambda **ov: fused_novograd(**{**kw, **ov}))

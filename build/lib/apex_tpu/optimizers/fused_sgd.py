@@ -1,0 +1,76 @@
+"""FusedSGD — TPU re-design of ``apex.optimizers.FusedSGD``.
+
+Ref: apex/optimizers/fused_sgd.py + csrc/multi_tensor_sgd_kernel.cu.
+Momentum/nesterov/dampening/weight-decay semantics match torch SGD with the
+reference's extra ``wd_after_momentum`` knob. ``materialize_master_grads``
+is a CUDA master-weight detail with no TPU analog (amp handles master
+params); accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers import _math
+from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedSGDState(NamedTuple):
+    count: jax.Array
+    momentum_buffer: Any
+
+
+def fused_sgd(
+    lr: ScalarOrSchedule,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    wd_after_momentum: bool = False,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+
+    def init(params):
+        buf = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return FusedSGDState(count=jnp.zeros([], jnp.int32), momentum_buffer=buf)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        first_run = state.count == 0  # seeds buf with raw grad (ref get_momentums)
+        lr_t = _lr_at(lr, state.count)  # optax convention: schedule sees pre-increment count
+        kw = dict(lr=lr_t, momentum=momentum, dampening=dampening, nesterov=nesterov,
+                  weight_decay=weight_decay, wd_after_momentum=wd_after_momentum,
+                  first_run=first_run)
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        b_leaves = jax.tree_util.tree_leaves(state.momentum_buffer)
+        results = [_math.sgd_step(g, p, b, **kw)
+                   for g, p, b in zip(g_leaves, p_leaves, b_leaves)]
+        updates = treedef.unflatten(
+            [r[0].astype(p.dtype) for r, p in zip(results, p_leaves)])
+        buf = treedef.unflatten([r[1] for r in results])
+        return updates, FusedSGDState(count=count, momentum_buffer=buf)
+
+    return optax.GradientTransformation(init, update)
+
+
+class FusedSGD(FusedOptimizer):
+    """Stateful apex-style API (ref apex/optimizers/fused_sgd.py:76)."""
+
+    def __init__(self, params, lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
+                 nesterov=False, wd_after_momentum=False,
+                 materialize_master_grads=True, set_grad_none=False):
+        del materialize_master_grads, set_grad_none  # no TPU analog / parity no-op
+        kw = dict(lr=lr, momentum=momentum, dampening=dampening,
+                  weight_decay=weight_decay, nesterov=nesterov,
+                  wd_after_momentum=wd_after_momentum)
+        super().__init__(params, fused_sgd(**kw), dict(
+            lr=lr, momentum=momentum, dampening=dampening,
+            weight_decay=weight_decay, nesterov=nesterov),
+            tx_factory=lambda **ov: fused_sgd(**{**kw, **ov}))

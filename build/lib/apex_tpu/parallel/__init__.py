@@ -1,0 +1,56 @@
+"""Distributed data parallelism (TPU re-design of ``apex.parallel``).
+
+Ref: apex/parallel/__init__.py.
+"""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    sync_gradients,
+    sync_gradients_flat,
+    average_reduced,
+    sync_autodiff_gradients,
+)
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
+from apex_tpu.parallel.larc import LARC, larc
+from apex_tpu.parallel import multiproc
+
+
+def create_syncbn_process_group(group_size, axis_name="data",
+                                world_size=None):
+    """ref apex/parallel/__init__.py:58 — stats subgroups for SyncBN.
+
+    The reference builds NCCL subgroups of ``group_size`` consecutive
+    ranks and returns the current GPU's group. On a mesh there is no
+    group object to build: the return value is the
+    ``(axis_name, group_size)`` pair to pass straight through
+    ``SyncBatchNorm(process_group=...)``, with the reference's
+    conventions kept — ``group_size=0`` means whole-axis sync and
+    returns ``None``; the size must divide the axis.
+
+    ``world_size`` defaults to ``jax.device_count()``, which equals the
+    sync axis only on a single-axis mesh; on a multi-axis mesh pass the
+    ``axis_name`` axis's size explicitly, or the 0/whole-axis decisions
+    here are made against the wrong total (the divisibility check inside
+    SyncBatchNorm still catches a non-dividing size at trace time).
+    """
+    import jax
+
+    if world_size is None:
+        world_size = jax.device_count()
+    if group_size == 0 or group_size == world_size:
+        return None
+    if group_size < 0 or world_size % group_size:
+        raise ValueError(
+            f"group_size={group_size} must be positive and divide the "
+            f"axis size {world_size}")
+    return (axis_name, int(group_size))
+
+
+__all__ = [
+    "DistributedDataParallel", "Reducer",
+    "sync_gradients", "sync_gradients_flat", "average_reduced",
+    "sync_autodiff_gradients",
+    "SyncBatchNorm", "convert_syncbn_model", "create_syncbn_process_group",
+    "LARC", "larc", "multiproc",
+]

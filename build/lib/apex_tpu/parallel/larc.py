@@ -1,0 +1,161 @@
+"""LARC — TPU re-design of ``apex.parallel.LARC``.
+
+Ref: apex/parallel/LARC.py. The reference wraps an optimizer and rescales
+each parameter's gradient by the layerwise adaptive rate before the inner
+step. Here that is an optax-style transform wrapper (``larc(inner_tx)``)
+plus an apex-shaped class wrapping a FusedOptimizer.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LARCState(NamedTuple):
+    inner: optax.OptState
+    count: jnp.ndarray
+
+
+def larc(inner_tx: optax.GradientTransformation, lr,
+         trust_coefficient: float = 0.02, clip: bool = True, eps: float = 1e-8,
+         weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """Wrap ``inner_tx`` with LARC gradient rescaling (ref LARC.py:75 step).
+
+    ``lr`` is the inner optimizer's learning rate — a float or an optax
+    schedule (evaluated at the wrapper's own step count) — needed for the
+    clipping form ``min(adaptive_lr / lr, 1)``.
+    """
+
+    def init(params):
+        return LARCState(inner=inner_tx.init(params),
+                         count=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        lr_now = lr(state.count) if callable(lr) else lr
+
+        def rescale(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = trust_coefficient * p_norm / (
+                g_norm + p_norm * weight_decay + eps)
+            if clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / lr_now, 1.0)
+            scale = jnp.where((p_norm > 0) & (g_norm > 0), adaptive_lr, 1.0)
+            if weight_decay:
+                g32 = g32 + weight_decay * p32
+            return (g32 * scale).astype(g.dtype)
+
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        scaled = treedef.unflatten(
+            [rescale(g, p) for g, p in zip(g_leaves, p_leaves)])
+        updates, inner = inner_tx.update(scaled, state.inner, params)
+        return updates, LARCState(inner=inner, count=state.count + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+class LARC:
+    """apex-shaped wrapper over a FusedOptimizer (ref LARC.py:LARC).
+
+    ``opt = LARC(FusedSGD(params, lr=0.1, momentum=0.9))``
+    """
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        lr = optimizer.defaults.get("lr", 1e-3)
+        wd = optimizer.defaults.get("weight_decay", 0.0)
+        # the larc wrapper owns weight decay (it must enter the adaptive-lr
+        # denominator and be scaled); zero it in the inner optimizer, like the
+        # reference temporarily zeroes group['weight_decay'] (ref LARC.py:88)
+        inner_tx = optimizer.tx
+        if wd and optimizer._tx_factory is not None:
+            inner_tx = optimizer._tx_factory(weight_decay=0.0)
+        self._inner_tx = inner_tx
+        self._built_lr, self._built_wd = lr, wd
+        self._tx = larc(inner_tx, lr=lr, trust_coefficient=trust_coefficient,
+                        clip=clip, eps=eps, weight_decay=wd)
+        self._state = LARCState(inner=optimizer.state,
+                                count=jnp.zeros((), jnp.int32))
+        self._jit_step = jax.jit(self._functional_step)
+
+    def _refresh_hparams(self):
+        """Honor scheduler-style pokes of ``param_groups[0]['lr']``
+        (and weight_decay): larc() bakes both into its closure, so a
+        change rebuilds the transformation. A float-lr poke therefore
+        recompiles — for per-step schedules pass an optax schedule as
+        the inner optimizer's lr instead."""
+        group = self.optim.param_groups[0] if self.optim.param_groups else {}
+        lr = group.get("lr", self._built_lr)
+        wd = group.get("weight_decay", self._built_wd)
+        if lr == self._built_lr and wd == self._built_wd:
+            return
+        self._built_lr, self._built_wd = lr, wd
+        # the inner transform bakes its own lr too — rebuild it when the
+        # optimizer exposes a factory (larc's lr only sets the clip ratio)
+        if self.optim._tx_factory is not None:
+            overrides = {"lr": lr}
+            if wd:
+                overrides["weight_decay"] = 0.0  # larc owns weight decay
+            self._inner_tx = self.optim._tx_factory(**overrides)
+        self._tx = larc(self._inner_tx, lr=lr,
+                        trust_coefficient=self.trust_coefficient,
+                        clip=self.clip, eps=self.eps, weight_decay=wd)
+        self._jit_step = jax.jit(self._functional_step)
+
+    def _functional_step(self, grads, state, params):
+        updates, new_state = self._tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    @property
+    def params(self):
+        return self.optim.params
+
+    @property
+    def state(self):
+        return self._state
+
+    @property
+    def param_groups(self):
+        """ref LARC.py param_groups — proxied to the wrapped optimizer
+        so schedulers that poke group['lr'] keep working."""
+        return self.optim.param_groups
+
+    @param_groups.setter
+    def param_groups(self, value):
+        self.optim.param_groups = value
+
+    def step(self, grads=None, closure=None):
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError("pass grads to step()")
+        self._refresh_hparams()
+        self.optim.params, self._state = self._jit_step(
+            grads, self._state, self.optim.params)
+        self.optim.state = self._state.inner
+        return loss if loss is not None else self.optim.params
+
+    @property
+    def defaults(self):
+        return self.optim.defaults
+
+    def zero_grad(self):
+        return None
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, d):
+        self.optim.load_state_dict(d)
+        self._state = LARCState(inner=self.optim.state,
+                                count=jnp.zeros((), jnp.int32))

@@ -1,0 +1,146 @@
+"""Multi-host launcher — TPU re-design of ``apex.parallel.multiproc``.
+
+Ref: apex/parallel/multiproc.py (spawns one process per GPU with
+WORLD_SIZE/RANK env vars fed to ``torch.distributed``). The TPU runtime
+already runs one process per HOST, so the launcher has two roles:
+
+- **on a pod**: each host process calls :func:`initialize_distributed`
+  (``jax.distributed.initialize`` reads the TPU metadata) and runs the
+  script — ``python -m apex_tpu.parallel.multiproc script.py``.
+- **local development / CI**: ``--nprocs N`` spawns N worker processes
+  on this machine wired to a localhost coordinator — the multi-HOST
+  (DCN) path, exercised for real: collectives cross the process
+  boundary over the Gloo transport exactly as they would cross hosts.
+  ``--cpu --devices-per-proc D`` gives each worker D virtual CPU
+  devices, so ``N x D`` global devices form the mesh.
+
+Example (the analog of ``torch.distributed.launch --nproc_per_node``)::
+
+    python -m apex_tpu.parallel.multiproc --nprocs 2 --cpu \
+        --devices-per-proc 4 train.py --steps 10
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Initialize the multi-host runtime (NCCL init_process_group analog).
+
+    Reads ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID``
+    from the environment when args are None (the launcher sets them);
+    with neither, defers to the TPU-pod metadata autodetection. Honors
+    ``APEX_TPU_FORCE_CPU=1`` by pinning the cpu platform through
+    jax.config BEFORE touching the backend (an env-var JAX_PLATFORMS
+    is not enough under a sitecustomize that registers other plugins).
+    """
+    import jax
+
+    if os.environ.get("APEX_TPU_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    # idempotent: the launcher's worker shim initializes before exec'ing
+    # the script, and the script may initialize again by itself
+    if jax.distributed.is_initialized():
+        return jax.process_index(), jax.process_count()
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(script_args, nprocs: int, devices_per_proc: int = 1,
+           cpu: bool = False, env=None) -> int:
+    """Spawn ``nprocs`` workers of ``python -m apex_tpu.parallel.multiproc
+    <script_args>`` against a localhost coordinator; returns the first
+    nonzero worker exit code (0 when all succeed). Workers inherit the
+    caller's env plus the coordinator variables (and the CPU forcing
+    knobs when ``cpu``)."""
+    addr = f"127.0.0.1:{_free_port()}"
+    base = dict(os.environ if env is None else env)
+    base.update(COORDINATOR_ADDRESS=addr, NUM_PROCESSES=str(nprocs))
+    if cpu:
+        base["APEX_TPU_FORCE_CPU"] = "1"
+        flags = base.get("XLA_FLAGS", "")
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        base["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={devices_per_proc}"
+        ).strip()
+    procs = []
+    for pid in range(nprocs):
+        env_p = dict(base, PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+             *script_args], env=env_p))
+    # wait on EVERY worker before returning (a short-circuit here would
+    # orphan still-running workers after the first failure)
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
+
+
+def main():
+    """CLI: ``python -m apex_tpu.parallel.multiproc [--nprocs N]
+    [--cpu] [--devices-per-proc D] script.py [args...]``.
+
+    Without ``--nprocs`` this IS the worker: initialize the distributed
+    runtime (coordinator env or pod metadata) and exec the script
+    in-process. With ``--nprocs`` it spawns that many workers locally.
+    """
+    argv = sys.argv[1:]
+    nprocs, devices_per_proc, cpu = None, 1, False
+    while argv and argv[0].startswith("--"):
+        flag = argv.pop(0)
+        if flag == "--nprocs":
+            nprocs = int(argv.pop(0))
+        elif flag == "--devices-per-proc":
+            devices_per_proc = int(argv.pop(0))
+        elif flag == "--cpu":
+            cpu = True
+        else:
+            print(f"unknown flag {flag}")
+            return 2
+    if not argv:
+        print("usage: python -m apex_tpu.parallel.multiproc "
+              "[--nprocs N] [--cpu] [--devices-per-proc D] "
+              "<script> [args...]")
+        return 1
+
+    if nprocs is not None:
+        return launch(argv, nprocs, devices_per_proc, cpu)
+
+    initialize_distributed()
+    script = argv[0]
+    sys.argv = argv
+    with open(script) as f:
+        code = compile(f.read(), script, "exec")
+    exec(code, {"__name__": "__main__", "__file__": script})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

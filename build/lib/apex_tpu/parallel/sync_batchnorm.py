@@ -1,0 +1,252 @@
+"""SyncBatchNorm — TPU re-design of ``apex.parallel.sync_batchnorm``.
+
+Ref: apex/parallel/{sync_batchnorm,optimized_sync_batchnorm}.py +
+csrc/{syncbn.cpp,welford.cu}.
+
+The reference's optimized path fuses a per-GPU Welford reduction with an
+NCCL allreduce of (mean, var, count) — ``welford.cu`` exists precisely
+because E[x²]−E[x]² cancels catastrophically for large-mean activations.
+The TPU formulation keeps that numerics guarantee: each replica computes
+its local (count, mean, M2 = Σ(x−mean)²), and the replicas merge with
+Chan's parallel update expressed over two ``psum``s —
+``M = Σnᵢmᵢ/N`` then ``M2 = Σ(M2ᵢ + nᵢ(mᵢ−M)²)`` — never forming a
+sum-of-squares. Running stats use the unbiased variance exactly as the
+reference does (sync_batchnorm.py:87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica BatchNorm over ``axis_name`` (default ``data``).
+
+    Mirrors ``apex.parallel.SyncBatchNorm(num_features, eps, momentum,
+    affine, track_running_stats, process_group, channel_last)`` — the
+    process group is a mesh axis name here. Drop-in for ``flax.linen
+    .BatchNorm`` with ``use_running_average`` semantics.
+
+    Channel axis: flax convention is NHWC, so ``channel_last`` defaults to
+    True (channels = last dim). Pass ``channel_last=False`` for torch-style
+    NCHW parity with the reference's default.
+    """
+
+    num_features: Optional[int] = None
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    process_group: Optional[str] = None  # mesh axis name
+    channel_last: bool = True
+    axis_name: Optional[str] = "data"
+    group_size: Optional[int] = None  # stats groups of N consecutive ranks
+    dtype: Any = jnp.float32
+    # flax.linen.BatchNorm conversion fidelity (convert_syncbn_model):
+    # None defers to ``affine`` / the call-time argument respectively
+    use_scale: Optional[bool] = None
+    use_bias: Optional[bool] = None
+    use_running_average: Optional[bool] = None
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
+    result_dtype: Any = None  # None = return in x.dtype (flax: bn.dtype)
+
+    def _group_merge(self, axis_name, g, local_count, local_mean,
+                     local_m2):
+        """Merge (count, mean, M2) within groups of ``group_size``
+        consecutive ranks (ref distributed/synced_batchnorm/test_groups.py;
+        the reference builds NCCL subgroups). shard_map's psum does not
+        support axis_index_groups, so gather the tiny per-channel stats and
+        reduce this rank's group slice locally — Chan's merge unchanged."""
+        n = jax.lax.axis_size(axis_name)
+        if n % g:
+            raise ValueError(f"group_size={g} must divide axis size {n}")
+        start = (jax.lax.axis_index(axis_name) // g) * g
+        counts = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_count, axis_name), start, g)
+        means = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_mean, axis_name), start, g)
+        m2s = jax.lax.dynamic_slice_in_dim(
+            jax.lax.all_gather(local_m2, axis_name), start, g)
+        total_count = jnp.sum(counts)
+        mean = jnp.sum(counts[:, None] * means, 0) / total_count
+        m2 = jnp.sum(m2s + counts[:, None] * jnp.square(means - mean[None]),
+                     0)
+        return total_count, mean, m2
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        if use_running_average is None:
+            # the module field supplies the default when the call site
+            # doesn't pass one. Divergence from flax (which RAISES when
+            # both are None): both-None means training mode here, matching
+            # the reference apex SyncBatchNorm, whose implicit
+            # module.training default is train
+            use_running_average = bool(self.use_running_average)
+        axis_name = self.process_group or self.axis_name
+        group_size = self.group_size
+        if isinstance(axis_name, tuple):
+            # create_syncbn_process_group's (axis_name, group_size) pair,
+            # passed straight through process_group= like the reference's
+            # group object
+            axis_name, tuple_size = axis_name
+            group_size = tuple_size if group_size is None else group_size
+        ch_axis = (x.ndim - 1) if (self.channel_last or x.ndim == 2) else 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        c = x.shape[ch_axis]
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+
+        stat_shape = [1] * x.ndim
+        stat_shape[ch_axis] = c
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            x32 = x.astype(jnp.float32)
+            local_count = jnp.asarray(x.size / c, jnp.float32)
+            local_mean = jnp.mean(x32, axis=reduce_axes)
+            # Welford M2: centered sum of squares — no E[x²]−E[x]²
+            # cancellation (ref csrc/welford.cu)
+            local_m2 = jnp.sum(
+                jnp.square(x32 - local_mean.reshape(stat_shape)),
+                axis=reduce_axes)
+            try:
+                if group_size is not None:
+                    total_count, mean, m2 = self._group_merge(
+                        axis_name, group_size, local_count, local_mean,
+                        local_m2)
+                else:
+                    total_count = jax.lax.psum(local_count, axis_name)
+                    mean = jax.lax.psum(local_count * local_mean,
+                                        axis_name) / total_count
+                    # Chan's parallel merge of per-replica (mean, M2, count)
+                    m2 = jax.lax.psum(
+                        local_m2
+                        + local_count * jnp.square(local_mean - mean),
+                        axis_name)
+            except NameError:
+                # outside pmap/shard_map: plain (single-replica) batch norm
+                total_count, mean, m2 = local_count, local_mean, local_m2
+            var = m2 / total_count
+            if self.track_running_stats and not self.is_initializing():
+                unbiased = var * total_count / jnp.maximum(total_count - 1.0, 1.0)
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+
+        shape = stat_shape
+        y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + self.eps)
+        scale_on = (self.affine if self.use_scale is None
+                    else self.use_scale)
+        bias_on = self.affine if self.use_bias is None else self.use_bias
+        if scale_on:
+            weight = self.param("scale", self.scale_init, (c,), self.dtype)
+            y = y * weight.astype(jnp.float32).reshape(shape)
+        if bias_on:
+            bias = self.param("bias", self.bias_init, (c,), self.dtype)
+            y = y + bias.astype(jnp.float32).reshape(shape)
+        return y.astype(self.result_dtype or x.dtype)
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=None):
+    """Analog of ``apex.parallel.convert_syncbn_model`` (ref
+    apex/parallel/__init__.py): recursively replace every
+    ``flax.linen.BatchNorm`` in a module tree with :class:`SyncBatchNorm`.
+
+    flax modules are frozen dataclasses, so the "surgery" is a functional
+    rebuild: dataclass fields (including lists/tuples/dicts of
+    submodules) are walked and modules containing conversions are
+    ``clone()``d. Like the reference, a tree with no BatchNorm passes
+    through unchanged. Limitation vs torch's in-place mutation: children
+    created inside ``setup()``/``__call__`` bodies are invisible to
+    dataclass traversal — declare them as attributes (flax's own
+    convention) or construct with ``sync_bn=True`` where the model
+    supports it (``apex_tpu.models.resnet`` / ``dcgan``).
+
+    ``channel_last=None`` infers the channel axis from each BatchNorm's
+    ``axis`` field (flax default -1 → channel-last)."""
+
+    def convert_bn(bn):
+        if channel_last is None:
+            # only axis == -1 (flax default, channel-last for any rank)
+            # and axis == 1 (torch-style NCHW) map onto SyncBatchNorm's
+            # two layouts rank-independently; anything else would
+            # silently normalize the wrong axis
+            if bn.axis in (-1, None):
+                ch_last = True
+            elif bn.axis == 1:
+                ch_last = False
+            else:
+                raise ValueError(
+                    f"cannot infer channel layout from BatchNorm axis="
+                    f"{bn.axis}; pass channel_last= explicitly")
+        else:
+            ch_last = channel_last
+        groups = getattr(bn, "axis_index_groups", None)
+        group_size = None
+        if groups is not None:
+            # SyncBatchNorm models subgroups as consecutive-rank blocks of
+            # one size; map exactly that shape, refuse anything else
+            # rather than silently syncing over the whole axis
+            sizes = {len(g) for g in groups}
+            flat = [r for g in groups for r in g]
+            if len(sizes) == 1 and flat == list(range(len(flat))):
+                group_size = sizes.pop()
+            else:
+                raise ValueError(
+                    f"cannot map axis_index_groups={groups!r} onto "
+                    f"group_size (needs equal-size consecutive-rank "
+                    f"blocks); construct SyncBatchNorm directly")
+        return SyncBatchNorm(
+            eps=bn.epsilon, momentum=1.0 - bn.momentum,
+            affine=bn.use_scale or bn.use_bias,
+            use_scale=bn.use_scale, use_bias=bn.use_bias,
+            use_running_average=bn.use_running_average,
+            scale_init=bn.scale_init, bias_init=bn.bias_init,
+            result_dtype=bn.dtype,
+            process_group=process_group,
+            # a BN already syncing over its own axis keeps that axis
+            axis_name=getattr(bn, "axis_name", None) or "data",
+            group_size=group_size,
+            channel_last=ch_last,
+            dtype=bn.param_dtype)
+
+    def walk(v):
+        if isinstance(v, SyncBatchNorm):
+            return v
+        if isinstance(v, nn.BatchNorm):
+            return convert_bn(v)
+        if isinstance(v, nn.Module):
+            changes = {}
+            for f in dataclasses.fields(v):
+                if f.name in ("parent", "name"):
+                    continue
+                old = getattr(v, f.name, None)
+                new = walk(old)
+                if new is not old:
+                    changes[f.name] = new
+            return v.clone(**changes) if changes else v
+        if isinstance(v, (list, tuple)):
+            items = [walk(i) for i in v]
+            if all(a is b for a, b in zip(items, v)):
+                return v
+            if hasattr(v, "_fields"):          # NamedTuple
+                return type(v)(*items)
+            return type(v)(items)
+        if isinstance(v, dict):
+            items = {k: walk(i) for k, i in v.items()}
+            if all(items[k] is v[k] for k in v):
+                return v
+            return items
+        return v
+
+    return walk(module)

@@ -1,0 +1,85 @@
+"""Profiling (TPU re-design of ``apex.pyprof``; ref apex/pyprof/*).
+
+The reference has three parts: nvtx instrumentation
+(apex/pyprof/nvtx/nvmarker.py), an nvprof-database parser
+(apex/pyprof/parse/parse.py) and a per-op flops/bytes report
+(apex/pyprof/prof/prof.py). The TPU analogs:
+
+- instrumentation (this module): ``jax.profiler`` annotations under the
+  pyprof API names (``init``, ``nvtx.range_push/pop``, ``wrap``) so
+  reference-style instrumentation ports unchanged; traces land in
+  TensorBoard/Perfetto instead of nvprof;
+- :mod:`apex_tpu.pyprof.parse` — xplane capture → per-op records with
+  exclusive-time attribution;
+- :mod:`apex_tpu.pyprof.prof` — records → per-op / per-category report
+  (flops, bytes and roofline bound merged from the capture when a
+  device plane is present). CLI: ``tools/trace_report.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+
+from apex_tpu.pyprof import parse, prof  # noqa: F401 (re-export)
+from apex_tpu.pyprof.prof import Report  # noqa: F401
+
+_enabled = False
+_trace_dir: Optional[str] = None
+
+
+def init(enable_trace: bool = True, trace_dir: str = "/tmp/apex_tpu_trace"):
+    """ref apex/pyprof/nvtx/nvmarker.py init: start instrumentation."""
+    global _enabled, _trace_dir
+    _enabled = enable_trace
+    _trace_dir = trace_dir
+
+
+def start():
+    """Begin a profiler trace (analog of cuda profiler start)."""
+    if _enabled and _trace_dir:
+        jax.profiler.start_trace(_trace_dir)
+
+
+def stop():
+    if _enabled and _trace_dir:
+        jax.profiler.stop_trace()
+
+
+class nvtx:
+    """nvtx-shaped annotation API; ranges become XLA trace annotations."""
+
+    _stack = []
+
+    @staticmethod
+    def range_push(name: str):
+        ctx = jax.profiler.TraceAnnotation(name)
+        ctx.__enter__()
+        nvtx._stack.append(ctx)
+
+    @staticmethod
+    def range_pop():
+        if nvtx._stack:
+            nvtx._stack.pop().__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def wrap(fn, name: Optional[str] = None):
+    """Decorate ``fn`` so every call is an annotated range (ref pyprof wraps
+    torch functions module-wide; explicit opt-in here)."""
+    label = name or getattr(fn, "__name__", "fn")
+
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        with jax.profiler.TraceAnnotation(label):
+            return fn(*a, **kw)
+
+    return wrapped

@@ -1,0 +1,255 @@
+"""Trace parsing: xplane capture → per-op records.
+
+TPU re-design of the reference's trace-parsing half
+(ref apex/pyprof/parse/parse.py:1 — reads an nvprof SQLite database and
+emits one record per kernel with name/duration/correlation). The TPU
+analog reads the ``jax.profiler`` xplane protobuf and emits one record
+per HLO-op execution event, with exclusive (self) time computed from
+event nesting — the quantity per-op attribution must sum.
+
+Works on any backend: CPU captures carry HLO thunk events on host
+threadpool lines; TPU captures carry XLA-op events on the device plane.
+The protobuf schema ships with tensorflow (baked into this image); the
+import is guarded so the rest of apex_tpu never depends on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "OpRecord", "classify", "short_name", "find_xplane_paths",
+    "parse_xspace", "step_times_us",
+    "CATEGORIES",
+]
+
+
+def _xplane_pb2():
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+        return xplane_pb2
+    except ImportError as e:  # pragma: no cover - tf is baked in
+        raise ImportError(
+            "parsing xplane captures needs the tensorflow protobuf "
+            "schema (tensorflow.tsl.profiler.protobuf.xplane_pb2); "
+            "install tensorflow or analyze the capture with xprof"
+        ) from e
+
+
+@dataclasses.dataclass
+class OpRecord:
+    """One HLO-op execution event (the parse.py kernel-record analog)."""
+
+    name: str            # HLO op name, e.g. "dot.11", "psum_invariant.7"
+    program: str         # HLO module name, e.g. "jit_train_step"
+    plane: str           # xplane name (device or host thread pool)
+    category: str        # see CATEGORIES
+    duration_ps: int     # inclusive span
+    self_ps: int         # exclusive time (minus nested HLO children)
+    flops: float = 0.0   # model flops, when the plane carries them (TPU)
+    bytes_accessed: float = 0.0
+    line: str = ""       # xplane line ('XLA Ops', 'Async XLA Ops', ...)
+
+
+# Category → regexes over HLO op names. Two name families appear in
+# captures: XLA's own (all-reduce, dot, fusion...) and jax-primitive
+# derived (psum, all_gather...); match both.
+CATEGORIES: Tuple[Tuple[str, str], ...] = (
+    ("collective",
+     r"^(all-reduce|all-gather|all-to-all|reduce-scatter|"
+     r"collective-permute|collective-broadcast|partition-id|replica-id|"
+     r"psum|pmax|pmin|all_gather|all_to_all|reduce_scatter|ppermute|"
+     r"ragged-all-to-all)"),
+    ("matmul", r"^(dot|cublas|gemm|matmul|dot_general)"),
+    ("convolution", r"^(conv|convolution)"),
+    ("attention-kernel", r"(flash|attention)"),
+    # any other Pallas/Mosaic kernel lowers to an HLO custom-call
+    # (e.g. a fused-Adam or layer-norm kernel) — its own bucket, NOT
+    # attention
+    ("custom-kernel", r"custom-call"),
+    ("rng", r"^(rng|threefry|random)"),
+    ("gather-scatter", r"^(gather|scatter|dynamic-slice|dynamic-update)"),
+    ("data-movement",
+     r"^(copy|bitcast|transpose|slice|concatenate|pad|reshape|broadcast|"
+     r"reverse|tuple|get-tuple-element|wrapped_slice|wrapped_broadcast)"),
+    ("host-transfer", r"^(infeed|outfeed|send|recv|host)"),
+    ("control", r"^(while|call|conditional|async|done|start)"),
+    ("reduction", r"^(reduce|wrapped_reduce|sort|top-k|topk|cumsum)"),
+)
+_COMPILED = [(cat, re.compile(pat)) for cat, pat in CATEGORIES]
+
+# containers whose time is their children's — excluded from self-time
+# rollups entirely (their exclusive remainder is scheduler overhead)
+_CONTAINER = re.compile(r"^(while|call|conditional)")
+
+
+def classify(name: str) -> str:
+    base = short_name(name).lower()
+    for cat, pat in _COMPILED:
+        if pat.search(base):
+            return cat
+    # everything else is an elementwise chain: XLA names them
+    # "<op>_<op>_fusion" / "fusion.N" / "wrapped_<op>" / bare op names
+    return "fusion-elementwise"
+
+
+def short_name(name: str) -> str:
+    """Normalize an event name to the bare HLO op name.
+
+    Real TPU captures (r5) carry the full HLO text — e.g.
+    ``%slice-start.73 = (...) async-start(...), calls=...`` — whose
+    leading ``%`` defeated every ``^``-anchored category pattern and sent
+    async copies into the elementwise bucket. Strip the sigil and keep
+    the lhs identifier only."""
+    base = name.strip()
+    if base.startswith("%"):
+        base = base[1:]
+    for sep in (" = ", " "):
+        cut = base.find(sep)
+        if cut > 0:
+            base = base[:cut]
+            break
+    return base
+
+
+def is_container(name: str) -> bool:
+    return bool(_CONTAINER.match(name.lower()))
+
+
+def find_xplane_paths(path: str) -> List[str]:
+    """Resolve a logdir (as passed to ``jax.profiler.trace``), a profile
+    run dir, or a direct ``.xplane.pb`` file to capture paths; for a
+    logdir with several runs, the newest run wins."""
+    if os.path.isfile(path):
+        return [path]
+    direct = sorted(glob.glob(os.path.join(path, "*.xplane.pb")))
+    if direct:
+        return direct
+    runs = sorted(glob.glob(os.path.join(path, "plugins", "profile", "*")))
+    # newest run first; an interrupted capture can leave an empty run dir
+    for run in reversed(runs):
+        found = sorted(glob.glob(os.path.join(run, "*.xplane.pb")))
+        if found:
+            return found
+    raise FileNotFoundError(f"no xplane capture under {path!r}")
+
+
+def _stat_lookup(plane) -> Dict[int, str]:
+    return {m.id: m.name for m in plane.stat_metadata.values()}
+
+
+def _stat_value(stat, stat_names):
+    if stat.str_value:
+        return stat.str_value
+    if stat.ref_value:
+        return stat_names.get(stat.ref_value, "")
+    for field in ("int64_value", "uint64_value", "double_value"):
+        v = getattr(stat, field)
+        if v:
+            return v
+    return 0
+
+
+def _line_records(plane_name, line, ev_names, stat_names) -> List[OpRecord]:
+    """Self time via interval nesting: events on one line form a forest
+    (a child lies within its parent's span); exclusive = inclusive minus
+    the children's inclusive sums."""
+    hlo_events = []
+    for ev in line.events:
+        stats = {}
+        for s in ev.stats:
+            k = stat_names.get(s.metadata_id)
+            if k in ("hlo_op", "hlo_module", "flops", "model_flops",
+                     "bytes_accessed", "bytes accessed",
+                     "device_offset_ps", "device_duration_ps"):
+                stats[k] = _stat_value(s, stat_names)
+        # Two event dialects (r5): CPU captures tag HLO events with an
+        # 'hlo_op' stat and use the event's own offset/duration; real TPU
+        # device planes name the event with the full HLO text and put
+        # timing in device_offset_ps/device_duration_ps stats instead.
+        # Name-only acceptance applies to DEVICE planes only — host
+        # planes name every TraceMe span (python frames etc.), which must
+        # stay excluded from HLO attribution.
+        named = (ev.metadata_id in ev_names
+                 and plane_name.startswith("/device:"))
+        if "hlo_op" not in stats and not named:
+            continue
+        if "device_offset_ps" in stats or "device_duration_ps" in stats:
+            # a stat present with value 0 is a real zero, not "absent"
+            start = int(stats.get("device_offset_ps", 0))
+            dur = int(stats.get("device_duration_ps", 0))
+        else:
+            start, dur = ev.offset_ps, ev.duration_ps
+        hlo_events.append((start, start + dur, dur, ev, stats))
+    hlo_events.sort(key=lambda t: (t[0], -t[1]))
+
+    records = []
+    stack: List[Tuple[int, int, list]] = []  # (start, end, child_ps box)
+    for start, end, dur, ev, stats in hlo_events:
+        while stack and start >= stack[-1][1]:
+            stack.pop()
+        if stack:
+            stack[-1][2][0] += dur
+        name = ev_names.get(ev.metadata_id) or str(stats.get("hlo_op", "?"))
+        child_box = [0]
+        stack.append((start, end, child_box))
+        records.append((dur, stats, name, child_box))
+
+    out = []
+    for dur, stats, name, child_box in records:
+        flops = float(stats.get("model_flops", stats.get("flops", 0)) or 0)
+        nbytes = float(stats.get("bytes_accessed",
+                                 stats.get("bytes accessed", 0)) or 0)
+        out.append(OpRecord(
+            name=name,
+            program=str(stats.get("hlo_module", "")),
+            plane=plane_name,
+            category=classify(name),
+            duration_ps=dur,
+            self_ps=max(dur - child_box[0], 0),
+            flops=flops,
+            bytes_accessed=nbytes,
+            line=line.name,
+        ))
+    return out
+
+
+def step_times_us(paths: Iterable[str]) -> List[float]:
+    """Device step durations (us) from the 'Steps' line of the device
+    plane — the profiler's own step markers, the authoritative wall time
+    per train step (r5: 'XLA Ops' self-time sums exceed it because async
+    copies overlap compute)."""
+    xplane_pb2 = _xplane_pb2()
+    steps: List[float] = []
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            for line in plane.lines:
+                if line.name == "Steps":
+                    steps.extend(e.duration_ps / 1e6 for e in line.events)
+    return steps
+
+
+def parse_xspace(paths: Iterable[str]) -> List[OpRecord]:
+    """All HLO-op execution records across the capture's planes."""
+    xplane_pb2 = _xplane_pb2()
+    records: List[OpRecord] = []
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            stat_names = _stat_lookup(plane)
+            ev_names = {m.id: m.name for m in plane.event_metadata.values()}
+            for line in plane.lines:
+                records.extend(
+                    _line_records(plane.name, line, ev_names, stat_names))
+    return records

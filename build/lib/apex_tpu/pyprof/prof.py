@@ -1,0 +1,253 @@
+"""Trace analysis: per-op attribution report from a parsed capture.
+
+TPU re-design of the reference's profiling-report half
+(ref apex/pyprof/prof/prof.py:1 — joins parsed kernel records with
+per-op analytic flops/bytes tables and prints a per-op efficiency
+report). On TPU the per-op flops/bytes come from the capture itself
+when a device plane is present (XLA records them per op); the report
+aggregates exclusive time per op and per category, and derives
+utilization against a configurable peak.
+
+Two data paths:
+
+- :func:`Report.from_capture` — always works (any backend): the
+  apex_tpu.pyprof.parse walker, exclusive-time attribution.
+- :func:`xprof_hlo_stats` — the native xprof pipeline's per-op table
+  (flops rate, memory BW, roofline bound) when a device plane exists;
+  ``Report`` merges these columns into its rows when available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from apex_tpu.pyprof.parse import (
+    OpRecord,
+    find_xplane_paths,
+    is_container,
+    parse_xspace,
+    short_name,
+    step_times_us,
+)
+
+__all__ = ["Report", "OpSummary", "xprof_hlo_stats"]
+
+
+@dataclasses.dataclass
+class OpSummary:
+    name: str
+    category: str
+    program: str
+    occurrences: int
+    self_us: float
+    total_us: float
+    share: float = 0.0           # of summed exclusive time
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    gflops_per_s: float = 0.0    # from xprof hlo_stats when merged
+    bound_by: str = ""
+
+
+def xprof_hlo_stats(paths) -> Optional[List[Dict]]:
+    """Per-op rows from the native xprof ``hlo_stats`` converter, or
+    ``None`` when unavailable/empty (host-only captures have no device
+    op-metrics, e.g. the CPU mesh used in CI)."""
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError:
+        return None
+    try:
+        data, _ = rtd.xspace_to_tool_data(list(paths), "hlo_stats", {})
+    except Exception:
+        return None
+    table = json.loads(data if isinstance(data, str) else data.decode())
+    cols = [c["id"] for c in table.get("cols", [])]
+    rows = [dict(zip(cols, [c.get("v") for c in r.get("c", [])]))
+            for r in table.get("rows", [])]
+    return rows or None
+
+
+class Report:
+    """Aggregated per-op / per-category attribution for one capture."""
+
+    def __init__(self, ops: List[OpSummary], total_self_us: float,
+                 steps_us: Optional[List[float]] = None,
+                 async_ops: Optional[List[OpSummary]] = None):
+        self.ops = sorted(ops, key=lambda o: -o.self_us)
+        self.total_self_us = total_self_us
+        # device step markers ('Steps' line): the authoritative wall time
+        self.steps_us = steps_us or []
+        # async-copy spans overlap compute — reported separately, never
+        # added into the exclusive-time total
+        self.async_ops = sorted(async_ops or [], key=lambda o: -o.self_us)
+        for o in self.ops:
+            o.share = o.self_us / total_self_us if total_self_us else 0.0
+        wall = sum(self.steps_us)
+        for o in self.async_ops:
+            o.share = o.total_us / wall if wall else 0.0
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def from_records(cls, records: List[OpRecord],
+                     steps_us: Optional[List[float]] = None) -> "Report":
+        """Attribution from a real TPU capture's device 'XLA Ops' line
+        when present (async copies split out; host python plane
+        excluded); otherwise — CPU CI captures with only host threadpool
+        lines — every HLO-tagged record counts, as before r5."""
+        device_ops = [r for r in records
+                      if r.plane.startswith("/device:")
+                      and r.line == "XLA Ops"]
+        async_recs = [r for r in records
+                      if r.plane.startswith("/device:")
+                      and r.line.startswith("Async")]
+        main = device_ops if device_ops else records
+
+        def aggregate(recs):
+            by_key: Dict[tuple, OpSummary] = {}
+            for r in recs:
+                if is_container(short_name(r.name)):
+                    continue  # a while/call span is its children's time
+                key = (short_name(r.name), r.program)
+                s = by_key.get(key)
+                if s is None:
+                    s = by_key[key] = OpSummary(
+                        name=key[0], category=r.category,
+                        program=r.program,
+                        occurrences=0, self_us=0.0, total_us=0.0)
+                s.occurrences += 1
+                s.self_us += r.self_ps / 1e6
+                s.total_us += r.duration_ps / 1e6
+                s.flops += r.flops
+                s.bytes_accessed += r.bytes_accessed
+            return list(by_key.values())
+
+        ops = aggregate(main)
+        total = sum(s.self_us for s in ops)
+        return cls(ops, total, steps_us=steps_us,
+                   async_ops=aggregate(async_recs))
+
+    @classmethod
+    def from_capture(cls, path: str) -> "Report":
+        """Build from a logdir / run dir / .xplane.pb path, merging the
+        native xprof per-op columns when the capture has a device plane."""
+        paths = find_xplane_paths(path)
+        report = cls.from_records(parse_xspace(paths),
+                                  steps_us=step_times_us(paths))
+        rows = xprof_hlo_stats(paths)
+        if rows:
+            report.merge_hlo_stats(rows)
+        return report
+
+    def merge_hlo_stats(self, rows: List[Dict]) -> None:
+        # hlo_stats rows carry a numeric program_id while OpSummary holds
+        # the module NAME, so the join key is the op name alone — merge
+        # only names that are unambiguous across programs (a name reused
+        # by two jitted programs would get the wrong program's rate)
+        counts: Dict[str, int] = {}
+        for o in self.ops:
+            counts[o.name] = counts.get(o.name, 0) + 1
+        by_name = {o.name: o for o in self.ops if counts[o.name] == 1}
+        for row in rows:
+            o = by_name.get(str(row.get("hlo_op_name", "")))
+            if o is None:
+                continue
+            o.gflops_per_s = float(row.get("model_flop_rate") or 0.0)
+            o.bound_by = str(row.get("bound_by") or "")
+            if not o.flops and o.gflops_per_s:
+                # rate [GFLOP/s] x self time [us] -> flops
+                o.flops = o.gflops_per_s * 1e9 * (o.self_us / 1e6)
+
+    # ---------------------------------------------------------- queries
+
+    def by_category(self) -> Dict[str, Dict[str, float]]:
+        cats: Dict[str, Dict[str, float]] = {}
+        for o in self.ops:
+            c = cats.setdefault(o.category, {
+                "self_us": 0.0, "occurrences": 0, "flops": 0.0,
+                "bytes_accessed": 0.0})
+            c["self_us"] += o.self_us
+            c["occurrences"] += o.occurrences
+            c["flops"] += o.flops
+            c["bytes_accessed"] += o.bytes_accessed
+        for c in cats.values():
+            c["share"] = (c["self_us"] / self.total_self_us
+                          if self.total_self_us else 0.0)
+        return dict(sorted(cats.items(), key=lambda kv: -kv[1]["self_us"]))
+
+    def utilization(self, peak_tflops: float,
+                    peak_hbm_gbps: Optional[float] = None) -> Dict:
+        """Achieved fraction of peak; only meaningful when the capture
+        carried per-op flops (device plane). MFU divides by the step wall
+        time ('Steps' markers) when present — busy self-time would flatter
+        a step with idle gaps."""
+        flops = sum(o.flops for o in self.ops)
+        busy_s = self.total_self_us / 1e6
+        wall_s = sum(self.steps_us) / 1e6 or busy_s
+        out = {"total_flops": flops, "busy_s": busy_s, "wall_s": wall_s,
+               "mfu": (flops / wall_s / (peak_tflops * 1e12))
+               if wall_s else 0.0}
+        if peak_hbm_gbps:
+            nbytes = sum(o.bytes_accessed for o in self.ops)
+            out["hbm_util"] = (
+                nbytes / wall_s / (peak_hbm_gbps * 1e9) if wall_s else 0.0)
+        return out
+
+    # ----------------------------------------------------------- output
+
+    def format_table(self, top: int = 30) -> str:
+        lines = [
+            f"{'op':<44} {'category':<18} {'#':>5} {'self ms':>9} "
+            f"{'share':>6} {'GFLOP/s':>9} {'bound':>7}",
+            "-" * 103,
+        ]
+        for o in self.ops[:top]:
+            lines.append(
+                f"{o.name[:44]:<44} {o.category:<18} {o.occurrences:>5} "
+                f"{o.self_us / 1e3:>9.3f} {o.share * 100:>5.1f}% "
+                f"{o.gflops_per_s:>9.1f} {o.bound_by[:7]:>7}")
+        lines.append("-" * 103)
+        lines.append(f"{'TOTAL (exclusive)':<69} "
+                     f"{self.total_self_us / 1e3:>9.3f}")
+        lines.append("")
+        lines.append(f"{'category':<24} {'self ms':>10} {'share':>7} "
+                     f"{'#ops':>6}")
+        for cat, c in self.by_category().items():
+            lines.append(
+                f"{cat:<24} {c['self_us'] / 1e3:>10.3f} "
+                f"{c['share'] * 100:>6.1f}% {int(c['occurrences']):>6}")
+        if self.steps_us:
+            n = len(self.steps_us)
+            lines.append("")
+            lines.append(
+                f"steps: {n} x {sum(self.steps_us) / n / 1e3:.2f} ms "
+                f"(device wall, 'Steps' markers)")
+        if self.async_ops:
+            tot = sum(o.total_us for o in self.async_ops)
+            lines.append(
+                f"async copies (overlapped, not in totals): "
+                f"{tot / 1e3:.2f} ms across "
+                f"{sum(o.occurrences for o in self.async_ops)} spans; top:")
+            for o in self.async_ops[:5]:
+                lines.append(
+                    f"  {o.name[:44]:<44} {o.total_us / 1e3:>9.3f} ms "
+                    f"({o.share * 100:.0f}% of wall)")
+        return "\n".join(lines)
+
+    def to_dict(self, top: int = 0) -> Dict:
+        ops = self.ops[:top] if top else self.ops
+        out = {
+            "total_self_us": self.total_self_us,
+            "categories": self.by_category(),
+            "ops": [dataclasses.asdict(o) for o in ops],
+        }
+        if self.steps_us:
+            out["steps"] = {"n": len(self.steps_us),
+                            "mean_ms": sum(self.steps_us)
+                            / len(self.steps_us) / 1e3}
+        if self.async_ops:
+            a = self.async_ops[:top] if top else self.async_ops
+            out["async_ops"] = [dataclasses.asdict(o) for o in a]
+        return out

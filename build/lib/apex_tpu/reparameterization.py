@@ -1,0 +1,139 @@
+"""Weight normalization (TPU re-design of ``apex.reparameterization``;
+ref apex/reparameterization/{__init__,weight_norm,reparameterization}.py).
+
+The reference installs forward-pre hooks that recompute w = g * v/||v||
+before each forward. Functionally, the reparameterized model simply stores
+(g, v) in its param tree and materializes w inside the (jitted) forward —
+XLA fuses the norm into the consuming matmul, which is the whole point of
+the CUDA "fused norm" path.
+
+API: :func:`apply_weight_norm` walks a pytree, replacing selected leaves
+``w`` with ``{name_g, name_v}`` subtrees; :func:`compute_weights` /
+:func:`remove_weight_norm` invert it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_G_SUFFIX = "_g"
+_V_SUFFIX = "_v"
+
+
+def _norm(v, dim: Optional[int]):
+    """2-norm over all dims except ``dim`` (ref weight_norm.py:8 _norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    n = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2, axis=axes,
+                         keepdims=True))
+    return n
+
+
+class WeightNorm:
+    """w = g * v / ||v|| (ref weight_norm.py:22)."""
+
+    @staticmethod
+    def reparameterize(weight, dim: Optional[int] = 0):
+        """weight → (g, v) (ref weight_norm.py:62)."""
+        g = _norm(weight, dim).astype(weight.dtype)
+        return g, weight
+
+    @staticmethod
+    def compute_weight(g, v, dim: Optional[int] = 0):
+        """(g, v) → w (ref weight_norm.py:39); fp32 norm, origin dtype out."""
+        w = v.astype(jnp.float32) * (
+            g.astype(jnp.float32) / (_norm(v, dim) + 1e-12))
+        return w.astype(v.dtype)
+
+
+Reparameterization = WeightNorm  # ref reparameterization.py base class
+
+
+def _eligible(leaf) -> bool:
+    # ref __init__.py: skip 1-d vectors and scalars
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+def apply_weight_norm(params, name: str = "", dim: int = 0):
+    """Replace eligible leaves (or the one named ``name``) with
+    ``{leaf + '_g', leaf + '_v'}`` pairs (ref __init__.py:7)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif (_eligible(v) and (name == "" or k == name)):
+                g, vv = WeightNorm.reparameterize(v, dim)
+                out[k + _G_SUFFIX] = g
+                out[k + _V_SUFFIX] = vv
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
+
+
+def compute_weights(params, dim: int = 0):
+    """Materialize every (g, v) pair back into w — call INSIDE the forward
+    so the norm fuses into the consumer."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k.endswith(_G_SUFFIX) and k[:-len(_G_SUFFIX)] + _V_SUFFIX in node:
+                base = k[:-len(_G_SUFFIX)]
+                out[base] = WeightNorm.compute_weight(
+                    v, node[base + _V_SUFFIX], dim)
+            elif k.endswith(_V_SUFFIX) and k[:-len(_V_SUFFIX)] + _G_SUFFIX in node:
+                pass  # consumed with its _g partner
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
+
+
+def remove_weight_norm(params, name: str = "", dim: int = 0):
+    """Collapse (g, v) back to plain weights (ref __init__.py:64)."""
+    del name
+    return compute_weights(params, dim)
+
+
+def apply_reparameterization(params, reparameterization=None, name: str = "",
+                             dim: int = 0, hook_child: bool = True):
+    """ref reparameterization/__init__.py:67 — apply a reparameterization
+    (WeightNorm is the only one the reference ships, and the default) to
+    one named weight or every eligible weight. Functional: returns the
+    transformed params tree instead of installing forward hooks
+    (``hook_child`` is accepted for parity; there are no hooks to place)."""
+    del hook_child
+    if reparameterization is not None and reparameterization is not WeightNorm:
+        raise ValueError(
+            f"unknown reparameterization {reparameterization!r}; "
+            "WeightNorm is the supported kind (as in the reference)")
+    return apply_weight_norm(params, name=name, dim=dim)
+
+
+def remove_reparameterization(params, reparameterization=None, name: str = "",
+                              remove_all: bool = False):
+    """ref reparameterization/__init__.py:99 — collapse (g, v) pairs back
+    to plain weights. ``remove_all``/``name`` narrow which weights in the
+    reference; the functional tree walk collapses every pair it finds, so
+    both spellings converge here."""
+    del remove_all
+    if reparameterization is not None and reparameterization is not WeightNorm:
+        raise ValueError(
+            f"unknown reparameterization {reparameterization!r}; "
+            "WeightNorm is the supported kind (as in the reference)")
+    return remove_weight_norm(params, name=name)

@@ -1,0 +1,6 @@
+"""apex.RNN parity surface (ref apex/RNN/__init__.py)."""
+
+from apex_tpu.rnn.models import LSTM, GRU, ReLU, Tanh, mLSTM
+from apex_tpu.rnn import cells, models
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "cells", "models"]

@@ -1,0 +1,107 @@
+"""RNN cells (TPU re-design of ``apex.RNN.cells`` + the fused pointwise
+cells in RNNBackend; ref apex/RNN/cells.py, apex/RNN/RNNBackend.py).
+
+The reference's "fused" cells rely on torch's rnnFusedPointwise CUDA kernel;
+under XLA the gate pointwise math fuses automatically, so the cells are pure
+functions ``cell(params, carry, x) -> (new_carry, output)`` designed for
+``jax.lax.scan`` over time.
+
+Weights follow the torch convention: w_ih [gates*h, in], w_hh [gates*h, h],
+gate order (i, f, g, o) for LSTM and (r, z, n) for GRU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cell_params(key, input_size, hidden_size, gate_multiplier,
+                     bias=True, extra_m=False, output_size=None, dtype=jnp.float32):
+    """Uniform(-1/sqrt(h), 1/sqrt(h)) init (ref RNNBackend.py reset_parameters)."""
+    out = output_size if output_size is not None else hidden_size
+    g = gate_multiplier
+    bound = 1.0 / hidden_size ** 0.5
+    ks = jax.random.split(key, 6)
+
+    def u(k, *shape):
+        return jax.random.uniform(k, shape, dtype, -bound, bound)
+
+    p = {"w_ih": u(ks[0], g * hidden_size, input_size),
+         "w_hh": u(ks[1], g * hidden_size, out)}
+    if bias:
+        p["b_ih"] = u(ks[2], g * hidden_size)
+        p["b_hh"] = u(ks[3], g * hidden_size)
+    if extra_m:  # mLSTM multiplicative weights (ref cells.py:21-25)
+        p["w_mih"] = u(ks[4], out, input_size)
+        p["w_mhh"] = u(ks[5], out, out)
+    if out != hidden_size:
+        # output projection h_out = w_ho @ h (ref RNNBackend.py RNNCell:
+        # "if output_size != hidden_size: h = F.linear(h, w_ho)")
+        key, k = jax.random.split(ks[5])
+        p["w_ho"] = u(k, out, hidden_size)
+    return p
+
+
+def _gates(p, x, h):
+    y = x @ p["w_ih"].T + h @ p["w_hh"].T
+    if "b_ih" in p:
+        y = y + p["b_ih"] + p["b_hh"]
+    return y
+
+
+def lstm_cell(p, carry, x):
+    """Fused-pointwise LSTM (ref RNNBackend fusedBackend.LSTMFused)."""
+    h, c = carry
+    i, f, g, o = jnp.split(_gates(p, x, h), 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def mlstm_cell(p, carry, x):
+    """Multiplicative LSTM (ref cells.py:61 mLSTMCell): the hidden input to
+    the gates is modulated m = (W_mih x) * (W_mhh h)."""
+    h, c = carry
+    m = (x @ p["w_mih"].T) * (h @ p["w_mhh"].T)
+    i, f, g, o = jnp.split(_gates(p, x, m), 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def gru_cell(p, carry, x):
+    """GRU with torch gate layout (r, z, n) (ref fusedBackend.GRUFused)."""
+    (h,) = carry
+    gi = x @ p["w_ih"].T + (p["b_ih"] if "b_ih" in p else 0.0)
+    gh = h @ p["w_hh"].T + (p["b_hh"] if "b_hh" in p else 0.0)
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    h_new = (1.0 - z) * n + z * h
+    return (h_new,), h_new
+
+
+def relu_cell(p, carry, x):
+    """Vanilla ReLU RNN (ref RNNBackend RNNReLUCell)."""
+    (h,) = carry
+    h_new = jax.nn.relu(_gates(p, x, h))
+    return (h_new,), h_new
+
+
+def tanh_cell(p, carry, x):
+    """Vanilla tanh RNN (ref RNNBackend RNNTanhCell)."""
+    (h,) = carry
+    h_new = jnp.tanh(_gates(p, x, h))
+    return (h_new,), h_new
+
+
+CELLS = {
+    "LSTM": (lstm_cell, 4, 2, False),
+    "mLSTM": (mlstm_cell, 4, 2, True),
+    "GRU": (gru_cell, 3, 1, False),
+    "ReLU": (relu_cell, 1, 1, False),
+    "Tanh": (tanh_cell, 1, 1, False),
+}

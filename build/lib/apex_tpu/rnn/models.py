@@ -1,0 +1,146 @@
+"""Stacked RNN models over lax.scan (ref apex/RNN/models.py which wires
+cells into stackedRNN/bidirectionalRNN containers).
+
+``LSTM(input_size, hidden_size, num_layers)`` returns a model object with
+``.params`` and ``__call__(x, params=None, h0=None)``; x is [seq, batch, in]
+(the torch RNN layout the reference uses; ``batch_first=True`` accepts
+[batch, seq, in]). ``bidirectional=True`` runs a second cell per layer over
+reversed time and concatenates the two outputs on the feature dim
+(ref RNNBackend.py:25 bidirectionalRNN: fwd + reversed scan, cat(-1)).
+Dropout between layers matches ref RNNBackend.stackedRNN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.rnn.cells import CELLS, init_cell_params
+
+
+class _RNNModel:
+    def __init__(self, mode: str, input_size: int, hidden_size: int,
+                 num_layers: int = 1, bias: bool = True, dropout: float = 0.0,
+                 bidirectional: bool = False, batch_first: bool = False,
+                 output_size: Optional[int] = None,
+                 seed: int = 0, dtype=jnp.float32):
+        self.mode = mode
+        self.cell, self.gate_multiplier, self.n_states, self.extra_m = CELLS[mode]
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+        self.batch_first = batch_first
+        self.n_directions = 2 if bidirectional else 1
+        self.output_size = output_size if output_size is not None else hidden_size
+        key = jax.random.PRNGKey(seed)
+        self.params = []
+        for layer in range(num_layers):
+            in_sz = (input_size if layer == 0
+                     else self.output_size * self.n_directions)
+            dirs = []
+            for _ in range(self.n_directions):
+                key, k = jax.random.split(key)
+                dirs.append(init_cell_params(
+                    k, in_sz, hidden_size, self.gate_multiplier, bias=bias,
+                    extra_m=self.extra_m, output_size=self.output_size,
+                    dtype=dtype))
+            self.params.append(dirs[0] if not bidirectional
+                               else {"fwd": dirs[0], "rev": dirs[1]})
+
+    def init_hidden(self, batch: int, dtype=jnp.float32):
+        """Zero states per layer (ref RNNBackend init_hidden): h carries
+        output_size, extra states (LSTM c) carry hidden_size. Bidirectional
+        layers carry a ``(fwd_states, rev_states)`` pair."""
+        sizes = [self.output_size] + [self.hidden_size] * (self.n_states - 1)
+
+        def one():
+            return tuple(jnp.zeros((batch, s), dtype) for s in sizes)
+
+        return [
+            (one(), one()) if self.bidirectional else one()
+            for _ in range(self.num_layers)
+        ]
+
+    def _scan_dir(self, lp, state, xs, reverse: bool):
+        def body(carry, xt):
+            new_carry, y = self.cell(lp, carry, xt)
+            if "w_ho" in lp:
+                # project hidden -> output_size (ref RNNBackend RNNCell
+                # forward); the projected h is what the carry stores
+                y = y @ lp["w_ho"].T
+                new_carry = (y,) + tuple(new_carry[1:])
+            return new_carry, y
+
+        return jax.lax.scan(body, state, xs, reverse=reverse)
+
+    def __call__(self, x, params=None, h0=None, dropout_rng=None):
+        """x [seq, batch, in] ([batch, seq, in] when ``batch_first``) →
+        (outputs [seq, batch, h·dirs] (resp. batch-first), final_states)."""
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        p = params if params is not None else self.params
+        states = h0 if h0 is not None else self.init_hidden(x.shape[1], x.dtype)
+        outs = x
+        finals = []
+        for layer in range(self.num_layers):
+            lp = p[layer]
+            if self.bidirectional:
+                s_f, s_r = states[layer]
+                final_f, out_f = self._scan_dir(lp["fwd"], s_f, outs, False)
+                # reverse=True consumes time back-to-front and emits ys in
+                # original order — the reversed-scan half of the ref's
+                # bidirectionalRNN without materializing x[::-1]
+                final_r, out_r = self._scan_dir(lp["rev"], s_r, outs, True)
+                outs = jnp.concatenate([out_f, out_r], axis=-1)
+                finals.append((final_f, final_r))
+            else:
+                final, outs = self._scan_dir(lp, states[layer], outs, False)
+                finals.append(final)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                if dropout_rng is None:
+                    raise ValueError(
+                        "dropout > 0 requires dropout_rng (pass None-free "
+                        "rng, or construct with dropout=0.0 for eval)")
+                dropout_rng, k = jax.random.split(dropout_rng)
+                keep = jax.random.bernoulli(
+                    k, 1.0 - self.dropout, outs.shape)
+                outs = jnp.where(keep, outs / (1.0 - self.dropout), 0.0)
+        if self.batch_first:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, finals
+
+
+def LSTM(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, **kw):
+    """ref RNN/models.py:34 LSTM."""
+    return _RNNModel("LSTM", input_size, hidden_size, num_layers, bias,
+                     dropout, bidirectional, batch_first, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, **kw):
+    return _RNNModel("GRU", input_size, hidden_size, num_layers, bias,
+                     dropout, bidirectional, batch_first, **kw)
+
+
+def ReLU(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, **kw):
+    return _RNNModel("ReLU", input_size, hidden_size, num_layers, bias,
+                     dropout, bidirectional, batch_first, **kw)
+
+
+def Tanh(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, **kw):
+    return _RNNModel("Tanh", input_size, hidden_size, num_layers, bias,
+                     dropout, bidirectional, batch_first, **kw)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, **kw):
+    """ref RNN/models.py:22 mLSTM."""
+    return _RNNModel("mLSTM", input_size, hidden_size, num_layers, bias,
+                     dropout, bidirectional, batch_first, **kw)

@@ -1,0 +1,20 @@
+"""Native host runtime bindings (SURVEY.md §2 #50).
+
+ctypes loader for ``csrc/libapex_tpu_host.so`` plus pure-Python fallbacks
+so the package works before ``make -C csrc`` has run.
+"""
+
+from apex_tpu.runtime.host import (
+    HostRuntime,
+    PrefetchLoader,
+    bucket_offsets,
+    flatten_into,
+    plan_buckets,
+    runtime_available,
+    unflatten_from,
+)
+
+__all__ = [
+    "HostRuntime", "PrefetchLoader", "bucket_offsets", "flatten_into",
+    "plan_buckets", "runtime_available", "unflatten_from",
+]

@@ -1,0 +1,34 @@
+"""Megatron-style model parallelism, TPU-native
+(ref apex/transformer/__init__.py).
+
+Axes ride a global ``jax.sharding.Mesh`` ('pp','dp','cp','tp','ep'); see
+``parallel_state`` for the group/rank API, ``tensor_parallel`` for TP
+layers/collectives, ``pipeline_parallel`` for collective 1F1B schedules,
+``context_parallel`` for ring-attention sequence parallelism, and ``moe``
+for expert parallelism (GShard/Switch dispatch over 'ep').
+"""
+
+from apex_tpu.transformer import enums
+from apex_tpu.transformer import functional
+from apex_tpu.transformer import microbatches
+from apex_tpu.transformer import moe
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import utils
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+from apex_tpu.transformer.log_util import set_logging_level
+
+__all__ = [
+    "enums",
+    "functional",
+    "microbatches",
+    "moe",
+    "parallel_state",
+    "tensor_parallel",
+    "utils",
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+    "set_logging_level",
+]

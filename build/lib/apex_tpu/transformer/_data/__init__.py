@@ -1,0 +1,8 @@
+"""Megatron-style batch samplers (ref apex/transformer/_data/__init__.py)."""
+
+from apex_tpu.transformer._data._batchsampler import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+__all__ = ["MegatronPretrainingRandomSampler", "MegatronPretrainingSampler"]

@@ -1,0 +1,5 @@
+"""Model-parallel-aware grad scaling (ref apex/transformer/amp/)."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

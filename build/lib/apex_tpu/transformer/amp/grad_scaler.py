@@ -1,0 +1,67 @@
+"""Gradient scaler with model-parallel inf check
+(ref apex/transformer/amp/grad_scaler.py GradScaler).
+
+The reference subclasses ``torch.cuda.amp.GradScaler`` and all-reduces
+``found_inf`` (MAX) over the model-parallel group before deciding to step
+or back off — a rank seeing a local overflow must make EVERY tp/pp rank
+skip, or the replicas diverge. The TPU form subclasses the in-graph
+:class:`apex_tpu.amp.LossScaler`: :meth:`unscale` ORs the overflow flag
+across the model-parallel mesh axes with ``pmax`` inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+
+
+def _axis_bound(axis: str) -> bool:
+    """True iff ``axis`` is a bound named axis in the current trace.
+
+    Probing the axis env directly (rather than catching pmax's unbound-axis
+    error) keeps genuine pmax failures loud — swallowing them would silently
+    drop the cross-rank overflow sync this class exists to guarantee.
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        return bool(get_axis_env().axis_exists(axis))
+    except Exception:  # private API moved: probe with a cheap axis_size
+        try:
+            jax.lax.axis_size(axis)
+            return True
+        except (NameError, AssertionError):
+            return False
+
+
+class GradScaler(LossScaler):
+    """ref grad_scaler.py:21. ``model_parallel_axes`` are the mesh axes the
+    overflow decision must agree across (tp and pp by default); axes not
+    bound in the current shard_map are skipped, so the same scaler works
+    under any mesh subset."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True,
+                 model_parallel_axes: Sequence[str] = ("tp", "pp")):
+        super().__init__(
+            loss_scale="dynamic", init_scale=init_scale,
+            scale_factor=growth_factor, scale_window=growth_interval,
+            enabled=enabled, backoff_factor=backoff_factor)
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def unscale(self, grads, state):
+        unscaled, overflow = super().unscale(grads, state)
+        if not self.enabled:  # disabled scaler compiles to nothing
+            return unscaled, overflow
+        # sync the decision across model-parallel ranks (ref
+        # _maybe_opt_step's MAX allreduce over get_model_parallel_group())
+        flag = overflow.astype(jnp.int32)
+        for axis in self.model_parallel_axes:
+            if not _axis_bound(axis):
+                continue
+            flag = jax.lax.pmax(flag, axis)
+        return unscaled, flag > 0

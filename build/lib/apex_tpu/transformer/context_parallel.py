@@ -1,0 +1,370 @@
+"""Context (sequence) parallelism — first-class long-context support.
+
+No reference-file analog (the CUDA reference scales sequence length with
+megatron context parallelism + flash attention at the framework level; see
+SURVEY.md §2 #53): sequences are sharded over the 'cp' mesh axis and
+attention runs as **ring attention** — each step computes one K/V block's
+contribution with an online-softmax accumulator (flash-attention algebra in
+fp32) and ``ppermute``s the K/V block around the ring, so peak memory is
+O(s_local²/P) and the ICI transfer overlaps the block matmul. Backward is
+autodiff through the scan: the transposed ppermutes run the ring in reverse.
+
+Alternative: :func:`ulysses_attention` (DeepSpeed-Ulysses-style) swaps
+sequence↔head sharding with two ``all_to_all``s and runs plain attention
+locally — cheaper at moderate sequence lengths when heads ≥ cp.
+
+All functions run inside ``shard_map`` with 'cp' bound; layouts are
+``[batch, seq_local, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+_NEG_INF = -1e30
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else parallel_state.CONTEXT_AXIS
+
+
+def _vary_like(x, axis, *like):
+    """pvary ``x`` over ``axis`` plus every mesh axis any of ``like`` varies
+    over. Fresh-zeros scan carries and cond branches must match the vma of
+    values computed from the real inputs — when cp composes with tp/pp/dp
+    in one shard_map (the 4-axis dryrun), q/k/v vary over MORE than the
+    ring axis and a carry marked only {cp} trips the scan vma check."""
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        _to_varying,
+        tree_vma,
+    )
+
+    for ax in sorted({axis} | tree_vma(like)):
+        x = _to_varying(x, ax)
+    return x
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    remat: bool = True,
+):
+    """Exact attention over a cp-sharded sequence.
+
+    q/k/v: [b, s_local, h, d] — this rank's sequence shard. Returns the
+    attention output for the local queries, identical (up to fp roundoff) to
+    full attention over the gathered sequence.
+
+    On TPU (Pallas enabled) each ring step runs the flash-attention kernel
+    on the resident K/V block and per-block results merge by logsumexp —
+    peak memory O(s_local·d), never a score matrix in HBM (see
+    :func:`_ring_flash`); elsewhere the jnp online-softmax path below runs.
+    """
+    from apex_tpu.ops import pallas_config
+
+    if pallas_config.use_pallas("flash_attention"):
+        b, s_local, h, d = q.shape
+        h_kv = k.shape[2]
+        if h % h_kv:
+            raise ValueError(
+                f"query heads {h} not a multiple of kv heads {h_kv}")
+        sc = float(scale if scale is not None else 1.0 / (d ** 0.5))
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h, s_local, d)
+        kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_local, d)
+        vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, s_local, d)
+        o = _ring_flash(_axis(axis_name), causal, sc, qt, kt, vt)
+        return (o.reshape(b, h, s_local, d).transpose(0, 2, 1, 3)
+                .astype(q.dtype))
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv  # GQA: k/v ride the ring at h_kv heads, never repeated
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q32 = q.astype(jnp.float32) * scale
+    if rep > 1:
+        q32 = q32.reshape(b, s_local, h_kv, rep, d)
+    row_pos = rank * s_local + jnp.arange(s_local)  # global query positions
+
+    def block(carry_kv, src_rank):
+        """One K/V block's contribution given its originating rank."""
+        k_blk, v_blk = carry_kv
+        k32 = k_blk.astype(jnp.float32)
+        if rep > 1:
+            s = jnp.einsum("bqgrd,bkgd->bgrqk", q32, k32)
+            s = s.reshape(b, h, s_local, -1)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
+        if causal:
+            col_pos = src_rank * s_local + jnp.arange(s_local)
+            allowed = col_pos[None, :] <= row_pos[:, None]  # [q, k]
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        return s
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        src = (rank - i) % n
+        s = block((k_blk, v_blk), src)  # [b, h, q, k]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # fully-masked rows have s == m_new == _NEG_INF; exp(0)=1 would leak
+        # weight onto masked keys, so zero them explicitly
+        p = jnp.where(
+            s <= _NEG_INF * 0.5, 0.0, jnp.exp(s - m_new[..., None])
+        )
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        v32 = v_blk.astype(jnp.float32)
+        if rep > 1:
+            pv = jnp.einsum(
+                "bgrqk,bkgd->bgrqd",
+                p.reshape(b, h_kv, rep, s_local, -1), v32
+            ).reshape(b, h, s_local, d)
+        else:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, v32)
+        o = o * alpha[..., None] + pv
+        # rotate K/V around the ring (rank r's block moves to r+1)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, m_new, l, o), None
+
+    step_fn = jax.checkpoint(step) if remat else step
+    # accumulators become device-varying inside the loop; start them that way
+    m0 = _vary_like(jnp.full((b, h, s_local), _NEG_INF, jnp.float32), axis,
+                    q, k, v)
+    l0 = _vary_like(jnp.zeros((b, h, s_local), jnp.float32), axis, q, k, v)
+    o0 = _vary_like(jnp.zeros((b, h, s_local, d), jnp.float32), axis,
+                    q, k, v)
+    (_, _, m, l, o), _ = jax.lax.scan(
+        step_fn, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]  # [b, h, q, d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+# ------------------------------------------------------ ring flash (Pallas)
+# Each ring step runs the flash-attention TPU kernel on the resident K/V
+# block; per-block (out, lse) pairs merge by logsumexp. Backward re-runs
+# the ring calling the flash dq/dk/dv kernels with the GLOBAL (out, lse) —
+# block probabilities recompute exactly, and the circulating dK/dV
+# accumulators arrive home after a full rotation (the ring-flash-attention
+# algorithm; same design as the fwd/bwd kernels in ops/flash_attention).
+
+
+def _rotate(x, axis):
+    n = jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _merge_lse(o_acc, lse_acc, o_i, lse_i):
+    """Merge normalized block outputs by their logsumexps (fp32)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+    w_a = jnp.exp(lse_acc - safe)[..., None]
+    w_i = jnp.exp(lse_i - safe)[..., None]
+    return o_acc * w_a + o_i.astype(jnp.float32) * w_i, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_flash(axis, causal, scale, q, k, v):
+    """Flattened flash ring: q [bh, s, d], k/v [bh_kv, s, d] (GQA via
+    fewer kv rows, kv-major head order as in ops.flash_attention)."""
+    return _ring_flash_fwd(axis, causal, scale, q, k, v)[0]
+
+
+def _ring_flash_block_fwd(q, kb, vb, src, rank, causal, scale, axis, interp):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import _flash_fwd_pallas
+
+    bh, s, d = q.shape
+    bq, bk = pallas_config.flash_blocks("fwd", s, s, d)
+
+    def diag(_):
+        return _flash_fwd_pallas(q, kb, vb, True, scale, bq, bk, interp)
+
+    def full(_):
+        return _flash_fwd_pallas(q, kb, vb, False, scale, bq, bk, interp)
+
+    def skip(_):
+        # zeros must carry the same vma as the kernel outputs
+        return (_vary_like(jnp.zeros((bh, s, d), q.dtype), axis, q, kb, vb),
+                _vary_like(jnp.full((bh, s), -jnp.inf, jnp.float32), axis,
+                           q, kb, vb))
+
+    if not causal:
+        return full(None)
+    return jax.lax.cond(
+        src == rank, diag,
+        lambda _: jax.lax.cond(src < rank, full, skip, None), None)
+
+
+def _ring_flash_fwd(axis, causal, scale, q, k, v):
+    from apex_tpu.ops import pallas_config
+
+    interp = pallas_config.interpret()
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    bh, s, d = q.shape
+
+    def step(carry, i):
+        kb, vb, o_acc, lse_acc = carry
+        src = (rank - i) % n
+        o_i, lse_i = _ring_flash_block_fwd(q, kb, vb, src, rank, causal,
+                                           scale, axis, interp)
+        o_acc, lse_acc = _merge_lse(o_acc, lse_acc, o_i, lse_i)
+        return (_rotate(kb, axis), _rotate(vb, axis), o_acc, lse_acc), None
+
+    o0 = _vary_like(jnp.zeros((bh, s, d), jnp.float32), axis, q, k, v)
+    lse0 = _vary_like(jnp.full((bh, s), -jnp.inf, jnp.float32), axis,
+                      q, k, v)
+    (_, _, o, lse), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    o = o.astype(q.dtype)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, res, do):
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import _flash_bwd_pallas
+
+    q, k, v, o, lse = res
+    interp = pallas_config.interpret()
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    bh, s, d = q.shape
+    bh_kv = k.shape[0]
+    bq, bk = pallas_config.flash_blocks("bwd", s, s, d)
+
+    def block_bwd(kb, vb, src):
+        def diag(_):
+            return _flash_bwd_pallas(q, kb, vb, o, lse, do, True, scale,
+                                     bq, bk, interp)
+
+        def full(_):
+            return _flash_bwd_pallas(q, kb, vb, o, lse, do, False, scale,
+                                     bq, bk, interp)
+
+        def skip(_):
+            return (_vary_like(jnp.zeros((bh, s, d), q.dtype), axis,
+                               q, kb, vb, do),
+                    _vary_like(jnp.zeros((bh_kv, s, d), k.dtype), axis,
+                               q, kb, vb, do),
+                    _vary_like(jnp.zeros((bh_kv, s, d), v.dtype), axis,
+                               q, kb, vb, do))
+
+        if not causal:
+            return full(None)
+        return jax.lax.cond(
+            src == rank, diag,
+            lambda _: jax.lax.cond(src < rank, full, skip, None), None)
+
+    def step(carry, i):
+        kb, vb, dkb, dvb, dq_acc = carry
+        src = (rank - i) % n
+        dq_i, dk_i, dv_i = block_bwd(kb, vb, src)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dkb = dkb + dk_i.astype(jnp.float32)
+        dvb = dvb + dv_i.astype(jnp.float32)
+        # dK/dV accumulators travel WITH their block; after the full
+        # rotation they are home with every rank's contribution
+        return (_rotate(kb, axis), _rotate(vb, axis), _rotate(dkb, axis),
+                _rotate(dvb, axis), dq_acc), None
+
+    z_kv = _vary_like(jnp.zeros((bh_kv, s, d), jnp.float32), axis,
+                      q, k, v, do)
+    z_q = _vary_like(jnp.zeros((bh, s, d), jnp.float32), axis, q, k, v, do)
+    (_, _, dk_out, dv_out, dq_out), _ = jax.lax.scan(
+        step, (k, v, z_kv, z_kv, z_q), jnp.arange(n))
+    return (dq_out.astype(q.dtype), dk_out.astype(k.dtype),
+            dv_out.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    attn_fn: Optional[Callable] = None,
+    axis_name: Optional[str] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """All-to-all sequence parallelism: trade seq sharding for head sharding,
+    attend locally over the FULL sequence, swap back.
+
+    Requires heads % cp == 0. ``attn_fn(q, k, v)`` (full-sequence layouts)
+    defaults to plain softmax attention with the usual 1/√d scale.
+    """
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+
+    def seq_to_heads(x):
+        # [b, s_local, h, d] -> [b, s_full, h/n, d]
+        x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                               tiled=True)
+        return x
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    if attn_fn is None:
+        d = q.shape[-1]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+
+        def attn_fn(q, k, v):
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+            ) * sc
+            if causal:
+                sq, sk = s.shape[-2], s.shape[-1]
+                rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                s = jnp.where((cols > rows)[None, None], _NEG_INF, s)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+            return o.astype(q.dtype)
+
+    of = attn_fn(qf, kf, vf)
+    return heads_to_seq(of)
+
+
+def split_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
+    """Take this rank's sequence chunk (delegates to the tensor_parallel
+    mapping; the cp default axis and [b, s, ...] seq_dim=1 differ)."""
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    return mappings.scatter_to_sequence_parallel_region(
+        x, _axis(axis_name), seq_dim=seq_dim)
+
+
+def gather_sequence(x, axis_name: Optional[str] = None, seq_dim: int = 1):
+    """Inverse of :func:`split_sequence`."""
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    return mappings.gather_from_sequence_parallel_region(
+        x, _axis(axis_name), seq_dim=seq_dim)
+
+
+def context_parallel_positions(s_local: int, axis_name: Optional[str] = None):
+    """Global position ids for this rank's shard (feed to RoPE)."""
+    axis = _axis(axis_name)
+    rank = jax.lax.axis_index(axis)
+    return rank * s_local + jnp.arange(s_local)

@@ -1,0 +1,27 @@
+"""Fused functional ops (ref apex/transformer/functional/__init__.py)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.functional.chunked_ce import (
+    chunked_lm_cross_entropy,
+)
+from apex_tpu.transformer.functional.rope import (
+    apply_rotary_pos_emb,
+    apply_rotary_qk,
+    fused_apply_rotary_pos_emb,
+    rotary_freqs,
+)
+
+__all__ = [
+    "chunked_lm_cross_entropy",
+    "FusedScaleMaskSoftmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "apply_rotary_pos_emb",
+    "apply_rotary_qk",
+    "fused_apply_rotary_pos_emb",
+    "rotary_freqs",
+]

@@ -1,0 +1,93 @@
+"""Fused rotary position embedding (ref csrc/megatron fused_rotary_positional_embedding
+via apex.transformer.functional.fused_rope API surface).
+
+The CUDA kernel fuses the rotate-half multiply-add; on TPU the whole
+expression is a single XLA fusion already, so the value here is the exact
+Megatron semantics (interleaved halves, fp32 trig, optional partial rotary
+dim) in one place, shared by the model families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_freqs(
+    seq_len: int,
+    dim: int,
+    base: float = 10000.0,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """[seq, dim] angle table θ_{t,i} (Megatron RotaryEmbedding analog)."""
+    inv = 1.0 / (
+        base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    )
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [seq, dim/2]
+    return jnp.concatenate([freqs, freqs], axis=-1).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def fused_apply_rotary_pos_emb(t, freqs) -> jnp.ndarray:
+    """Apply rotary embedding: t·cos + rotate_half(t)·sin, fp32 trig.
+
+    ``t``: [..., seq, ..., dim] with ``freqs`` broadcastable [seq, dim] →
+    callers reshape freqs to line up (Megatron uses [sq, 1, 1, hn]).
+    Partial rotary (freqs dim < t dim) rotates the leading slice and passes
+    the rest through, like the reference kernel.
+    """
+    rot_dim = freqs.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    f32 = jnp.float32
+    cos, sin = jnp.cos(freqs.astype(f32)), jnp.sin(freqs.astype(f32))
+    out = t_rot.astype(f32) * cos + _rotate_half(t_rot.astype(f32)) * sin
+    out = out.astype(t.dtype)
+    if t_pass.shape[-1] == 0:
+        return out
+    return jnp.concatenate([out, t_pass], axis=-1)
+
+
+def apply_rotary_pos_emb(t, freqs) -> jnp.ndarray:
+    """Megatron-shaped entry: t [sq, b, np, hn], freqs [sq, 1, 1, hn]."""
+    return fused_apply_rotary_pos_emb(t, freqs)
+
+
+def apply_rotary_qk(
+    q,
+    k,
+    freqs: Optional[jnp.ndarray] = None,
+    *,
+    positions: Optional[jnp.ndarray] = None,
+    base: float = 10000.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Convenience for [b, seq, heads, dim] layouts (our model families).
+
+    ``positions`` ([b, seq] int) selects rows of the angle table for packed /
+    shifted sequences (context-parallel shards pass their global offsets).
+    """
+    dim = q.shape[-1]
+    if freqs is None:
+        if positions is not None:
+            # Compute angles straight from positions — no table, no
+            # data-dependent bound, traceable under jit/shard_map.
+            inv = 1.0 / (
+                base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+            )
+            half = positions.astype(jnp.float32)[..., None] * inv  # [b,s,d/2]
+            freqs = jnp.concatenate([half, half], axis=-1)
+        else:
+            freqs = rotary_freqs(q.shape[1], dim, base)
+    if freqs.ndim == 2:  # [seq, dim] -> [1, seq, 1, dim]
+        freqs = freqs[None, :, None, :]
+    elif freqs.ndim == 3:  # [b, seq, dim] -> [b, seq, 1, dim]
+        freqs = freqs[:, :, None, :]
+    return (
+        fused_apply_rotary_pos_emb(q, freqs),
+        fused_apply_rotary_pos_emb(k, freqs),
+    )

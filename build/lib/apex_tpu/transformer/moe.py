@@ -1,0 +1,242 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+No reference-file analog (SURVEY.md §1 lists 'ep' among the comms-layer
+mesh axes the TPU design must serve; the CUDA reference predates MoE).
+The design is the GShard/Switch formulation, which is TPU-first by
+construction — everything is static-shaped einsums the MXU eats directly:
+
+- router: softmax over experts, top-1 (Switch) or top-2 (GShard) gating
+  with the standard load-balancing auxiliary loss;
+- dispatch/combine: one-hot [tokens, experts, capacity] masks — no
+  sorting, no dynamic shapes; tokens beyond an expert's capacity are
+  dropped (scaled by capacity_factor);
+- expert parallelism: experts shard over 'ep'; inside ``shard_map`` a pair
+  of ``all_to_all`` collectives swaps the token dimension for the expert
+  dimension and back, so each rank runs only its local experts (the NCCL
+  analog would be torch all_to_all; here XLA schedules it on ICI).
+
+Layout summary (per ep rank, T = local tokens, E = global experts,
+C = per-expert capacity):
+
+    x [T, h] --dispatch--> [E, C, h] --all_to_all--> [E_local, n*C, h]
+      --expert mlp--> [E_local, n*C, h] --all_to_all--> [E, C, h]
+      --combine--> [T, h]
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+EXPERT_AXIS = "ep"
+
+
+class MoEConfig(NamedTuple):
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 2                 # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    router_jitter: float = 0.0     # optional exploration noise (training)
+    aux_loss_coef: float = 1e-2
+    # router z-loss (ST-MoE §4, arXiv:2202.08906): penalizes large router
+    # logits, which destabilize bf16 training; 0 disables (default)
+    z_loss_coef: float = 0.0
+
+
+def init_moe_params(key, cfg: MoEConfig, dtype=jnp.float32):
+    """router [h, E] + per-expert MLP weights stacked on dim 0.
+
+    Shard for ep with ``P('ep', ...)`` on the expert-stacked weights;
+    the router replicates.
+    """
+    kr, k1, k2 = jax.random.split(key, 3)
+    h, f, e = cfg.hidden_size, cfg.ffn_hidden_size, cfg.num_experts
+    lim1 = (6.0 / (h + f)) ** 0.5
+    return {
+        "router": (jax.random.normal(kr, (h, e)) * 0.02).astype(dtype),
+        "wi": jax.random.uniform(k1, (e, h, f), dtype, -lim1, lim1),
+        "wo": jax.random.uniform(k2, (e, f, h), dtype, -lim1, lim1),
+    }
+
+
+def moe_param_specs(cfg: MoEConfig, ep_axis: str = EXPERT_AXIS):
+    from jax.sharding import PartitionSpec as P
+
+    return {"router": P(), "wi": P(ep_axis, None, None),
+            "wo": P(ep_axis, None, None)}
+
+
+def _capacity(tokens: int, cfg: MoEConfig) -> int:
+    cap = int(tokens * cfg.top_k * cfg.capacity_factor / cfg.num_experts)
+    return max(cap, cfg.top_k)
+
+
+def router_gates(logits, cfg: MoEConfig, with_stats: bool = False):
+    """Top-k gating with position-in-expert assignment (GShard algo).
+
+    logits [T, E] -> (combine [T, E, C], dispatch [T, E, C], aux_loss).
+    All shapes static; tokens past an expert's capacity get zero gates
+    (dropped — the residual stream carries them unchanged).
+
+    ``aux_loss`` is the scalar TOTAL auxiliary loss (load-balance +
+    optional z-loss) so callers can add it straight to the task loss.
+    ``with_stats=True`` appends a telemetry dict
+    ``{"dropped_frac", "balance_loss", "z_loss"}`` — dropped_frac is the
+    fraction of the T·k routing assignments that fell past an expert's
+    capacity (the production drop-rate signal a capacity_factor is tuned
+    against).
+    """
+    t, e = logits.shape
+    c = _capacity(t, cfg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    combine = jnp.zeros((t, e, c), jnp.float32)
+    remaining = probs
+    # cumulative per-expert fill across the k choices
+    fill = jnp.zeros((e,), jnp.int32)
+    gates_sum = jnp.zeros((t,), jnp.float32)
+    pieces = []
+    for _ in range(cfg.top_k):
+        idx = jnp.argmax(remaining, axis=-1)                     # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)       # [T, E]
+        gate = jnp.sum(probs * onehot, axis=-1)                  # [T]
+        # position of each token within its chosen expert's queue:
+        # running count of earlier tokens (any k-th choice) + earlier
+        # choices' fill
+        pos = (jnp.cumsum(onehot, axis=0) - onehot) + fill[None, :]
+        pos_t = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = pos_t < c
+        gate = gate * keep.astype(jnp.float32)
+        pieces.append((onehot, gate, pos_t, keep))
+        fill = fill + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        gates_sum = gates_sum + gate
+        remaining = remaining * (1.0 - onehot)
+
+    # top-k>1: normalize the kept gates to sum to 1 per token (GShard /
+    # Mixtral combine). top-1 keeps the RAW probability (Switch eq. 2):
+    # normalizing would make the gate a constant 1 and kill the router's
+    # task-loss gradient — it would learn from the balance loss only.
+    if cfg.top_k == 1:
+        denom = jnp.ones_like(gates_sum)
+    else:
+        denom = jnp.maximum(gates_sum, 1e-9)
+    for onehot, gate, pos_t, keep in pieces:
+        slot = jax.nn.one_hot(pos_t, c, dtype=jnp.float32)       # [T, C]
+        contrib = (gate / denom)[:, None, None] * onehot[:, :, None] \
+            * slot[:, None, :]
+        combine = combine + jnp.where(keep[:, None, None], contrib, 0.0)
+
+    dispatch = combine > 0.0
+
+    # load-balancing aux loss (Switch eq. 4): E * mean_frac . mean_prob
+    first_onehot = pieces[0][0]
+    frac = jnp.mean(first_onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    balance = cfg.aux_loss_coef * e * jnp.sum(frac * mean_prob)
+
+    # router z-loss (ST-MoE eq. 5): mean (logsumexp of the fp32 logits)^2.
+    # cfg.z_loss_coef is a static float: skip the logsumexp (+ backward)
+    # entirely at the 0.0 default — 0*z is not DCE-safe for XLA
+    if cfg.z_loss_coef:
+        z_loss = cfg.z_loss_coef * jnp.mean(jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1) ** 2)
+    else:
+        z_loss = jnp.zeros((), jnp.float32)
+    aux = balance + z_loss
+    if not with_stats:
+        return combine, dispatch, aux
+
+    kept = sum(jnp.sum(keep.astype(jnp.float32))
+               for _, _, _, keep in pieces)
+    stats = {
+        "dropped_frac": 1.0 - kept / (t * cfg.top_k),
+        "balance_loss": balance,
+        "z_loss": z_loss,
+    }
+    return combine, dispatch, aux, stats
+
+
+def expert_parallel_apply(expert_fn, expert_params, x, router,
+                          cfg: MoEConfig,
+                          ep_axis: Optional[str] = EXPERT_AXIS,
+                          router_key=None, with_stats: bool = False):
+    """Route tokens through per-expert functions; returns (y, aux_loss).
+
+    ``expert_fn(expert_params, tokens)`` maps [E_local, C', h] ->
+    [E_local, C', h] with the LOCAL experts' stacked params (any
+    structure — a dict of stacked weights works). Inside ``shard_map``
+    with ``ep_axis`` bound the dispatch swaps the expert dim for the
+    token dim with a pair of tiled all_to_all collectives so each rank
+    runs only its experts; without the axis everything runs locally
+    (identical math). This is the layer other modules build on — e.g.
+    the Llama Mixtral-style SwiGLU experts — while :func:`moe_mlp` is
+    the plain two-matmul MLP instance.
+
+    ``with_stats=True`` returns ``(y, aux_loss, stats)`` (see
+    :func:`router_gates`); inside ``shard_map`` the stats are per-rank —
+    ``pmean`` them over the dp/ep axes for global telemetry.
+    """
+    lead = x.shape[:-1]
+    h = x.shape[-1]
+    xt = x.reshape(-1, h)
+
+    logits = jnp.matmul(xt.astype(jnp.float32), router.astype(jnp.float32))
+    if router_key is not None and cfg.router_jitter > 0.0:
+        logits = logits * jax.random.uniform(
+            router_key, logits.shape, jnp.float32,
+            1.0 - cfg.router_jitter, 1.0 + cfg.router_jitter)
+    gated = router_gates(logits, cfg, with_stats=with_stats)
+    combine, dispatch, aux = gated[:3]
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(xt.dtype), xt)
+
+    if _axis_bound(ep_axis):
+        # [E, C, h] -> [E/n, n*C, h]: send expert-chunk j to rank j, gather
+        # every rank's C-token slab for my local experts along capacity.
+        # tiled=True is load-bearing: untiled all_to_all STACKS a new rank
+        # axis instead of concatenating tiles, which silently broadcasts
+        # against the local expert dim whenever E/n == 1
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True)
+
+    y = expert_fn(expert_params, expert_in)
+
+    if _axis_bound(ep_axis):
+        # inverse: [E/n, n*C, h] -> [E, C, h]; capacity slab j returns to
+        # rank j, expert chunks re-concatenate in global expert order
+        y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
+                               tiled=True)
+
+    out = jnp.einsum("tec,ech->th", combine.astype(xt.dtype), y)
+    out = out.reshape(*lead, h).astype(x.dtype)
+    if with_stats:
+        return out, aux.astype(jnp.float32), gated[3]
+    return out, aux.astype(jnp.float32)
+
+
+def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
+            activation=jax.nn.gelu, router_key=None,
+            with_stats: bool = False):
+    """MoE feed-forward on [..., h]; returns (y, aux_loss).
+
+    Inside ``shard_map`` with ``ep_axis`` bound, experts run
+    expert-parallel: params['wi']/'wo' hold only the LOCAL experts
+    ([E/n, ...], sharded with :func:`moe_param_specs`) while the router
+    and dispatch math see all E experts. Without a bound axis it runs all
+    experts locally (single-device semantics, same math).
+    """
+
+    def expert_fn(p, tokens):
+        y = jnp.einsum("ech,ehf->ecf", tokens, p["wi"].astype(tokens.dtype))
+        y = activation(y)
+        return jnp.einsum("ecf,efh->ech", y, p["wo"].astype(tokens.dtype))
+
+    return expert_parallel_apply(
+        expert_fn, {"wi": params["wi"], "wo": params["wo"]}, x,
+        params["router"], cfg, ep_axis=ep_axis, router_key=router_key,
+        with_stats=with_stats)

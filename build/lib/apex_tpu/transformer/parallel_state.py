@@ -1,0 +1,408 @@
+"""Model/tensor/pipeline-parallel state over a global ``jax.sharding.Mesh``.
+
+TPU re-design of ``apex/transformer/parallel_state.py`` (which builds NCCL
+process groups per parallel dimension). On TPU there are no process groups:
+one global device mesh carries named axes, collectives name the axis they
+ride, and XLA lowers them onto ICI. This module keeps the reference's exact
+getter API (ref parallel_state.py:73 ``initialize_model_parallel`` and the
+getters at :250-555) but the underlying object is a Mesh with axes
+
+    ('pp', 'dp', 'cp', 'tp')    # pipeline, data, context, tensor
+
+laid out so tensor-parallel neighbours are adjacent devices (innermost axis ⇒
+fastest-varying ⇒ nearest on the ICI torus), matching the reference's rank
+ordering where tp ranks are consecutive (ref parallel_state.py:93-117).
+
+"Groups" become axis names: passing the result of
+``get_tensor_model_parallel_group()`` to ``psum``/``all_gather`` inside
+``shard_map`` is the analog of passing an NCCL group to ``dist.all_reduce``.
+
+Rank getters are dual-mode:
+- inside ``shard_map`` (axis bound) they return the traced ``lax.axis_index``;
+- outside, they return the value injected via the ``set_*_rank`` overrides
+  (used by tests and by host-side schedule construction, same as the
+  reference's ``set_tensor_model_parallel_rank`` test hooks), defaulting to 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names.
+PIPELINE_AXIS = "pp"
+DATA_AXIS = "dp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_WORLD_SIZE: Optional[int] = None
+_VIRTUAL_PIPELINE_RANK: Optional[int] = None
+_PIPELINE_SPLIT_RANK: Optional[int] = None
+
+# Host-side overrides (ref parallel_state.py:378-443 set_* hooks).
+_OVERRIDES = {}
+
+
+def is_unitialized() -> bool:
+    """(sic — the reference misspells it too, ref parallel_state.py:68)"""
+    return _MESH is None
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    context_parallel_size_: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global mesh (ref parallel_state.py:73).
+
+    The data-parallel size is inferred: world // (tp * pp * cp). ``devices``
+    defaults to ``jax.devices()``; pass an explicit list to subset or reorder
+    (e.g. to align tp with an ICI axis on a real slice).
+    """
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    cp = context_parallel_size_
+    if world % (tp * pp * cp) != 0:
+        raise RuntimeError(
+            f"world size {world} not divisible by tp({tp})*pp({pp})*cp({cp})"
+        )
+    dp = world // (tp * pp * cp)
+    # Reference rank order (parallel_state.py:93): tp consecutive, then dp,
+    # then pp outermost — reshape preserves it.
+    arr = np.asarray(devices, dtype=object).reshape(pp, dp, cp, tp)
+    _MESH = Mesh(arr, (PIPELINE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+    if virtual_pipeline_model_parallel_size_ is not None:
+        _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size_
+        _VIRTUAL_PIPELINE_RANK = 0
+    else:
+        _VIRTUAL_PIPELINE_WORLD_SIZE = None
+        _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank_
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """Tear down global state (ref parallel_state.py:555)."""
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PIPELINE_WORLD_SIZE = None
+    _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = None
+    _OVERRIDES.clear()
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized "
+            "(call initialize_model_parallel first)"
+        )
+    return _MESH
+
+
+# ------------------------------------------------------------------ groups
+# A "group" is the axis name (or tuple of names) collectives should ride.
+
+
+def get_model_parallel_group() -> Tuple[str, str]:
+    """tp+pp combined (ref parallel_state.py:273)."""
+    get_mesh()
+    return (PIPELINE_AXIS, TENSOR_AXIS)
+
+
+def get_tensor_model_parallel_group() -> str:
+    get_mesh()
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    get_mesh()
+    return PIPELINE_AXIS
+
+
+def get_data_parallel_group() -> str:
+    get_mesh()
+    return DATA_AXIS
+
+
+def get_context_parallel_group() -> str:
+    get_mesh()
+    return CONTEXT_AXIS
+
+
+def get_embedding_group() -> str:
+    """First+last pipeline stage share embedding grads (ref
+    parallel_state.py:301). On the mesh this is a masked psum over 'pp'
+    (see pipeline_parallel.p2p.embedding_allreduce); the axis is still 'pp'.
+    """
+    get_mesh()
+    return PIPELINE_AXIS
+
+
+def get_position_embedding_group() -> str:
+    get_mesh()
+    return PIPELINE_AXIS
+
+
+# ------------------------------------------------------------- world sizes
+
+
+def _axis_size(axis: str) -> int:
+    return get_mesh().shape[axis]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    ov = _OVERRIDES.get("tp_world")
+    return ov if ov is not None else _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    ov = _OVERRIDES.get("pp_world")
+    return ov if ov is not None else _axis_size(PIPELINE_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    ov = _OVERRIDES.get("dp_world")
+    return ov if ov is not None else _axis_size(DATA_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    ov = _OVERRIDES.get("cp_world")
+    return ov if ov is not None else _axis_size(CONTEXT_AXIS)
+
+
+def set_tensor_model_parallel_world_size(world_size) -> None:
+    _OVERRIDES["tp_world"] = world_size
+
+
+def set_pipeline_model_parallel_world_size(world_size) -> None:
+    _OVERRIDES["pp_world"] = world_size
+
+
+# ------------------------------------------------------------------- ranks
+
+
+def _axis_rank(axis: str, override_key: str):
+    ov = _OVERRIDES.get(override_key)
+    if ov is not None:
+        return ov
+    try:
+        # Traced value when the axis is bound (inside shard_map).
+        return jax.lax.axis_index(axis)
+    except (NameError, ValueError, KeyError, TypeError):
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_AXIS, "tp_rank")
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS, "pp_rank")
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS, "dp_rank")
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_AXIS, "cp_rank")
+
+
+def set_tensor_model_parallel_rank(rank) -> None:
+    _OVERRIDES["tp_rank"] = rank
+
+
+def set_pipeline_model_parallel_rank(rank) -> None:
+    _OVERRIDES["pp_rank"] = rank
+
+
+def get_rank_info() -> Tuple:
+    """(tp_rank, pp_rank, dp_rank) for debug logging (ref :250)."""
+    return (
+        get_tensor_model_parallel_rank(),
+        get_pipeline_model_parallel_rank(),
+        get_data_parallel_rank(),
+    )
+
+
+# -------------------------------------------------------- pipeline helpers
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """ref parallel_state.py:449. Traced bool inside shard_map."""
+    if not ignore_virtual:
+        if (
+            _VIRTUAL_PIPELINE_WORLD_SIZE is not None
+            and get_virtual_pipeline_model_parallel_rank() != 0
+        ):
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    """ref parallel_state.py:460."""
+    if not ignore_virtual:
+        vws = _VIRTUAL_PIPELINE_WORLD_SIZE
+        if vws is not None and get_virtual_pipeline_model_parallel_rank() != (
+            vws - 1
+        ):
+            return False
+    return (
+        get_pipeline_model_parallel_rank()
+        == get_pipeline_model_parallel_world_size() - 1
+    )
+
+
+def get_virtual_pipeline_model_parallel_rank():
+    return _VIRTUAL_PIPELINE_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank) -> None:
+    global _VIRTUAL_PIPELINE_RANK
+    _VIRTUAL_PIPELINE_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _VIRTUAL_PIPELINE_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_split_rank():
+    return _PIPELINE_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: int) -> None:
+    global _PIPELINE_SPLIT_RANK
+    _PIPELINE_SPLIT_RANK = rank
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """Encoder side of an encoder-decoder split (ref :338)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    if _PIPELINE_SPLIT_RANK is None:
+        return True
+    return rank < _PIPELINE_SPLIT_RANK
+
+
+def is_pipeline_stage_after_split(rank=None):
+    """Decoder side (ref :353)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    if _PIPELINE_SPLIT_RANK is None:
+        return True
+    return rank >= _PIPELINE_SPLIT_RANK
+
+
+def is_pipeline_stage_at_split():
+    """ref :368 — the stage feeding encoder output into the decoder."""
+    rank = get_pipeline_model_parallel_rank()
+    return is_pipeline_stage_before_split(rank) & is_pipeline_stage_after_split(
+        rank + 1
+    )
+
+
+def is_rank_in_embedding_group(ignore_virtual: bool = False):
+    """First or last pp stage (ref :315)."""
+    del ignore_virtual
+    return is_pipeline_first_stage(ignore_virtual=True) | is_pipeline_last_stage(
+        ignore_virtual=True
+    )
+
+
+def is_rank_in_position_embedding_group():
+    return is_pipeline_first_stage(ignore_virtual=True)
+
+
+# ------------------------------------------------- global-rank conversions
+# The reference exposes flat global ranks for src-rank broadcasts
+# (ref :493-541). With a single-controller mesh these index into
+# mesh.devices; they're mostly useful for logging / multihost launch.
+
+
+def get_tensor_model_parallel_src_rank():
+    """Global rank of tp-rank-0 in this rank's tp group (ref :493)."""
+    world = get_tensor_model_parallel_world_size()
+    # With tp innermost, the group leader is the floor to a multiple of tp.
+    return (_flat_rank() // world) * world
+
+
+def get_data_parallel_src_rank():
+    """ref :501."""
+    tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
+    rank = _flat_rank()
+    # dp varies over blocks of (cp*tp) within a pp stage.
+    stage = rank % (get_data_parallel_world_size() * cp * tp)
+    return (rank - stage) + stage % (cp * tp)
+
+
+def get_pipeline_model_parallel_first_rank():
+    return _flat_rank() % _stage_stride()
+
+
+def get_pipeline_model_parallel_last_rank():
+    return get_pipeline_model_parallel_first_rank() + _stage_stride() * (
+        get_pipeline_model_parallel_world_size() - 1
+    )
+
+
+def get_pipeline_model_parallel_next_rank():
+    stride = _stage_stride()
+    world = get_pipeline_model_parallel_world_size()
+    rank = _flat_rank()
+    return rank % stride + stride * ((rank // stride + 1) % world)
+
+
+def get_pipeline_model_parallel_prev_rank():
+    stride = _stage_stride()
+    world = get_pipeline_model_parallel_world_size()
+    rank = _flat_rank()
+    return rank % stride + stride * ((rank // stride - 1) % world)
+
+
+def _stage_stride() -> int:
+    return (
+        get_data_parallel_world_size()
+        * get_context_parallel_world_size()
+        * get_tensor_model_parallel_world_size()
+    )
+
+
+def _flat_rank():
+    ov = _OVERRIDES.get("flat_rank")
+    if ov is not None:
+        return ov
+    pp = get_pipeline_model_parallel_rank()
+    dp = get_data_parallel_rank()
+    cp = get_context_parallel_rank()
+    tp = get_tensor_model_parallel_rank()
+    cpw = get_context_parallel_world_size()
+    tpw = get_tensor_model_parallel_world_size()
+    dpw = get_data_parallel_world_size()
+    return ((pp * dpw + dp) * cpw + cp) * tpw + tp
+
+
+def set_flat_rank(rank) -> None:
+    _OVERRIDES["flat_rank"] = rank

@@ -1,0 +1,118 @@
+"""Stage-to-stage communication (ref apex/transformer/pipeline_parallel/p2p_communication.py).
+
+The reference posts paired NCCL isend/irecv ops between pipeline neighbours
+(ref p2p_communication.py:29 ``_run_p2pops``). On TPU, neighbour exchange is
+one collective: ``lax.ppermute`` over the 'pp' mesh axis moves every stage's
+tensor to its neighbour in a single ICI hop, and XLA overlaps it with
+compute. Each "send X recv Y" pair from the reference API is therefore a
+single ppermute here; ranks with no sender receive **zeros** (ppermute
+semantics), which is exactly what the schedules want for warmup bubbles.
+
+Shape negotiation (``_communicate``'s tensor_shape exchange) does not exist:
+shapes are static under jit.
+
+All functions must run inside ``shard_map`` with 'pp' bound.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else parallel_state.PIPELINE_AXIS
+
+
+def _shift(x, delta: int, axis_name: Optional[str] = None):
+    """ppermute every stage's ``x`` to rank+delta (non-cyclic: edge ranks
+    receive zeros)."""
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    perm = [
+        (i, i + delta) for i in range(n) if 0 <= i + delta < n
+    ]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def _shift_cyclic(x, delta: int, axis_name: Optional[str] = None):
+    """Cyclic ppermute (used by the interleaved schedule's ring)."""
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + delta) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def send_forward_recv_forward(output_tensor, axis_name: Optional[str] = None):
+    """Push activations one stage downstream; returns what arrived from the
+    previous stage (ref p2p_communication.py:337)."""
+    return _shift(output_tensor, +1, axis_name)
+
+
+def send_backward_recv_backward(input_grad, axis_name: Optional[str] = None):
+    """Push gradients one stage upstream (ref p2p_communication.py:361)."""
+    return _shift(input_grad, -1, axis_name)
+
+
+def send_forward(output_tensor, axis_name: Optional[str] = None):
+    """Collective alias: on TPU a lone send is still the paired shift —
+    the result is meaningful on the receiving ranks
+    (ref p2p_communication.py:237)."""
+    return _shift(output_tensor, +1, axis_name)
+
+
+def recv_forward(output_tensor, axis_name: Optional[str] = None):
+    """Alias of :func:`send_forward` from the receiver's point of view
+    (ref p2p_communication.py:187): pass the tensor being sent by the
+    upstream stages; every stage gets its predecessor's copy."""
+    return _shift(output_tensor, +1, axis_name)
+
+
+def send_backward(input_grad, axis_name: Optional[str] = None):
+    """ref p2p_communication.py:263."""
+    return _shift(input_grad, -1, axis_name)
+
+
+def recv_backward(input_grad, axis_name: Optional[str] = None):
+    """ref p2p_communication.py:213."""
+    return _shift(input_grad, -1, axis_name)
+
+
+def send_forward_recv_backward(output_tensor, input_grad,
+                               axis_name: Optional[str] = None):
+    """Both directions in one step (ref p2p_communication.py:287); XLA
+    schedules the two ppermutes concurrently on opposite ICI directions."""
+    return (_shift(input_grad, -1, axis_name),
+            _shift(output_tensor, +1, axis_name))
+
+
+def send_backward_recv_forward(input_grad, output_tensor,
+                               axis_name: Optional[str] = None):
+    """ref p2p_communication.py:312."""
+    return (_shift(output_tensor, +1, axis_name),
+            _shift(input_grad, -1, axis_name))
+
+
+def send_forward_backward_recv_forward_backward(
+    output_tensor, input_grad, axis_name: Optional[str] = None
+):
+    """ref p2p_communication.py:385."""
+    return (_shift(output_tensor, +1, axis_name),
+            _shift(input_grad, -1, axis_name))
+
+
+def embedding_allreduce(grad, axis_name: Optional[str] = None):
+    """Sum embedding grads between first and last stage (the reference's
+    embedding group allreduce; ref parallel_state.py:301 + Megatron's
+    allreduce_word_embedding_grads): contribute zero unless first/last."""
+    axis = _axis(axis_name)
+    n = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    is_member = (r == 0) | (r == n - 1)
+    masked = jnp.where(is_member, grad, jnp.zeros_like(grad))
+    total = jax.lax.psum(masked, axis)
+    return jnp.where(is_member, total, grad)

@@ -1,0 +1,300 @@
+"""Pipeline training utilities (ref apex/transformer/pipeline_parallel/utils.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+
+from apex_tpu.transformer.pipeline_parallel._timers import (  # noqa: F401
+    Timers,
+    _Timer,
+)
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+_GLOBAL_AUTORESUME = None
+
+
+def _ensure_var_is_initialized(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized")
+
+
+def _ensure_var_is_not_initialized(var, name):
+    if var is not None:
+        raise RuntimeError(f"{name} is already initialized")
+
+
+def listify_model(model) -> List:
+    """ref utils.py:42."""
+    return model if isinstance(model, list) else [model]
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """ref utils.py:58."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_not_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _reconfigure_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """ref utils.py:72 (test/eval hook — replaces unconditionally)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_micro_batch_size() -> int:
+    """ref utils.py:88."""
+    _ensure_var_is_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_num_microbatches() -> int:
+    """ref utils.py:92."""
+    _ensure_var_is_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    """ref utils.py:96."""
+    _ensure_var_is_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True) -> None:
+    """ref utils.py:100."""
+    _ensure_var_is_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(
+        consumed_samples, consistency_check
+    )
+
+
+def split_batch_into_microbatches(batch, micro_batch_size: int):
+    """Reshape [B, ...] leaves to [M, mb, ...] for the schedules
+    (ref utils.py:105 ``_split_batch_into_microbatch``)."""
+    def split(x):
+        b = x.shape[0]
+        if b % micro_batch_size:
+            raise ValueError(
+                f"batch {b} not divisible by micro batch {micro_batch_size}"
+            )
+        return x.reshape((b // micro_batch_size, micro_batch_size)
+                         + x.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def get_kth_microbatch(batch, k: int):
+    """ref utils.py:122."""
+    return jax.tree_util.tree_map(lambda x: x[k], batch)
+
+
+def average_losses_across_data_parallel_group(losses):
+    """ref utils.py:242 — pmean over 'dp' (inside shard_map)."""
+    stacked = jnp.stack([jnp.reshape(l, ()) for l in losses])
+    return jax.lax.pmean(stacked, parallel_state.DATA_AXIS)
+
+
+def param_is_not_shared(param) -> bool:
+    """ref utils.py:181 — no shared-parameter aliasing in functional trees."""
+    del param
+    return True
+
+
+def unwrap_model(model, module_instances=None):
+    """ref utils.py:185 — unwrap DDP-style wrappers."""
+    return_list = True
+    if not isinstance(model, list):
+        model = [model]
+        return_list = False
+    unwrapped = []
+    for m in model:
+        while hasattr(m, "module") and m.module is not None and (
+            module_instances is None or isinstance(m, module_instances)
+        ):
+            inner = m.module
+            if inner is m:
+                break
+            m = inner
+        unwrapped.append(m)
+    return unwrapped if return_list else unwrapped[0]
+
+
+def calc_params_l2_norm(params, bf16: bool = True):
+    """Global param L2 norm across model-parallel ranks (ref utils.py:213).
+    Outside shard_map this is just the tree norm."""
+    del bf16
+    leaves = jax.tree_util.tree_leaves(params)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def get_ltor_masks_and_position_ids(
+    data,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right masks + position ids (ref utils.py:303). Static-shape
+    version: per-document resets use cumulative counts of EOD tokens rather
+    than Python loops over found positions."""
+    b, s = data.shape
+    attention_mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
+    loss_mask = jnp.ones((b, s), dtype=jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    if (reset_position_ids or reset_attention_mask) and eod_token is not None:
+        # document id = number of EODs strictly before each position
+        is_eod = (data == eod_token).astype(jnp.int32)
+        doc_id = jnp.cumsum(is_eod, axis=1) - is_eod
+        if reset_position_ids:
+            # position restarts right after each EOD: running max of
+            # (index of the token following the latest EOD) per row
+            seg_start = jax.lax.associative_scan(
+                jnp.maximum,
+                jnp.where(
+                    jnp.roll(is_eod, 1, axis=1).at[:, 0].set(0) == 1,
+                    jnp.broadcast_to(jnp.arange(s), (b, s)),
+                    0,
+                ),
+                axis=1,
+            )
+            position_ids = jnp.arange(s)[None] - seg_start
+        if reset_attention_mask:
+            same_doc = doc_id[:, :, None] == doc_id[:, None, :]
+            attention_mask = attention_mask & same_doc
+    return attention_mask, loss_mask, position_ids
+
+
+# ------------------------------------------------------------------- timers
+
+
+# _Timer/Timers live in _timers.py (the single implementation: device
+# sync via block_until_ready, profiler TraceAnnotations, tensorboard
+# write) — re-exported here for the reference's utils-level access path.
+
+
+def _set_timers():
+    global _GLOBAL_TIMERS
+    _ensure_var_is_not_initialized(_GLOBAL_TIMERS, "timers")
+    _GLOBAL_TIMERS = Timers()
+
+
+def get_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS
+
+
+def print_rank_0(message: str) -> None:
+    """ref utils.py:159."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def is_last_rank() -> bool:
+    return jax.process_index() == jax.process_count() - 1
+
+
+def print_rank_last(message):
+    if is_last_rank():
+        print(message, flush=True)
+
+
+def report_memory(name: str) -> str:
+    """ref pipeline_parallel/utils.py report_memory — print device memory
+    stats. CUDA's allocated/cached split maps onto the PJRT
+    ``memory_stats`` of the local device: bytes in use, peak, and limit
+    (absent on backends that don't report, e.g. the CPU mesh)."""
+    import jax
+
+    dev = jax.local_devices()[0]
+    stats = dev.memory_stats() or {}
+    giga = 1024.0 ** 3
+    parts = [f"[{name}] memory on {dev.platform}:{dev.id}"]
+    for key, label in (("bytes_in_use", "in use"),
+                       ("peak_bytes_in_use", "peak"),
+                       ("bytes_limit", "limit")):
+        if key in stats:
+            parts.append(f"{label} {stats[key] / giga:.3f} GiB")
+    line = " | ".join(parts)
+    print(line, flush=True)
+    return line
+
+
+def print_params_min_max_norm(optimizer, iteration: int) -> None:
+    """ref pipeline_parallel/utils.py print_params_min_max_norm — per-param
+    (iteration, rank, index, min, max, norm) lines. Accepts a
+    FusedOptimizer-shaped object (``.params``) or a bare params tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer import parallel_state
+
+    import flax.linen as nn
+
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        param_is_not_tensor_parallel_duplicate)
+
+    params = getattr(optimizer, "params", optimizer)
+    try:
+        rank = parallel_state.get_tensor_model_parallel_rank()
+    except Exception:  # outside an initialized mesh
+        rank = 0
+    index = 0
+    # stop at Partitioned boxes: flattening through them would strip the
+    # .names metadata the model-parallel flag reads
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, nn.Partitioned))[0]
+    for path, leaf in flat:
+        index += 1
+        mp = int(param_is_not_tensor_parallel_duplicate(leaf))
+        if isinstance(leaf, nn.Partitioned):
+            leaf = leaf.value
+        x = leaf.astype(jnp.float32)
+        print(f"iteration, rank, index, model-parallel, min, max, norm: "
+              f"{iteration} {rank} {index} {mp} "
+              f"{float(x.min()):.6e} {float(x.max()):.6e} "
+              f"{float(jnp.linalg.norm(x.ravel())):.6e}  {jax.tree_util.keystr(path)}",
+              flush=True)

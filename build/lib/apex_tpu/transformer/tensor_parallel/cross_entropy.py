@@ -1,0 +1,118 @@
+"""Vocab-parallel cross entropy (ref apex/transformer/tensor_parallel/cross_entropy.py).
+
+The logits' vocab dim is sharded across the tensor-parallel axis; the loss is
+computed without ever materializing the full-vocab logits on one device:
+
+    1. global max  — pmax over tp (numerical stability)
+    2. sum of exp  — local row-sum, then psum
+    3. target logit — each rank masks targets outside its vocab slice,
+       gathers its local value, psum combines (exactly one rank contributes)
+
+Backward is a custom_vjp: d logits = (softmax - onehot_local) * g, computed
+from the saved (exp_logits, sum_exp, target_mask) — the same memory shape the
+reference saves (ref cross_entropy.py:23-99 _VocabParallelCrossEntropy).
+
+Runs inside ``shard_map`` with the tp axis bound and per-shard logits
+``[..., vocab/tp]``; with tp=1 it degrades to plain stable CE, so the same
+model code works unsharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+
+@functools.lru_cache(maxsize=None)
+def _make_vocab_parallel_ce(axis: Optional[str]):
+    """Build the custom_vjp CE for a fixed (hashable) axis name."""
+
+    def pmax(x):
+        return jax.lax.pmax(x, axis) if axis else x
+
+    def psum(x):
+        return jax.lax.psum(x, axis) if axis else x
+
+    def rank():
+        return jax.lax.axis_index(axis) if axis else 0
+
+    def fwd_math(logits, target, label_smoothing):
+        # logits: [..., v_local]; target: [...] global vocab ids.
+        v_local = logits.shape[-1]
+        logits_max = pmax(jnp.max(logits, axis=-1))
+        logits = logits - jax.lax.stop_gradient(logits_max)[..., None]
+        exp_logits = jnp.exp(logits)
+        sum_exp = psum(jnp.sum(exp_logits, axis=-1))
+
+        vocab_start = rank() * v_local
+        local_target = target - vocab_start
+        in_range = (local_target >= 0) & (local_target < v_local)
+        safe_target = jnp.where(in_range, local_target, 0)
+        predicted = jnp.take_along_axis(
+            logits, safe_target[..., None], axis=-1
+        )[..., 0]
+        predicted = psum(jnp.where(in_range, predicted, 0.0))
+
+        loss = jnp.log(sum_exp) - predicted
+        if label_smoothing > 0.0:
+            # Smoothed CE = (1-eps)·CE + eps·mean over vocab of -log p
+            # (ref contrib/xentropy semantics; vocab mean needs the global
+            # sum of logits).
+            vocab_size = v_local * (
+                jax.lax.axis_size(axis) if axis else 1
+            )
+            mean_logit = psum(jnp.sum(logits, axis=-1)) / vocab_size
+            smooth_loss = jnp.log(sum_exp) - mean_logit
+            loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth_loss
+        residuals = (exp_logits, sum_exp, in_range, safe_target)
+        return loss, residuals
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def ce(logits, target, label_smoothing=0.0):
+        return fwd_math(logits, target, label_smoothing)[0]
+
+    def ce_fwd(logits, target, label_smoothing):
+        loss, res = fwd_math(logits, target, label_smoothing)
+        return loss, (res, target, logits.shape[-1])
+
+    def ce_bwd(label_smoothing, carry, g):
+        (exp_logits, sum_exp, in_range, safe_target), target, v_local = carry
+        del target
+        softmax = exp_logits / sum_exp[..., None]
+        onehot = jax.nn.one_hot(
+            safe_target, v_local, dtype=softmax.dtype
+        ) * in_range[..., None].astype(softmax.dtype)
+        if label_smoothing > 0.0:
+            vocab_size = v_local * (
+                jax.lax.axis_size(axis) if axis else 1
+            )
+            grad = softmax - (1.0 - label_smoothing) * onehot
+            grad = grad - label_smoothing / vocab_size
+        else:
+            grad = softmax - onehot
+        d_logits = grad * g[..., None]
+        return (d_logits.astype(exp_logits.dtype), None)
+
+    ce.defvjp(ce_fwd, ce_bwd)
+    return ce
+
+
+def vocab_parallel_cross_entropy(
+    vocab_parallel_logits,
+    target,
+    label_smoothing: float = 0.0,
+    axis_name: Optional[str] = None,
+):
+    """Per-token CE over vocab-sharded logits (ref cross_entropy.py:101)."""
+    axis = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+    if not _axis_bound(axis):
+        axis = None
+    return _make_vocab_parallel_ce(axis)(
+        vocab_parallel_logits, target, label_smoothing
+    )

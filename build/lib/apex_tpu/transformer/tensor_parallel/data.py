@@ -1,0 +1,56 @@
+"""Cross-rank data broadcast (ref apex/transformer/tensor_parallel/data.py).
+
+The reference broadcasts tokenized batches from tp-rank-0 to the rest of the
+tp group so every rank sees identical data. Under single-controller JAX the
+host hands the same global arrays to every device by construction, so
+``broadcast_data`` reduces to dtype checking + casting; under multi-host
+(multi-controller) it broadcasts host-0's arrays with
+``multihost_utils.broadcast_one_to_all``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_data_types(keys, data, target_dtype):
+    """ref data.py:25."""
+    for key in keys:
+        if jnp.asarray(data[key]).dtype != target_dtype:
+            raise ValueError(
+                f"{key} has data type {jnp.asarray(data[key]).dtype}, "
+                f"expected {target_dtype}"
+            )
+
+
+def _build_key_size_numel_dictionaries(keys, data):
+    """ref data.py:34 — shapes/sizes bookkeeping."""
+    key_size = {}
+    key_numel = {}
+    total_numel = 0
+    for key in keys:
+        arr = jnp.asarray(data[key])
+        key_size[key] = arr.shape
+        numel = int(arr.size)
+        key_numel[key] = numel
+        total_numel += numel
+    return key_size, key_numel, total_numel
+
+
+def broadcast_data(keys: Sequence[str], data: Dict, datatype) -> Dict:
+    """Return ``{key: array}`` identical on every rank (ref data.py:80)."""
+    _check_data_types(keys, data, datatype)
+    key_size, _, _ = _build_key_size_numel_dictionaries(keys, data)
+    out = {}
+    multi_process = jax.process_count() > 1
+    for key in keys:
+        arr = jnp.asarray(data[key], dtype=datatype)
+        if multi_process:
+            from jax.experimental import multihost_utils
+
+            arr = multihost_utils.broadcast_one_to_all(arr)
+        out[key] = arr.reshape(key_size[key])
+    return out

@@ -1,0 +1,120 @@
+"""Flat memory buffers (ref apex/transformer/tensor_parallel/memory.py).
+
+The reference pre-allocates big flat CUDA buffers and hands out zero-copy
+views to dodge the caching allocator's fragmentation. XLA owns device memory
+under jit, so the TPU analog keeps the *packing* semantics — a flat array
+plus offset bookkeeping, useful for fused multi-tensor updates and bucketed
+collectives — with buffer donation (``jax.jit(donate_argnums=...)``) playing
+the role of in-place reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+_MEM_BUFFS: Dict[str, "MemoryBuffer"] = {}
+
+
+def allocate_mem_buff(name, numel, dtype, track_usage):
+    """ref memory.py:23."""
+    if name in _MEM_BUFFS:
+        raise ValueError(f"memory buffer {name} already allocated")
+    _MEM_BUFFS[name] = MemoryBuffer(name, numel, dtype, track_usage)
+    return _MEM_BUFFS[name]
+
+
+def get_mem_buff(name):
+    """ref memory.py:30."""
+    return _MEM_BUFFS.get(name)
+
+
+def reset_mem_buffs():
+    _MEM_BUFFS.clear()
+
+
+class MemoryBuffer:
+    """Flat buffer with bump-pointer allocation (ref memory.py:35)."""
+
+    def __init__(self, name, numel, dtype, track_usage=False):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self.data = jnp.zeros((numel,), dtype=dtype)
+        self._start = 0
+        self.track_usage = track_usage
+        self.in_use_value = 0.0
+        self.total_value = 0.0
+
+    def reset(self):
+        self._start = 0
+        if self.track_usage:
+            self.total_value += float(self.numel)
+            self.in_use_value = 0.0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def allocated(self) -> int:
+        return self._start
+
+    def add(self, shape):
+        """Reserve a region; returns (start, stop) flat offsets."""
+        numel = 1
+        for s in shape:
+            numel *= int(s)
+        if self._start + numel > self.numel:
+            raise MemoryError(
+                f"buffer {self.name} out of space "
+                f"({self._start}+{numel} > {self.numel})"
+            )
+        start = self._start
+        self._start += numel
+        if self.track_usage:
+            self.in_use_value += float(numel)
+        return start, start + numel
+
+    def get(self, shape, start: int):
+        """Slice of the flat buffer viewed as ``shape`` (functional: a copy
+        under jit; XLA elides it when possible)."""
+        numel = 1
+        for s in shape:
+            numel *= int(s)
+        return jnp.reshape(
+            jnp.asarray(self.data)[start : start + numel], shape
+        )
+
+    def put(self, value, start: int):
+        """Write ``value`` into the region (returns the updated buffer)."""
+        flat = jnp.ravel(value).astype(self.dtype)
+        self.data = self.data.at[start : start + flat.size].set(flat)
+        return self.data
+
+    def print_average_usage(self):
+        if not self.track_usage:
+            return
+        if self.total_value:
+            print(
+                f"buffer {self.name} average usage: "
+                f"{100.0 * self.in_use_value / self.total_value:.2f}%"
+            )
+
+
+class RingMemBuffer:
+    """Round-robin set of memory buffers (ref memory.py:133)."""
+
+    def __init__(self, name, num_buffers, numel, dtype, track_usage):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            allocate_mem_buff(f"{name}-{i}", numel, dtype, track_usage)
+            for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self):
+        self._index = (self._index + 1) % self.num_buffers
+        buff = self.buffers[self._index]
+        if buff.is_in_use():
+            raise RuntimeError("next ring buffer is still in use")
+        return buff

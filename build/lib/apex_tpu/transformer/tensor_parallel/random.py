@@ -1,0 +1,124 @@
+"""Model-parallel RNG state tracking (ref apex/transformer/tensor_parallel/random.py).
+
+The reference snapshots/restores CUDA RNG states so that dropout inside
+tensor-parallel regions differs per tp rank while everything else matches
+(ref random.py:120 CudaRNGStatesTracker). JAX keys are explicit and
+functional, so the tracker holds named PRNG keys; per-rank divergence is a
+``fold_in`` of the tp axis index — deterministic, trace-friendly, and exactly
+reproducible on replay, which is also why activation checkpointing needs no
+special RNG save/restore here: ``jax.checkpoint`` replays the same folded
+keys (vs the reference's CheckpointFunction manually stashing CUDA states,
+ref random.py:233-305).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Named PRNG keys with fork semantics (ref random.py:120)."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        if not isinstance(states, dict):
+            raise TypeError("states must be a dict of name -> PRNG key")
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already present")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already present")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a fresh subkey from the named stream and advance it.
+
+        The reference swaps the global CUDA state in/out; here the caller
+        gets an explicit key to pass to its dropout/init.
+        """
+        if name not in self.states_:
+            raise KeyError(f"rng state {name} is not added")
+        key = self.states_[name]
+        key, sub = jax.random.split(key)
+        self.states_[name] = key
+        yield sub
+
+
+# Parity alias (the reference class name).
+CudaRNGStatesTracker = RNGStatesTracker
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+# Parity alias (ref random.py:195).
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_rng_seed(seed: int) -> None:
+    """Seed the default + tensor-parallel streams (ref random.py:200
+    ``model_parallel_cuda_manual_seed``): tp stream = seed + 2718 + tp_rank,
+    default stream = seed (same across tp, differs per dp via the caller's
+    data sharding)."""
+    offset = seed + 2718
+    tracker = get_rng_tracker()
+    tracker.reset()
+    tracker.add("default", seed)
+    tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, offset)
+    # Per-rank divergence happens at use time via fold_in (trace-friendly).
+
+
+model_parallel_cuda_manual_seed = model_parallel_rng_seed
+
+
+def tp_rank_key(key, axis_name=None):
+    """Fold the tensor-parallel rank into a key (per-rank dropout streams)."""
+    axis = axis_name if axis_name is not None else parallel_state.TENSOR_AXIS
+    if not _axis_bound(axis):
+        return key
+    return jax.random.fold_in(key, jax.lax.axis_index(axis))
+
+
+def checkpoint(function, *args, **kwargs):
+    """Activation-checkpointed call (ref random.py:306 ``checkpoint``).
+
+    ``jax.checkpoint`` rematerializes the forward during backward; explicit
+    PRNG keys replay identically, so no RNG state stashing is needed.
+    """
+    return jax.checkpoint(function)(*args, **kwargs)
+
+
+def init_checkpointed_activations_memory_buffer(*args, **kwargs):
+    """No-op: XLA owns activation memory; remat policy replaces the
+    reference's hand-managed buffer (ref random.py:45)."""
+    del args, kwargs
+
+
+def reset_checkpointed_activations_memory_buffer():
+    """No-op (ref random.py:80)."""

@@ -1,0 +1,46 @@
+"""Tensor-parallel helpers (ref apex/transformer/tensor_parallel/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.utils import divide
+
+
+def split_tensor_along_last_dim(
+    tensor, num_partitions: int, contiguous_split_chunks: bool = False
+):
+    """Split along the last dim (ref utils.py:20). Chunks are always
+    "contiguous" on TPU — XLA owns layout — so the flag is accepted and
+    ignored."""
+    del contiguous_split_chunks
+    last_dim_size = divide(tensor.shape[-1], num_partitions)
+    return jnp.split(
+        tensor,
+        [last_dim_size * i for i in range(1, num_partitions)],
+        axis=tensor.ndim - 1,
+    )
+
+
+class VocabUtility:
+    """Vocab range bookkeeping for vocab-parallel embeddings/CE
+    (ref utils.py:40)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        index_f = rank * per_partition_vocab_size
+        index_l = index_f + per_partition_vocab_size
+        return index_f, index_l
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple[int, int]:
+        per_partition_vocab_size = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size, rank, world_size
+        )

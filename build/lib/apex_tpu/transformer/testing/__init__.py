@@ -1,0 +1,29 @@
+"""Standalone test harness (ref apex/transformer/testing/).
+
+The reference ships a mini-Megatron (argument parser, global singletons,
+toy + standalone GPT/BERT models, a distributed unittest base) so its
+transformer tests run without Megatron-LM. The TPU form serves the same
+role for mesh-based tests: argument parsing with the same flag names,
+`get_args`/`get_num_microbatches` singletons, timers, mesh fixtures, and
+standalone model builders over ``apex_tpu.models``.
+"""
+
+from apex_tpu.transformer.testing import global_vars
+from apex_tpu.transformer.testing.commons import (
+    build_mesh,
+    fwd_step_func,
+    initialize_distributed,
+    model_provider_func,
+    print_separator,
+    set_random_seed,
+)
+
+__all__ = [
+    "global_vars",
+    "build_mesh",
+    "fwd_step_func",
+    "initialize_distributed",
+    "model_provider_func",
+    "print_separator",
+    "set_random_seed",
+]

@@ -1,0 +1,146 @@
+"""Shared fixtures for transformer tests
+(ref apex/transformer/testing/commons.py).
+
+The reference's commons builds a toy ``MyModel`` (one linear per pipeline
+stage), a forward-step function in the schedule's expected shape, seeded
+RNG, and NCCL setup. The TPU analogs: a toy stage function + params for
+the collective pipeline, mesh construction over the virtual CPU devices,
+and `fold_in`-seeded keys.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from apex_tpu.transformer import parallel_state
+
+
+# ------------------------------------------------------------- toy model
+# ref commons.py:34-67 — MyLayer (square weight + bias) and MyModel.
+
+
+def init_toy_stage_params(key, hidden_size: int, layers_per_stage: int = 1):
+    """Per-stage params of the reference's MyModel shape."""
+    ws, bs = [], []
+    for i in range(layers_per_stage):
+        kw, kb, key = jax.random.split(key, 3)
+        ws.append(jax.random.normal(kw, (hidden_size, hidden_size)) * 0.1)
+        bs.append(jax.random.normal(kb, (hidden_size,)) * 0.1)
+    return {"w": jnp.stack(ws), "b": jnp.stack(bs)}
+
+
+def toy_stage_fn(stage_params, x):
+    """The reference MyLayer fwd (x @ w + b per layer), scan over layers."""
+
+    def body(h, lp):
+        w, b = lp
+        return h @ w + b, None
+
+    out, _ = jax.lax.scan(body, x, (stage_params["w"], stage_params["b"]))
+    return out
+
+
+def model_provider_func(hidden_size, pre_process=True, post_process=True):
+    """ref commons.py:70 — returns (init_fn, stage_fn) for one stage."""
+    del pre_process, post_process  # stage io is uniform in the TPU design
+
+    def init_fn(key, layers_per_stage=1):
+        return init_toy_stage_params(key, hidden_size, layers_per_stage)
+
+    return init_fn, toy_stage_fn
+
+
+def process_batch(batch):
+    """ref commons.py:74 — unpack (x,) or x."""
+    if isinstance(batch, (list, tuple)):
+        return batch[0]
+    return batch
+
+
+def fwd_step_func(batch, stage_params):
+    """ref commons.py:82 — forward + loss closure in the schedule shape."""
+    x = process_batch(batch)
+    y = toy_stage_fn(stage_params, x)
+
+    def loss_func(y):
+        loss = jnp.mean(y * y)
+        return loss, {"avg": loss}
+
+    return y, loss_func
+
+
+class IdentityLayer:
+    """ref commons.py:96 — a trainable tensor behind an identity call."""
+
+    def __init__(self, key, shape, scale=1.0):
+        self.weight = scale * jax.random.normal(key, shape)
+
+    def __call__(self):
+        return self.weight
+
+
+# ------------------------------------------- stage splitting (model zoo)
+
+
+def split_stages(params, n_stages: int):
+    """Split a model-zoo params tree's [L, ...] layer stack into
+    [n_stages, L/n_stages, ...] (shared by the standalone GPT/BERT
+    builders; the stacked-layer convention is uniform across the zoo)."""
+    layers = params["layers"]
+    L = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(n_stages, L // n_stages, *a.shape[1:]), layers)
+
+
+def io_params(params):
+    """Stage-replicated non-layer params (embeddings, final norms, heads)."""
+    return {k: v for k, v in params.items() if k != "layers"}
+
+
+# ------------------------------------------------------------ environment
+
+
+def set_random_seed(seed: int):
+    """ref commons.py:105 — one seed for model and data streams."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def build_mesh(shape: Sequence[int], axis_names: Sequence[str],
+               devices=None) -> Mesh:
+    """Mesh over the first prod(shape) devices (tests: virtual CPU mesh)."""
+    n = int(np.prod(shape))
+    devices = list(jax.devices() if devices is None else devices)[:n]
+    if len(devices) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices).reshape(*shape), tuple(axis_names))
+
+
+def initialize_distributed(tp: int = 1, pp: int = 1, cp: int = 1,
+                           backend: Optional[str] = None) -> Mesh:
+    """ref commons.py:113 initialize_distributed — here: build the mesh and
+    register it with parallel_state (no process groups to create)."""
+    del backend  # XLA collectives; kept for call-site parity
+    n = len(jax.devices())
+    dp = n // (tp * pp * cp)
+    if dp * tp * pp * cp != n:
+        raise RuntimeError(
+            f"tp*pp*cp ({tp * pp * cp}) must divide device count ({n})")
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=tp,
+        pipeline_model_parallel_size_=pp,
+        context_parallel_size_=cp,
+    )
+    return parallel_state.get_mesh()
+
+
+def print_separator(message: str):
+    """ref commons.py:148."""
+    print("\n" + "-" * 31 + f" {message} " + "-" * 31, flush=True)

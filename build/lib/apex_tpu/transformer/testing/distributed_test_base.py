@@ -1,0 +1,55 @@
+"""Distributed test base (ref apex/transformer/testing/distributed_test_base.py).
+
+The reference subclasses a multi-process NCCL test harness; on TPU the
+"distributed" environment is the device mesh inside one process, so the
+base class manages parallel_state setup/teardown around each test and
+skips when the device count can't fit the requested topology.
+"""
+
+from __future__ import annotations
+
+import unittest
+
+import jax
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import global_vars
+
+
+class DistributedTestBase(unittest.TestCase):
+    """ref distributed_test_base.py:DistributedTestBase.
+
+    Subclasses set ``TP``/``PP``/``CP`` (defaults 1) and get a live
+    parallel_state mesh in every test; state is torn down afterwards.
+    """
+
+    TP = 1
+    PP = 1
+    CP = 1
+
+    @property
+    def world_size(self) -> int:
+        return len(jax.devices())
+
+    def setUp(self):
+        super().setUp()
+        need = self.TP * self.PP * self.CP
+        if self.world_size % need:
+            self.skipTest(
+                f"needs a multiple of {need} devices, have {self.world_size}")
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=self.TP,
+            pipeline_model_parallel_size_=self.PP,
+            context_parallel_size_=self.CP,
+        )
+        self.mesh = parallel_state.get_mesh()
+
+    def tearDown(self):
+        parallel_state.destroy_model_parallel()
+        global_vars.destroy_global_vars()
+        super().tearDown()
+
+
+class NcclDistributedTestBase(DistributedTestBase):
+    """Name-parity alias (ref uses NCCL; the TPU mesh needs no backend)."""

@@ -1,0 +1,120 @@
+"""Global singletons for the test harness
+(ref apex/transformer/testing/global_vars.py).
+
+``set_global_variables`` parses args once and builds the num-microbatches
+calculator; ``get_args``/``get_num_microbatches``/``get_timers`` read the
+singletons with the reference's initialized/not-initialized assertions.
+Timers block on device work (``block_until_ready``) the way the
+reference's timers ``cuda.synchronize`` (ref global_vars.py:191).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer.pipeline_parallel import _timers as _shared_timers
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+    return var
+
+
+def _ensure_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def get_args():
+    """Return arguments (ref global_vars.py:34)."""
+    return _ensure_initialized(_GLOBAL_ARGS, "args")
+
+
+def get_num_microbatches() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).update(consumed_samples, consistency_check)
+
+
+def get_timers():
+    return _ensure_initialized(_GLOBAL_TIMERS, "timers")
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args: bool = True,
+                         data_parallel_size: Optional[int] = None,
+                         args=None):
+    """Parse args and set every singleton (ref global_vars.py:87)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _ensure_not_initialized(_GLOBAL_ARGS, "args")
+    parsed = parse_args(extra_args_provider, args_defaults,
+                        ignore_unknown_args, args=args)
+    _GLOBAL_ARGS = parsed
+    dp = data_parallel_size if data_parallel_size is not None else 1
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank=0,
+        rampup_batch_size=parsed.rampup_batch_size,
+        global_batch_size=parsed.global_batch_size,
+        micro_batch_size=parsed.micro_batch_size,
+        data_parallel_size=dp,
+    )
+    _GLOBAL_TIMERS = Timers()
+    return parsed
+
+
+def destroy_global_vars():
+    """Reset for the next test (the reference leaks these across tests)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TIMERS = None
+
+
+class _Timer(_shared_timers._Timer):
+    """Shared timer + an up-front device drain: start/stop first flush
+    ALL pending async dispatches (jax.device_put round-trip), so the
+    bracket excludes work queued before the region — the strictest
+    reading of the reference's cuda.synchronize placement
+    (ref global_vars.py:191)."""
+
+    def _drain(self):
+        jax.device_put(0.0).block_until_ready()
+
+    def start(self):
+        self._drain()
+        super().start()
+
+    def stop(self, block_on=None):
+        self._drain()
+        super().stop(block_on)
+
+
+class Timers(_shared_timers.Timers):
+    """ref global_vars.py:236 — named registry over the draining timer."""
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]

@@ -1,0 +1,83 @@
+"""Standalone BERT for pipeline-parallel tests
+(ref apex/transformer/testing/standalone_bert.py).
+
+Adapts ``apex_tpu.models.bert`` to the harness contract (see
+standalone_gpt.py): config from ``get_args``, stage splitting, and
+embed / stage_fn / head pieces for the collective pipeline schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import bert
+from apex_tpu.transformer.tensor_parallel.layers import (
+    vocab_parallel_embedding,
+)
+
+
+def bert_config_from_args(args) -> bert.BertConfig:
+    dtype = (jnp.bfloat16 if args.params_dtype == "bfloat16"
+             else jnp.float16 if args.params_dtype == "float16"
+             else jnp.float32)
+    return bert.BertConfig(
+        vocab_size=args.padded_vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_attention_heads,
+        max_seq_len=args.max_position_embeddings,
+        ln_eps=args.layernorm_epsilon,
+        dtype=dtype,
+    )
+
+
+from apex_tpu.transformer.testing.commons import io_params, split_stages  # noqa: E402,F401 - re-export (harness contract)
+
+
+def embed(io, tokens, cfg: bert.BertConfig, type_ids=None,
+          tp_axis: Optional[str] = "tp"):
+    s = tokens.shape[-1]
+    x = vocab_parallel_embedding(tokens, io["embed"], axis_name=tp_axis)
+    x = x + io["pos_embed"][None, :s]
+    if type_ids is None:
+        x = x + io["type_embed"][0]
+    else:
+        x = x + jnp.take(io["type_embed"], type_ids, axis=0)
+    return bert._ln(x.astype(cfg.dtype), io["emb_ln_w"], io["emb_ln_b"],
+                    cfg.ln_eps)
+
+
+def stage_fn(stage_params, x, cfg: bert.BertConfig, pad_mask=None,
+             tp_axis: Optional[str] = "tp"):
+    def body(h, lp):
+        return bert.encoder_layer(h, lp, cfg, pad_mask, tp_axis), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def head_loss(io, x, targets, loss_mask, cfg: bert.BertConfig,
+              tp_axis: Optional[str] = "tp"):
+    """MLM head over the final hidden states + masked CE."""
+    # mlm_logits reads only io params + the tied embedding
+    logits = bert.mlm_logits(io, x, cfg, tp_axis=tp_axis)
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+
+    ce = vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis)
+    denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.sum(ce * loss_mask) / denom
+
+
+def bert_model_provider(args=None):
+    """ref standalone_bert.py:bert_model_provider."""
+    if args is None:
+        from apex_tpu.transformer.testing.global_vars import get_args
+
+        args = get_args()
+    cfg = bert_config_from_args(args)
+    return cfg, bert.init_params, split_stages, embed, stage_fn, head_loss

@@ -1,0 +1,83 @@
+"""Standalone GPT for pipeline-parallel tests
+(ref apex/transformer/testing/standalone_gpt.py).
+
+The reference carries a 1.5k-line Megatron GPT to test its schedules
+without importing Megatron-LM; ``apex_tpu.models.gpt2`` already is that
+model, so this module adapts it to the harness contract: build from
+``get_args`` flags, split layer params into pipeline stages, and expose
+embed / stage_fn / head pieces in the shape the collective pipeline
+schedules consume.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import gpt2
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    vocab_parallel_embedding,
+)
+
+
+def gpt_config_from_args(args) -> gpt2.GPT2Config:
+    """Map harness args (ref arguments.py flags) onto GPT2Config."""
+    dtype = (jnp.bfloat16 if args.params_dtype == "bfloat16"
+             else jnp.float16 if args.params_dtype == "float16"
+             else jnp.float32)
+    return gpt2.GPT2Config(
+        vocab_size=args.padded_vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_attention_heads,
+        max_seq_len=args.max_position_embeddings,
+        ln_eps=args.layernorm_epsilon,
+        dtype=dtype,
+    )
+
+
+from apex_tpu.transformer.testing.commons import io_params, split_stages  # noqa: E402,F401 - re-export (harness contract)
+
+
+def embed(io, tokens, cfg: gpt2.GPT2Config, tp_axis: Optional[str] = "tp"):
+    """First-stage input: token + positional embedding."""
+    s = tokens.shape[-1]
+    x = vocab_parallel_embedding(tokens, io["embed"], axis_name=tp_axis)
+    return (x + io["pos_embed"][None, :s]).astype(cfg.dtype)
+
+
+def stage_fn(stage_params, x, cfg: gpt2.GPT2Config,
+             tp_axis: Optional[str] = "tp"):
+    """One pipeline stage: scan this stage's decoder layers."""
+
+    def body(h, lp):
+        return gpt2.decoder_layer(h, lp, cfg, tp_axis), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def head_loss(io, x, targets, cfg: gpt2.GPT2Config,
+              tp_axis: Optional[str] = "tp"):
+    """Last-stage output: final LN + tied-embedding head + vocab-parallel CE."""
+    x = gpt2._ln(x, io["lnf_w"], io["lnf_b"], cfg.ln_eps)
+    logits = jnp.matmul(
+        x, io["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    return jnp.mean(
+        vocab_parallel_cross_entropy(logits, targets, axis_name=tp_axis))
+
+
+def gpt_model_provider(args=None):
+    """ref standalone_gpt.py:gpt_model_provider — returns
+    (cfg, init_fn, split_stages, embed, stage_fn, head_loss)."""
+    if args is None:
+        from apex_tpu.transformer.testing.global_vars import get_args
+
+        args = get_args()
+    cfg = gpt_config_from_args(args)
+    return cfg, gpt2.init_params, split_stages, embed, stage_fn, head_loss

@@ -1,0 +1,47 @@
+"""Transformer-wide utilities (ref apex/transformer/utils.py).
+
+The reference's ``split_tensor_into_1d_equal_chunks`` / ``gather_split_1d_tensor``
+move flat shards between tensor-parallel ranks; here they are expressed as
+per-shard ops usable under ``shard_map`` over the tensor-parallel axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """Raise unless numerator is divisible by denominator (ref utils.py:7)."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact integer division (ref utils.py:14)."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_into_1d_equal_chunks(tensor, axis_name: str = "tp"):
+    """Return this rank's equal flat chunk of ``tensor`` (ref utils.py:21).
+
+    Must run inside ``shard_map`` with ``axis_name`` bound; the input is the
+    (replicated) full tensor, the output is the local 1-D shard.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    flat = tensor.reshape(-1)
+    chunk = flat.shape[0] // n
+    return jax.lax.dynamic_slice(flat, (rank * chunk,), (chunk,))
+
+
+def gather_split_1d_tensor(tensor, axis_name: str = "tp"):
+    """All-gather flat shards back into the full 1-D tensor (ref utils.py:32)."""
+    return jax.lax.all_gather(tensor, axis_name, axis=0, tiled=True)
+
+
+def cast_if_needed(x, dtype):
+    """Cast ``x`` to ``dtype`` when set (mirrors torch.Tensor.to semantics
+    used throughout the reference's mixed-precision paths)."""
+    return x if dtype is None else jnp.asarray(x, dtype)
