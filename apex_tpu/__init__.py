@@ -13,6 +13,33 @@ for the component-by-component mapping.
 
 import logging
 
+import jax as _jax
+
+if not hasattr(_jax.lax, "axis_size"):
+    # The container's jax (0.4.37) predates jax.lax.axis_size; the tree,
+    # its examples and tests call it pervasively inside shard_map bodies.
+    # psum of a Python scalar is statically resolved to value*axis_size
+    # (no collective is emitted), which is exactly axis_size's semantics
+    # — including raising NameError outside a bound axis context.
+    def _axis_size(axis_name):
+        return _jax.lax.psum(1, axis_name)
+
+    _jax.lax.axis_size = _axis_size
+
+if not hasattr(_jax, "shard_map"):
+    # jax.shard_map was promoted out of jax.experimental after 0.4.37;
+    # every caller here uses keyword mesh/in_specs/out_specs, which the
+    # experimental entry point accepts identically.
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _jax.shard_map = _shard_map
+
+if not hasattr(_jax.lax, "pvary"):
+    # pvary annotates varying-over-mesh-axes types for the post-0.4.37
+    # check_vma system; under pre-vma jax the value is unchanged and the
+    # annotation has no checker to feed, so identity is the exact analog.
+    _jax.lax.pvary = lambda x, axis_names=(): x
+
 
 class RankInfoFormatter(logging.Formatter):
     """ref apex/__init__.py:28 — logging formatter injecting the current

@@ -1,0 +1,38 @@
+"""apex_tpu.analysis — static TPU lint (SURVEY: sanitizer/pyprof-adjacent
+correctness tooling, rebuilt as compile-time analysis).
+
+Two engines, one CLI, one pytest gate:
+
+- **jaxpr engine** (:mod:`.jaxpr_checks`): trace a function with
+  abstract avals on any backend and walk the closed jaxpr for donation
+  races, retrace hazards, collective-axis mismatches against the live
+  ``parallel_state`` mesh, and Pallas BlockSpec tiling/VMEM problems.
+- **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
+  examples/, tools/, bench.py) for host-sync anti-patterns — the
+  ``block_until_ready``-as-timing bug that produced r5's impossible
+  MFU=330, host pulls and Python RNG inside jit, mutable defaults.
+
+CLI: ``python -m apex_tpu.analysis`` (see :mod:`.cli`). Gate:
+``tools/lint.sh`` + ``tests/run_analysis/`` with a checked-in baseline.
+Docs: ``docs/analysis.md``.
+"""
+
+from apex_tpu.analysis.ast_checks import (
+    AST_CHECKS,
+    lint_paths,
+    lint_source,
+)
+from apex_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS, analyze_fn
+from apex_tpu.analysis.targets import TARGETS, run_targets
+
+__all__ = [
+    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "TARGETS", "analyze_fn",
+    "lint_paths", "lint_source", "load_baseline", "new_findings",
+    "run_targets", "save_baseline",
+]

@@ -1,0 +1,333 @@
+"""Engine 2: AST-level lint for host-sync and trace-hygiene anti-patterns.
+
+Runs over apex_tpu's own sources, ``examples/``, ``tools/`` and
+``bench.py`` — the code that *drives* TPUs, where the r5 instrument bug
+(an impossible MFU=330 timed around a tunnel-no-op ``block_until_ready``)
+lived. Checks:
+
+- ``sync-timing``     ``block_until_ready`` inside a function that also
+                      reads a wall clock: over the axon tunnel it is a
+                      no-op, so the "timed" region measures dispatch.
+                      Use ``apex_tpu.runtime.timing.sync`` (host fetch).
+- ``host-in-jit``     ``float()``/``int()``/``np.asarray``/``.item()``/
+                      ``.tolist()`` inside a jit-decorated body: host
+                      pulls that either fail to trace or silently sync.
+- ``rng-in-jit``      Python/numpy RNG inside a jit-decorated body: the
+                      sample is baked in at trace time, identical every
+                      step. Use ``jax.random`` with a threaded key.
+- ``mutable-default`` mutable default argument (list/dict/set): shared
+                      across calls; with jit in play, also a cache-key
+                      footgun.
+
+Suppress with ``# apex-lint: disable=<id>`` on (or above) the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from apex_tpu.analysis.findings import Finding, is_suppressed
+
+AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
+              "mutable-default")
+
+# Modules whose job is the corrected sync itself.
+_SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
+
+_CLOCK_CALLS = {("time", "perf_counter"), ("time", "time"),
+                ("time", "monotonic"), ("time", "perf_counter_ns"),
+                ("timeit", "default_timer")}
+
+_HOST_PULL_NAMES = {"float", "int"}
+_HOST_PULL_NP = {"asarray", "array", "copyto"}
+_HOST_PULL_METHODS = {"item", "tolist"}
+
+
+def _attr_chain(node):
+    """Dotted name parts of an Attribute/Name chain, outermost first."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+_STATIC_FNS = {"len", "min", "max", "abs", "int", "float", "round"}
+
+
+def _is_static_expr(node):
+    """True when the WHOLE expression derives from static trace-time
+    metadata (``x.shape[0] * 2``, ``len(xs)``): int()/float() on these
+    is idiomatic jax, not a host pull. One static leaf is not enough —
+    ``x.mean() / x.shape[0]`` still pulls the traced mean."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Index):  # py<3.9 slice wrapper
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static_expr(e) for e in node.elts)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id == "len":
+            return True  # len() is a host int even on traced arrays
+        return (node.func.id in _STATIC_FNS
+                and all(_is_static_expr(a) for a in node.args))
+    return False
+
+
+def _is_jit_decorator(dec):
+    """jax.jit / jit / pjit, possibly through functools.partial(...)."""
+    chain = _attr_chain(dec)
+    if chain and chain[-1] in ("jit", "pjit"):
+        return True
+    if isinstance(dec, ast.Call):
+        chain = _attr_chain(dec.func)
+        if chain and chain[-1] in ("jit", "pjit"):
+            return True
+        if chain and chain[-1] == "partial" and dec.args:
+            inner = _attr_chain(dec.args[0])
+            if inner and inner[-1] in ("jit", "pjit"):
+                return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path, relpath, checks):
+        self.relpath = relpath
+        self.checks = checks
+        self.findings = []
+        # stack of (symbol, in_jit); module scope counts as one frame
+        self.stack = [("<module>", False)]
+        # per-function-frame call records for sync-timing
+        self.frames = [{"clock": [], "block": []}]
+        # local name -> imported dotted module, so `from jax import
+        # random` is not mistaken for the stdlib `random` module
+        self.imports = {}
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.asname:
+                self.imports[alias.asname] = alias.name
+            else:
+                # `import numpy.random` binds the ROOT name `numpy`
+                root = alias.name.split(".")[0]
+                self.imports[root] = root
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module and node.level == 0:
+            for alias in node.names:
+                self.imports[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+        self.generic_visit(node)
+
+    def _resolve(self, chain):
+        """Expand the chain's root through the module's imports:
+        ['random','normal'] under `from jax import random` resolves to
+        ['jax','random','normal']."""
+        root = self.imports.get(chain[0])
+        if root is None:
+            return chain
+        return root.split(".") + chain[1:]
+
+    def _sym(self):
+        return self.stack[-1][0]
+
+    def _in_jit(self):
+        return self.stack[-1][1]
+
+    def _emit(self, check, severity, line, message):
+        if check in self.checks:
+            self.findings.append(Finding(
+                check, severity, self.relpath, line, self._sym(), message))
+
+    # ------------------------------------------------- function frames
+
+    def _enter_function(self, node):
+        jit = self._in_jit() or any(
+            _is_jit_decorator(d) for d in getattr(node, "decorator_list",
+                                                  ()))
+        name = getattr(node, "name", "<lambda>")
+        if "mutable-default" in self.checks and hasattr(node, "args"):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set")):
+                    self.findings.append(Finding(
+                        "mutable-default", "warning", self.relpath,
+                        d.lineno, name,
+                        f"mutable default argument in '{name}': shared "
+                        f"across calls (and a jit cache-key footgun); "
+                        f"default to None and build inside"))
+        self.stack.append((name, jit))
+        self.frames.append({"clock": [], "block": []})
+
+    def _exit_function(self):
+        frame = self.frames.pop()
+        if frame["clock"] and frame["block"]:
+            for line in frame["block"]:
+                self._emit(
+                    "sync-timing", "error", line,
+                    "block_until_ready in a function that also reads a "
+                    "wall clock: it is a NO-OP over the axon tunnel "
+                    "(r5 measured an impossible MFU=330 this way) — "
+                    "sync timed regions with "
+                    "apex_tpu.runtime.timing.sync / time_fn")
+        elif len(self.frames) > 1:
+            # an unpaired NESTED def usually runs inside its enclosing
+            # function's timed region — propagate its records up so a
+            # clock in the parent still pairs with a block in a closure.
+            # Top-level functions do NOT propagate into the module frame:
+            # pairing a clock in one sibling with a block in another
+            # would flag unrelated correctness-sync helpers.
+            # (Cross-FUNCTION helpers remain out of reach: documented
+            # limitation in docs/analysis.md.)
+            self.frames[-1]["block"] += frame["block"]
+            self.frames[-1]["clock"] += frame["clock"]
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node)
+        self.generic_visit(node)
+        self._exit_function()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter_function(node)
+        self.generic_visit(node)
+        self._exit_function()
+
+    # ------------------------------------------------------ call sites
+
+    def visit_Call(self, node):
+        chain = _attr_chain(node.func)
+        tail = chain[-1] if chain else None
+
+        if tail == "block_until_ready" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            self.frames[-1]["block"].append(node.lineno)
+        # resolve through the import map so `from time import time` and
+        # `import time as t` still count as clock reads
+        res = self._resolve(chain) if chain else None
+        is_clock = (res and len(res) >= 2
+                    and (res[-2], res[-1]) in _CLOCK_CALLS) or (
+            tail in ("perf_counter", "perf_counter_ns", "monotonic",
+                     "default_timer"))
+        if is_clock:
+            self.frames[-1]["clock"].append(node.lineno)
+
+        if self._in_jit():
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _HOST_PULL_NAMES and node.args and \
+                    not isinstance(node.args[0], ast.Constant) and \
+                    not _is_static_expr(node.args[0]):
+                self._emit(
+                    "host-in-jit", "error", node.lineno,
+                    f"'{node.func.id}(...)' inside a jit-decorated body "
+                    f"forces a host pull: it raises on traced values or "
+                    f"silently syncs on constants — keep the value on "
+                    f"device (jnp) or hoist it out of the jit")
+            if res and len(res) >= 2 and \
+                    res[0] in ("np", "numpy", "onp") and \
+                    res[-1] in _HOST_PULL_NP:
+                self._emit(
+                    "host-in-jit", "error", node.lineno,
+                    f"'{'.'.join(chain)}(...)' inside a jit-decorated "
+                    f"body: numpy materializes on host at trace time — "
+                    f"use jnp, or hoist the constant out of the jit")
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_PULL_METHODS:
+                self._emit(
+                    "host-in-jit", "error", node.lineno,
+                    f"'.{node.func.attr}()' inside a jit-decorated body "
+                    f"is a device sync / trace error")
+            if res and (
+                    res[0] == "random"
+                    or (len(res) >= 2 and res[0] in ("np", "numpy")
+                        and res[1] == "random")):
+                self._emit(
+                    "rng-in-jit", "error", node.lineno,
+                    f"'{'.'.join(chain)}(...)' inside a jit-decorated "
+                    f"body: the sample is drawn once at trace time and "
+                    f"baked in as a constant — every step reuses it; "
+                    f"use jax.random with a threaded key")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, relpath: str, checks=None):
+    """Lint one file's source text; returns a list of Findings."""
+    checks = set(checks or AST_CHECKS)
+    unknown = checks - set(AST_CHECKS)
+    if unknown:
+        raise ValueError(f"unknown AST check(s) {sorted(unknown)}; "
+                         f"valid: {list(AST_CHECKS)}")
+    norm = relpath.replace("\\", "/")
+    if any(norm.endswith(allow.replace("\\", "/"))
+           for allow in _SYNC_ALLOWLIST):
+        checks = checks - {"sync-timing"}
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("syntax", "error", relpath, e.lineno or 0,
+                        "<module>", f"does not parse: {e.msg}")]
+    visitor = _Visitor(relpath, relpath, checks)
+    visitor.visit(tree)
+    # close the module-level frame (module-scope timing code, e.g. a
+    # script body, gets the same sync-timing treatment)
+    frame = visitor.frames[0]
+    if "sync-timing" in checks and frame["clock"] and frame["block"]:
+        for line in frame["block"]:
+            visitor.findings.append(Finding(
+                "sync-timing", "error", relpath, line, "<module>",
+                "block_until_ready in module-level timing code — use "
+                "apex_tpu.runtime.timing.sync"))
+    lines = source.splitlines()
+    return [f for f in visitor.findings
+            if not is_suppressed(f, lines)]
+
+
+def iter_python_files(paths):
+    """Expand files/dirs into .py files, skipping caches and build dirs."""
+    skip_dirs = {"__pycache__", ".git", "build", ".eggs", "node_modules"}
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in skip_dirs
+                                 and not d.endswith(".egg-info"))
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.join(root, fname)
+
+
+def lint_paths(paths, root=None, checks=None):
+    """Lint every .py under ``paths``; paths in findings are relative to
+    ``root`` (default: cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings = []
+    for fpath in iter_python_files(paths):
+        ap = os.path.abspath(fpath)
+        rel = os.path.relpath(ap, root) if ap.startswith(root) else fpath
+        with open(ap, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, rel, checks))
+    return findings

@@ -1,0 +1,155 @@
+"""``python -m apex_tpu.analysis`` — run both lint engines.
+
+    python -m apex_tpu.analysis                       # default target set
+    python -m apex_tpu.analysis apex_tpu/ops bench.py # AST over a subset
+    python -m apex_tpu.analysis --no-jaxpr            # AST engine only
+    python -m apex_tpu.analysis --baseline tests/run_analysis/baseline.json
+    python -m apex_tpu.analysis --write-baseline tests/run_analysis/baseline.json
+    python -m apex_tpu.analysis --list-checks
+
+Exit codes: 0 clean (or all findings grandfathered), 1 new findings,
+2 a registered jaxpr target failed to trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
+from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
+
+DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
+
+
+def _default_paths(root):
+    return [p for p in DEFAULT_PATHS if os.path.exists(
+        os.path.join(root, p))]
+
+
+def known_checks():
+    return (set(ast_checks.AST_CHECKS) | set(JAXPR_CHECKS)
+            | set(targets.TARGET_CHECKS))
+
+
+def run(paths=None, root=None, ast=True, jaxpr=True, checks=None):
+    """Programmatic entry: returns (findings, target_errors)."""
+    if checks:
+        unknown = set(checks) - known_checks()
+        if unknown:
+            # a typo'd id silently matching nothing would report a clean
+            # run forever — fail loudly instead
+            raise ValueError(
+                f"unknown check id(s): {sorted(unknown)}; valid: "
+                f"{sorted(known_checks())}")
+    root = os.path.abspath(root or os.getcwd())
+    use = [os.path.join(root, p) if not os.path.isabs(p) else p
+           for p in (paths or _default_paths(root))]
+    if paths:
+        # validate EXPLICIT paths regardless of engine selection: a
+        # typo'd path yielding zero files would report a clean run
+        # forever — same failure mode as a typo'd check id
+        missing = [p for p in use if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"lint path(s) do not exist: {missing}")
+    all_findings, errors = [], {}
+    if ast:
+        ast_ids = (set(checks) & set(ast_checks.AST_CHECKS)
+                   if checks else None)
+        if ast_ids is None or ast_ids:
+            all_findings += ast_checks.lint_paths(use, root=root,
+                                                 checks=ast_ids)
+    if jaxpr:
+        if checks is None or set(checks) & set(JAXPR_CHECKS):
+            names = None  # tracing targets can emit any jaxpr check
+        else:
+            # only the (cheap, non-tracing) targets whose checks were
+            # asked for — skips the kernel trace suite
+            names = set(checks) & set(targets.TARGET_CHECKS)
+        if names is None or names:
+            jf, errors = targets.run_targets(names)
+            if checks:
+                jf = [f for f in jf if f.check in checks]
+            all_findings += jf
+    return all_findings, errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="apex_tpu static TPU lint (jaxpr + AST engines)")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs for the AST engine "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root findings are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--no-ast", dest="ast", action="store_false")
+    ap.add_argument("--no-jaxpr", dest="jaxpr", action="store_false")
+    ap.add_argument("--checks", default=None,
+                    help="comma-separated check ids to run")
+    ap.add_argument("--baseline", default=None,
+                    help="JSON baseline of grandfathered findings; only "
+                         "NEW findings fail the run")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="write current findings as the baseline and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for cid in ast_checks.AST_CHECKS:
+            print(f"{cid:24s} [ast]")
+        for cid in JAXPR_CHECKS:
+            print(f"{cid:24s} [jaxpr]")
+        for cid in targets.TARGET_CHECKS:
+            print(f"{cid:24s} [jaxpr]")
+        return 0
+
+    checks = None
+    if args.checks:
+        checks = {c.strip() for c in args.checks.split(",") if c.strip()}
+
+    try:
+        found, errors = run(paths=args.paths or None, root=args.root,
+                            ast=args.ast, jaxpr=args.jaxpr, checks=checks)
+    except (FileNotFoundError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    found.sort(key=lambda f: (f.path, f.line, f.check))
+
+    for name, err in sorted(errors.items()):
+        print(f"TARGET ERROR {name}: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        findings_mod.save_baseline(args.write_baseline, found)
+        print(f"wrote {len(found)} grandfathered finding(s) to "
+              f"{args.write_baseline}")
+        return 2 if errors else 0
+
+    fresh = found
+    grandfathered = 0
+    if args.baseline:
+        baseline = findings_mod.load_baseline(args.baseline)
+        fresh = findings_mod.new_findings(found, baseline)
+        grandfathered = len(found) - len(fresh)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in fresh],
+            "grandfathered": grandfathered,
+            "target_errors": errors,
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        tail = f" ({grandfathered} grandfathered)" if args.baseline else ""
+        print(f"{len(fresh)} finding(s){tail}", file=sys.stderr)
+
+    if errors:
+        return 2
+    return 1 if fresh else 0

@@ -1,0 +1,110 @@
+"""Finding model, suppression comments, and the checked-in baseline.
+
+A ``Finding`` is one report from either engine. Its ``key`` deliberately
+excludes the line number: the baseline must survive unrelated edits above
+a grandfathered finding, so identity is (check, path, symbol) plus an
+occurrence counter handled by the baseline diff (two findings of the same
+check in the same function count as two baseline slots).
+
+Suppression (AST engine only — jaxpr findings have no source line):
+
+    x = float(loss)  # apex-lint: disable=host-in-jit
+    # apex-lint: disable=sync-timing        <- or on the line above
+
+``# apex-lint: disable`` with no ids suppresses every check on that line.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import re
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*apex-lint:\s*disable(?:=([a-z0-9_,\- ]+))?", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str        # check id, e.g. "donation" or "sync-timing"
+    severity: str     # "error" | "warning"
+    path: str         # repo-relative source path, or "<jaxpr:target>"
+    line: int         # 1-based source line; 0 when not source-mapped
+    symbol: str       # enclosing function / analysis-target name
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.check}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.severity}] {self.check}: {self.message}" \
+               f" (in {self.symbol})"
+
+
+def suppressed_checks(source_lines, lineno: int):
+    """Check ids suppressed at 1-based ``lineno`` (same line, or a
+    comment-ONLY line directly above — a trailing comment on the
+    previous code line suppresses that line, not this one). Returns
+    None for "none", or a set; the empty set means ALL."""
+    ids = None
+    for ln in (lineno, lineno - 1):
+        if not 1 <= ln <= len(source_lines):
+            continue
+        text = source_lines[ln - 1]
+        if ln != lineno and not text.lstrip().startswith("#"):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            named = m.group(1)
+            if not named:
+                return set()   # bare disable: everything
+            ids = (ids or set()) | {
+                s.strip() for s in named.split(",") if s.strip()}
+    return ids
+
+
+def is_suppressed(finding: Finding, source_lines) -> bool:
+    ids = suppressed_checks(source_lines, finding.line)
+    if ids is None:
+        return False
+    return not ids or finding.check in ids
+
+
+# ------------------------------------------------------------- baseline
+
+def load_baseline(path) -> collections.Counter:
+    """Baseline file -> Counter of grandfathered finding keys."""
+    with open(path) as f:
+        data = json.load(f)
+    return collections.Counter(data.get("grandfathered", {}))
+
+
+def save_baseline(path, findings) -> None:
+    counts = collections.Counter(f.key for f in findings)
+    with open(path, "w") as f:
+        json.dump({
+            "_comment": (
+                "apex_tpu.analysis grandfathered findings. Keys are "
+                "check:path:symbol; values are allowed occurrence counts. "
+                "Regenerate with: python -m apex_tpu.analysis "
+                "--write-baseline <this file>. Shrink it, never grow it."),
+            "grandfathered": dict(sorted(counts.items())),
+        }, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def new_findings(findings, baseline: collections.Counter):
+    """Findings not covered by the baseline (multiplicity-aware)."""
+    budget = collections.Counter(baseline)
+    fresh = []
+    for f in findings:
+        if budget[f.key] > 0:
+            budget[f.key] -= 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,179 @@
+"""Registered jaxpr-engine analysis targets: the repo's real entry
+points, traced with representative avals and run through every jaxpr
+check. ``python -m apex_tpu.analysis`` and tests/run_analysis execute
+all of them, so a regression in donation discipline, collective axis
+wiring, or a kernel's BlockSpecs fails tier-1 without hardware.
+
+Each target is a zero-arg callable returning a list of Findings. Keep
+them cheap: tracing only (no compile, no execution) on the CPU backend.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.analysis.findings import Finding
+from apex_tpu.analysis.jaxpr_checks import analyze_fn
+
+TARGETS = {}
+
+# Check ids produced by non-tracing targets (everything else emits the
+# jaxpr_checks.JAXPR_CHECKS ids). The CLI derives --list-checks, check-id
+# validation, and target narrowing from this — register new
+# target-provided checks here, not in cli.py.
+TARGET_CHECKS = ("kernel-auto-provenance",)
+
+
+def target(name):
+    def deco(fn):
+        TARGETS[name] = fn
+        return fn
+    return deco
+
+
+@target("fused_adam_flat_step")
+def _fused_adam_flat_step():
+    """The flat-buffer Adam path behind a donated train step — the first
+    customer the ISSUE names: its donated aliasing was never
+    machine-checked."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import fused_adam
+
+    params = {"w": jnp.zeros((64, 128), jnp.float32),
+              "b": jnp.zeros((128,), jnp.float32)}
+    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=True)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    def train_step(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state
+
+    return analyze_fn(train_step, params, state, grads,
+                      donate_argnums=(0, 1), name="fused_adam_flat_step")
+
+
+@target("fused_adam_flat_kernel")
+def _fused_adam_flat_kernel():
+    """The Pallas flat-Adam kernel's BlockSpecs (scalar block + slab
+    padding are the Mosaic-sensitive parts)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.ops import pallas_config
+
+    params = {"w": jnp.zeros((4096,), jnp.float32)}
+    tx = fused_adam(lr=1e-3, flat=True, use_kernel=True)
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    with pallas_config.force("interpret"):
+        return analyze_fn(lambda g, s, p: tx.update(g, s, p),
+                          grads, state, params,
+                          name="fused_adam_flat_kernel")
+
+
+@target("flash_attention_fwd")
+def _flash_attention_fwd():
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((2, 256, 4, 64), jnp.bfloat16)
+    with pallas_config.force("on"):
+        return analyze_fn(
+            lambda q, k, v: flash_attention(q, k, v, causal=True),
+            q, q, q, name="flash_attention_fwd")
+
+
+@target("layer_norm_fwd")
+def _layer_norm_fwd():
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.ops.layer_norm import layer_norm
+
+    x = jnp.zeros((256, 1024), jnp.bfloat16)
+    w = jnp.ones((1024,), jnp.float32)
+    b = jnp.zeros((1024,), jnp.float32)
+    with pallas_config.force("on"):
+        return analyze_fn(lambda x, w, b: layer_norm(x, w, b, (1024,)),
+                          x, w, b, name="layer_norm_fwd")
+
+
+@target("causal_softmax")
+def _causal_softmax():
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import pallas_config
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax,
+    )
+
+    x = jnp.zeros((8, 256, 256), jnp.bfloat16)
+    with pallas_config.force("on"):
+        return analyze_fn(
+            lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0),
+            x, name="causal_softmax")
+
+
+@target("tp_collectives")
+def _tp_collectives():
+    """Tensor-parallel allreduce wiring against the live parallel_state
+    mesh — the collective-axis check's first customer."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+
+    owned = not parallel_state.model_parallel_is_initialized()
+    if owned:
+        tp = 2 if len(jax.devices()) >= 2 else 1
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=tp)
+    try:
+        mesh = parallel_state.get_mesh()
+        axis = parallel_state.get_tensor_model_parallel_group()
+        tp = mesh.shape[axis]
+
+        def allreduce(x):
+            return jax.lax.psum(x, axis)
+
+        fn = shard_map(allreduce, mesh=mesh, in_specs=P(axis),
+                       out_specs=P())
+        return analyze_fn(fn, jnp.zeros((tp * 8,), jnp.float32),
+                          mesh_axes=mesh, name="tp_collectives")
+    finally:
+        if owned:
+            parallel_state.destroy_model_parallel()
+
+
+@target("kernel-auto-provenance")
+def _kernel_auto_provenance():
+    """Every pinned _KERNEL_AUTO verdict must name its evidence artifact
+    (satellite: ops/pallas_config.py provenance)."""
+    from apex_tpu.ops import pallas_config
+
+    return [Finding("kernel-auto-provenance", "error",
+                    "apex_tpu/ops/pallas_config.py", 0, "_KERNEL_AUTO",
+                    problem)
+            for problem in pallas_config.validate_kernel_auto_provenance()]
+
+
+def run_targets(names=None):
+    """Run the registered targets; returns (findings, errors) where
+    errors maps target name -> repr of an exception that kept the target
+    from tracing at all (itself a failure the caller should surface)."""
+    findings, errors = [], {}
+    for name, fn in TARGETS.items():
+        if names is not None and name not in names:
+            continue
+        try:
+            findings.extend(fn())
+        except Exception as e:  # noqa: BLE001 — report, don't abort the scan
+            errors[name] = repr(e)[:300]
+    return findings, errors

@@ -38,6 +38,16 @@ _KERNEL_AUTO = {
     "flat_adam": False,
 }
 
+# Provenance: every pinned verdict above MUST name the evidence artifact
+# that justified it (a repo path for source pins; env/runtime pins are
+# tagged automatically by set_kernel_auto). The apex_tpu.analysis
+# self-check and tests/run_analysis enforce this — an unevidenced pin is
+# exactly how a stale race result outlives the hardware it was measured
+# on.
+_KERNEL_AUTO_EVIDENCE = {
+    "flat_adam": "docs/kernel_cost_study.md",
+}
+
 # every kernel that consults use_pallas(<name>); a verdict for anything
 # else is a typo that would silently never be consulted
 KNOWN_KERNELS = frozenset(
@@ -65,7 +75,7 @@ def _load_env_overrides():
     bench_kernels race result without editing source."""
     table = _env_json("APEX_TPU_KERNEL_AUTO", "kernel name -> bool|null")
     if table is not None:
-        set_kernel_auto(**table)
+        set_kernel_auto(evidence="env:APEX_TPU_KERNEL_AUTO", **table)
 
 
 def _load_flash_tile_overrides():
@@ -101,13 +111,19 @@ def use_pallas(kernel: str | None = None) -> bool:
     return on_tpu
 
 
-def set_kernel_auto(**verdicts) -> None:
+def set_kernel_auto(*, evidence: "str | None" = None, **verdicts) -> None:
     """Pin per-kernel auto decisions (True/False) or restore the backend
     heuristic (None). Used to apply measured race results.
 
     Strict on both axes: a typo'd kernel name would be stored but never
     consulted, and a stringly value ("false" via yaml/k8s templating)
-    would bool() to the OPPOSITE of the intent — both raise instead."""
+    would bool() to the OPPOSITE of the intent — both raise instead.
+
+    ``evidence`` names the artifact that justifies the pin (repo path of
+    a measurement doc, or a deployment tag like the env loader's
+    ``env:APEX_TPU_KERNEL_AUTO``); unevidenced runtime pins are tagged
+    ``runtime:set_kernel_auto`` so :func:`validate_kernel_auto_provenance`
+    can tell them from an unevidenced SOURCE pin, which is an error."""
     unknown = set(verdicts) - KNOWN_KERNELS
     if unknown:
         raise ValueError(f"unknown kernel name(s) {sorted(unknown)}; "
@@ -119,12 +135,77 @@ def set_kernel_auto(**verdicts) -> None:
                 f"got {v!r}")
         if v is None:
             _KERNEL_AUTO.pop(kernel, None)
+            _KERNEL_AUTO_EVIDENCE.pop(kernel, None)
         else:
             _KERNEL_AUTO[kernel] = v
+            _KERNEL_AUTO_EVIDENCE[kernel] = (
+                evidence if evidence else "runtime:set_kernel_auto")
 
 
 def kernel_auto() -> dict:
     return dict(_KERNEL_AUTO)
+
+
+def kernel_auto_evidence() -> dict:
+    """Pinned-verdict provenance: kernel name -> evidence artifact."""
+    return dict(_KERNEL_AUTO_EVIDENCE)
+
+
+def validate_kernel_auto_provenance(repo_root: "str | None" = None) -> list:
+    """Problems with the pinned-verdict provenance, [] when clean.
+
+    Every key of :data:`_KERNEL_AUTO` must have an evidence entry, and
+    path-like evidence (no ``tag:`` prefix) must exist relative to
+    ``repo_root`` (default: the checkout containing this file). Run by
+    the ``kernel-auto-provenance`` check in ``apex_tpu.analysis`` and by
+    tests/run_analysis, so a new pin cannot land without naming the
+    measurement that justified it."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    problems = []
+    for kernel in sorted(_KERNEL_AUTO):
+        ev = _KERNEL_AUTO_EVIDENCE.get(kernel)
+        if not ev:
+            problems.append(
+                f"pinned verdict for {kernel!r} has no evidence artifact")
+        elif ev.split(":", 1)[0] in ("env", "runtime"):
+            pass  # deployment tags, set by the loaders themselves
+        elif not os.path.exists(os.path.join(repo_root, ev)):
+            problems.append(
+                f"evidence for {kernel!r} names a missing artifact: {ev}")
+    for kernel in sorted(set(_KERNEL_AUTO_EVIDENCE) - set(_KERNEL_AUTO)):
+        problems.append(
+            f"evidence entry for {kernel!r} has no pinned verdict")
+    return problems
+
+
+# Per-core VMEM by device generation, matched by substring against
+# jax.devices()[0].device_kind (same scheme as bench._PEAK_FLOPS). The
+# Pallas guide's planning figure is ~16 MiB/core across current
+# generations; entries here override when a generation differs. Used by
+# the pallas-block VMEM-budget check in apex_tpu.analysis and available
+# to kernels for tile planning.
+_VMEM_BYTES_DEFAULT = 16 << 20
+_VMEM_BYTES = (
+    ("v6", 32 << 20), ("trillium", 32 << 20),
+)
+
+
+def device_vmem_bytes(kind: "str | None" = None) -> int:
+    """Per-core VMEM budget in bytes for ``kind`` (a device_kind string;
+    default: the current backend's first device, or the conservative
+    16 MiB planning figure off-TPU)."""
+    if kind is None:
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return _VMEM_BYTES_DEFAULT
+        kind = dev.device_kind
+    kind = kind.lower()
+    for key, nbytes in _VMEM_BYTES:
+        if key in kind:
+            return nbytes
+    return _VMEM_BYTES_DEFAULT
 
 
 def out_struct(shape, dtype, *like):

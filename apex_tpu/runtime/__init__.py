@@ -1,9 +1,11 @@
 """Native host runtime bindings (SURVEY.md §2 #50).
 
 ctypes loader for ``csrc/libapex_tpu_host.so`` plus pure-Python fallbacks
-so the package works before ``make -C csrc`` has run.
+so the package works before ``make -C csrc`` has run. ``timing`` holds
+the corrected-sync device timing helpers shared by bench.py and tools/.
 """
 
+from apex_tpu.runtime import timing
 from apex_tpu.runtime.host import (
     HostRuntime,
     PrefetchLoader,
@@ -16,5 +18,5 @@ from apex_tpu.runtime.host import (
 
 __all__ = [
     "HostRuntime", "PrefetchLoader", "bucket_offsets", "flatten_into",
-    "plan_buckets", "runtime_available", "unflatten_from",
+    "plan_buckets", "runtime_available", "timing", "unflatten_from",
 ]

@@ -1,0 +1,179 @@
+"""Corrected-sync device timing — the shared helper every timed region
+must go through.
+
+``jax.block_until_ready`` is a NO-OP over the axon remote backend
+(measured r5: a 1.1-TFLOP matmul "completed" in 0.04 ms under
+block_until_ready vs 5.6 ms true device time) — every r1-r4 timing that
+trusted it on TPU was dispatch time, not device time, and the r5 bench
+published an impossible MFU=330 because of it. A host fetch of a single
+element is the only sync that provably waits, and because the TPU
+executes enqueued programs in order, syncing the LAST output of a
+sequence syncs the whole sequence.
+
+This module is the one place that knowledge lives. bench.py,
+tools/tpu_profile.py and tools/tpu_validate.py all import from here, and
+the ``sync-timing`` check in ``apex_tpu.analysis`` flags any new code
+that times around a bare ``block_until_ready`` instead.
+
+jax is imported lazily inside each function: bench.py's launcher half
+must stay importable without touching the backend.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = [
+    "sync", "fetch_cost", "time_fn", "time_train_step", "time_chained",
+    "time_scanned",
+]
+
+
+def sync(out):
+    """Force completion of ``out``'s producing computation by fetching one
+    element of its last leaf to the host.
+
+    Index (not ravel) one element: ravel() would dispatch a full-array
+    reshape — on a sharded 16 GiB output that's a device-filling copy.
+    The last leaf is fetched on the assumption that ``out`` came from one
+    program (or that its leaves were enqueued in pytree order, as a
+    ``(*state, loss)`` step output is): in-order device execution then
+    makes one fetch sync everything. Pass the final output explicitly
+    when timing a multi-dispatch region."""
+    import jax
+    import numpy as np
+    leaves = jax.tree_util.tree_leaves(out)
+    if not leaves:
+        return None
+    # belt: block_until_ready waits on EVERY leaf on backends that honor
+    # it (local CPU/GPU/TPU pods — covers leaves from independent
+    # dispatch queues); braces: the host fetch below is the only wait
+    # the axon tunnel honors, and in-order execution makes one fetch of
+    # the last-enqueued output cover the whole queue.
+    jax.block_until_ready(leaves)
+    leaf = leaves[-1]
+    return np.asarray(leaf if getattr(leaf, "ndim", 0) == 0
+                      else leaf[(0,) * leaf.ndim])
+
+
+def fetch_cost(out):
+    """Measured cost of one :func:`sync` on an already-ready array — ~79 ms
+    through the tunnel (RTT + tiny-gather dispatch), ~0 locally. Timed
+    loops subtract it so the fetch doesn't masquerade as device time."""
+    sync(out)
+    costs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(out)
+        costs.append(time.perf_counter() - t0)
+    return min(costs)
+
+
+_FETCH_COST = None
+
+
+def cached_fetch_cost(sample) -> float:
+    """:func:`fetch_cost` measured once per process (the tunnel constant
+    is stable) — for one-shot timed regions like the pipeline phase
+    timers, where re-measuring per stop would cost more than the fetch
+    it corrects for. ``sample`` must already be synced."""
+    global _FETCH_COST
+    if _FETCH_COST is None:
+        _FETCH_COST = fetch_cost(sample)
+    return _FETCH_COST
+
+
+def time_fn(fn, *args, iters=20, warmup=3, max_time_s=None):
+    """Warmup then time ``iters`` independent calls + ONE final sync
+    (in-order device execution ⇒ last-completion = all-complete), minus
+    the measured fetch constant. ``max_time_s`` caps the TIMED loop's
+    wall clock: the last warmup call (synced) estimates the per-step cost
+    and ``iters`` shrinks to fit — the dispatch-bound baselines can take
+    tens of seconds per step through a remote device tunnel, and one pass
+    of a 2k-dispatch loop is a statistically fine sample."""
+    for _ in range(max(warmup, 1) - 1):
+        out = fn(*args)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    sync(out)
+    per_step = time.perf_counter() - t0
+    fetch = fetch_cost(out)
+    if max_time_s is not None:
+        iters = max(1, min(iters, int(max_time_s / max(per_step, 1e-9))))
+    # sync every ~2s of enqueued work: async dispatch with NO sync lets
+    # the in-flight buffer queue grow until the device OOMs (observed r5:
+    # the 2k-dispatch eager loop exhausted HBM that a synced loop never
+    # touches), and deletion RPCs only flush at a sync point
+    sync_every = max(1, int(2.0 / max(per_step, 1e-9)))
+    n_syncs = 0
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(*args)
+        if (i + 1) % sync_every == 0 and i + 1 < iters:
+            sync(out)
+            n_syncs += 1
+    sync(out)
+    n_syncs += 1
+    return max((time.perf_counter() - t0 - fetch * n_syncs), 1e-9) / iters
+
+
+def time_train_step(step, state, batch, iters=10):
+    """Warm up once, then time ``iters`` chained calls of a jitted train
+    step whose outputs are ``(*new_state, loss)`` and whose inputs are
+    ``(*state, *batch)`` — the shared methodology for every model-level
+    bench (donated state threads through). The final-step loss is fetched
+    to the host: it depends on the whole chain, so one fetch syncs all
+    ``iters`` steps; the fetch constant is subtracted."""
+    out = step(*state, *batch)
+    sync(out[-1])
+    fetch = fetch_cost(out[-1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(*out[:-1], *batch)
+    sync(out[-1])
+    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
+
+
+def time_chained(step, grads, state, params, iters=100):
+    """Output-feeds-input timing: true serial device time per step."""
+    p, s = step(grads, state, params)
+    sync(p)
+    fetch = fetch_cost(p)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, s = step(grads, s, p)
+    sync(p)
+    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
+
+
+def time_scanned(make_step, carry, chain, k=32, reps=3):
+    """Per-iteration device time of a sub-millisecond kernel.
+
+    Per-dispatch overhead through the tunnel is ~0.7 ms (measured r5), so
+    a chained host loop can't resolve kernels faster than that. Instead
+    run ``k`` iterations ON DEVICE under one ``lax.scan`` dispatch
+    (``chain(carry, step) -> carry`` threads the output back in so
+    nothing is dead-code-eliminated), time 1 rep and ``reps`` chained
+    reps of the SAME jitted scan, and take the slope — the fetch constant
+    and dispatch overhead cancel."""
+    import jax
+
+    step = make_step()
+
+    @jax.jit
+    def scan_k(c):
+        return jax.lax.scan(lambda c, _: (chain(c, step), None), c, None,
+                            length=k)[0]
+
+    out = scan_k(carry)       # compile + settle
+    sync(out)
+    t0 = time.perf_counter()
+    out = scan_k(out)
+    sync(out)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = scan_k(out)
+    sync(out)
+    t_many = time.perf_counter() - t0
+    return max(t_many - t_one, 1e-9) / ((reps - 1) * k)

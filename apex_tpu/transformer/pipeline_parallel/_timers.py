@@ -47,14 +47,25 @@ class _Timer:
 
     def stop(self, block_on=None):
         """``block_on``: pytree of device values produced by the timed
-        region — blocked on so the elapsed time covers device execution
+        region — synced so the elapsed time covers device execution
         (the reference's cuda.synchronize analog). Omit for host-only
-        regions."""
+        regions. Host-fetch sync rather than block_until_ready: the
+        latter is a no-op over the axon tunnel (the r5 MFU=330 bug),
+        which would turn every phase timing into dispatch time."""
         if not self.started_:
             raise RuntimeError("timer is not started")
+        overhead = 0.0
         if block_on is not None:
-            jax.block_until_ready(block_on)
-        self.elapsed_ += time.time() - self.start_time
+            from apex_tpu.runtime import timing
+            timing.sync(block_on)
+            now = time.time()
+            # the sync's own host-fetch RTT (~79 ms over the tunnel)
+            # must not count as phase time; the constant is measured
+            # once per process and subtracted
+            overhead = timing.cached_fetch_cost(block_on)
+        else:
+            now = time.time()
+        self.elapsed_ += max(now - self.start_time - overhead, 0.0)
         self.started_ = False
         if self._annotation is not None:
             self._annotation.__exit__(None, None, None)

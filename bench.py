@@ -73,132 +73,48 @@ def make_params(key, n_layers=24, hidden=1024, vocab=50304):
     return params
 
 
-def _sync(out):
-    """Force completion of ``out``'s producing computation by fetching one
-    element to the host.
+# The corrected-sync timing machinery (host-fetch sync because
+# block_until_ready is a no-op over the axon tunnel, fetch-constant
+# subtraction, on-device scan loops) lives in apex_tpu/runtime/timing.py
+# since round 6 so tools/ and examples/ share one audited implementation.
+# These delegates keep bench.py's public names (tests and older notes
+# reference bench.time_fn etc.) while importing lazily: the launcher half
+# of this file must stay importable without touching jax or the backend.
 
-    ``jax.block_until_ready`` is a NO-OP over the axon remote backend
-    (measured r5: a 1.1-TFLOP matmul "completed" in 0.04 ms under
-    block_until_ready vs 5.6 ms true device time) — every r1-r4 timing
-    that trusted it on TPU was dispatch time, not device time. A host
-    fetch of a single element is the only sync that provably waits, and
-    because the TPU executes enqueued programs in order, syncing the LAST
-    output of a sequence syncs the whole sequence."""
-    import jax
-    import numpy as np
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    # index (not ravel) one element: ravel() would dispatch a full-array
-    # reshape — on a sharded 16 GiB output that's a device-filling copy
-    return np.asarray(leaf if leaf.ndim == 0 else leaf[(0,) * leaf.ndim])
+def _sync(out):
+    """Host-fetch sync — see apex_tpu.runtime.timing.sync."""
+    from apex_tpu.runtime import timing
+    return timing.sync(out)
 
 
 def _fetch_cost(out):
-    """Measured cost of one ``_sync`` on an already-ready array — ~79 ms
-    through the tunnel (RTT + tiny-gather dispatch), ~0 locally. Timed
-    loops subtract it so the fetch doesn't masquerade as device time."""
-    _sync(out)
-    costs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _sync(out)
-        costs.append(time.perf_counter() - t0)
-    return min(costs)
+    """Measured per-sync fetch constant — see timing.fetch_cost."""
+    from apex_tpu.runtime import timing
+    return timing.fetch_cost(out)
 
 
-def time_fn(fn, *args, iters=20, warmup=3, max_time_s=None):
-    """Warmup then time ``iters`` independent calls + ONE final sync
-    (in-order device execution ⇒ last-completion = all-complete), minus
-    the measured fetch constant. ``max_time_s`` caps the TIMED loop's
-    wall clock: the last warmup call (synced) estimates the per-step cost
-    and ``iters`` shrinks to fit — the dispatch-bound baselines can take
-    tens of seconds per step through a remote device tunnel, and one pass
-    of a 2k-dispatch loop is a statistically fine sample."""
-    for _ in range(max(warmup, 1) - 1):
-        out = fn(*args)
-    t0 = time.perf_counter()
-    out = fn(*args)
-    _sync(out)
-    per_step = time.perf_counter() - t0
-    fetch = _fetch_cost(out)
-    if max_time_s is not None:
-        iters = max(1, min(iters, int(max_time_s / max(per_step, 1e-9))))
-    # sync every ~2s of enqueued work: async dispatch with NO sync lets
-    # the in-flight buffer queue grow until the device OOMs (observed r5:
-    # the 2k-dispatch eager loop exhausted HBM that a synced loop never
-    # touches), and deletion RPCs only flush at a sync point
-    sync_every = max(1, int(2.0 / max(per_step, 1e-9)))
-    n_syncs = 0
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = fn(*args)
-        if (i + 1) % sync_every == 0 and i + 1 < iters:
-            _sync(out)
-            n_syncs += 1
-    _sync(out)
-    n_syncs += 1
-    return max((time.perf_counter() - t0 - fetch * n_syncs), 1e-9) / iters
+def time_fn(fn, *args, **kw):
+    """Independent-call timing — see timing.time_fn."""
+    from apex_tpu.runtime import timing
+    return timing.time_fn(fn, *args, **kw)
 
 
 def time_train_step(step, state, batch, iters=10):
-    """Warm up once, then time ``iters`` chained calls of a jitted train
-    step whose outputs are ``(*new_state, loss)`` and whose inputs are
-    ``(*state, *batch)`` — the shared methodology for every model-level
-    bench (donated state threads through). The final-step loss is fetched
-    to the host: it depends on the whole chain, so one fetch syncs all
-    ``iters`` steps; the fetch constant is subtracted."""
-    out = step(*state, *batch)
-    _sync(out[-1])
-    fetch = _fetch_cost(out[-1])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step(*out[:-1], *batch)
-    _sync(out[-1])
-    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
+    """Chained train-step timing — see timing.time_train_step."""
+    from apex_tpu.runtime import timing
+    return timing.time_train_step(step, state, batch, iters=iters)
 
 
 def time_chained(step, grads, state, params, iters=100):
-    """Output-feeds-input timing: true serial device time per step."""
-    p, s = step(grads, state, params)
-    _sync(p)
-    fetch = _fetch_cost(p)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p, s = step(grads, s, p)
-    _sync(p)
-    return max((time.perf_counter() - t0 - fetch), 1e-9) / iters
+    """Output-feeds-input timing — see timing.time_chained."""
+    from apex_tpu.runtime import timing
+    return timing.time_chained(step, grads, state, params, iters=iters)
 
 
 def time_scanned(make_step, carry, chain, k=32, reps=3):
-    """Per-iteration device time of a sub-millisecond kernel.
-
-    Per-dispatch overhead through the tunnel is ~0.7 ms (measured r5), so
-    a chained host loop can't resolve kernels faster than that. Instead
-    run ``k`` iterations ON DEVICE under one ``lax.scan`` dispatch
-    (``chain(carry, step) -> carry`` threads the output back in so
-    nothing is dead-code-eliminated), time 1 rep and ``reps`` chained
-    reps of the SAME jitted scan, and take the slope — the fetch constant
-    and dispatch overhead cancel."""
-    import jax
-
-    step = make_step()
-
-    @jax.jit
-    def scan_k(c):
-        return jax.lax.scan(lambda c, _: (chain(c, step), None), c, None,
-                            length=k)[0]
-
-    out = scan_k(carry)       # compile + settle
-    _sync(out)
-    t0 = time.perf_counter()
-    out = scan_k(out)
-    _sync(out)
-    t_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = scan_k(out)
-    _sync(out)
-    t_many = time.perf_counter() - t0
-    return max(t_many - t_one, 1e-9) / ((reps - 1) * k)
+    """On-device scan-slope timing — see timing.time_scanned."""
+    from apex_tpu.runtime import timing
+    return timing.time_scanned(make_step, carry, chain, k=k, reps=reps)
 
 
 def bench_fused_adam(cpu_mode, extras):
@@ -805,7 +721,8 @@ def worker():
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     # warm the backend with a trivial compile before starting any clock
-    jax.block_until_ready(jnp.ones((8, 8)) + 1)
+    # (host-fetch sync: block_until_ready is a no-op over the tunnel)
+    _sync(jnp.ones((8, 8)) + 1)
     init_s = time.perf_counter() - t_init
     ready.set()
     print(f"backend init + warm-up took {init_s:.1f}s", file=sys.stderr)

@@ -1,0 +1,405 @@
+"""Engine 2 (AST) unit tests: true-positive snippet + idiomatic clean
+snippet per check, plus suppression syntax and the baseline machinery."""
+
+import collections
+
+import pytest
+
+from apex_tpu.analysis import lint_source
+from apex_tpu.analysis.findings import (
+    Finding,
+    new_findings,
+    save_baseline,
+    load_baseline,
+)
+
+
+def _lint(src, checks=None):
+    return lint_source(src, "snippet.py", checks)
+
+
+def _by_check(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# ------------------------------------------------------------ sync-timing
+
+def test_sync_timing_flagged():
+    src = """
+import time, jax
+
+def bench_step(fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+"""
+    found = _by_check(_lint(src), "sync-timing")
+    assert len(found) == 1
+    assert found[0].line == 7 and found[0].symbol == "bench_step"
+    assert "timing.sync" in found[0].message
+
+
+def test_sync_timing_method_call_and_module_scope():
+    src = """
+import time, jax
+t0 = time.perf_counter()
+out.block_until_ready()
+print(time.perf_counter() - t0)
+"""
+    found = _by_check(_lint(src), "sync-timing")
+    assert len(found) == 1 and found[0].symbol == "<module>"
+    # the module-scope pass must honor the checks= narrowing too
+    assert not _lint(src, checks=("mutable-default",))
+
+
+def test_sync_timing_sees_aliased_clock_imports():
+    """`from time import time` / `import time as t` are still clock
+    reads — the r5 bug class must not slip through an import alias."""
+    src = """
+import jax
+from time import time
+
+def bench_step(fn, x):
+    t0 = time()
+    jax.block_until_ready(fn(x))
+    return time() - t0
+"""
+    assert len(_by_check(_lint(src), "sync-timing")) == 1
+    src2 = """
+import jax
+import time as t
+
+def bench_step(fn, x):
+    t0 = t.time()
+    jax.block_until_ready(fn(x))
+    return t.time() - t0
+"""
+    assert len(_by_check(_lint(src2), "sync-timing")) == 1
+
+
+def test_sync_timing_pairs_block_in_nested_def():
+    """A closure blocking inside a clock-reading function is the same
+    timed region — nested-def records propagate to the parent frame."""
+    src = """
+import time, jax
+
+def bench_step(fn, x):
+    def run():
+        return jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    out = run()
+    return time.perf_counter() - t0
+"""
+    assert len(_by_check(_lint(src), "sync-timing")) == 1
+
+
+def test_sync_timing_clean_correctness_sync():
+    """block_until_ready with NO clock in scope is a correctness sync,
+    not a timing bug — must not be flagged."""
+    src = """
+import jax
+
+def settle(out):
+    jax.block_until_ready(out)
+    return out
+"""
+    assert not _lint(src)
+
+
+def test_sync_timing_clean_across_sibling_functions():
+    """A clock in one top-level function must not pair with a
+    correctness sync in an unrelated sibling."""
+    src = """
+import time, jax
+
+def now():
+    return time.perf_counter()
+
+def settle(out):
+    jax.block_until_ready(out)
+    return out
+"""
+    assert not _lint(src)
+
+
+def test_sync_timing_clean_via_helper():
+    """The idiomatic corrected pattern: timing helper, no bare block."""
+    src = """
+import time
+from apex_tpu.runtime import timing
+
+def bench_step(fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    timing.sync(out)
+    return time.perf_counter() - t0
+"""
+    assert not _lint(src)
+
+
+# ------------------------------------------------------------ host-in-jit
+
+def test_host_pull_in_jit_flagged():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    lr = float(x.mean())
+    host = np.asarray(x)
+    v = x.item()
+    return x * lr
+"""
+    found = _by_check(_lint(src), "host-in-jit")
+    assert len(found) == 3
+    assert {f.line for f in found} == {7, 8, 9}
+    assert all(f.symbol == "step" for f in found)
+
+
+def test_host_pull_partial_jit_decorator_flagged():
+    src = """
+import functools, jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(x):
+    return x * float(x.sum())
+"""
+    assert len(_by_check(_lint(src), "host-in-jit")) == 1
+
+
+def test_host_pull_clean_outside_jit():
+    """float()/np.asarray in host-side code is idiomatic (bench.py's
+    launcher, metric emission) — only jit bodies are flagged."""
+    src = """
+import numpy as np
+
+def emit(metrics, loss):
+    metrics["loss"] = float(loss)
+    return np.asarray(loss)
+"""
+    assert not _lint(src)
+
+
+def test_host_pull_clean_static_shape_arithmetic():
+    """int()/float() over trace-time-static metadata is idiomatic jax,
+    not a host pull."""
+    src = """
+import jax
+
+@jax.jit
+def step(x, xs):
+    n = int(x.shape[0] * 2)
+    frac = float(len(xs)) / x.ndim
+    return x.reshape(n // 2, -1) * frac
+"""
+    assert not _lint(src)
+
+
+def test_host_pull_mixed_traced_static_still_flagged():
+    """One static leaf must not exempt a traced pull: x.mean()/x.shape[0]
+    concretizes the traced mean."""
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    lr = float(x.mean() / x.shape[0])
+    return x * lr
+"""
+    assert len(_by_check(_lint(src), "host-in-jit")) == 1
+
+
+def test_dotted_import_binds_root_name():
+    """`import numpy.random` binds `numpy`; numpy.asarray in jit is a
+    host pull, NOT an rng finding."""
+    src = """
+import jax
+import numpy.random
+
+@jax.jit
+def step(x):
+    return numpy.asarray(x)
+"""
+    found = _lint(src)
+    assert len(_by_check(found, "host-in-jit")) == 1
+    assert not _by_check(found, "rng-in-jit")
+
+
+def test_host_pull_clean_jnp_in_jit():
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.asarray(x, jnp.float32) * jnp.float32(2)
+"""
+    assert not _lint(src)
+
+
+# ------------------------------------------------------------- rng-in-jit
+
+def test_python_rng_in_jit_flagged():
+    src = """
+import jax, random
+import numpy as np
+
+@jax.jit
+def step(x):
+    noise = np.random.normal(size=(4,))
+    jitter = random.random()
+    return x + noise * jitter
+"""
+    found = _by_check(_lint(src), "rng-in-jit")
+    assert len(found) == 2
+    assert {f.line for f in found} == {7, 8}
+
+
+def test_rng_clean_jax_random_in_jit():
+    src = """
+import jax
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)
+    return x + noise
+"""
+    assert not _lint(src)
+
+
+def test_rng_clean_from_jax_import_random():
+    """`from jax import random` must resolve through the import map and
+    not be mistaken for the stdlib random module."""
+    src = """
+import jax
+from jax import random
+
+@jax.jit
+def step(x, key):
+    return x + random.normal(key, x.shape)
+"""
+    assert not _lint(src)
+
+
+def test_rng_aliased_stdlib_random_still_flagged():
+    src = """
+import jax
+import random as rnd
+
+@jax.jit
+def step(x):
+    return x * rnd.random()
+"""
+    assert len(_by_check(_lint(src), "rng-in-jit")) == 1
+
+
+def test_rng_clean_numpy_rng_outside_jit():
+    """Host-side data pipelines use np.random legitimately (e.g.
+    examples/imagenet_resnet50.py input synthesis)."""
+    src = """
+import numpy as np
+
+def make_batch(seed):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(8, 8))
+"""
+    assert not _lint(src)
+
+
+# -------------------------------------------------------- mutable-default
+
+def test_mutable_default_flagged():
+    src = """
+def accumulate(x, history=[], opts={}):
+    history.append(x)
+    return history, opts
+"""
+    found = _by_check(_lint(src), "mutable-default")
+    assert len(found) == 2
+    assert all(f.symbol == "accumulate" for f in found)
+
+
+def test_mutable_default_clean():
+    src = """
+def accumulate(x, history=None, n=3, name="adam"):
+    history = [] if history is None else history
+    history.append(x)
+    return history
+"""
+    assert not _lint(src)
+
+
+# ------------------------------------------------- suppression + baseline
+
+def test_suppression_on_line_and_line_above():
+    src = """
+import time, jax
+
+def bench(fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    jax.block_until_ready(out)  # apex-lint: disable=sync-timing
+    # apex-lint: disable=sync-timing
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+"""
+    assert not _lint(src)
+
+
+def test_trailing_suppression_does_not_blanket_next_line():
+    """A trailing comment suppresses ITS line only; the same violation
+    unannotated on the next line must still be flagged."""
+    src = """
+import time, jax
+
+def bench(fn, x):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(x))  # apex-lint: disable=sync-timing
+    jax.block_until_ready(fn(x))
+    return time.perf_counter() - t0
+"""
+    found = _by_check(_lint(src), "sync-timing")
+    assert len(found) == 1 and found[0].line == 7
+
+
+def test_suppression_is_check_specific():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * float(x.sum())  # apex-lint: disable=rng-in-jit
+"""
+    assert len(_by_check(_lint(src), "host-in-jit")) == 1
+
+
+def test_bare_suppression_disables_all():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * float(x.sum())  # apex-lint: disable
+"""
+    assert not _lint(src)
+
+
+def test_unknown_check_id_raises():
+    with pytest.raises(ValueError, match="unknown AST check"):
+        lint_source("x = 1", "s.py", checks=("bogus",))
+
+
+def test_baseline_roundtrip_and_multiplicity(tmp_path):
+    f1 = Finding("sync-timing", "error", "a.py", 3, "f", "m1")
+    f2 = Finding("sync-timing", "error", "a.py", 9, "f", "m2")  # same key
+    f3 = Finding("host-in-jit", "error", "b.py", 1, "g", "m3")
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [f1, f2])
+    baseline = load_baseline(path)
+    assert baseline == collections.Counter({f1.key: 2})
+    # both grandfathered slots consumed; the third finding is new
+    assert new_findings([f1, f2, f3], baseline) == [f3]
+    # a THIRD occurrence of the same key no longer fits the budget
+    assert new_findings([f1, f2, f1], baseline) == [f1]

@@ -1,0 +1,83 @@
+"""Satellite: every pinned _KERNEL_AUTO verdict must name its evidence
+artifact (ops/pallas_config.py), validated by the analyzer target and
+directly here."""
+
+import pytest
+
+from apex_tpu.ops import pallas_config
+
+
+def _restore():
+    """Reset the verdict table to the source defaults."""
+    pallas_config.set_kernel_auto(
+        **{k: None for k in pallas_config.kernel_auto()})
+    pallas_config.set_kernel_auto(
+        evidence="docs/kernel_cost_study.md", flat_adam=False)
+
+
+def test_source_pins_have_valid_provenance():
+    problems = pallas_config.validate_kernel_auto_provenance()
+    assert problems == [], problems
+    ev = pallas_config.kernel_auto_evidence()
+    assert set(ev) == set(pallas_config.kernel_auto())
+    # the shipped pin names the cost study that justified it
+    assert ev["flat_adam"] == "docs/kernel_cost_study.md"
+
+
+def test_missing_artifact_is_flagged():
+    try:
+        pallas_config.set_kernel_auto(
+            evidence="docs/no_such_study.md", layer_norm=False)
+        problems = pallas_config.validate_kernel_auto_provenance()
+        assert any("missing artifact" in p for p in problems), problems
+    finally:
+        _restore()
+
+
+def test_freetext_evidence_is_not_a_valid_tag():
+    """Only env:/runtime: prefixes are deployment tags; anything else
+    (including a colon typo for a slash) must exist as an artifact."""
+    try:
+        pallas_config.set_kernel_auto(
+            evidence="docs:kernel_cost_study.md", layer_norm=False)
+        problems = pallas_config.validate_kernel_auto_provenance()
+        assert any("missing artifact" in p for p in problems), problems
+    finally:
+        _restore()
+
+
+def test_unpinning_drops_evidence():
+    try:
+        pallas_config.set_kernel_auto(
+            evidence="docs/kernel_cost_study.md", layer_norm=False)
+        assert "layer_norm" in pallas_config.kernel_auto_evidence()
+        pallas_config.set_kernel_auto(layer_norm=None)
+        assert "layer_norm" not in pallas_config.kernel_auto_evidence()
+        assert pallas_config.validate_kernel_auto_provenance() == []
+    finally:
+        _restore()
+
+
+def test_runtime_and_env_pins_are_tagged():
+    try:
+        pallas_config.set_kernel_auto(rms_norm=True)  # no evidence kwarg
+        ev = pallas_config.kernel_auto_evidence()
+        assert ev["rms_norm"] == "runtime:set_kernel_auto"
+        # tagged (non-path) evidence is valid provenance
+        assert pallas_config.validate_kernel_auto_provenance() == []
+    finally:
+        _restore()
+
+
+def test_analyzer_target_reports_problems(monkeypatch):
+    from apex_tpu.analysis.targets import TARGETS
+
+    try:
+        pallas_config.set_kernel_auto(
+            evidence="docs/no_such_study.md", layer_norm=False)
+        findings = TARGETS["kernel-auto-provenance"]()
+        assert any(f.check == "kernel-auto-provenance"
+                   and f.severity == "error" for f in findings)
+    finally:
+        _restore()
+    assert TARGETS["kernel-auto-provenance"]() == []

@@ -1,0 +1,84 @@
+"""Satellite: pallas_config.force() nesting/restore semantics and the
+interpret-mode interaction — the contextmanager state machine every
+test and the bench kernel race lean on, previously untested."""
+
+import pytest
+
+from apex_tpu.ops import pallas_config
+
+
+def test_nested_force_restores_in_order():
+    assert pallas_config.mode() == "auto"
+    with pallas_config.force("off"):
+        assert pallas_config.mode() == "off"
+        with pallas_config.force("interpret"):
+            assert pallas_config.mode() == "interpret"
+            with pallas_config.force("on"):
+                assert pallas_config.mode() == "on"
+            assert pallas_config.mode() == "interpret"
+        assert pallas_config.mode() == "off"
+    assert pallas_config.mode() == "auto"
+
+
+def test_force_restores_on_exception():
+    with pytest.raises(RuntimeError, match="boom"):
+        with pallas_config.force("interpret"):
+            raise RuntimeError("boom")
+    assert pallas_config.mode() == "auto"
+    # and from a NESTED failure the outer level must still unwind
+    with pytest.raises(RuntimeError):
+        with pallas_config.force("off"):
+            with pallas_config.force("on"):
+                raise RuntimeError("nested")
+    assert pallas_config.mode() == "auto"
+
+
+def test_force_rejects_unknown_mode_without_corrupting_state():
+    with pallas_config.force("off"):
+        with pytest.raises(ValueError, match="unknown pallas mode"):
+            with pallas_config.force("fast"):
+                pass  # pragma: no cover
+        # the failed entry must not have clobbered the current mode
+        assert pallas_config.mode() == "off"
+    assert pallas_config.mode() == "auto"
+
+
+def test_interpret_flag_tracks_mode():
+    assert pallas_config.interpret() is False
+    with pallas_config.force("interpret"):
+        assert pallas_config.interpret() is True
+        assert pallas_config.use_pallas("flat_adam") is True
+        with pallas_config.force("on"):
+            # compiled mode inside interpret: interpret flag drops
+            assert pallas_config.interpret() is False
+        assert pallas_config.interpret() is True
+    assert pallas_config.interpret() is False
+
+
+def test_use_pallas_under_each_mode():
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    with pallas_config.force("off"):
+        assert pallas_config.use_pallas() is False
+    with pallas_config.force("on"):
+        assert pallas_config.use_pallas() is True
+    with pallas_config.force("interpret"):
+        assert pallas_config.use_pallas() is True
+    with pallas_config.force("auto"):
+        assert pallas_config.use_pallas() == on_tpu
+
+
+def test_interpret_mode_executes_kernel_body_and_restores():
+    """interpret mode must actually route a kernel through the Pallas
+    interpreter on CPU and leave the mode clean afterwards."""
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.layer_norm import rms_norm
+
+    x = jnp.ones((8, 128), jnp.float32)
+    w = jnp.full((128,), 2.0, jnp.float32)
+    with pallas_config.force("interpret"):
+        got = rms_norm(x, w, (128,))
+    assert pallas_config.mode() == "auto"
+    assert jnp.allclose(got, 2.0, atol=1e-3)

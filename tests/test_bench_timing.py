@@ -18,13 +18,34 @@ def test_sync_fetches_one_element():
     v = bench._sync(x)
     assert float(v) == 0.0  # element [0, 0]
     assert bench._sync(jnp.float32(7.0)) == 7.0
-    assert bench._sync({"a": jnp.ones((2, 2))}) == 1.0  # first leaf
+    assert bench._sync({"a": jnp.ones((2, 2))}) == 1.0
+
+
+def test_sync_uses_last_leaf_and_tolerates_empty():
+    """The LAST leaf is the sync anchor (a (*state, loss) step output
+    enqueues it last), and an empty pytree is a no-op like
+    block_until_ready, not an IndexError."""
+    from apex_tpu.runtime import timing
+
+    out = (jnp.zeros((2, 2)), jnp.full((3,), 5.0))
+    assert float(timing.sync(out)) == 5.0
+    assert timing.sync(()) is None
+    assert timing.sync({}) is None
 
 
 def test_fetch_cost_nonnegative_and_small_on_cpu():
     x = jnp.ones((4,))
     c = bench._fetch_cost(x)
     assert 0.0 <= c < 0.5  # ~zero locally; ~79ms through the tunnel
+
+
+def test_cached_fetch_cost_measures_once():
+    from apex_tpu.runtime import timing
+
+    c1 = timing.cached_fetch_cost(jnp.ones((4,)))
+    assert 0.0 <= c1 < 0.5
+    # second call returns the cached constant without re-measuring
+    assert timing.cached_fetch_cost(jnp.ones((8,))) == c1
 
 
 def test_time_fn_measures_wall_and_subtracts_fetch():

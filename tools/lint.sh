@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# apex_tpu static-analysis gate: both apex_tpu.analysis engines over the
+# canonical target set, failing on any finding not grandfathered in
+# tests/run_analysis/baseline.json.
+#
+#   bash tools/lint.sh                 # the tier-1 gate (run by
+#                                      # tests/run_analysis/test_repo_selfcheck.py)
+#   bash tools/lint.sh --write-baseline tests/run_analysis/baseline.json
+#
+# Extra args are forwarded to `python -m apex_tpu.analysis` (which
+# ignores --baseline when --write-baseline is given).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# CPU backend + an 8-device virtual mesh, same environment the test
+# suite runs under (tests/conftest.py), so the tp_collectives jaxpr
+# target sees a multi-device mesh without hardware.
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+exec python -m apex_tpu.analysis \
+    --baseline tests/run_analysis/baseline.json \
+    apex_tpu examples tools bench.py "$@"

@@ -84,9 +84,14 @@ def main():
         return params, opt_state, loss
 
     batch = (tokens, targets)
-    # compile + warm outside the trace
+    # compile + warm outside the trace; host-fetch sync (timing.sync)
+    # because block_until_ready is a no-op over the tunnel and the
+    # printed ms/step below would otherwise be dispatch time (the r5
+    # MFU=330 bug class)
+    from apex_tpu.runtime import timing
+
     params, opt_state, loss = train_step(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    fetch = timing.fetch_cost(loss)  # ~79 ms/fetch through the tunnel
     print(f"warm step loss={float(loss):.4f}; tracing {args.steps} steps "
           f"to {args.out}", flush=True)
 
@@ -96,8 +101,8 @@ def main():
             with jax.profiler.StepTraceAnnotation("train", step_num=i):
                 params, opt_state, loss = train_step(params, opt_state,
                                                      batch)
-        jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / args.steps
+        timing.sync(loss)
+    dt = max(time.perf_counter() - t0 - fetch, 1e-9) / args.steps
     print(f"traced: {dt * 1e3:.1f} ms/step  -> {args.out}", flush=True)
     return 0
 

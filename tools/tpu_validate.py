@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# host-fetch sync: block_until_ready is a no-op over the axon tunnel, so
+# the per-check wall times printed by check() would otherwise measure
+# dispatch only (and a compiled-kernel failure could surface later, at
+# the comparison fetch, attributed to the wrong check)
+from apex_tpu.runtime.timing import sync as device_sync
+
 RESULTS = []
 
 
@@ -91,7 +97,7 @@ def flash_fwd(B, S, H, D):
     with pallas_config.force("on"):
         got = jax.jit(lambda q, k, v: flash_attention(
             q, k, v, causal=True))(q, k, v)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda q, k, v: flash_attention(
             q, k, v, causal=True))(q, k, v)
@@ -114,7 +120,7 @@ def flash_bwd(B, S, H, D):
 
     with pallas_config.force("on"):
         got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
-        jax.block_until_ready(got)
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
     for n, a, b in zip("qkv", got, want):
@@ -134,7 +140,7 @@ def flash_varlen(B, S, H, D):
     with pallas_config.force("on"):
         got = jax.jit(lambda q, k, v: flash_attention(
             q, k, v, kv_lens=lens))(q, k, v)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda q, k, v: flash_attention(
             q, k, v, kv_lens=lens))(q, k, v)
@@ -159,7 +165,7 @@ def flash_dropout(B, S, H, D):
     # same counter-based mask on both paths -> grads must agree
     with pallas_config.force("on"):
         got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
-        jax.block_until_ready(got)
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
     for n, a, b in zip("qkv", got, want):
@@ -181,7 +187,7 @@ def layer_norm(rows, hidden):
 
     with pallas_config.force("on"):
         got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(x, w, b)
-        jax.block_until_ready(got)
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(x, w, b)
     # dx: elementwise. dw/db: sums over `rows` of bf16-quantized grads —
@@ -204,7 +210,7 @@ def rms_norm(rows, hidden):
     w = jnp.ones((hidden,), jnp.float32)
     with pallas_config.force("on"):
         got = jax.jit(lambda x: rms(x, w, (hidden,)))(x)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda x: rms(x, w, (hidden,)))(x)
     _close(got, want, name="rms")
@@ -220,7 +226,7 @@ def causal_softmax(bh, S):
     x = jax.random.normal(jax.random.PRNGKey(6), (bh, S, S), jnp.bfloat16)
     with pallas_config.force("on"):
         got = jax.jit(lambda x: causal_sm(x, None, 1.0))(x)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda x: causal_sm(x, None, 1.0))(x)
     _close(got, want, name="causal softmax")
@@ -239,7 +245,7 @@ def masked_softmax(bh, S):
             > 0.8)
     with pallas_config.force("on"):
         got = jax.jit(lambda x: scaled_masked_softmax(x, mask, 0.5))(x)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda x: scaled_masked_softmax(x, mask, 0.5))(x)
     _close(got, want, name="masked softmax")
@@ -262,7 +268,7 @@ def flat_adam(n_params):
                         use_kernel=use_kernel)
         state = tx.init(params)
         updates, _ = jax.jit(tx.update)(grads, state, params)
-        jax.block_until_ready(updates)
+        device_sync(updates)
         return updates
 
     with pallas_config.force("on"):
@@ -282,7 +288,7 @@ def odd_rows(hidden):
     b = jnp.zeros((hidden,), jnp.float32)
     with pallas_config.force("on"):
         got = jax.jit(lambda x: ln(x, w, b, (hidden,)))(x)
-        got.block_until_ready()
+        device_sync(got)
     with pallas_config.force("off"):
         want = jax.jit(lambda x: ln(x, w, b, (hidden,)))(x)
     _close(got, want, name="odd rows")
