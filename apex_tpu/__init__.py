@@ -56,6 +56,7 @@ class RankInfoFormatter(logging.Formatter):
 
 
 from apex_tpu import amp
+from apex_tpu import observability
 from apex_tpu import optimizers
 from apex_tpu import normalization
 from apex_tpu import parallel
@@ -78,6 +79,7 @@ __all__ = [
     "normalization",
     "parallel",
     "multi_tensor_apply",
+    "observability",
     "transformer",
     "fp16_utils",
     "fused_dense",

@@ -131,6 +131,38 @@ class LossScaler:
     def loss_scale(self, state: LossScaleState):
         return state.loss_scale
 
+    # ---- host-side diagnostics (ISSUE 2 satellite) ------------------------
+
+    def overflow_count(self, state: LossScaleState) -> int:
+        """Cumulative overflow/skip count as a host int.
+
+        The in-graph automaton tracks ``state.overflows`` as a traced
+        i32 (zero host syncs per step); this is the sanctioned read-out
+        for logging cadence — one device fetch per CALL, so poll it at
+        report intervals, not per step. Until now the count was only
+        provable via multichip dryrun logs; this makes it first-class.
+        """
+        return int(jax.device_get(state.overflows))
+
+    def report(self, state: LossScaleState, registry=None,
+               prefix: str = "amp") -> dict:
+        """Publish scaler health to a metrics registry (default: the
+        process registry): gauges ``<prefix>/loss_scale``,
+        ``<prefix>/overflow_count``, ``<prefix>/unskipped_steps``.
+        Returns the values as a dict. One host sync per call."""
+        from apex_tpu.observability import get_registry
+
+        host = jax.device_get(state)
+        values = {
+            "loss_scale": float(host.loss_scale),
+            "overflow_count": int(host.overflows),
+            "unskipped_steps": int(host.unskipped),
+        }
+        reg = registry if registry is not None else get_registry()
+        for name, v in values.items():
+            reg.gauge(f"{prefix}/{name}").set(v)
+        return values
+
     # ---- checkpointing (ref apex/amp/frontend.py:state_dict) --------------
 
     def state_dict(self, state: LossScaleState) -> dict:

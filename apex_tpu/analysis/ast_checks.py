@@ -18,6 +18,15 @@ lived. Checks:
 - ``mutable-default`` mutable default argument (list/dict/set): shared
                       across calls; with jit in play, also a cache-key
                       footgun.
+- ``raw-clock``       a direct wall-clock read (``time.perf_counter`` &
+                      co) in library code under ``apex_tpu/`` outside
+                      ``runtime/timing.py`` and ``observability/``: all
+                      timing must flow through the corrected-sync
+                      helpers / the observability Timer, or the next
+                      hand-rolled timer re-introduces the r5 dispatch-
+                      time bug. Driver code (bench.py, tools/,
+                      examples/) may read clocks — sync-timing still
+                      polices HOW it times.
 
 Suppress with ``# apex-lint: disable=<id>`` on (or above) the line.
 """
@@ -30,10 +39,32 @@ import os
 from apex_tpu.analysis.findings import Finding, is_suppressed
 
 AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
-              "mutable-default")
+              "mutable-default", "raw-clock")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
+
+# raw-clock applies only to library code under apex_tpu/; these own the
+# sanctioned clocks (timing.py implements the corrected sync, the
+# observability layer's Timer/StepReporter are built on it).
+_RAW_CLOCK_ALLOW_FILES = {"apex_tpu/runtime/timing.py"}
+_RAW_CLOCK_ALLOW_PREFIXES = ("apex_tpu/observability/",)
+
+
+def _raw_clock_applies(path: str) -> bool:
+    """Is ``path`` (absolute when available — relpaths depend on the
+    caller's cwd/root) library code the raw-clock check governs? True
+    when an ``apex_tpu`` DIRECTORY segment appears in it, minus the
+    allowlisted clock owners (matched from the last such segment, so
+    checkouts living under a directory that happens to be named
+    apex_tpu still resolve correctly)."""
+    norm = path.replace("\\", "/")
+    if "apex_tpu" not in norm.split("/")[:-1]:
+        return False
+    tail = norm[norm.rindex("apex_tpu/"):]
+    if tail in _RAW_CLOCK_ALLOW_FILES:
+        return False
+    return not any(tail.startswith(p) for p in _RAW_CLOCK_ALLOW_PREFIXES)
 
 _CLOCK_CALLS = {("time", "perf_counter"), ("time", "time"),
                 ("time", "monotonic"), ("time", "perf_counter_ns"),
@@ -232,6 +263,13 @@ class _Visitor(ast.NodeVisitor):
                      "default_timer"))
         if is_clock:
             self.frames[-1]["clock"].append(node.lineno)
+            self._emit(
+                "raw-clock", "error", node.lineno,
+                f"direct wall-clock read ('{'.'.join(chain or [tail])}') "
+                f"in apex_tpu library code: time through "
+                f"apex_tpu.runtime.timing (corrected host-fetch sync) or "
+                f"an apex_tpu.observability Timer instead — a bare clock "
+                f"pair measures dispatch, not device time")
 
         if self._in_jit():
             if isinstance(node.func, ast.Name) and \
@@ -271,8 +309,12 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source: str, relpath: str, checks=None):
-    """Lint one file's source text; returns a list of Findings."""
+def lint_source(source: str, relpath: str, checks=None, abspath=None):
+    """Lint one file's source text; returns a list of Findings.
+
+    ``abspath``: the file's absolute path when known (lint_paths passes
+    it) — path-scoped checks like raw-clock must not depend on what cwd
+    the relpath happened to be computed against."""
     checks = set(checks or AST_CHECKS)
     unknown = checks - set(AST_CHECKS)
     if unknown:
@@ -282,6 +324,10 @@ def lint_source(source: str, relpath: str, checks=None):
     if any(norm.endswith(allow.replace("\\", "/"))
            for allow in _SYNC_ALLOWLIST):
         checks = checks - {"sync-timing"}
+    # raw-clock: library code under an apex_tpu/ package dir only, minus
+    # the modules that implement the sanctioned clocks themselves
+    if not _raw_clock_applies(abspath or relpath):
+        checks = checks - {"raw-clock"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:
@@ -329,5 +375,5 @@ def lint_paths(paths, root=None, checks=None):
         rel = os.path.relpath(ap, root) if ap.startswith(root) else fpath
         with open(ap, encoding="utf-8") as f:
             source = f.read()
-        findings.extend(lint_source(source, rel, checks))
+        findings.extend(lint_source(source, rel, checks, abspath=ap))
     return findings

@@ -19,7 +19,7 @@ TARGETS = {}
 # jaxpr_checks.JAXPR_CHECKS ids). The CLI derives --list-checks, check-id
 # validation, and target narrowing from this — register new
 # target-provided checks here, not in cli.py.
-TARGET_CHECKS = ("kernel-auto-provenance",)
+TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 
 
 def target(name):
@@ -162,6 +162,51 @@ def _kernel_auto_provenance():
                     "apex_tpu/ops/pallas_config.py", 0, "_KERNEL_AUTO",
                     problem)
             for problem in pallas_config.validate_kernel_auto_provenance()]
+
+
+@target("step-record-schema")
+def _step_record_schema():
+    """The observability layer's own gate: a StepReporter record built
+    from synthetic inputs must carry every STEP_RECORD_FIELDS key and
+    survive a registry JSONL round-trip — the step-record schema is the
+    evidence format every perf PR reads, so drift fails tier-1 here
+    (ISSUE 2 satellite: the new module is registered and linted like
+    any other entry point; the AST engine covers its sources via the
+    default path set)."""
+    import json as _json
+
+    from apex_tpu.observability.registry import MetricRegistry
+    from apex_tpu.observability.step_report import (
+        STEP_RECORD_FIELDS, StepReporter,
+    )
+
+    findings = []
+
+    def problem(msg):
+        findings.append(Finding(
+            "step-record-schema", "error",
+            "apex_tpu/observability/step_report.py", 0, "StepReporter",
+            msg))
+
+    reg = MetricRegistry()
+    rec = StepReporter("schema_check", registry=reg, tokens_per_step=1024,
+                       flops_per_step=1e12, device_kind="cpu",
+                       peak=1e15).step(0.01, loss=1.0)
+    for field in STEP_RECORD_FIELDS:
+        if field not in rec:
+            problem(f"step record is missing documented field "
+                    f"{field!r}")
+    try:
+        records = reg.to_records()
+        _json.dumps(records)
+    except (TypeError, ValueError) as e:
+        problem(f"registry records are not JSON-serializable: {e}")
+        return findings
+    if not any(r.get("type") == "event" and r.get("name") == "step"
+               for r in records):
+        problem("StepReporter.step did not append a 'step' event to "
+                "the registry")
+    return findings
 
 
 def run_targets(names=None):

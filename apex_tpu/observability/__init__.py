@@ -1,0 +1,68 @@
+"""apex_tpu.observability — unified runtime telemetry (ISSUE 2).
+
+The single layer the whole stack reports through:
+
+- :mod:`~apex_tpu.observability.registry` — thread-safe metrics
+  (counter/gauge/histogram/corrected-sync timer), structured events,
+  JSONL export and the merge/summary reader;
+- :mod:`~apex_tpu.observability.scope` — named trace scopes on both the
+  host (``TraceAnnotation``) and device (``named_scope`` → HLO metadata)
+  timelines, wired into the pipeline/tensor-parallel/DDP/optimizer hot
+  paths;
+- :mod:`~apex_tpu.observability.recompile` — runtime compile/retrace
+  accounting via ``jax.monitoring`` + ``jax_log_compiles``, with a
+  budget guard that fails a run on steady-state retraces;
+- :mod:`~apex_tpu.observability.step_report` — per-training-step
+  records (step time, tokens/s, MFU, loss scale, overflow count);
+- ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
+  summary CLI (also ``tools/metrics_report.py``).
+
+The modules themselves import jax lazily and never force backend init —
+but importing them through the ``apex_tpu`` package still runs the
+parent ``__init__`` (which imports jax). Truly backend-free processes
+(the bench *launcher*) therefore write the JSONL event format inline
+rather than importing this package; the record shape is pinned by
+:func:`~apex_tpu.observability.registry.append_event`.
+"""
+
+from apex_tpu.observability.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    Timer,
+    append_event,
+    get_registry,
+    read_jsonl,
+    set_registry,
+    summarize,
+)
+from apex_tpu.observability.recompile import (  # noqa: F401
+    RecompileListener,
+    RetraceBudgetExceeded,
+    retrace_guard,
+)
+from apex_tpu.observability.recompile import (  # noqa: F401
+    install as install_recompile_listener,
+)
+from apex_tpu.observability.recompile import (  # noqa: F401
+    uninstall as uninstall_recompile_listener,
+)
+from apex_tpu.observability.scope import annotate, scope  # noqa: F401
+from apex_tpu.observability.step_report import (  # noqa: F401
+    STEP_RECORD_FIELDS,
+    StepReporter,
+    peak_flops,
+    transformer_step_flops,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Timer", "MetricRegistry",
+    "get_registry", "set_registry", "read_jsonl", "summarize",
+    "append_event",
+    "RecompileListener", "RetraceBudgetExceeded", "retrace_guard",
+    "install_recompile_listener", "uninstall_recompile_listener",
+    "scope", "annotate",
+    "StepReporter", "STEP_RECORD_FIELDS", "peak_flops",
+    "transformer_step_flops",
+]

@@ -1,0 +1,97 @@
+"""``python -m apex_tpu.observability report <metrics.jsonl> [...]``
+
+Summarize one or more metrics JSONL dumps (bench.py's
+``BENCH_METRICS.jsonl``, a training run's step log): counters sum,
+gauges keep their last value, histogram/timer stats merge exactly,
+events print in order. ``--json`` emits the merged summary as JSON for
+scripting; ``--events`` limits how many event lines print (default 20,
+0 = all).
+
+Exit codes: 0 ok, 1 no records found, 2 bad usage / unreadable file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from apex_tpu.observability.registry import read_jsonl, summarize
+
+
+def _fmt_num(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _render(summary: dict, events_limit: int) -> str:
+    lines = []
+    if summary["counters"]:
+        lines.append("counters:")
+        for name, v in summary["counters"].items():
+            lines.append(f"  {name:48s} {_fmt_num(v)}")
+    if summary["gauges"]:
+        lines.append("gauges:")
+        for name, v in summary["gauges"].items():
+            lines.append(f"  {name:48s} {_fmt_num(v)}")
+    if summary["histograms"]:
+        lines.append("histograms:")
+        for name, h in summary["histograms"].items():
+            parts = [f"n={_fmt_num(h.get('count'))}",
+                     f"mean={_fmt_num(h.get('mean'))}",
+                     f"min={_fmt_num(h.get('min'))}",
+                     f"max={_fmt_num(h.get('max'))}"]
+            for q in ("p50", "p90", "p99"):
+                if h.get(q) is not None:
+                    parts.append(f"{q}={_fmt_num(h[q])}")
+            if h.get("unit"):
+                parts.append(h["unit"])
+            lines.append(f"  {name:48s} " + "  ".join(parts))
+    events = summary["events"]
+    if events:
+        shown = events if events_limit == 0 else events[-events_limit:]
+        lines.append(f"events ({len(events)} total, "
+                     f"showing {len(shown)}):")
+        for ev in shown:
+            fields = ev.get("fields") or {}
+            body = "  ".join(f"{k}={_fmt_num(v) if not isinstance(v, str) else v}"
+                             for k, v in fields.items())
+            lines.append(f"  [{ev.get('name')}] {body}")
+    if summary["parse_errors"]:
+        lines.append(f"({summary['parse_errors']} unparseable line(s) "
+                     f"skipped)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.observability",
+        description="apex_tpu runtime telemetry tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="summarize metrics JSONL dump(s)")
+    rp.add_argument("paths", nargs="+", help="metrics .jsonl file(s)")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the merged summary as JSON")
+    rp.add_argument("--events", type=int, default=20,
+                    help="max event lines to print (0 = all)")
+    args = ap.parse_args(argv)
+
+    records = []
+    for path in args.paths:
+        try:
+            records.extend(read_jsonl(path))
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(_render(summary, args.events))
+    return 0

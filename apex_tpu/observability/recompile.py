@@ -1,0 +1,266 @@
+"""Runtime recompile/retrace accounting (ISSUE 2 tentpole piece 3).
+
+``apex_tpu.analysis`` lints recompile *hazards* statically (unhashable
+static args, closure captures); this module counts what actually
+happened at runtime and turns the count into a budget a bench run can
+fail on. Two feeds, both installed by :func:`install`:
+
+- ``jax.monitoring`` duration events (``/jax/core/compile/*``) give the
+  process-total trace/lower/compile counts and seconds — version-stable,
+  but carry no function names.
+- with ``jax_log_compiles`` enabled, jax logs one
+  ``"Compiling <name> with global shapes..."`` record per cache-miss
+  compile; a logging filter on the emitting loggers parses the name for
+  PER-FUNCTION compile counts (retraces = compiles - 1) and swallows
+  the records so enabling the flag doesn't spray stderr. When jax's
+  logger layout changes the per-function table degrades to empty while
+  the monitoring totals keep working.
+
+Counts also land in a :class:`~apex_tpu.observability.registry
+.MetricRegistry`: counter ``jax/compiles{fn=...}``, histogram
+``jax/backend_compile_secs``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import re
+import threading
+
+from apex_tpu.observability.registry import get_registry
+
+__all__ = [
+    "RecompileListener", "RetraceBudgetExceeded", "install", "uninstall",
+    "current", "retrace_guard",
+]
+
+# jax loggers that emit the per-compile records under jax_log_compiles
+# (jax 0.4.x: pxla logs "Compiling <name> with global shapes and types
+# ...", dispatch logs the "Finished tracing/compilation ..." lines).
+_JAX_LOG_COMPILE_LOGGERS = ("jax._src.interpreters.pxla",
+                            "jax._src.dispatch")
+_COMPILING_RE = re.compile(r"^Compiling ([\w<>.\-]+) ")
+_FINISHED_RE = re.compile(r"^Finished (tracing \+ transforming|"
+                          r"jaxpr to MLIR module conversion|"
+                          r"XLA compilation)")
+
+# monitoring event names (jax 0.4.37 _src/dispatch.py)
+_EV_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_EV_LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_EV_COMPILE = "/jax/core/compile/backend_compile_duration"
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """A guarded region retraced more than its budget allows."""
+
+
+class RecompileListener:
+    """Aggregates compile activity while installed; see module doc."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self.compiles_by_fn = collections.Counter()
+        self.totals = collections.Counter()      # event name -> count
+        self.seconds = collections.defaultdict(float)
+
+    # ---- feed: jax.monitoring duration events
+
+    def _on_duration(self, name: str, secs: float) -> None:
+        if not name.startswith("/jax/core/compile/"):
+            return
+        with self._lock:
+            self.totals[name] += 1
+            self.seconds[name] += secs
+        if self.registry is not None and name == _EV_COMPILE:
+            self.registry.histogram("jax/backend_compile_secs").observe(secs)
+
+    # ---- feed: jax_log_compiles records
+
+    def _on_compile_record(self, fn_name: str) -> None:
+        with self._lock:
+            self.compiles_by_fn[fn_name] += 1
+        if self.registry is not None:
+            self.registry.counter("jax/compiles", fn=fn_name).inc()
+
+    # ---- read side
+
+    def compiles(self, fn: "str | None" = None):
+        """Per-function compile counts (dict), or one function's count."""
+        with self._lock:
+            if fn is not None:
+                return self.compiles_by_fn.get(fn, 0)
+            return dict(self.compiles_by_fn)
+
+    def retraces(self, fn: "str | None" = None):
+        """Compiles beyond the first per function — the recompiles a
+        steady-state training loop should never see."""
+        with self._lock:
+            table = {name: n - 1 for name, n in self.compiles_by_fn.items()
+                     if n > 1}
+            if fn is not None:
+                return table.get(fn, 0)
+            return table
+
+    def total_retraces(self) -> int:
+        return sum(self.retraces().values())
+
+    def backend_compiles(self) -> int:
+        """Process-total backend compiles from jax.monitoring (includes
+        jax-internal helper jits the per-function table may not name)."""
+        with self._lock:
+            return self.totals[_EV_COMPILE]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles_by_fn": dict(self.compiles_by_fn),
+                "retraces_by_fn": {n: c - 1 for n, c in
+                                   self.compiles_by_fn.items() if c > 1},
+                "backend_compiles": self.totals[_EV_COMPILE],
+                "backend_compile_secs": round(
+                    self.seconds[_EV_COMPILE], 3),
+                "trace_events": self.totals[_EV_TRACE],
+            }
+
+
+class _CompileLogFilter(logging.Filter):
+    """Captures per-function compile records; swallows the log spam we
+    induced by enabling jax_log_compiles (records pass through untouched
+    when the user had the flag on themselves)."""
+
+    def __init__(self, state):
+        super().__init__()
+        self._state = state
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — never break logging
+            return True
+        m = _COMPILING_RE.match(msg)
+        if m and self._state.listener is not None:
+            self._state.listener._on_compile_record(m.group(1))
+        if self._state.we_enabled_flag and (m or _FINISHED_RE.match(msg)):
+            return False
+        return True
+
+
+class _State:
+    def __init__(self):
+        self.listener: "RecompileListener | None" = None
+        self.monitoring_registered = False
+        self.filters: list = []
+        self.we_enabled_flag = False
+        self.lock = threading.Lock()
+
+
+_STATE = _State()
+
+
+def _monitoring_callback(name, secs, **_kw):
+    listener = _STATE.listener
+    if listener is not None:
+        listener._on_duration(name, secs)
+
+
+def install(registry=None) -> RecompileListener:
+    """Install (or return the already-installed) process listener.
+
+    Idempotent: repeated calls return the same listener (updating its
+    registry only if one is passed). ``jax.monitoring`` has no
+    single-listener unregister, so the monitoring hook is registered
+    once per process and routed through the module state — after
+    :func:`uninstall` it goes inert rather than away.
+    """
+    import jax
+
+    with _STATE.lock:
+        if _STATE.listener is not None:
+            if registry is not None:
+                _STATE.listener.registry = registry
+            return _STATE.listener
+        listener = RecompileListener(
+            registry if registry is not None else get_registry())
+        if not _STATE.monitoring_registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                _monitoring_callback)
+            _STATE.monitoring_registered = True
+        _STATE.we_enabled_flag = not jax.config.jax_log_compiles
+        if _STATE.we_enabled_flag:
+            jax.config.update("jax_log_compiles", True)
+        for lname in _JAX_LOG_COMPILE_LOGGERS:
+            filt = _CompileLogFilter(_STATE)
+            logging.getLogger(lname).addFilter(filt)
+            _STATE.filters.append((lname, filt))
+        _STATE.listener = listener
+        return listener
+
+
+def uninstall() -> None:
+    """Detach the log filters, restore jax_log_compiles, and deactivate
+    the monitoring hook. Counts on the returned-by-install listener stop
+    growing but remain readable."""
+    import jax
+
+    with _STATE.lock:
+        if _STATE.listener is None:
+            return
+        for lname, filt in _STATE.filters:
+            logging.getLogger(lname).removeFilter(filt)
+        _STATE.filters.clear()
+        if _STATE.we_enabled_flag:
+            jax.config.update("jax_log_compiles", False)
+        _STATE.we_enabled_flag = False
+        _STATE.listener = None
+
+
+def current() -> "RecompileListener | None":
+    return _STATE.listener
+
+
+@contextlib.contextmanager
+def retrace_guard(budget: int = 0, registry=None, fns=None):
+    """Fail a region that retraces more than ``budget`` times.
+
+    The runtime teeth behind the analysis subsystem's static
+    "recompile hazard" lint: wrap a bench/training loop and any
+    steady-state retrace beyond the budget raises
+    :class:`RetraceBudgetExceeded` naming the offending functions.
+    First-compiles are free — only compiles of a function already
+    compiled once inside OR before the region count.
+
+        with retrace_guard(budget=0):
+            for batch in data:
+                train_step(params, batch)   # must not retrace
+
+    ``fns``: optional iterable of jitted-function names to watch; other
+    names are ignored. Use it when the region also BUILDS inputs —
+    jax's internal helper jits (``broadcast_in_dim``, ...) recompile per
+    fresh shape and would otherwise spend the budget on noise.
+    """
+    listener = install(registry=registry)
+    watch = None if fns is None else set(fns)
+    before = listener.compiles()
+    yield listener
+    after = listener.compiles()
+    retraced = {}
+    for fn_name, n in after.items():
+        if watch is not None and fn_name not in watch:
+            continue
+        prior = before.get(fn_name, 0)
+        # compiles in-region beyond the function's first-ever compile
+        in_region = n - prior
+        free = 1 if prior == 0 else 0
+        if in_region - free > 0:
+            retraced[fn_name] = in_region - free
+    total = sum(retraced.values())
+    if registry is not None or listener.registry is not None:
+        reg = registry if registry is not None else listener.registry
+        reg.counter("jax/guarded_retraces").inc(total)
+    if total > budget:
+        raise RetraceBudgetExceeded(
+            f"{total} retrace(s) exceed budget {budget}: " + ", ".join(
+                f"{name} x{n}" for name, n in sorted(retraced.items())))

@@ -78,6 +78,8 @@ def fused_adam(
             lr=lr_t, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
             adam_w_mode=adam_w_mode, step=step, bias_correction=bias_correction,
         )
+        from apex_tpu.observability import get_registry, scope
+
         if flat:
             from apex_tpu.ops import pallas_config
 
@@ -91,46 +93,64 @@ def fused_adam(
             # both and flips the table if on-chip numbers ever disagree.
             kernel_on = (use_kernel if use_kernel is not None
                          else pallas_config.use_pallas("flat_adam"))
-            # Group by *param* dtype; grads may arrive in a different dtype
-            # (e.g. fp32 grads over bf16 params) and are packed fp32 anyway.
-            pbufs, meta = flatten_tree(params)
-            _, _, specs = meta
-            g_leaves = jax.tree_util.tree_leaves(grads)
-            deltas, mu, nu = {}, {}, {}
-            for k, (idxs, spec) in specs.items():
-                gbuf = jnp.concatenate(
-                    [g_leaves[i].ravel().astype(jnp.float32) for i in idxs])
-                if kernel_on:
-                    from apex_tpu.ops.fused_adam_kernel import (
-                        adam_flat_pallas,
-                    )
+            # the _KERNEL_AUTO outcome, observable: the counter ticks
+            # once per TRACE of this update (not per step — eval_shape
+            # and cond-branch traces count too), and the scope names the
+            # ops so an on-silicon trace attributes kernel time to
+            # flat/pallas vs flat/xla — the per-kernel race table's
+            # missing evidence
+            path = "pallas" if kernel_on else "xla"
+            get_registry().counter("optimizer/fused_adam/dispatch",
+                                   path=f"flat_{path}").inc()
+            with scope(f"fused_adam/flat/{path}"):
+                # Group by *param* dtype; grads may arrive in a different
+                # dtype (e.g. fp32 grads over bf16 params) and are packed
+                # fp32 anyway.
+                pbufs, meta = flatten_tree(params)
+                _, _, specs = meta
+                g_leaves = jax.tree_util.tree_leaves(grads)
+                deltas, mu, nu = {}, {}, {}
+                for k, (idxs, spec) in specs.items():
+                    gbuf = jnp.concatenate(
+                        [g_leaves[i].ravel().astype(jnp.float32)
+                         for i in idxs])
+                    if kernel_on:
+                        from apex_tpu.ops.fused_adam_kernel import (
+                            adam_flat_pallas,
+                        )
 
-                    d, m, v = adam_flat_pallas(
-                        gbuf, pbufs[k], state.mu[k], state.nu[k],
-                        jnp.asarray(lr_t, jnp.float32), step,
-                        b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
-                        adam_w_mode=adam_w_mode,
-                        bias_correction=bias_correction,
-                        interpret=pallas_config.interpret())
-                else:
-                    d, m, v = _math.adam_step(
-                        gbuf, pbufs[k], state.mu[k], state.nu[k], **kw)
-                deltas[k] = d.astype(spec.dtype)
-                mu[k], nu[k] = m, v
-            updates = unflatten_tree(deltas, meta)
+                        d, m, v = adam_flat_pallas(
+                            gbuf, pbufs[k], state.mu[k], state.nu[k],
+                            jnp.asarray(lr_t, jnp.float32), step,
+                            b1=b1, b2=b2, eps=eps,
+                            weight_decay=weight_decay,
+                            adam_w_mode=adam_w_mode,
+                            bias_correction=bias_correction,
+                            interpret=pallas_config.interpret())
+                    else:
+                        d, m, v = _math.adam_step(
+                            gbuf, pbufs[k], state.mu[k], state.nu[k], **kw)
+                    deltas[k] = d.astype(spec.dtype)
+                    mu[k], nu[k] = m, v
+                updates = unflatten_tree(deltas, meta)
         else:
-            g_leaves, treedef = jax.tree_util.tree_flatten(grads)
-            p_leaves = jax.tree_util.tree_leaves(params)
-            m_leaves = jax.tree_util.tree_leaves(state.mu)
-            v_leaves = jax.tree_util.tree_leaves(state.nu)
-            results = [
-                _math.adam_step(g, p, m, v, **kw)
-                for g, p, m, v in zip(g_leaves, p_leaves, m_leaves, v_leaves)
-            ]
-            updates = treedef.unflatten(
-                [r[0].astype(p.dtype) for r, p in zip(results, p_leaves)])
-            mu = treedef.unflatten([r[1] for r in results])
-            nu = treedef.unflatten([r[2] for r in results])
+            get_registry().counter("optimizer/fused_adam/dispatch",
+                                   path="tree").inc()
+            with scope("fused_adam/tree"):
+                g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+                p_leaves = jax.tree_util.tree_leaves(params)
+                m_leaves = jax.tree_util.tree_leaves(state.mu)
+                v_leaves = jax.tree_util.tree_leaves(state.nu)
+                results = [
+                    _math.adam_step(g, p, m, v, **kw)
+                    for g, p, m, v in zip(g_leaves, p_leaves, m_leaves,
+                                          v_leaves)
+                ]
+                updates = treedef.unflatten(
+                    [r[0].astype(p.dtype)
+                     for r, p in zip(results, p_leaves)])
+                mu = treedef.unflatten([r[1] for r in results])
+                nu = treedef.unflatten([r[2] for r in results])
         return updates, FusedAdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init, update)

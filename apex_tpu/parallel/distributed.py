@@ -46,6 +46,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import scope
 from apex_tpu.ops.flat import flatten_tree, unflatten_tree
 
 
@@ -67,7 +68,8 @@ def sync_gradients(grads, axis_name: str = "data", gradient_average: bool = True
             g = g * (gradient_predivide_factor / n)
         return g
 
-    return jax.tree_util.tree_map(reduce_leaf, grads)
+    with scope("ddp/allreduce"):
+        return jax.tree_util.tree_map(reduce_leaf, grads)
 
 
 def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool = True):
@@ -76,15 +78,17 @@ def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool =
     The explicit analog of the reference's flat NCCL buckets
     (ref apex/parallel/distributed.py:flat_dist_call).
     """
-    bufs, meta = flatten_tree(grads)
-    reduced = {}
-    for k, buf in bufs.items():
-        r = jax.lax.psum(buf, axis_name)
-        if gradient_average:
-            n = jax.lax.psum(jnp.ones((), buf.dtype), axis_name)
-            r = r / n
-        reduced[k] = r
-    return unflatten_tree(reduced, meta)
+    with scope("ddp/allreduce_flat"):
+        bufs, meta = flatten_tree(grads)
+        reduced = {}
+        for k, buf in bufs.items():
+            with scope(f"ddp/bucket/{k}"):
+                r = jax.lax.psum(buf, axis_name)
+                if gradient_average:
+                    n = jax.lax.psum(jnp.ones((), buf.dtype), axis_name)
+                    r = r / n
+            reduced[k] = r
+        return unflatten_tree(reduced, meta)
 
 
 def sync_gradients_bucketed(grads, axis_name: str = "data",
@@ -121,10 +125,11 @@ def sync_gradients_bucketed(grads, axis_name: str = "data",
         n_buckets = max(bucket_ids) + 1 if bucket_ids else 0
         for b in range(n_buckets):
             members = [i for i, bid in zip(idxs, bucket_ids) if bid == b]
-            flat = jnp.concatenate([leaves[i].ravel() for i in members])
-            red = jax.lax.psum(flat, axis_name)
-            if gradient_average:
-                red = red / jnp.asarray(n, red.dtype)
+            with scope(f"ddp/bucket{b}/{dt}"):
+                flat = jnp.concatenate([leaves[i].ravel() for i in members])
+                red = jax.lax.psum(flat, axis_name)
+                if gradient_average:
+                    red = red / jnp.asarray(n, red.dtype)
             off = 0
             for i in members:
                 sz = leaves[i].size

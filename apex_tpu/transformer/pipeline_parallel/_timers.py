@@ -1,92 +1,89 @@
 """Named phase timers (ref apex/transformer/pipeline_parallel/_timers.py).
 
-The reference's ``_Timer`` calls ``torch.cuda.synchronize()`` around each
-start/stop so wall-clock brackets the device work. The TPU analog has no
-global sync primitive — async dispatch means a bare ``time.time()`` pair
-measures dispatch, not execution — so :meth:`_Timer.stop` accepts the
-step's output and calls ``jax.block_until_ready`` on it, and each running
-timer opens a ``jax.profiler.TraceAnnotation`` so the phases also show up
-named in a profiler trace (the nvtx analog the reference pairs with
-pyprof).
+Since ISSUE 2 this is a thin adapter over the shared telemetry layer:
+the actual timing lives in :class:`apex_tpu.observability.Timer`
+(corrected host-fetch sync via ``runtime.timing`` — the reference's
+``torch.cuda.synchronize`` analog, minus the tunnel-no-op
+``block_until_ready`` trap — plus a ``timer/<name>`` trace scope, the
+nvtx analog the reference pairs with pyprof). What remains here is the
+reference-shaped ``Timers.write/log`` API, and the timers register in
+the process :class:`~apex_tpu.observability.MetricRegistry` so pipeline
+phase times ride the same JSONL export as every other metric.
 
 Usage (identical shape to the reference):
 
     timers = Timers()
     timers("forward").start()
     out = step(batch)
-    timers("forward").stop(out)        # blocks on out, records elapsed
+    timers("forward").stop(out)        # syncs out, records elapsed
     timers.log(["forward"], normalizer=n_iters)
 """
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
-import jax
+from apex_tpu.observability import MetricRegistry, Timer, get_registry
 
 
 class _Timer:
-    """One named timer (ref _timers.py:6)."""
+    """One named timer (ref _timers.py:6) — adapter over
+    ``observability.Timer`` preserving the reference's accumulate /
+    elapsed(reset) contract.
 
-    def __init__(self, name: str):
+    Start/stop/accumulate state is PER INSTANCE (a private Timer, like
+    the reference's per-``Timers``-group ``_Timer`` objects — two groups
+    must never see each other's running flag), while every recorded
+    interval is also observed into the shared registry metric
+    ``pp_phase/<name>`` so phase times ride the process JSONL export.
+    """
+
+    def __init__(self, name: str, registry: Optional[MetricRegistry] = None):
         self.name_ = name
-        self.elapsed_ = 0.0
-        self.started_ = False
-        self.start_time = time.time()
-        self._annotation = None
+        reg = registry if registry is not None else get_registry()
+        self._timer = Timer(f"pp_phase/{name}", {})   # private state
+        self._sink = reg.timer(f"pp_phase/{name}")    # shared metric
+
+    @property
+    def started_(self) -> bool:
+        return self._timer.running
+
+    @property
+    def elapsed_(self) -> float:
+        return self._timer.total_elapsed
 
     def start(self):
-        if self.started_:
+        if self._timer.running:
             raise RuntimeError("timer has already been started")
-        self._annotation = jax.profiler.TraceAnnotation(
-            f"timer/{self.name_}")
-        self._annotation.__enter__()
-        self.start_time = time.time()
-        self.started_ = True
+        self._timer.start()
 
     def stop(self, block_on=None):
         """``block_on``: pytree of device values produced by the timed
-        region — synced so the elapsed time covers device execution
-        (the reference's cuda.synchronize analog). Omit for host-only
-        regions. Host-fetch sync rather than block_until_ready: the
-        latter is a no-op over the axon tunnel (the r5 MFU=330 bug),
-        which would turn every phase timing into dispatch time."""
-        if not self.started_:
+        region — synced (host fetch, fetch-constant subtracted) so the
+        elapsed time covers device execution. Omit for host-only
+        regions."""
+        if not self._timer.running:
             raise RuntimeError("timer is not started")
-        overhead = 0.0
-        if block_on is not None:
-            from apex_tpu.runtime import timing
-            timing.sync(block_on)
-            now = time.time()
-            # the sync's own host-fetch RTT (~79 ms over the tunnel)
-            # must not count as phase time; the constant is measured
-            # once per process and subtracted
-            overhead = timing.cached_fetch_cost(block_on)
-        else:
-            now = time.time()
-        self.elapsed_ += max(now - self.start_time - overhead, 0.0)
-        self.started_ = False
-        if self._annotation is not None:
-            self._annotation.__exit__(None, None, None)
-            self._annotation = None
+        self._sink.observe(self._timer.stop(block_on))
 
     def reset(self):
-        self.elapsed_ = 0.0
-        self.started_ = False
-        if self._annotation is not None:
-            # a running timer's profiler range must close or the trace
+        if self._timer.running:
+            # a running timer's profiler scope must close or the trace
             # nesting stays unbalanced for the rest of the process
-            self._annotation.__exit__(None, None, None)
-            self._annotation = None
+            self._timer.cancel()
+        self._timer.reset_total()
 
     def elapsed(self, reset: bool = True) -> float:
-        started = self.started_
+        started = self._timer.running
         if started:
-            self.stop()
-        elapsed = self.elapsed_
+            # split the PRIVATE accumulator only: a poll (write/log on a
+            # running timer, reference semantics) is not a completed
+            # phase, so the shared pp_phase histogram must not record
+            # the fragment — only real stop() calls feed the sink
+            self._timer.stop()
+        elapsed = self._timer.total_elapsed
         if reset:
-            self.reset()
+            self._timer.reset_total()
         if started:
             self.start()
         return elapsed
@@ -95,12 +92,13 @@ class _Timer:
 class Timers:
     """Group of named timers (ref _timers.py:51 _Timers)."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricRegistry] = None):
         self.timers = {}
+        self._registry = registry
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            self.timers[name] = _Timer(name, self._registry)
         return self.timers[name]
 
     def write(self, names, writer, iteration, normalizer: float = 1.0,

@@ -27,6 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import scope
 from apex_tpu.transformer import parallel_state
 
 
@@ -109,7 +110,8 @@ def copy_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return _to_varying(x, axis)
+    with scope("tp/copy"):
+        return _to_varying(x, axis)
 
 
 def reduce_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
@@ -117,7 +119,8 @@ def reduce_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.psum(x, axis)
+    with scope("tp/allreduce"):
+        return jax.lax.psum(x, axis)
 
 
 def scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
@@ -128,8 +131,10 @@ def scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     chunk = x.shape[-1] // n
-    x = _to_varying(x, axis)
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=x.ndim - 1)
+    with scope("tp/scatter"):
+        x = _to_varying(x, axis)
+        return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
+                                            axis=x.ndim - 1)
 
 
 def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
@@ -137,7 +142,8 @@ def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+    with scope("tp/all_gather"):
+        return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
 # --------------------------------------------------- sequence-parallel duals
@@ -155,8 +161,10 @@ def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None,
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     chunk = x.shape[seq_dim] // n
-    x = _to_varying(x, axis)
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=seq_dim)
+    with scope("sp/scatter"):
+        x = _to_varying(x, axis)
+        return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
+                                            axis=seq_dim)
 
 
 def gather_from_sequence_parallel_region(x, axis_name: Optional[str] = None,
@@ -164,7 +172,8 @@ def gather_from_sequence_parallel_region(x, axis_name: Optional[str] = None,
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.all_gather(x, axis, axis=seq_dim, tiled=True)
+    with scope("sp/all_gather"):
+        return jax.lax.all_gather(x, axis, axis=seq_dim, tiled=True)
 
 
 def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None,
@@ -173,4 +182,6 @@ def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = Non
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=seq_dim, tiled=True)
+    with scope("sp/reduce_scatter"):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=seq_dim,
+                                    tiled=True)

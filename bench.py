@@ -29,23 +29,24 @@ import time
 
 TARGET_SPEEDUP = 3.0  # reference north star: fused >= 3x eager
 
-# bf16 peak FLOP/s per chip by device generation (public figures).
-_PEAK_FLOPS = (
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    """Peak bf16 FLOP/s by device generation — the table lives in
+    apex_tpu.observability.step_report (single source of truth for
+    bench, StepReporter MFU, and the examples). Lazy import: the
+    launcher half of this file stays backend-free."""
+    from apex_tpu.observability.step_report import peak_flops
+    return peak_flops(device_kind)
+
+
+def _metrics_path() -> str:
+    """Where this run's metrics JSONL lands (APEX_TPU_METRICS overrides;
+    default: BENCH_METRICS.jsonl next to bench.py). Summarize with
+    ``python -m apex_tpu.observability report <path>``."""
+    return os.environ.get(
+        "APEX_TPU_METRICS",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_METRICS.jsonl"))
 
 
 # ---------------------------------------------------------------------------
@@ -168,6 +169,22 @@ def bench_fused_adam(cpu_mode, extras):
     extras["flat_fused_step_ms"] = round(flat_t * 1e3, 3)
     print(f"fused: tree {tree_t * 1e3:.3f} / flat {flat_t * 1e3:.3f} ms/step",
           file=sys.stderr)
+
+    # the race verdict as telemetry: which one-dispatch design won, and
+    # (via fused_adam's trace-time dispatch counter, already in the
+    # registry) whether flat took the Pallas kernel or the XLA chain —
+    # the acceptance criterion's "kernel-dispatch choice" record
+    from apex_tpu import observability as obs
+
+    choice = "flat" if flat_t < tree_t else "tree"
+    extras["fused_adam_dispatch_choice"] = choice
+    reg = obs.get_registry()
+    reg.gauge("optimizer/fused_adam/choice").set(choice)
+    reg.event("kernel_dispatch", component="fused_adam", choice=choice,
+              tree_ms=round(tree_t * 1e3, 3),
+              flat_ms=round(flat_t * 1e3, 3))
+    obs.StepReporter("fused_adam", registry=reg).step(
+        fused_t, choice=choice)
 
     # eager analog of the reference's baseline (unfused torch.optim.Adam:
     # one kernel per OP per tensor): op-by-op jax dispatch, no jit
@@ -358,23 +375,25 @@ def bench_llama(extras):
         except Exception as e:  # noqa: BLE001
             record_failure(e, remat, B, chunks, tag="upgrade ")
 
-    # fwd+bwd FLOPs/token ~ 6N + 12*L*h*S (PaLM appendix accounting)
-    flops = B_used * S * (6 * n_params
-                          + 12 * cfg.num_layers * cfg.hidden_size * S)
+    # throughput/MFU derivation via StepReporter: the PaLM-appendix
+    # accounting and the MFU>1 sanity trap (the r5 MFU=330 bug) live in
+    # apex_tpu.observability.step_report now; the extras keys keep their
+    # names for the driver's JSON-line contract
+    from apex_tpu import observability as obs
+
+    flops = obs.transformer_step_flops(
+        n_params, cfg.num_layers, cfg.hidden_size, S, B_used)
     kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
+    rec = obs.StepReporter(
+        "llama_0p9b", tokens_per_step=B_used * S,
+        flops_per_step=flops).step(step_t)
     extras["llama_0p9b_step_ms"] = round(step_t * 1e3, 2)
-    extras["llama_tokens_per_sec"] = round(B_used * S / step_t)
-    extras["llama_tflops_per_sec"] = round(flops / step_t / 1e12, 1)
-    if peak:
-        mfu = flops / step_t / peak
-        extras["llama_mfu"] = round(mfu, 3)
-        if mfu > 1.0:
-            # r5 first TPU run reported 330 "MFU" because
-            # block_until_ready is a no-op over the tunnel; never let an
-            # impossible number pass as a result again
-            extras["llama_mfu_suspect"] = (
-                "MFU>1 is impossible: timing failed to sync the device")
+    extras["llama_tokens_per_sec"] = round(rec["tokens_per_sec"])
+    extras["llama_tflops_per_sec"] = round(rec["tflops_per_sec"], 1)
+    if rec["mfu"] is not None:
+        extras["llama_mfu"] = round(rec["mfu"], 3)
+        if "mfu_suspect" in rec:
+            extras["llama_mfu_suspect"] = rec["mfu_suspect"]
     extras["device_kind"] = kind
     print(f"llama: {step_t*1e3:.1f} ms/step  "
           f"{flops/step_t/1e12:.1f} TF/s on {kind}", file=sys.stderr)
@@ -416,8 +435,11 @@ def bench_resnet(extras):
     step_t = time_train_step(
         train_step, (params, batch_stats, opt_state), (x, labels),
         iters=30)
+    from apex_tpu import observability as obs
+
+    rec = obs.StepReporter("resnet50", tokens_per_step=B).step(step_t)
     extras["resnet50_step_ms"] = round(step_t * 1e3, 2)
-    extras["resnet50_images_per_sec"] = round(B / step_t)
+    extras["resnet50_images_per_sec"] = round(rec["tokens_per_sec"])
     print(f"resnet50: {step_t*1e3:.1f} ms/step  {B/step_t:.0f} im/s",
           file=sys.stderr)
 
@@ -451,8 +473,11 @@ def bench_bert(extras):
         return params, opt_state, loss
 
     step_t = time_train_step(train_step, (params, opt_state), (batch,))
+    from apex_tpu import observability as obs
+
+    rec = obs.StepReporter("bert_base_lamb", tokens_per_step=B).step(step_t)
     extras["bert_base_lamb_step_ms"] = round(step_t * 1e3, 2)
-    extras["bert_base_seq_per_sec"] = round(B / step_t, 1)
+    extras["bert_base_seq_per_sec"] = round(rec["tokens_per_sec"], 1)
     print(f"bert-base lamb: {step_t*1e3:.1f} ms/step  "
           f"{B/step_t:.1f} seq/s", file=sys.stderr)
 
@@ -487,15 +512,18 @@ def bench_gpt2(extras):
 
     step_t = time_train_step(train_step, (params, opt_state),
                              ((tokens, targets),))
-    extras["gpt2_345m_step_ms"] = round(step_t * 1e3, 2)
-    extras["gpt2_345m_tokens_per_sec"] = round(B * S / step_t)
-    kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
+    from apex_tpu import observability as obs
+
     # same PaLM accounting as bench_llama: 6N + attention's 12·L·h·S
-    flops = B * S * (6 * n_params
-                     + 12 * cfg.num_layers * cfg.hidden_size * S)
-    if peak:
-        extras["gpt2_345m_mfu"] = round(flops / step_t / peak, 3)
+    flops = obs.transformer_step_flops(
+        n_params, cfg.num_layers, cfg.hidden_size, S, B)
+    rec = obs.StepReporter(
+        "gpt2_345m", tokens_per_step=B * S,
+        flops_per_step=flops).step(step_t)
+    extras["gpt2_345m_step_ms"] = round(step_t * 1e3, 2)
+    extras["gpt2_345m_tokens_per_sec"] = round(rec["tokens_per_sec"])
+    if rec["mfu"] is not None:
+        extras["gpt2_345m_mfu"] = round(rec["mfu"], 3)
     print(f"gpt2-345m: {step_t*1e3:.1f} ms/step  "
           f"{B*S/step_t:.0f} tok/s", file=sys.stderr)
 
@@ -736,11 +764,57 @@ def worker():
     print(f"platform: {platform} x{jax.device_count()} "
           f"({jax.devices()[0].device_kind})", file=sys.stderr)
 
+    # runtime telemetry (ISSUE 2): every bench reports through the
+    # process registry; compile/retrace counts come from the
+    # jax.monitoring listener; the whole run dumps to a metrics JSONL
+    # (summarize: python -m apex_tpu.observability report <path>)
+    from apex_tpu import observability as obs
+
+    listener = obs.install_recompile_listener()
+    reg = obs.get_registry()
+    reg.event("bench_start", platform=platform,
+              device_count=jax.device_count(),
+              device_kind=jax.devices()[0].device_kind,
+              backend_init_s=round(init_s, 1))
+
     extras = {"platform": platform, "backend_init_s": round(init_s, 1)}
     speedup, fused_ms = bench_fused_adam(cpu_mode, extras)
     extras["fused_adam_step_ms"] = round(fused_ms * 1e3, 3)
 
+    def finalize_metrics():
+        """Fold recompile counts into extras and (re)write the metrics
+        JSONL — called before EVERY emit so even a timed-out worker
+        leaves a readable dump on disk."""
+        snap = listener.snapshot()
+        retraces = sum(snap["retraces_by_fn"].values())
+        extras["recompiles"] = snap["backend_compiles"]
+        extras["retraces"] = retraces
+        reg.gauge("jax/retraces_total").set(retraces)
+        budget = os.environ.get("APEX_TPU_RETRACE_BUDGET")
+        if budget:
+            try:
+                budget_n = int(budget)
+            except ValueError:
+                # a malformed budget must not cost the JSON line
+                extras["retrace_budget_invalid"] = budget[:40]
+                budget_n = None
+            if budget_n is not None and retraces > budget_n:
+                # record the violation rather than killing the worker:
+                # the bench's JSON-line contract must always land;
+                # consumers and CI gates read this field / event
+                extras["retrace_budget_exceeded"] = (
+                    f"{retraces} retraces > budget {budget_n}")
+                reg.event("retrace_budget_exceeded", retraces=retraces,
+                          budget=budget_n,
+                          by_fn=snap["retraces_by_fn"])
+        try:
+            reg.dump(_metrics_path())
+            extras["metrics_jsonl"] = os.path.basename(_metrics_path())
+        except OSError as e:
+            extras["metrics_jsonl_error"] = repr(e)[:120]
+
     def emit():
+        finalize_metrics()
         print(json.dumps({
             "metric": "fused_adam_speedup_vs_eager",
             "value": round(speedup, 2),
@@ -932,6 +1006,20 @@ def launcher():
     if line is not None:
         parsed = json.loads(line)
         parsed["tpu_init_error"] = "; ".join(errors)[-600:]
+        # the same failure as a structured event in the metrics JSONL
+        # (the CPU worker just wrote it) — machine-readable where the
+        # string field above is for humans. Written inline (the format
+        # of observability.append_event) rather than imported: pulling
+        # apex_tpu into the launcher would drag the whole jax stack
+        # into the one process this file keeps backend-free.
+        try:
+            with open(_metrics_path(), "a") as f:
+                f.write(json.dumps(
+                    {"type": "event", "name": "tpu_init_error", "seq": -1,
+                     "fields": {"attempts": len(errors),
+                                "errors": errors}}) + "\n")
+        except OSError as e:
+            print(f"metrics event append failed: {e!r}", file=sys.stderr)
         # a CPU fallback does NOT mean there are no TPU numbers: the
         # relay hunter persists any on-chip capture the moment it lands —
         # point readers of this JSON at the newest one and whichever

@@ -188,6 +188,14 @@ def main():
                 if resume_exhausted(start_it, args.steps):
                     return
 
+        # per-step telemetry through the shared layer: structured step
+        # records (step time, tokens/s, loss) land in the process
+        # registry; APEX_TPU_METRICS=<path> dumps the run as JSONL for
+        # `python -m apex_tpu.observability report`
+        from apex_tpu import observability as obs
+
+        reporter = obs.StepReporter("llama_train",
+                                    tokens_per_step=M * mb * dp * s)
         key = jax.random.PRNGKey(1)
         first = None
         fixed = None
@@ -203,11 +211,13 @@ def main():
             t0 = time.perf_counter()
             stage_params, io_params, opt_state, loss = step(
                 stage_params, io_params, opt_state, tokens, targets)
-            loss = float(loss)
+            loss = float(loss)  # host pull: syncs the whole step chain
+            rec = reporter.step(time.perf_counter() - t0, loss=loss)
             if first is None:
                 first = loss
             print(f"step {it:3d}  loss {loss:.4f}  "
-                  f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+                  f"({rec['step_time_ms']:.0f} ms  "
+                  f"{rec['tokens_per_sec']:.0f} tok/s)")
             if manager is not None and (it % args.save_every == 0
                                         or it == args.steps - 1):
                 manager.save(it, {"stage": stage_params, "io": io_params,
@@ -217,6 +227,11 @@ def main():
     print(f"mesh pp={pp} dp={dp} tp={tp} sp={sp}: "
           f"loss {first:.4f} -> {loss:.4f} "
           f"({'decreased' if loss < first else 'NOT decreased'})")
+    import os
+
+    if os.environ.get("APEX_TPU_METRICS"):
+        obs.get_registry().dump(os.environ["APEX_TPU_METRICS"])
+        print(f"metrics -> {os.environ['APEX_TPU_METRICS']}")
 
 
 if __name__ == "__main__":

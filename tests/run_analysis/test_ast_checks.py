@@ -331,6 +331,91 @@ def accumulate(x, history=None, n=3, name="adam"):
     assert not _lint(src)
 
 
+# --------------------------------------------------------------- raw-clock
+
+_CLOCK_SRC = """
+import time
+
+def measure(fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    return time.perf_counter() - t0
+"""
+
+
+def test_raw_clock_flagged_in_library_code():
+    found = _by_check(
+        lint_source(_CLOCK_SRC, "apex_tpu/models/llama.py"), "raw-clock")
+    assert len(found) == 2
+    assert "runtime.timing" in found[0].message
+
+
+def test_raw_clock_aliased_import_still_flagged():
+    src = """
+from time import perf_counter as clock
+
+def measure():
+    return clock()
+"""
+    assert _by_check(lint_source(src, "apex_tpu/mlp.py"), "raw-clock")
+
+
+def test_raw_clock_not_applied_outside_apex_tpu():
+    """Driver code (bench.py, tools/, examples/, tests) may read
+    clocks; sync-timing still polices HOW it times."""
+    for path in ("bench.py", "tools/tpu_profile.py",
+                 "examples/llama_train.py", "snippet.py"):
+        assert not _by_check(lint_source(_CLOCK_SRC, path), "raw-clock")
+
+
+def test_raw_clock_allowlists_sanctioned_clock_owners():
+    for path in ("apex_tpu/runtime/timing.py",
+                 "apex_tpu/observability/registry.py",
+                 "apex_tpu/observability/recompile.py"):
+        assert not _by_check(lint_source(_CLOCK_SRC, path), "raw-clock")
+
+
+def test_raw_clock_gate_uses_abspath_not_cwd_relative_relpath():
+    """Linting from inside the package (relpath 'amp/scaler.py') must
+    still recognize library code via the absolute path — and the
+    allowlist must match from the LAST apex_tpu segment."""
+    found = _by_check(
+        lint_source(_CLOCK_SRC, "amp/scaler.py",
+                    abspath="/ckpt/apex_tpu/amp/scaler.py"), "raw-clock")
+    assert found
+    assert not _by_check(
+        lint_source(_CLOCK_SRC, "timing.py",
+                    abspath="/ckpt/apex_tpu/runtime/timing.py"),
+        "raw-clock")
+
+
+def test_raw_clock_suppressible():
+    src = """
+import time
+
+def measure():
+    return time.monotonic()  # apex-lint: disable=raw-clock
+"""
+    assert not _by_check(
+        lint_source(src, "apex_tpu/models/gpt2.py"), "raw-clock")
+
+
+def test_raw_clock_clean_tree():
+    """The live apex_tpu tree must carry no raw clocks outside the
+    allowlist — the satellite's point: every timer in the library goes
+    through the corrected-sync machinery."""
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths([os.path.join(repo, "apex_tpu")],
+                                   root=repo, checks=("raw-clock",))
+             if f.check == "raw-clock"]
+    assert not found, "\n".join(f.render() for f in found)
+
+
 # ------------------------------------------------- suppression + baseline
 
 def test_suppression_on_line_and_line_above():

@@ -1,0 +1,112 @@
+"""Recompile listener: a forced retrace under JAX_PLATFORMS=cpu is
+counted per jitted function, flows into the registry, and trips the
+budget guard (ISSUE 2 acceptance: "a test forces an extra retrace and
+asserts the recompile counter catches it")."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.observability import (
+    MetricRegistry,
+    RetraceBudgetExceeded,
+    install_recompile_listener,
+    retrace_guard,
+    uninstall_recompile_listener,
+)
+from apex_tpu.observability import recompile as recompile_mod
+
+
+@pytest.fixture
+def listener():
+    reg = MetricRegistry()
+    lst = install_recompile_listener(reg)
+    yield lst
+    uninstall_recompile_listener()
+
+
+def test_forced_retrace_is_counted(listener):
+    @jax.jit
+    def obs_retrace_probe(x):
+        return x * 2 + 1
+
+    obs_retrace_probe(jnp.ones((4,)))
+    base = listener.compiles("obs_retrace_probe")
+    assert base >= 1  # first compile seen with its real name
+    obs_retrace_probe(jnp.ones((5,)))  # new shape -> retrace
+    obs_retrace_probe(jnp.ones((5,)))  # cache hit -> no compile
+    assert listener.compiles("obs_retrace_probe") == base + 1
+    assert listener.retraces("obs_retrace_probe") >= 1
+    assert listener.total_retraces() >= 1
+
+
+def test_counts_flow_into_registry(listener):
+    @jax.jit
+    def obs_registry_probe(x):
+        return x + 1
+
+    obs_registry_probe(jnp.ones((2,)))
+    obs_registry_probe(jnp.ones((3,)))
+    c = listener.registry.counter("jax/compiles", fn="obs_registry_probe")
+    assert c.value == 2
+    # monitoring totals feed the compile-seconds histogram
+    h = listener.registry.histogram("jax/backend_compile_secs")
+    assert h.count >= 2
+    assert listener.backend_compiles() >= 2
+
+
+def test_snapshot_shape(listener):
+    @jax.jit
+    def obs_snap_probe(x):
+        return x - 1
+
+    obs_snap_probe(jnp.ones((2,)))
+    snap = listener.snapshot()
+    assert snap["compiles_by_fn"].get("obs_snap_probe") == 1
+    assert snap["backend_compiles"] >= 1
+    assert snap["backend_compile_secs"] >= 0.0
+    assert "retraces_by_fn" in snap
+
+
+def test_retrace_guard_trips_over_budget(listener):
+    @jax.jit
+    def obs_guard_probe(x):
+        return x * 3
+
+    x4, x5, x6 = jnp.ones((4,)), jnp.ones((5,)), jnp.ones((6,))
+    obs_guard_probe(x4)  # first compile, outside the guard
+    with pytest.raises(RetraceBudgetExceeded) as ei:
+        with retrace_guard(budget=0, fns=["obs_guard_probe"]):
+            obs_guard_probe(x5)  # retrace inside -> over budget
+    assert "obs_guard_probe" in str(ei.value)
+
+    # budget=1 tolerates exactly one retrace
+    with retrace_guard(budget=1, fns=["obs_guard_probe"]):
+        obs_guard_probe(x6)
+
+    # steady-state reuse does not spend budget
+    with retrace_guard(budget=0, fns=["obs_guard_probe"]):
+        obs_guard_probe(x6)
+        obs_guard_probe(x6)
+
+
+def test_guard_first_compile_is_free(listener):
+    @jax.jit
+    def obs_fresh_probe(x):
+        return x / 2
+
+    with retrace_guard(budget=0, fns=["obs_fresh_probe"]):
+        obs_fresh_probe(jnp.ones((3,)))  # first-ever compile: free
+
+
+def test_install_is_idempotent_and_uninstall_restores():
+    prev_flag = jax.config.jax_log_compiles
+    reg = MetricRegistry()
+    l1 = install_recompile_listener(reg)
+    l2 = install_recompile_listener()
+    assert l1 is l2
+    assert recompile_mod.current() is l1
+    uninstall_recompile_listener()
+    assert recompile_mod.current() is None
+    assert jax.config.jax_log_compiles == prev_flag
+    uninstall_recompile_listener()  # second uninstall is a no-op

@@ -1,0 +1,207 @@
+"""MetricRegistry semantics: counter/gauge/histogram/timer, identity by
+(name, labels), thread safety, JSONL round-trip, and the merge/summary
+reader (ISSUE 2 test satellite)."""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.observability import (
+    MetricRegistry,
+    get_registry,
+    read_jsonl,
+    set_registry,
+    summarize,
+)
+from apex_tpu.observability.registry import append_event
+
+
+def test_counter_identity_and_inc():
+    reg = MetricRegistry()
+    reg.counter("hits").inc()
+    reg.counter("hits").inc(2)
+    assert reg.counter("hits").value == 3
+    # distinct labels are distinct metrics
+    reg.counter("hits", path="a").inc()
+    assert reg.counter("hits", path="a").value == 1
+    assert reg.counter("hits").value == 3
+    with pytest.raises(ValueError):
+        reg.counter("hits").inc(-1)
+
+
+def test_gauge_keeps_last():
+    reg = MetricRegistry()
+    g = reg.gauge("scale")
+    g.set(2.0)
+    g.set(0.5)
+    assert reg.gauge("scale").value == 0.5
+    # non-numeric gauges are allowed (dispatch choices etc.)
+    reg.gauge("choice").set("flat")
+    assert reg.gauge("choice").value == "flat"
+
+
+def test_histogram_stats_and_percentiles():
+    reg = MetricRegistry()
+    h = reg.histogram("lat")
+    for v in range(100):
+        h.observe(v)
+    rec = h.to_record()
+    assert rec["count"] == 100
+    assert rec["min"] == 0 and rec["max"] == 99
+    assert rec["mean"] == pytest.approx(49.5)
+    assert 45 <= rec["p50"] <= 55
+    assert 85 <= rec["p90"] <= 95
+    assert rec["p99"] >= 95
+
+
+def test_timer_accumulates_and_syncs_device_values():
+    reg = MetricRegistry()
+    t = reg.timer("phase")
+    t.start()
+    x = jnp.ones((32, 32)) @ jnp.ones((32, 32))
+    e1 = t.stop(x)
+    assert e1 >= 0.0
+    t.start()
+    e2 = t.stop()
+    assert t.total_elapsed == pytest.approx(e1 + e2)
+    assert t.to_record()["count"] == 2
+    assert t.reset_total() == pytest.approx(e1 + e2)
+    assert t.total_elapsed == 0.0
+    # histogram observations survive the total reset (export history)
+    assert t.to_record()["count"] == 2
+
+
+def test_timer_double_start_and_stop_raise():
+    t = MetricRegistry().timer("x")
+    with pytest.raises(RuntimeError):
+        t.stop()
+    t.start()
+    with pytest.raises(RuntimeError):
+        t.start()
+    t.stop()
+
+
+def test_timer_stop_sync_failure_does_not_wedge(monkeypatch):
+    """A deferred XLA error surfacing at the sync must not leave the
+    timer 'running' with trace scopes open — the next start() would
+    mask the real failure."""
+    from apex_tpu.runtime import timing
+
+    t = MetricRegistry().timer("wedge")
+    t.start()
+
+    def boom(out):
+        raise RuntimeError("deferred XLA error")
+
+    monkeypatch.setattr(timing, "sync", boom)
+    with pytest.raises(RuntimeError, match="deferred XLA error"):
+        t.stop(block_on=jnp.ones((2,)))
+    assert not t.running
+    assert t.count == 0  # the failed interval was not recorded
+    monkeypatch.undo()
+    t.start()
+    t.stop()  # recovers cleanly
+    assert t.count == 1
+
+
+def test_timer_context_manager_cancels_on_error():
+    t = MetricRegistry().timer("ctx")
+    with t.time():
+        pass
+    assert t.to_record()["count"] == 1
+    with pytest.raises(RuntimeError):
+        with t.time():
+            raise RuntimeError("body failed")
+    # the failed interval was cancelled, not recorded
+    assert t.to_record()["count"] == 1
+    assert not t.running
+
+
+def test_thread_safety_exact_counts():
+    reg = MetricRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.counter("n").inc()
+            reg.histogram("h").observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert reg.counter("n").value == 8000
+    assert reg.histogram("h").count == 8000
+
+
+def test_jsonl_round_trip_and_events(tmp_path):
+    reg = MetricRegistry()
+    reg.counter("c", k="v").inc(5)
+    reg.gauge("g").set(1.5)
+    reg.histogram("h").observe(3.0)
+    reg.event("boom", reason="test", value=jnp.float32(2.5))
+    path = tmp_path / "m.jsonl"
+    reg.dump(str(path))
+    back = read_jsonl(str(path))
+    by_type = {}
+    for r in back:
+        by_type.setdefault(r["type"], []).append(r)
+    assert by_type["counter"][0]["value"] == 5
+    assert by_type["counter"][0]["labels"] == {"k": "v"}
+    assert by_type["gauge"][0]["value"] == 1.5
+    assert by_type["event"][0]["name"] == "boom"
+    # device scalar was converted to a plain JSON number
+    assert by_type["event"][0]["fields"]["value"] == 2.5
+    # every line is valid standalone JSON
+    for line in path.read_text().splitlines():
+        json.loads(line)
+
+
+def test_read_jsonl_tolerates_garbage(tmp_path):
+    path = tmp_path / "m.jsonl"
+    path.write_text('{"type": "counter", "name": "a", "value": 1}\n'
+                    "not json at all\n"
+                    '{"type": "gauge", "name": "b", "value": 2}\n')
+    back = read_jsonl(str(path))
+    assert [r["type"] for r in back] == ["counter", "parse-error", "gauge"]
+    assert summarize(back)["parse_errors"] == 1
+
+
+def test_summarize_merges_dumps():
+    reg1, reg2 = MetricRegistry(), MetricRegistry()
+    reg1.counter("n").inc(2)
+    reg2.counter("n").inc(3)
+    reg1.gauge("g").set("old")
+    reg2.gauge("g").set("new")
+    for v in (1.0, 2.0):
+        reg1.histogram("h").observe(v)
+    reg2.histogram("h").observe(9.0)
+    s = summarize(reg1.to_records() + reg2.to_records())
+    assert s["counters"]["n"] == 5
+    assert s["gauges"]["g"] == "new"
+    h = s["histograms"]["histogram:h"]
+    assert h["count"] == 3 and h["min"] == 1.0 and h["max"] == 9.0
+    assert h["mean"] == pytest.approx(4.0)
+    # quantiles cannot merge across dumps; they must not be fabricated
+    assert h["p50"] is None
+
+
+def test_append_event_no_registry(tmp_path):
+    path = tmp_path / "m.jsonl"
+    append_event(str(path), "tpu_init_error", errors=["rc=3: boom"])
+    append_event(str(path), "tpu_init_error", errors=["timeout"])
+    back = read_jsonl(str(path))
+    assert len(back) == 2
+    assert back[0]["fields"]["errors"] == ["rc=3: boom"]
+
+
+def test_global_registry_swap():
+    prev = get_registry()
+    mine = MetricRegistry()
+    assert set_registry(mine) is prev
+    try:
+        assert get_registry() is mine
+    finally:
+        set_registry(prev)

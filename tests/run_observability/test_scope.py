@@ -1,0 +1,79 @@
+"""Trace scopes: no-ops without an active profiler, nest cleanly, and
+work inside traced code where they tag the HLO (ISSUE 2 test satellite:
+"trace scopes are no-ops without an active profiler")."""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.observability import annotate, scope
+
+
+def test_scope_is_noop_without_profiler():
+    with scope("outer"):
+        with scope("outer/inner"):
+            x = jnp.ones((4,)) + 1
+    assert float(x[0]) == 2.0
+
+
+def test_scope_inside_jit_tags_hlo():
+    @jax.jit
+    def f(x):
+        with scope("my_tagged_region"):
+            return x * 2 + 1
+
+    x = jnp.ones((4,))
+    assert float(f(x)[0]) == 3.0
+    # named_scope half survives into the lowered module's debug info:
+    # that is what lets an on-silicon trace attribute device time to
+    # the region (plain as_text() strips location metadata)
+    asm = f.lower(x).compiler_ir().operation.get_asm(
+        enable_debug_info=True)
+    assert "my_tagged_region" in asm
+
+
+def test_scope_exception_safe():
+    try:
+        with scope("failing"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    # a fresh scope still works after an exception unwound one
+    with scope("after"):
+        pass
+
+
+def test_annotate_decorator():
+    @annotate("wrapped_op")
+    def g(x):
+        return x + 1
+
+    assert g(1) == 2
+
+    @jax.jit
+    def h(x):
+        return g(x)
+
+    asm = h.lower(jnp.ones((2,))).compiler_ir().operation.get_asm(
+        enable_debug_info=True)
+    assert "wrapped_op" in asm
+
+
+def test_hot_path_wiring_traces():
+    """The instrumented collective mappings still trace and compute
+    correctly under shard_map (the scopes must never change numerics)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    n = min(4, jax.device_count())
+    mesh = Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+    def body(x):
+        x = mappings.copy_to_tensor_model_parallel_region(x, "tp")
+        return mappings.reduce_from_tensor_model_parallel_region(x, "tp")
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                               out_specs=P()))
+    out = fn(jnp.ones((8,)))
+    np.testing.assert_allclose(np.asarray(out), n * np.ones((8,)))

@@ -1,0 +1,97 @@
+"""The pipeline_parallel.Timers adapter now rides the observability
+registry (ISSUE 2 satellite: "port _timers.py onto the new registry;
+keep the reference-shaped Timers.write/log API")."""
+
+import jax.numpy as jnp
+
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.transformer.pipeline_parallel import Timers
+
+
+def test_phase_times_land_in_registry():
+    reg = MetricRegistry()
+    timers = Timers(registry=reg)
+    timers("forward").start()
+    x = jnp.ones((16, 16)) @ jnp.ones((16, 16))
+    timers("forward").stop(x)
+    t = reg.timer("pp_phase/forward")
+    assert t.count == 1
+    assert t.total > 0.0
+    # adapter's elapsed(reset=True) drains the accumulator...
+    e = timers("forward").elapsed(reset=True)
+    assert e > 0.0
+    assert timers("forward").elapsed() == 0.0
+    # ...but the histogram history stays for JSONL export
+    assert reg.timer("pp_phase/forward").count == 1
+    recs = reg.to_records()
+    assert any(r["type"] == "timer" and r["name"] == "pp_phase/forward"
+               for r in recs)
+
+
+def test_timers_instances_are_independent():
+    """Two Timers() groups sharing one registry share the METRIC sink
+    but never each other's running/elapsed state (the reference's
+    per-group contract — a fresh group must start at zero and must be
+    able to start a phase another group left running)."""
+    reg = MetricRegistry()
+    t1 = Timers(registry=reg)
+    t1("fwd").start()
+    t1("fwd").stop()
+    t2 = Timers(registry=reg)
+    assert t2("fwd").elapsed_ == 0.0
+    t1("bwd").start()          # left running by group 1...
+    t2("bwd").start()          # ...must not block group 2
+    t2("bwd").stop()
+    t1("bwd").stop()
+    # both groups' intervals landed in the one shared metric
+    assert reg.timer("pp_phase/bwd").count == 2
+
+
+def test_write_and_log_contracts_preserved():
+    reg = MetricRegistry()
+    timers = Timers(registry=reg)
+    timers("a").start()
+    timers("a").stop()
+
+    lines = []
+    timers.log(["a", "never_started"], printer=lines.append)
+    assert lines and "a:" in lines[0]
+    assert "never_started" not in lines[0]
+
+    class W:
+        def __init__(self):
+            self.calls = []
+
+        def add_scalar(self, *args):
+            self.calls.append(args)
+
+    timers("b").start()
+    timers("b").stop()
+    w = W()
+    timers.write(["b"], w, iteration=7)
+    assert w.calls == [("b-time", w.calls[0][1], 7)]
+
+
+def test_elapsed_poll_does_not_record_fragments():
+    """write/log on a RUNNING timer (reference polling semantics) splits
+    the private accumulator but must not feed poll fragments into the
+    shared pp_phase histogram — only real stop() calls are samples."""
+    reg = MetricRegistry()
+    timers = Timers(registry=reg)
+    timers("f").start()
+    timers("f").elapsed(reset=False)   # poll
+    timers("f").elapsed(reset=False)   # poll
+    assert reg.timer("pp_phase/f").count == 0
+    timers("f").stop()
+    assert reg.timer("pp_phase/f").count == 1
+    assert timers("f").elapsed_ > 0.0
+
+
+def test_reset_while_running_closes_scope():
+    timers = Timers(registry=MetricRegistry())
+    timers("x").start()
+    timers("x").reset()
+    assert not timers("x").started_
+    # restartable after a mid-flight reset
+    timers("x").start()
+    timers("x").stop()

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Summarize apex_tpu metrics JSONL dumps.
+
+Thin wrapper over ``python -m apex_tpu.observability report`` so the
+tools/ directory carries the complete telemetry workflow next to
+tpu_profile.py / trace_report.py:
+
+    python tools/metrics_report.py BENCH_METRICS.jsonl
+    python tools/metrics_report.py run1.jsonl run2.jsonl --json
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex_tpu.observability.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "report")
+    sys.exit(main(sys.argv[1:]))
