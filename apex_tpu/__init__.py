@@ -40,6 +40,46 @@ if not hasattr(_jax.lax, "pvary"):
     # annotation has no checker to feed, so identity is the exact analog.
     _jax.lax.pvary = lambda x, axis_names=(): x
 
+if not hasattr(_jax.sharding, "set_mesh"):
+    # jax.sharding.set_mesh became public after 0.4.37. Its two effects —
+    # binding the abstract mesh (so bare-PartitionSpec sharding
+    # constraints and get_abstract_mesh resolve) and binding the concrete
+    # mesh for dispatch — map onto 0.4.37's internal set_abstract_mesh
+    # plus the classic `with mesh:` thread-resources context. The
+    # internal helper's sharding_in_types flip is deliberately NOT
+    # replicated: 0.4.37's sharding-in-types was pre-release and changes
+    # unrelated jit semantics.
+    import contextlib as _contextlib
+
+    try:
+        from jax._src.mesh import set_abstract_mesh as _set_abstract_mesh
+    except ImportError:  # pragma: no cover - future jax without this path
+        _set_abstract_mesh = None
+
+    @_contextlib.contextmanager
+    def _set_mesh(mesh):
+        if mesh is None:
+            yield None
+            return
+        with _contextlib.ExitStack() as stack:
+            abstract = getattr(mesh, "abstract_mesh", None)
+            if _set_abstract_mesh is not None and abstract is not None:
+                stack.enter_context(_set_abstract_mesh(abstract))
+            stack.enter_context(mesh)
+            yield mesh
+
+    _jax.sharding.set_mesh = _set_mesh
+
+if not hasattr(_jax.sharding, "get_abstract_mesh"):
+    # Public alias for the internal reader the set_mesh shim feeds; the
+    # tensor-parallel activation-sharding hints consult it.
+    try:
+        from jax._src.mesh import get_abstract_mesh as _get_abstract_mesh
+    except ImportError:  # pragma: no cover
+        _get_abstract_mesh = None
+    if _get_abstract_mesh is not None:
+        _jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
 
 class RankInfoFormatter(logging.Formatter):
     """ref apex/__init__.py:28 — logging formatter injecting the current

@@ -7,6 +7,11 @@ Two engines, one CLI, one pytest gate:
   abstract avals on any backend and walk the closed jaxpr for donation
   races, retrace hazards, collective-axis mismatches against the live
   ``parallel_state`` mesh, and Pallas BlockSpec tiling/VMEM problems.
+  On top of it, the **dataflow engine** (:mod:`.dataflow`) runs a
+  forward abstract interpretation (dtype/cast/taint lattice) powering
+  the **precision-flow checks** (:mod:`.precision_checks`):
+  low-precision accumulation, master-weight discipline, unsafe exp,
+  cast churn, loss-scale bypass.
 - **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
   examples/, tools/, bench.py) for host-sync anti-patterns — the
   ``block_until_ready``-as-timing bug that produced r5's impossible
@@ -29,10 +34,19 @@ from apex_tpu.analysis.findings import (
     save_baseline,
 )
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS, analyze_fn
-from apex_tpu.analysis.targets import TARGETS, run_targets
+from apex_tpu.analysis.precision_checks import (
+    PRECISION_CHECKS,
+    analyze_precision,
+)
+from apex_tpu.analysis.targets import (
+    TARGETS,
+    run_precision_findings,
+    run_targets,
+)
 
 __all__ = [
-    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "TARGETS", "analyze_fn",
-    "lint_paths", "lint_source", "load_baseline", "new_findings",
-    "run_targets", "save_baseline",
+    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PRECISION_CHECKS",
+    "TARGETS", "analyze_fn", "analyze_precision", "lint_paths",
+    "lint_source", "load_baseline", "new_findings",
+    "run_precision_findings", "run_targets", "save_baseline",
 ]

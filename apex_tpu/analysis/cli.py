@@ -5,6 +5,7 @@
     python -m apex_tpu.analysis --no-jaxpr            # AST engine only
     python -m apex_tpu.analysis --baseline tests/run_analysis/baseline.json
     python -m apex_tpu.analysis --write-baseline tests/run_analysis/baseline.json
+    python -m apex_tpu.analysis --allow my_target:master-weights
     python -m apex_tpu.analysis --list-checks
 
 Exit codes: 0 clean (or all findings grandfathered), 1 new findings,
@@ -20,8 +21,13 @@ import sys
 
 from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
+from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 
 DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
+
+# Version of the --json payload; bump when its shape changes so
+# downstream readers (tools/metrics_report.py) can dispatch on it.
+JSON_SCHEMA_VERSION = 1
 
 
 def _default_paths(root):
@@ -31,11 +37,41 @@ def _default_paths(root):
 
 def known_checks():
     return (set(ast_checks.AST_CHECKS) | set(JAXPR_CHECKS)
-            | set(targets.TARGET_CHECKS))
+            | set(PRECISION_CHECKS) | set(targets.TARGET_CHECKS))
 
 
-def run(paths=None, root=None, ast=True, jaxpr=True, checks=None):
-    """Programmatic entry: returns (findings, target_errors)."""
+def parse_allow(entries):
+    """['target:check', ...] -> {target: {check, ...}}; loud on typos
+    (an allow matching nothing would silently stop allowing). Only
+    target-emittable check ids are accepted — an AST id here could
+    never filter anything (same rule as @target(allow=...))."""
+    target_checks = set(targets.TRACING_CHECKS) | set(
+        targets.TARGET_CHECKS)
+    allow: dict = {}
+    for entry in entries or ():
+        target_name, sep, check = entry.partition(":")
+        if not sep or not target_name or not check:
+            raise ValueError(
+                f"--allow expects target:check, got {entry!r}")
+        if target_name not in targets.TARGETS:
+            raise ValueError(
+                f"--allow names unknown target {target_name!r}; valid: "
+                f"{sorted(targets.TARGETS)}")
+        if check not in target_checks:
+            raise ValueError(
+                f"--allow names check id {check!r} that no jaxpr "
+                f"target can emit; valid: {sorted(target_checks)}")
+        allow.setdefault(target_name, set()).add(check)
+    return allow
+
+
+def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
+        allow=None):
+    """Programmatic entry: returns (findings, target_errors).
+
+    ``allow``: {target: {check ids}} per-target grandfather, merged over
+    the ``@target(allow=...)`` declarations.
+    """
     if checks:
         unknown = set(checks) - known_checks()
         if unknown:
@@ -63,14 +99,14 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None):
             all_findings += ast_checks.lint_paths(use, root=root,
                                                  checks=ast_ids)
     if jaxpr:
-        if checks is None or set(checks) & set(JAXPR_CHECKS):
-            names = None  # tracing targets can emit any jaxpr check
+        if checks is None or set(checks) & set(targets.TRACING_CHECKS):
+            names = None  # tracing targets can emit any tracing check
         else:
             # only the (cheap, non-tracing) targets whose checks were
             # asked for — skips the kernel trace suite
             names = set(checks) & set(targets.TARGET_CHECKS)
         if names is None or names:
-            jf, errors = targets.run_targets(names)
+            jf, errors = targets.run_targets(names, extra_allow=allow)
             if checks:
                 jf = [f for f in jf if f.check in checks]
             all_findings += jf
@@ -91,6 +127,11 @@ def main(argv=None):
     ap.add_argument("--no-jaxpr", dest="jaxpr", action="store_false")
     ap.add_argument("--checks", default=None,
                     help="comma-separated check ids to run")
+    ap.add_argument("--allow", action="append", default=[],
+                    metavar="TARGET:CHECK",
+                    help="drop findings of CHECK from jaxpr TARGET "
+                         "(repeatable) — per-target grandfather for "
+                         "deliberate violations")
     ap.add_argument("--baseline", default=None,
                     help="JSON baseline of grandfathered findings; only "
                          "NEW findings fail the run")
@@ -106,6 +147,8 @@ def main(argv=None):
             print(f"{cid:24s} [ast]")
         for cid in JAXPR_CHECKS:
             print(f"{cid:24s} [jaxpr]")
+        for cid in PRECISION_CHECKS:
+            print(f"{cid:24s} [jaxpr/dataflow]")
         for cid in targets.TARGET_CHECKS:
             print(f"{cid:24s} [jaxpr]")
         return 0
@@ -115,8 +158,10 @@ def main(argv=None):
         checks = {c.strip() for c in args.checks.split(",") if c.strip()}
 
     try:
+        allow = parse_allow(args.allow)
         found, errors = run(paths=args.paths or None, root=args.root,
-                            ast=args.ast, jaxpr=args.jaxpr, checks=checks)
+                            ast=args.ast, jaxpr=args.jaxpr, checks=checks,
+                            allow=allow)
     except (FileNotFoundError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -140,6 +185,8 @@ def main(argv=None):
 
     if args.json:
         print(json.dumps({
+            "schema_version": JSON_SCHEMA_VERSION,
+            "kind": "apex_tpu.analysis",
             "findings": [vars(f) for f in fresh],
             "grandfathered": grandfathered,
             "target_errors": errors,

@@ -1,0 +1,394 @@
+"""Forward abstract interpretation over closed jaxprs — the flow engine
+under the precision checks (ISSUE 3 tentpole).
+
+PR 1's jaxpr engine (:mod:`.jaxpr_checks`) is per-equation pattern
+matching: it can see *one* ``pallas_call``'s BlockSpecs or *one*
+collective's axis name, but it cannot answer flow questions like "does
+this bf16 value reach a sum without an fp32 accumulator?" or "did these
+gradients pass through the scaler's unscale before touching the
+params?". This module adds the missing machinery: a small forward
+abstract interpreter whose value lattice tracks, per jaxpr ``Var``,
+
+- ``dtype`` / ``origin``   current dtype and the dtype the value was
+  born with (input, constant, or first producer);
+- ``cast_chain``           the run of *consecutive*
+  ``convert_element_type``s the value just went through (any compute op
+  resets it) — the cast-churn signal;
+- ``reduction_depth``      how many accumulating ops (``dot_general``,
+  ``reduce_sum``, ...) lie on the value's history;
+- ``taints``               client-assigned labels ("grad", "master",
+  "scale", ...) propagated through every op — the dataflow analog of
+  the roles apex documents (master weights, scaled gradients);
+- ``unscaled``             True once a "grad"-tainted value has been
+  multiplied/divided by a "scale"-tainted value (the loss-scaler's
+  unscale);
+- ``from_max`` / ``max_subtracted``  whether the value is (derived
+  from) a running max, and whether a max was subtracted from it — the
+  softmax-stability signal.
+
+Sub-jaxprs are entered, not skipped: ``pjit``/``closed_call``/
+``remat``/``custom_jvp_call``/``custom_vjp_call`` bodies are
+interpreted with the caller's abstract values bound to their invars;
+``scan``/``while``/``cond`` bodies likewise (one pass, no fixpoint —
+a loop-carried precision change is seen on its first iteration, which
+is where every check here fires anyway). ``pallas_call`` is opaque by
+design: its outputs are rebuilt from the out avals with the union of
+the input taints (kernel internals are covered by the pallas-block
+check and kernel unit tests, not by dataflow).
+
+Clients subscribe with visitor callbacks; :mod:`.precision_checks`
+builds the five shipped analyses on top. The engine itself never emits
+a Finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "AbsVal", "HALF_DTYPES", "ADDITIVE_REDUCTIONS", "ARITH_PRIMS",
+    "interpret", "abs_val_for_aval", "itemsize",
+]
+
+HALF_DTYPES = frozenset({"bfloat16", "float16"})
+
+FLOAT_DTYPES = frozenset({
+    "bfloat16", "float16", "float32", "float64",
+    "float8_e4m3fn", "float8_e5m2",
+})
+
+# Call-like primitives whose bodies run in the caller's value world.
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+               "checkpoint"}
+
+# Accumulating primitives: a low-precision operand here loses mass.
+ADDITIVE_REDUCTIONS = frozenset({
+    "reduce_sum", "add_any", "cumsum", "reduce_window_sum",
+    "dot_general", "conv_general_dilated",
+})
+
+# Ops that preserve the value's *identity* (broadcasts, layout moves,
+# gradient stops): from_max / max_subtracted / cast_chain flow through.
+_PRESERVE_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims", "transpose",
+    "slice", "dynamic_slice", "stop_gradient", "copy", "rev", "neg",
+})
+
+# Arithmetic primitives in the "touches the value's bits" sense the
+# master-weight / loss-scale checks care about.
+ARITH_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "dot_general", "conv_general_dilated",
+    "pow", "integer_pow", "sqrt", "rsqrt", "exp", "log", "log1p",
+    "tanh", "logistic", "max", "min", "square", "abs", "erf",
+    "add_any", "atan2", "expm1", "cbrt",
+})
+
+_MAX_PRIMS = frozenset({"reduce_max", "cummax"})
+
+
+def itemsize(dtype: str) -> int:
+    return np.dtype(dtype).itemsize
+
+
+def _is_float(dtype: str) -> bool:
+    return dtype in FLOAT_DTYPES
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsVal:
+    """One point of the value lattice (see module docstring)."""
+
+    dtype: str
+    origin: str
+    cast_chain: tuple = ()
+    reduction_depth: int = 0
+    taints: frozenset = frozenset()
+    unscaled: bool = False
+    from_max: bool = False
+    max_subtracted: bool = False
+
+    def with_(self, **kw) -> "AbsVal":
+        return dataclasses.replace(self, **kw)
+
+
+def abs_val_for_aval(aval, taints=frozenset()) -> AbsVal:
+    dtype = str(getattr(aval, "dtype", "float32"))
+    return AbsVal(dtype=dtype, origin=dtype, taints=frozenset(taints))
+
+
+def _is_var(v):
+    import jax.core as core
+    return isinstance(v, core.Var)
+
+
+def _closed_jaxprs_in(value):
+    import jax.core as core
+    out = []
+    if isinstance(value, core.ClosedJaxpr):
+        out.append(value)
+    elif isinstance(value, core.Jaxpr):
+        out.append(value)
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(_closed_jaxprs_in(v))
+    return out
+
+
+def _jaxpr_of(obj):
+    import jax.core as core
+    return obj.jaxpr if isinstance(obj, core.ClosedJaxpr) else obj
+
+
+def _consts_of(obj):
+    import jax.core as core
+    return obj.consts if isinstance(obj, core.ClosedJaxpr) else ()
+
+
+def _join(vals, out_aval):
+    """Default transfer: merge the float inputs into the output value."""
+    dtype = str(getattr(out_aval, "dtype", "float32"))
+    floats = [v for v in vals if v is not None and _is_float(v.dtype)]
+    ins = [v for v in vals if v is not None]
+    origin = floats[0].origin if floats else dtype
+    taints = frozenset().union(*(v.taints for v in ins)) if ins \
+        else frozenset()
+    depth = max((v.reduction_depth for v in ins), default=0)
+    unscaled = any(v.unscaled for v in ins)
+    return AbsVal(dtype=dtype, origin=origin, reduction_depth=depth,
+                  taints=taints, unscaled=unscaled)
+
+
+def _transfer(eqn, in_vals, out_avals):
+    """Abstract transfer function: in_vals (AbsVal | None for Literals)
+    -> tuple of out AbsVals."""
+    prim = eqn.primitive.name
+    outs = []
+
+    if prim == "convert_element_type":
+        src = in_vals[0]
+        for aval in out_avals:
+            new_dtype = str(aval.dtype)
+            if src is None:
+                outs.append(AbsVal(dtype=new_dtype, origin=new_dtype))
+                continue
+            chain = src.cast_chain or (src.dtype,)
+            outs.append(src.with_(
+                dtype=new_dtype, cast_chain=chain + (new_dtype,)))
+        return tuple(outs)
+
+    if prim in _PRESERVE_PRIMS:
+        src = next((v for v in in_vals if v is not None), None)
+        for aval in out_avals:
+            dtype = str(getattr(aval, "dtype", "float32"))
+            if src is None:
+                outs.append(AbsVal(dtype=dtype, origin=dtype))
+            else:
+                outs.append(src.with_(dtype=dtype, cast_chain=()))
+        return tuple(outs)
+
+    if prim in _MAX_PRIMS or (
+            prim == "max" and any(v is not None and v.from_max
+                                  for v in in_vals)):
+        base = _join(in_vals, out_avals[0])
+        return tuple(base.with_(dtype=str(a.dtype), from_max=True)
+                     for a in out_avals)
+
+    if prim == "sub":
+        base = _join(in_vals, out_avals[0])
+        rhs = in_vals[1] if len(in_vals) > 1 else None
+        if rhs is not None and rhs.from_max:
+            base = base.with_(max_subtracted=True)
+        return (base,)
+
+    if prim in ("mul", "div"):
+        base = _join(in_vals, out_avals[0])
+        present = [v for v in in_vals if v is not None]
+        has_grad = any("grad" in v.taints for v in present)
+        has_scale = any("scale" in v.taints and "grad" not in v.taints
+                        for v in present)
+        if has_grad and has_scale:
+            base = base.with_(unscaled=True)
+        return (base,)
+
+    if prim in ADDITIVE_REDUCTIONS:
+        base = _join(in_vals, out_avals[0])
+        return tuple(
+            base.with_(dtype=str(a.dtype),
+                       reduction_depth=base.reduction_depth + 1)
+            for a in out_avals)
+
+    if prim == "pallas_call":
+        taints = frozenset().union(
+            *(v.taints for v in in_vals if v is not None)) \
+            if any(v is not None for v in in_vals) else frozenset()
+        unscaled = any(v is not None and v.unscaled for v in in_vals)
+        return tuple(
+            abs_val_for_aval(a, taints).with_(unscaled=unscaled)
+            for a in out_avals)
+
+    return tuple(_join(in_vals, a) for a in out_avals)
+
+
+class _Interp:
+    def __init__(self, visit):
+        self.visit = visit
+
+    def run(self, jaxpr, consts, in_vals, env=None):
+        env = {} if env is None else env
+
+        def write(var, val):
+            if _is_var(var):
+                env[var] = val
+
+        def read(atom):
+            if _is_var(atom):
+                return env.get(atom)
+            return None  # Literal
+
+        for var, const in zip(jaxpr.constvars, consts):
+            aval = getattr(var, "aval", None)
+            write(var, abs_val_for_aval(
+                aval if aval is not None else np.asarray(const)))
+        # a sub-jaxpr reached with fewer caller vals than invars (or a
+        # constvar with no const) still needs *some* value
+        for var in jaxpr.constvars:
+            if var not in env:
+                write(var, abs_val_for_aval(var.aval))
+        for var, val in zip(jaxpr.invars, in_vals):
+            write(var, val if val is not None
+                  else abs_val_for_aval(var.aval))
+        for var in jaxpr.invars:
+            if var not in env:
+                write(var, abs_val_for_aval(var.aval))
+
+        for eqn in jaxpr.eqns:
+            ins = tuple(read(v) for v in eqn.invars)
+            prim = eqn.primitive.name
+            sub_outs = self._maybe_call(eqn, ins)
+            if sub_outs is not None:
+                outs = sub_outs
+            else:
+                outs = _transfer(
+                    eqn, ins, tuple(v.aval for v in eqn.outvars))
+            if self.visit is not None:
+                self.visit(eqn, ins, outs)
+            for var, val in zip(eqn.outvars, outs):
+                write(var, val)
+        return tuple(
+            env.get(v) if _is_var(v)
+            else abs_val_for_aval(getattr(v, "aval", None) or v.aval)
+            for v in jaxpr.outvars)
+
+    # ---- structured primitives ----------------------------------------
+
+    def _maybe_call(self, eqn, ins):
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        if prim in _CALL_PRIMS:
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                if key in params:
+                    subs = _closed_jaxprs_in(params[key])
+                    if subs:
+                        return self._run_sub(subs[0], ins, eqn)
+            return None
+
+        if prim == "scan":
+            sub = params.get("jaxpr")
+            if sub is None:
+                return None
+            sub = _closed_jaxprs_in(sub)
+            if not sub:
+                return None
+            return self._run_sub(sub[0], ins, eqn)
+
+        if prim == "while":
+            body = params.get("body_jaxpr")
+            if body is None:
+                return None
+            body = _closed_jaxprs_in(body)
+            if not body:
+                return None
+            n_cond = params.get("cond_nconsts", 0)
+            carry_ins = ins[n_cond:]
+            return self._run_sub(body[0], carry_ins, eqn)
+
+        if prim == "cond":
+            branches = _closed_jaxprs_in(params.get("branches", ()))
+            if not branches:
+                return None
+            outs = None
+            for br in branches:
+                br_outs = self._run_sub(br, ins[1:], eqn)
+                if outs is None:
+                    outs = list(br_outs)
+                else:
+                    outs = [self._join_branch(a, b)
+                            for a, b in zip(outs, br_outs)]
+            return tuple(outs)
+
+        if prim == "shard_map":
+            sub = _closed_jaxprs_in(params.get("jaxpr", ()))
+            if sub:
+                return self._run_sub(sub[0], ins, eqn)
+            return None
+
+        return None
+
+    @staticmethod
+    def _join_branch(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a.with_(
+            taints=a.taints | b.taints,
+            unscaled=a.unscaled or b.unscaled,
+            reduction_depth=max(a.reduction_depth, b.reduction_depth),
+        )
+
+    def _run_sub(self, closed_or_jaxpr, ins, eqn):
+        jaxpr = _jaxpr_of(closed_or_jaxpr)
+        consts = _consts_of(closed_or_jaxpr)
+        n = len(jaxpr.invars)
+        # positional binding; pad/truncate defensively (scan xs are
+        # sliced along the leading axis but keep dtype, which is all
+        # the lattice reads)
+        bound = list(ins[:n]) + [None] * max(0, n - len(ins))
+        mapped = []
+        for var, val in zip(jaxpr.invars, bound):
+            if val is None:
+                mapped.append(abs_val_for_aval(var.aval))
+            else:
+                mapped.append(val.with_(dtype=str(var.aval.dtype)))
+        outs = self.run(jaxpr, consts, tuple(mapped))
+        out_avals = tuple(v.aval for v in eqn.outvars)
+        if len(outs) != len(out_avals):
+            # e.g. scan: sub outputs = carry + per-iter ys while eqn
+            # outputs = carry + stacked ys; lengths match there, but be
+            # safe for anything exotic
+            outs = tuple(
+                outs[i] if i < len(outs) else abs_val_for_aval(a)
+                for i, a in enumerate(out_avals))
+        return tuple(
+            o.with_(dtype=str(a.dtype)) if o is not None
+            else abs_val_for_aval(a)
+            for o, a in zip(outs, out_avals))
+
+
+def interpret(closed, in_vals, visit=None):
+    """Run the forward abstract interpretation over ``closed`` (a
+    ``ClosedJaxpr``).
+
+    ``in_vals``: one :class:`AbsVal` (or None for "derive from aval")
+    per flat invar. ``visit(eqn, in_abs_vals, out_abs_vals)`` is called
+    for every equation at every depth, after its transfer function.
+    Returns the abstract values of the jaxpr outputs.
+    """
+    jaxpr = closed.jaxpr
+    vals = list(in_vals) + [None] * max(
+        0, len(jaxpr.invars) - len(in_vals))
+    return _Interp(visit).run(jaxpr, closed.consts, tuple(vals))

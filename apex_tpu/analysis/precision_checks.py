@@ -1,0 +1,294 @@
+"""Precision-flow checks — client analyses over :mod:`.dataflow`
+(ISSUE 3 tentpole).
+
+Apex's value proposition is mixed precision *done safely*: O1/O2
+boundary casting, fp32 master weights, loss scaling, fp32 statistics in
+the fused kernels. These checks turn each of those documented
+invariants into a machine-checked fact over the traced programs:
+
+- ``lowprec-accum``      bf16/fp16 operands reaching ``dot_general`` /
+  ``conv`` whose result stays half (no fp32
+  ``preferred_element_type``), or an additive reduction
+  (``reduce_sum``/``cumsum``/``reduce_window_sum``) running directly
+  over a half-precision operand with no upcast on the path.
+- ``master-weights``     a value tainted "master" (params / m / v on an
+  optimizer update path) that is born half, touched by arithmetic while
+  half, or stored half in a designated output slot.
+- ``unsafe-exp``         ``exp`` on a half-precision value with no
+  subtracted running max (the softmax-overflow recipe; fp16 overflows
+  at x ≈ 11.1), and ``log``/``log1p`` on fp16.
+- ``cast-churn``         consecutive ``convert_element_type`` runs that
+  round-trip (f32→bf16→f32 or back) with no compute in between — pure
+  VMEM/HBM bandwidth burn plus, on the down-up direction, a silent
+  precision haircut.
+- ``loss-scale-bypass``  a "grad"-tainted value that reaches arithmetic
+  with "master"/"param"-tainted state without ever being multiplied or
+  divided by a "scale"-tainted value (the scaler's unscale) — the skip
+  that applies *scaled* gradients.
+
+Entry point: :func:`analyze_precision` (mirrors
+``jaxpr_checks.analyze_fn``); the registered customers live in
+:mod:`.targets`. ``roles`` assigns input taints by positional argnum;
+``master_outs`` names flat output slots that must stay fp32 (the O2
+re-materialized half model copy is *not* one of them — downcasting the
+master into the model copy is the discipline, not a violation of it).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.analysis.dataflow import (
+    ARITH_PRIMS,
+    HALF_DTYPES,
+    AbsVal,
+    interpret,
+    itemsize,
+)
+from apex_tpu.analysis.findings import Finding
+
+PRECISION_CHECKS = (
+    "lowprec-accum", "master-weights", "unsafe-exp", "cast-churn",
+    "loss-scale-bypass",
+)
+
+_REDUCE_PRIMS = ("reduce_sum", "cumsum", "reduce_window_sum")
+_CONTRACT_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+class _Ctx:
+    """Shared state for one analyze_precision run."""
+
+    def __init__(self, name, path, checks):
+        self.name = name
+        self.path = path
+        self.checks = checks
+        self.findings = []
+        self.seen = set()
+        self.bypass_fired = False
+
+    def add(self, check, severity, message, dedup_key=None):
+        if dedup_key is not None:
+            key = (check,) + tuple(dedup_key)
+            if key in self.seen:
+                return
+            self.seen.add(key)
+        self.findings.append(Finding(
+            check, severity, self.path, 0, self.name, message))
+
+
+def _visit_lowprec_accum(ctx, eqn, ins, outs):
+    prim = eqn.primitive.name
+    if prim in _CONTRACT_PRIMS:
+        half_in = sorted({v.dtype for v in ins
+                          if v is not None and v.dtype in HALF_DTYPES})
+        if half_in and outs and outs[0].dtype in HALF_DTYPES:
+            ctx.add(
+                "lowprec-accum", "error",
+                f"'{prim}' contracts {'/'.join(half_in)} operands into a "
+                f"{outs[0].dtype} result: the accumulator is not fp32 — "
+                f"pass preferred_element_type=jnp.float32 (and downcast "
+                f"after) so the MXU accumulates in full precision",
+                dedup_key=(prim, outs[0].dtype))
+    elif prim in _REDUCE_PRIMS:
+        op = ins[0] if ins else None
+        if op is not None and op.dtype in HALF_DTYPES:
+            ctx.add(
+                "lowprec-accum", "error",
+                f"'{prim}' accumulates directly over a {op.dtype} "
+                f"operand: each partial sum rounds to "
+                f"{op.dtype} — upcast to fp32 on the accumulation "
+                f"path (x.astype(jnp.float32)) before reducing",
+                dedup_key=(prim, op.dtype))
+
+
+def _visit_master_weights(ctx, eqn, ins, outs):
+    prim = eqn.primitive.name
+    if prim == "convert_element_type" or prim not in ARITH_PRIMS:
+        return
+    for v in ins:
+        if v is not None and "master" in v.taints \
+                and v.dtype in HALF_DTYPES:
+            ctx.add(
+                "master-weights", "error",
+                f"master-weight/optimizer-state value is touched in "
+                f"{v.dtype} by '{prim}': O2 discipline keeps params, m "
+                f"and v in fp32 through the whole update path",
+                dedup_key=(prim, v.dtype))
+
+
+def _visit_unsafe_exp(ctx, eqn, ins, outs):
+    prim = eqn.primitive.name
+    op = ins[0] if ins else None
+    if op is None:
+        return
+    if prim == "exp" and op.dtype in HALF_DTYPES \
+            and not op.max_subtracted:
+        ctx.add(
+            "unsafe-exp", "error",
+            f"'exp' on a {op.dtype} value with no subtracted running "
+            f"max: a softmax built this way overflows "
+            f"({'x > ~11' if op.dtype == 'float16' else 'x > ~88'}) — "
+            f"subtract the row max first (or upcast to fp32 and use "
+            f"jax.nn.softmax)",
+            dedup_key=(op.dtype,))
+    elif prim in ("log", "log1p") and op.dtype == "float16":
+        ctx.add(
+            "unsafe-exp", "warning",
+            f"'{prim}' on a float16 value: fp16's 10-bit mantissa and "
+            f"6e-5 normal floor make log unstable near 0/1 — compute "
+            f"it in fp32",
+            dedup_key=(prim,))
+
+
+def _visit_cast_churn(ctx, eqn, ins, outs):
+    if eqn.primitive.name != "convert_element_type" or not outs:
+        return
+    chain = outs[0].cast_chain
+    if len(chain) < 3:
+        return
+    a, b, c = chain[-3:]
+    try:
+        ia, ib = itemsize(a), itemsize(b)
+    except TypeError:
+        return
+    # Two shapes of churn, both pure casts with no compute in between:
+    # - N -> W -> N: the upcast recovered nothing, the round trip is an
+    #   identity paid for in bandwidth;
+    # - a down-up-down cycle (W -> N -> W -> N ...): the value keeps
+    #   bouncing through the narrow dtype.
+    # A single lossy W -> N -> W is deliberately NOT flagged: that is
+    # the normal storage-dtype boundary (producer downcasts its output,
+    # the next consumer upcasts to compute).
+    noop_round_trip = c == a and ib > ia
+    cycle = (len(chain) >= 4 and chain[-1] == chain[-3]
+             and chain[-2] == chain[-4])
+    if noop_round_trip or cycle:
+        shown = chain[-4:] if cycle and not noop_round_trip \
+            else chain[-3:]
+        ctx.add(
+            "cast-churn", "warning",
+            f"cast churn: {' -> '.join(shown)} with no compute in "
+            f"between — the round trip burns bandwidth for nothing"
+            + ("" if noop_round_trip
+               else " and silently rounds through the narrow dtype"),
+            dedup_key=(shown,))
+
+
+def _visit_loss_scale_bypass(ctx, eqn, ins, outs):
+    if ctx.bypass_fired or eqn.primitive.name not in ARITH_PRIMS:
+        return
+    present = [v for v in ins if v is not None]
+    raw_grads = [v for v in present
+                 if "grad" in v.taints and not v.unscaled]
+    state = [v for v in present
+             if {"master", "param"} & v.taints and "grad" not in v.taints]
+    if raw_grads and state:
+        ctx.bypass_fired = True
+        ctx.add(
+            "loss-scale-bypass", "error",
+            f"gradients reach '{eqn.primitive.name}' together with "
+            f"param/optimizer state without passing through the "
+            f"scaler's unscale: the update applies loss-SCALED "
+            f"gradients (effective lr multiplied by the loss scale)")
+
+
+_VISITORS = {
+    "lowprec-accum": _visit_lowprec_accum,
+    "master-weights": _visit_master_weights,
+    "unsafe-exp": _visit_unsafe_exp,
+    "cast-churn": _visit_cast_churn,
+    "loss-scale-bypass": _visit_loss_scale_bypass,
+}
+
+
+def _taints_of(role):
+    if role is None:
+        return frozenset()
+    if isinstance(role, str):
+        return frozenset({role})
+    return frozenset(role)
+
+
+def analyze_precision(fn, *example_args, name=None, roles=None,
+                      master_outs=(), checks=None):
+    """Trace ``fn`` and run the precision-flow checks over its jaxpr.
+
+    ``roles``: {argnum: taint | iterable-of-taints} applied to every
+    leaf of that positional argument. Meaningful taints: ``"grad"``
+    (loss-scaled gradients), ``"scale"`` (the scaler state /
+    loss-scale value), ``"master"`` (params/m/v that must stay fp32 on
+    this path), ``"param"`` (model params; only read by the bypass
+    check). ``master_outs``: flat output indices that must not be half
+    precision. Returns a list of :class:`Finding`.
+    """
+    import jax
+    import numpy as np
+
+    name = name or getattr(fn, "__name__", "fn")
+    path = f"<jaxpr:{name}>"
+    run = set(checks or PRECISION_CHECKS)
+    unknown = run - set(PRECISION_CHECKS)
+    if unknown:
+        raise ValueError(
+            f"unknown precision check(s) {sorted(unknown)}; valid: "
+            f"{list(PRECISION_CHECKS)}")
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+
+    roles = roles or {}
+    ctx = _Ctx(name, path, run)
+    in_vals = []
+    for argnum, arg in enumerate(example_args):
+        taints = _taints_of(roles.get(argnum))
+        for leaf in jax.tree_util.tree_leaves(arg):
+            dtype = str(np.asarray(leaf).dtype) if not hasattr(
+                leaf, "dtype") else str(leaf.dtype)
+            val = AbsVal(dtype=dtype, origin=dtype, taints=taints)
+            in_vals.append(val)
+            if "master-weights" in run and "master" in taints \
+                    and dtype in HALF_DTYPES:
+                ctx.add(
+                    "master-weights", "error",
+                    f"master-weight/optimizer-state input (arg {argnum}) "
+                    f"arrives in {dtype}: the optimizer must hold fp32 "
+                    f"master copies (amp O2)",
+                    dedup_key=("input", argnum, dtype))
+
+    visitors = [_VISITORS[c] for c in PRECISION_CHECKS if c in run]
+
+    def visit(eqn, ins, outs):
+        for v in visitors:
+            v(ctx, eqn, ins, outs)
+
+    out_vals = interpret(closed, in_vals, visit=visit)
+
+    if "master-weights" in run:
+        for idx in master_outs:
+            if idx < len(out_vals) and out_vals[idx] is not None \
+                    and out_vals[idx].dtype in HALF_DTYPES:
+                ctx.add(
+                    "master-weights", "error",
+                    f"output {idx} is a master-weight/optimizer-state "
+                    f"slot but is stored in {out_vals[idx].dtype}: the "
+                    f"fp32 master copy is being narrowed between steps",
+                    dedup_key=("output", idx))
+
+    return ctx.findings
+
+
+def report_to_registry(findings, registry=None):
+    """Publish precision finding counts as the ``analysis/precision``
+    counter family (+ a total gauge) so bench runs carry them in their
+    metrics JSONL. Returns {check id: count} over all five checks."""
+    from apex_tpu.observability import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    counts = {c: 0 for c in PRECISION_CHECKS}
+    for f in findings:
+        if f.check in counts:
+            counts[f.check] += 1
+    for check, n in counts.items():
+        if n:
+            reg.counter("analysis/precision_findings", check=check).inc(n)
+    reg.gauge("analysis/precision_findings_total").set(
+        sum(counts.values()))
+    return counts

@@ -11,9 +11,20 @@ them cheap: tracing only (no compile, no execution) on the CPU backend.
 from __future__ import annotations
 
 from apex_tpu.analysis.findings import Finding
-from apex_tpu.analysis.jaxpr_checks import analyze_fn
+from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS, analyze_fn
+from apex_tpu.analysis.precision_checks import (
+    PRECISION_CHECKS,
+    analyze_precision,
+)
 
 TARGETS = {}
+
+# Per-target grandfather lists (the jaxpr analog of `# apex-lint:
+# disable`, which only reaches AST findings): @target(..., allow=(...))
+# drops those check ids from that target's findings at the source, so a
+# deliberate half-precision path doesn't need a global baseline slot.
+# The CLI's --allow target:check lands here too (see run_targets).
+TARGET_ALLOW = {}
 
 # Check ids produced by non-tracing targets (everything else emits the
 # jaxpr_checks.JAXPR_CHECKS ids). The CLI derives --list-checks, check-id
@@ -21,10 +32,21 @@ TARGETS = {}
 # target-provided checks here, not in cli.py.
 TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 
+# Check ids that require running the tracing targets (the CLI runs the
+# full target suite when any of these is requested).
+TRACING_CHECKS = tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
 
-def target(name):
+
+def target(name, allow=()):
     def deco(fn):
         TARGETS[name] = fn
+        if allow:
+            unknown = set(allow) - set(TRACING_CHECKS) - set(TARGET_CHECKS)
+            if unknown:
+                raise ValueError(
+                    f"@target({name!r}) allows unknown check id(s) "
+                    f"{sorted(unknown)}")
+            TARGET_ALLOW[name] = frozenset(allow)
         return fn
     return deco
 
@@ -209,16 +231,284 @@ def _step_record_schema():
     return findings
 
 
-def run_targets(names=None):
+# ----------------------------------------------- precision-flow targets
+# (ISSUE 3): the amp/optimizer/normalization/transformer entry points
+# whose documented precision discipline the dataflow checks enforce.
+# All are trace-only on the CPU backend, like everything above.
+
+def _leaf_count(tree):
+    import jax
+    return len(jax.tree_util.tree_leaves(tree))
+
+
+@target("mlp_train_step")
+def _mlp_train_step():
+    """bf16 MLP forward+backward with an fp32 loss: every dot must pin
+    an fp32 accumulator (mlp.py preferred_element_type) and the loss
+    reduction must run in fp32 — the seeded-regression anchor the ISSUE
+    names (drop the preferred_element_type and tier-1 fails here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.mlp import mlp_function
+
+    params = (jnp.zeros((128, 256), jnp.bfloat16),
+              jnp.zeros((256,), jnp.bfloat16),
+              jnp.zeros((256, 64), jnp.bfloat16),
+              jnp.zeros((64,), jnp.bfloat16))
+    x = jnp.zeros((32, 128), jnp.bfloat16)
+    y = jnp.zeros((32, 64), jnp.float32)
+
+    def loss_fn(params, x, y):
+        out = mlp_function(True, "relu", x, *params)
+        d = out.astype(jnp.float32) - y
+        return jnp.mean(jnp.square(d))
+
+    return analyze_precision(
+        lambda p, x, y: jax.value_and_grad(loss_fn)(p, x, y),
+        params, x, y, name="mlp_train_step")
+
+
+@target("amp_o1_train_step")
+def _amp_o1_train_step():
+    """O1: fp32 params, bf16 boundary casting via the active policy,
+    loss scaled before backward. The precision contract here is that
+    boundary-cast matmuls still accumulate fp32 and the loss math stays
+    fp32 — exactly what docs/amp.md promises for O1."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.amp import amp as amp_mod
+    from apex_tpu.amp.frontend import Policy
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.mlp import mlp_function
+
+    params = (jnp.zeros((128, 256), jnp.float32),
+              jnp.zeros((256,), jnp.float32),
+              jnp.zeros((256, 64), jnp.float32),
+              jnp.zeros((64,), jnp.float32))
+    x = jnp.zeros((32, 128), jnp.float32)
+    y = jnp.zeros((32, 64), jnp.float32)
+    scaler = LossScaler("dynamic")
+    sstate = scaler.init()
+    policy = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                    output_dtype=jnp.float32)
+
+    def scaled_loss(params, x, y, sstate):
+        out = mlp_function(True, "relu", x, *params)
+        loss = jnp.mean(jnp.square(out.astype(jnp.float32) - y))
+        return scaler.scale_loss(loss, sstate)
+
+    with amp_mod.casting(policy):
+        return analyze_precision(
+            lambda p, x, y, s: jax.value_and_grad(scaled_loss)(p, x, y, s),
+            params, x, y, sstate, name="amp_o1_train_step")
+
+
+@target("amp_o2_master_update")
+def _amp_o2_master_update():
+    """O2 update phase: bf16 model copy, fp32 master + moments, scaled
+    bf16 grads through unscale -> overflow-gated FusedAdam -> master
+    apply -> half re-materialization. Exercises master-weights (the
+    fp32 path must never dip to half) and loss-scale-bypass (the grads
+    must pass the scaler's unscale before touching state)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu.amp.scaler import LossScaler, scaled_update
+    from apex_tpu.optimizers import fused_adam
+
+    master = {"w": jnp.zeros((64, 128), jnp.float32),
+              "b": jnp.zeros((128,), jnp.float32)}
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), master)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.ones_like(p, jnp.bfloat16), master)
+    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=True)
+    state = tx.init(master)
+    scaler = LossScaler("dynamic")
+    sstate = scaler.init()
+
+    def update(grads, opt_state, master, params, sstate):
+        updates, new_opt, new_ss, overflow = scaled_update(
+            tx, scaler, grads, opt_state, master, sstate)
+        new_master = optax.apply_updates(master, updates)
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_master, params)
+        return new_master, new_opt, new_params, new_ss
+
+    n_master = _leaf_count(master)
+    n_state = _leaf_count(state)
+    return analyze_precision(
+        update, grads, state, master, params, sstate,
+        roles={0: "grad", 1: "master", 2: "master", 3: "param",
+               4: "scale"},
+        master_outs=tuple(range(n_master + n_state)),
+        name="amp_o2_master_update")
+
+
+@target("fused_adam_tree_master_step")
+def _fused_adam_tree_master_step():
+    """Per-tensor FusedAdam over fp32 master params: the whole update
+    chain (m, v, decay, apply) must stay fp32."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu.optimizers import fused_adam
+
+    master = {"w": jnp.zeros((64, 128), jnp.float32),
+              "b": jnp.zeros((128,), jnp.float32)}
+    tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=False)
+    state = tx.init(master)
+    grads = jax.tree_util.tree_map(jnp.ones_like, master)
+
+    def step(grads, state, master):
+        updates, new_state = tx.update(grads, state, master)
+        return optax.apply_updates(master, updates), new_state
+
+    n_out = _leaf_count(master) + _leaf_count(state)
+    return analyze_precision(
+        step, grads, state, master,
+        roles={1: "master", 2: "master"},
+        master_outs=tuple(range(n_out)),
+        name="fused_adam_tree_master_step")
+
+
+@target("fused_lamb_master_step")
+def _fused_lamb_master_step():
+    """FusedLAMB over fp32 master params: grad-norm, trust ratio and
+    moments are all reductions/chains that must accumulate fp32."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu.optimizers import fused_lamb
+
+    master = {"w": jnp.zeros((64, 128), jnp.float32),
+              "b": jnp.zeros((128,), jnp.float32)}
+    tx = fused_lamb(lr=1e-3, weight_decay=0.01)
+    state = tx.init(master)
+    grads = jax.tree_util.tree_map(jnp.ones_like, master)
+
+    def step(grads, state, master):
+        updates, new_state = tx.update(grads, state, master)
+        return optax.apply_updates(master, updates), new_state
+
+    n_out = _leaf_count(master) + _leaf_count(state)
+    return analyze_precision(
+        step, grads, state, master,
+        roles={1: "master", 2: "master"},
+        master_outs=tuple(range(n_out)),
+        name="fused_lamb_master_step")
+
+
+@target("fused_layer_norm_fwd_bwd")
+def _fused_layer_norm_fwd_bwd():
+    """FusedLayerNorm forward+backward on bf16 activations with fp32
+    affine params (the Megatron mixed pattern): statistics and both
+    backward reductions must be fp32 — the jnp fallback path is the one
+    dataflow can see (the Pallas kernels are covered by their own unit
+    tests and the pallas-block check)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.normalization import fused_layer_norm_affine
+
+    x = jnp.zeros((256, 1024), jnp.bfloat16)
+    w = jnp.ones((1024,), jnp.float32)
+    b = jnp.zeros((1024,), jnp.float32)
+
+    def loss(x, w, b):
+        y = fused_layer_norm_affine(x, w, b, (1024,))
+        return jnp.sum(y.astype(jnp.float32))
+
+    return analyze_precision(
+        lambda x, w, b: jax.grad(loss, argnums=(0, 1, 2))(x, w, b),
+        x, w, b, name="fused_layer_norm_fwd_bwd")
+
+
+@target("fused_rms_norm_fwd_bwd")
+def _fused_rms_norm_fwd_bwd():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.normalization import fused_rms_norm_affine
+
+    x = jnp.zeros((256, 1024), jnp.bfloat16)
+    w = jnp.ones((1024,), jnp.float32)
+
+    def loss(x, w):
+        y = fused_rms_norm_affine(x, w, (1024,))
+        return jnp.sum(y.astype(jnp.float32))
+
+    return analyze_precision(
+        lambda x, w: jax.grad(loss, argnums=(0, 1))(x, w),
+        x, w, name="fused_rms_norm_fwd_bwd")
+
+
+@target("tp_fused_softmax")
+def _tp_fused_softmax():
+    """Tensor-parallel fused softmax, jnp fallback path on bf16 logits:
+    the exp must sit behind an fp32 upcast + max subtraction (the
+    Pallas kernel keeps the same contract in VMEM)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax,
+    )
+
+    x = jnp.zeros((8, 256, 256), jnp.bfloat16)
+    return analyze_precision(
+        lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0),
+        x, name="tp_fused_softmax")
+
+
+def run_targets(names=None, extra_allow=None):
     """Run the registered targets; returns (findings, errors) where
     errors maps target name -> repr of an exception that kept the target
-    from tracing at all (itself a failure the caller should surface)."""
+    from tracing at all (itself a failure the caller should surface).
+
+    ``extra_allow``: {target name: set of check ids} merged over the
+    ``@target(allow=...)`` lists — findings of an allowed check from
+    that target are dropped (the per-target grandfather the CLI's
+    ``--allow target:check`` feeds)."""
     findings, errors = [], {}
     for name, fn in TARGETS.items():
         if names is not None and name not in names:
             continue
+        allowed = set(TARGET_ALLOW.get(name, ()))
+        if extra_allow:
+            allowed |= set(extra_allow.get(name, ()))
         try:
-            findings.extend(fn())
+            got = fn()
         except Exception as e:  # noqa: BLE001 — report, don't abort the scan
             errors[name] = repr(e)[:300]
+            continue
+        if allowed:
+            got = [f for f in got if f.check not in allowed]
+        findings.extend(got)
     return findings, errors
+
+
+def run_precision_findings(registry=None, names=None):
+    """Run only the precision-flow targets and publish their finding
+    counts to the observability registry (``analysis/precision``
+    counter family) — the hook bench.py reports through. Returns
+    (findings, errors)."""
+    from apex_tpu.analysis.precision_checks import report_to_registry
+
+    wanted = names if names is not None else PRECISION_TARGETS
+    findings, errors = run_targets(wanted)
+    findings = [f for f in findings if f.check in PRECISION_CHECKS]
+    report_to_registry(findings, registry=registry)
+    return findings, errors
+
+
+PRECISION_TARGETS = (
+    "mlp_train_step", "amp_o1_train_step", "amp_o2_master_update",
+    "fused_adam_tree_master_step", "fused_lamb_master_step",
+    "fused_layer_norm_fwd_bwd", "fused_rms_norm_fwd_bwd",
+    "tp_fused_softmax",
+)

@@ -11,46 +11,52 @@ API; ``fused_dense_gelu_dense_function`` uses a custom_vjp that saves
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.ops.precision import einsum_fp32acc, matmul_fp32acc as _mm
+
+_wgrad = functools.partial(einsum_fp32acc, "...i,...o->io")
 
 
 def fused_dense_function(input, weight, bias):
     """gemm + bias; weight is (in, out) (ref FusedDenseFunc)."""
-    return jnp.matmul(input, weight) + bias
+    return _mm(input, weight) + bias
 
 
 def dense_no_bias_function(input, weight):
-    return jnp.matmul(input, weight)
+    return _mm(input, weight)
 
 
 @jax.custom_vjp
 def fused_dense_gelu_dense_function(input, weight1, bias1, weight2, bias2):
     """dense → gelu → dense (ref FusedDenseGeluDenseFunc)."""
-    gelu_in = jnp.matmul(input, weight1) + bias1
+    gelu_in = _mm(input, weight1) + bias1
     output1 = jax.nn.gelu(gelu_in, approximate=False)
-    return jnp.matmul(output1, weight2) + bias2
+    return _mm(output1, weight2) + bias2
 
 
 def _fdgd_fwd(input, weight1, bias1, weight2, bias2):
-    gelu_in = jnp.matmul(input, weight1) + bias1
+    gelu_in = _mm(input, weight1) + bias1
     output1 = jax.nn.gelu(gelu_in, approximate=False)
-    output2 = jnp.matmul(output1, weight2) + bias2
+    output2 = _mm(output1, weight2) + bias2
     return output2, (input, weight1, weight2, gelu_in, output1)
 
 
 def _fdgd_bwd(res, g):
     input, weight1, weight2, gelu_in, output1 = res
     # second gemm
-    d_output1 = jnp.matmul(g, weight2.T)
-    d_weight2 = jnp.einsum("...i,...o->io", output1, g)
+    d_output1 = _mm(g, weight2.T)
+    d_weight2 = _wgrad(output1, g)
     d_bias2 = jnp.sum(g, axis=tuple(range(g.ndim - 1)))
     # gelu (exact erf form) backward
     _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t, approximate=False), gelu_in)
     d_gelu_in = gelu_vjp(d_output1)[0]
     # first gemm
-    d_input = jnp.matmul(d_gelu_in, weight1.T)
-    d_weight1 = jnp.einsum("...i,...o->io", input, d_gelu_in)
+    d_input = _mm(d_gelu_in, weight1.T)
+    d_weight1 = _wgrad(input, d_gelu_in)
     d_bias1 = jnp.sum(d_gelu_in, axis=tuple(range(d_gelu_in.ndim - 1)))
     return d_input, d_weight1, d_bias1, d_weight2, d_bias2
 

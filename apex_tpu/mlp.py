@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.precision import matmul_fp32acc
 
 _ACTIVATIONS = ("none", "relu", "sigmoid")
 
@@ -48,11 +49,18 @@ def _forward(bias, activation, x, wb):
     y = x
     for i in range(n):
         w = wb[i * step]
-        y = jnp.matmul(y, w)
+        # accumulator pinned >= fp32 with bias+activation kept in the
+        # accumulator dtype, storage dtype restored per layer (enforced
+        # by the mlp_train_step precision target — apex_tpu.analysis
+        # lowprec-accum; downcasting before the bias add would push the
+        # bias-grad reduction into bf16)
+        out_dtype = jnp.promote_types(y.dtype, w.dtype)
+        y = matmul_fp32acc(y, w, keep_acc=True)
         if bias:
             y = y + wb[i * step + 1]
         if i < n - 1:
             y = _act(y, activation)
+        y = y.astype(out_dtype)
     return y
 
 

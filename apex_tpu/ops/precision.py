@@ -1,0 +1,48 @@
+"""fp32-accumulator contraction helpers.
+
+One home for the "storage dtype unchanged, MXU accumulator pinned at
+>= fp32" contract every half-precision contraction in the tree follows
+(enforced by the ``apex_tpu.analysis`` ``lowprec-accum`` precision
+check): the result dtype stays the operands' promotion (so callers'
+dtype contracts are untouched), while ``preferred_element_type`` keeps
+the partial sums in at least fp32 on the MXU. For fp32/fp64 operands
+both helpers are exact no-ops relative to a plain call.
+
+Used by ``mlp``, ``fused_dense``, ``transformer.tensor_parallel.layers``
+and ``transformer.moe`` — fix accumulation policy here, not per-site.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _acc_dtype(out_dtype):
+    if not jnp.issubdtype(out_dtype, jnp.floating):
+        return out_dtype  # integer/bool contraction: leave untouched
+    return jnp.promote_types(out_dtype, jnp.float32)
+
+
+def matmul_fp32acc(a, b, *, keep_acc=False):
+    """``jnp.matmul`` with the accumulator pinned at >= fp32; output
+    dtype identical to ``jnp.matmul(a, b)``.
+
+    ``keep_acc=True`` returns the accumulator-dtype result instead of
+    downcasting — for callers that fuse more fp32 epilogue work (bias,
+    activation) before settling to the storage dtype. They own the final
+    downcast; leaving the epilogue in the narrow dtype would push its
+    *backward* reductions (e.g. the bias-grad sum) into bf16, which the
+    lowprec-accum check rightly flags.
+    """
+    out = jnp.result_type(a, b)
+    y = jnp.matmul(a, b, preferred_element_type=_acc_dtype(out))
+    return y if keep_acc else y.astype(out)
+
+
+def einsum_fp32acc(subscripts, a, b):
+    """``jnp.einsum`` (two operands) with the accumulator pinned at
+    >= fp32; output dtype identical to ``jnp.einsum(subscripts, a, b)``."""
+    out = jnp.result_type(a, b)
+    return jnp.einsum(
+        subscripts, a, b,
+        preferred_element_type=_acc_dtype(out)).astype(out)

@@ -30,6 +30,10 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+# dispatch/combine/expert einsums contract over the (large) token and
+# capacity axes — bf16 partial sums there lose real gate mass, so the
+# accumulator is pinned >= fp32 (apex_tpu.analysis lowprec-accum)
+from apex_tpu.ops.precision import einsum_fp32acc as _ein_fp32acc
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
 
 EXPERT_AXIS = "ep"
@@ -193,7 +197,7 @@ def expert_parallel_apply(expert_fn, expert_params, x, router,
     gated = router_gates(logits, cfg, with_stats=with_stats)
     combine, dispatch, aux = gated[:3]
 
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(xt.dtype), xt)
+    expert_in = _ein_fp32acc("tec,th->ech", dispatch.astype(xt.dtype), xt)
 
     if _axis_bound(ep_axis):
         # [E, C, h] -> [E/n, n*C, h]: send expert-chunk j to rank j, gather
@@ -212,7 +216,7 @@ def expert_parallel_apply(expert_fn, expert_params, x, router,
         y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
                                tiled=True)
 
-    out = jnp.einsum("tec,ech->th", combine.astype(xt.dtype), y)
+    out = _ein_fp32acc("tec,ech->th", combine.astype(xt.dtype), y)
     out = out.reshape(*lead, h).astype(x.dtype)
     if with_stats:
         return out, aux.astype(jnp.float32), gated[3]
@@ -232,9 +236,11 @@ def moe_mlp(params, x, cfg: MoEConfig, ep_axis: Optional[str] = EXPERT_AXIS,
     """
 
     def expert_fn(p, tokens):
-        y = jnp.einsum("ech,ehf->ecf", tokens, p["wi"].astype(tokens.dtype))
+        y = _ein_fp32acc("ech,ehf->ecf", tokens,
+                         p["wi"].astype(tokens.dtype))
         y = activation(y)
-        return jnp.einsum("ecf,efh->ech", y, p["wo"].astype(tokens.dtype))
+        return _ein_fp32acc("ecf,efh->ech", y,
+                            p["wo"].astype(tokens.dtype))
 
     return expert_parallel_apply(
         expert_fn, {"wi": params["wi"], "wo": params["wo"]}, x,

@@ -30,6 +30,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.precision import matmul_fp32acc as _mm_fp32acc
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.tensor_parallel import mappings
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
@@ -139,7 +140,7 @@ class ColumnParallelLinear(nn.Module):
             # Input arrives sequence-sharded over tp; the gemm needs the
             # full sequence — constrain to replicated and let XLA gather.
             x = _constrain(x, *([None] * x.ndim))
-        y = jnp.matmul(x.astype(dtype), kernel.astype(dtype))
+        y = _mm_fp32acc(x.astype(dtype), kernel.astype(dtype))
         if bias is not None and not self.skip_bias_add:
             y = y + bias.astype(dtype)
         if self.gather_output:
@@ -188,7 +189,7 @@ class RowParallelLinear(nn.Module):
         dtype = self.compute_dtype or x.dtype
         if not self.input_is_parallel:
             x = _constrain(x, *([None] * (x.ndim - 1)), TP)
-        y = jnp.matmul(x.astype(dtype), kernel.astype(dtype))
+        y = _mm_fp32acc(x.astype(dtype), kernel.astype(dtype))
         if self.sequence_parallel_enabled:
             # reduce_scatter over the sequence dim instead of full allreduce.
             y = _constrain(y, TP, *([None] * (y.ndim - 1)))
@@ -248,16 +249,16 @@ def _matmul_fp32_wgrad(x, weight):
     grad-accumulation loops carry fp32 main grads with no cast or extra
     buffer per microbatch.
     """
-    return jnp.matmul(x, weight.astype(x.dtype))
+    return _mm_fp32acc(x, weight.astype(x.dtype))
 
 
 def _matmul_fp32_wgrad_fwd(x, weight):
-    return jnp.matmul(x, weight.astype(x.dtype)), (x, weight)
+    return _mm_fp32acc(x, weight.astype(x.dtype)), (x, weight)
 
 
 def _matmul_fp32_wgrad_bwd(res, g):
     x, weight = res
-    dx = jnp.matmul(g, weight.astype(g.dtype).swapaxes(-1, -2))
+    dx = _mm_fp32acc(g, weight.astype(g.dtype).swapaxes(-1, -2))
     # fp32 accumulation on the MXU; cotangent dtype = stored weight dtype
     dw = jnp.einsum("...i,...o->io", x, g,
                     preferred_element_type=jnp.float32)
@@ -300,7 +301,7 @@ def linear_with_grad_accumulation_and_async_allreduce(
     if gradient_accumulation_fusion:
         y = _matmul_fp32_wgrad(x, weight)
     else:
-        y = jnp.matmul(x, weight)
+        y = _mm_fp32acc(x, weight)
     if bias is not None:
         y = y + bias
     return y
@@ -340,7 +341,7 @@ def row_parallel_linear(
     axis = axis_name if axis_name is not None else TP
     if not input_is_parallel:
         x = mappings.scatter_to_tensor_model_parallel_region(x, axis)
-    y = jnp.matmul(x, kernel)
+    y = _mm_fp32acc(x, kernel)
     if sequence_parallel_enabled:
         y = mappings.reduce_scatter_to_sequence_parallel_region(y, axis,
                                                                 seq_dim=seq_dim)

@@ -781,6 +781,23 @@ def worker():
     speedup, fused_ms = bench_fused_adam(cpu_mode, extras)
     extras["fused_adam_step_ms"] = round(fused_ms * 1e3, 3)
 
+    # precision-flow sanitizer verdict for this run (trace-only, any
+    # backend): counts land in the metrics JSONL via the
+    # analysis/precision counter family and in the JSON line, so a
+    # perf number always ships with its mixed-precision lint status
+    try:
+        from apex_tpu.analysis import run_precision_findings
+
+        pfindings, perrors = run_precision_findings(registry=reg)
+        extras["precision_findings"] = len(pfindings)
+        if perrors:
+            # full reprs: the bench JSON is the only artifact a remote
+            # run ships, so "which target" without "why" is useless
+            extras["precision_target_errors"] = dict(sorted(
+                perrors.items()))
+    except Exception as e:  # never let the sanitizer cost the JSON line
+        extras["precision_findings_error"] = repr(e)[:120]
+
     def finalize_metrics():
         """Fold recompile counts into extras and (re)write the metrics
         JSONL — called before EVERY emit so even a timed-out worker

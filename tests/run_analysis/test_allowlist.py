@@ -1,0 +1,96 @@
+"""Per-target check allowlisting (the jaxpr analog of `# apex-lint:
+disable`): @target(allow=...) and the CLI's --allow target:check."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis import cli
+from apex_tpu.analysis import targets as targets_mod
+from apex_tpu.analysis.precision_checks import analyze_precision
+
+
+@pytest.fixture
+def scratch_target():
+    """Register a deliberately-violating precision target; clean up."""
+    name = "_test_bf16_sum_target"
+
+    def bad():
+        x = jnp.ones((4, 8), jnp.bfloat16)
+        return analyze_precision(
+            lambda x, w: jnp.matmul(x, w), x, x.T, name=name)
+
+    targets_mod.TARGETS[name] = bad
+    try:
+        yield name
+    finally:
+        targets_mod.TARGETS.pop(name, None)
+        targets_mod.TARGET_ALLOW.pop(name, None)
+
+
+def test_violation_reported_without_allow(scratch_target):
+    findings, errors = targets_mod.run_targets((scratch_target,))
+    assert not errors
+    assert [f.check for f in findings] == ["lowprec-accum"]
+
+
+def test_decorator_allow_drops_findings(scratch_target):
+    targets_mod.TARGET_ALLOW[scratch_target] = frozenset(
+        {"lowprec-accum"})
+    findings, errors = targets_mod.run_targets((scratch_target,))
+    assert not errors and not findings
+
+
+def test_extra_allow_drops_findings(scratch_target):
+    findings, _ = targets_mod.run_targets(
+        (scratch_target,),
+        extra_allow={scratch_target: {"lowprec-accum"}})
+    assert not findings
+
+
+def test_allow_is_per_target(scratch_target):
+    """An allow for one target must not grandfather another target's
+    findings of the same check."""
+    findings, _ = targets_mod.run_targets(
+        (scratch_target,),
+        extra_allow={"mlp_train_step": {"lowprec-accum"}})
+    assert [f.check for f in findings] == ["lowprec-accum"]
+
+
+def test_decorator_rejects_unknown_check():
+    with pytest.raises(ValueError, match="unknown check"):
+        @targets_mod.target("_test_bad_allow", allow=("no-such-check",))
+        def t():  # pragma: no cover
+            return []
+    targets_mod.TARGETS.pop("_test_bad_allow", None)
+
+
+def test_parse_allow_happy_path():
+    allow = cli.parse_allow(["mlp_train_step:lowprec-accum",
+                             "mlp_train_step:cast-churn",
+                             "tp_fused_softmax:unsafe-exp"])
+    assert allow == {
+        "mlp_train_step": {"lowprec-accum", "cast-churn"},
+        "tp_fused_softmax": {"unsafe-exp"},
+    }
+
+
+@pytest.mark.parametrize("entry,match", [
+    ("no-colon", "expects target:check"),
+    ("nosuchtarget:lowprec-accum", "unknown target"),
+    ("mlp_train_step:nosuchcheck", "no jaxpr target can emit"),
+    # AST-only ids are real check ids but no jaxpr target ever emits
+    # them — accepting one would be a silently-dead allow
+    ("mlp_train_step:sync-timing", "no jaxpr target can emit"),
+])
+def test_parse_allow_rejects_typos(entry, match):
+    """A typo'd allow silently matching nothing would stop allowing —
+    fail loudly instead (same rule as --checks/paths)."""
+    with pytest.raises(ValueError, match=match):
+        cli.parse_allow([entry])
+
+
+def test_cli_run_threads_allow_through(scratch_target):
+    findings, errors = cli.run(jaxpr=True, ast=False,
+                               allow={scratch_target: {"lowprec-accum"}})
+    assert not errors
+    assert not [f for f in findings if f.symbol == scratch_target]

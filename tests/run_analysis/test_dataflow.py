@@ -1,0 +1,140 @@
+"""Dataflow engine unit tests: the value lattice and its transfer
+functions, independent of the client checks."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis.dataflow import (
+    AbsVal,
+    abs_val_for_aval,
+    interpret,
+)
+
+
+def _closed(fn, *args):
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _in_vals(taints_by_idx, *args):
+    vals = []
+    for i, a in enumerate(args):
+        vals.append(AbsVal(dtype=str(a.dtype), origin=str(a.dtype),
+                           taints=frozenset(taints_by_idx.get(i, ()))))
+    return vals
+
+
+def test_convert_tracks_cast_chain_and_resets_on_compute():
+    events = []
+
+    def visit(eqn, ins, outs):
+        if eqn.primitive.name == "convert_element_type":
+            events.append(outs[0].cast_chain)
+
+    x = jnp.ones((4,), jnp.float32)
+    interpret(_closed(
+        lambda x: (x.astype(jnp.bfloat16).astype(jnp.float16) * 2.0)
+        .astype(jnp.float32), x),
+        _in_vals({}, x), visit=visit)
+    # two consecutive converts build one chain; the mul resets it so the
+    # final convert starts fresh
+    assert events[0] == ("float32", "bfloat16")
+    assert events[1] == ("float32", "bfloat16", "float16")
+    assert events[-1][0] != "float32" or len(events[-1]) == 2
+
+
+def test_taints_flow_through_pjit_and_unscale_marks_grad():
+    x = jnp.ones((4,), jnp.float32)
+    s = jnp.asarray(2.0, jnp.float32)
+
+    @jax.jit
+    def inner(g, s):
+        return g * (1.0 / s)
+
+    outs = interpret(_closed(inner, x, s),
+                     _in_vals({0: {"grad"}, 1: {"scale"}}, x, s))
+    assert "grad" in outs[0].taints
+    assert outs[0].unscaled
+
+
+def test_no_unscale_without_scale_taint():
+    x = jnp.ones((4,), jnp.float32)
+    outs = interpret(_closed(lambda g: g * 0.5, x),
+                     _in_vals({0: {"grad"}}, x))
+    assert not outs[0].unscaled
+
+
+def test_reduction_depth_counts_accumulating_ops():
+    x = jnp.ones((4, 4), jnp.float32)
+    outs = interpret(
+        _closed(lambda x: jnp.sum(x @ x), x), _in_vals({}, x))
+    assert outs[0].reduction_depth >= 2  # dot + reduce_sum
+
+
+def test_max_subtraction_survives_stop_gradient():
+    """jax.nn.softmax subtracts a stop_gradient'ed running max; the
+    lattice must still see the exp input as max-subtracted."""
+    seen = []
+
+    def visit(eqn, ins, outs):
+        if eqn.primitive.name == "exp":
+            seen.append(ins[0].max_subtracted)
+
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    interpret(_closed(lambda x: jax.nn.softmax(x, axis=-1), x),
+              _in_vals({}, x), visit=visit)
+    assert seen and all(seen)
+
+
+def test_cond_branches_join_taints():
+    x = jnp.ones((4,), jnp.float32)
+    p = jnp.asarray(True)
+
+    def fn(pred, x):
+        return jax.lax.cond(pred, lambda v: v * 2.0, lambda v: v + 1.0, x)
+
+    outs = interpret(_closed(fn, p, x),
+                     _in_vals({1: {"grad"}}, p, x))
+    assert "grad" in outs[0].taints
+
+
+def test_scan_body_is_entered():
+    seen = []
+
+    def visit(eqn, ins, outs):
+        if eqn.primitive.name == "mul":
+            seen.append([v.taints for v in ins if v is not None])
+
+    def fn(c, xs):
+        def body(c, x):
+            return c * x, c
+        return jax.lax.scan(body, c, xs)
+
+    c = jnp.ones((), jnp.float32)
+    xs = jnp.ones((3,), jnp.float32)
+    interpret(_closed(fn, c, xs), _in_vals({0: {"grad"}}, c, xs),
+              visit=visit)
+    assert any(any("grad" in t for t in ts) for ts in seen)
+
+
+def test_pallas_call_is_opaque_but_propagates_taints():
+    pl = pytest.importorskip("jax.experimental.pallas")
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True)(x)
+
+    x = jnp.ones((8, 128), jnp.float32)
+    outs = interpret(_closed(fn, x), _in_vals({0: {"grad"}}, x))
+    assert "grad" in outs[0].taints
+
+
+def test_abs_val_for_aval_defaults():
+    v = abs_val_for_aval(jax.ShapeDtypeStruct((2,), jnp.bfloat16))
+    assert v.dtype == "bfloat16" and v.origin == "bfloat16"
+    assert not v.taints and v.cast_chain == ()

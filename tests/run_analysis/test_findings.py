@@ -1,0 +1,116 @@
+"""Finding-model edge cases (ISSUE 3 satellite): suppression-comment
+scoping and baseline occurrence-counting stability."""
+
+import collections
+
+from apex_tpu.analysis.findings import (
+    Finding,
+    is_suppressed,
+    load_baseline,
+    new_findings,
+    save_baseline,
+    suppressed_checks,
+)
+
+
+def _f(check="sync-timing", path="a.py", symbol="fn", line=1):
+    return Finding(check, "error", path, line, symbol, "msg")
+
+
+# ---------------------------------------------------------- suppression
+
+def test_trailing_comment_on_previous_code_line_does_not_leak():
+    """A trailing disable on the previous CODE line suppresses that
+    line, not this one."""
+    src = ["x = float(y)  # apex-lint: disable=host-in-jit",
+           "z = float(w)"]
+    assert suppressed_checks(src, 1) == {"host-in-jit"}
+    assert suppressed_checks(src, 2) is None
+
+
+def test_comment_only_line_above_suppresses():
+    src = ["# apex-lint: disable=host-in-jit",
+           "z = float(w)"]
+    assert suppressed_checks(src, 2) == {"host-in-jit"}
+
+
+def test_mixed_id_list_parses_with_spaces_and_empties():
+    src = ["x = 1  # apex-lint: disable=host-in-jit, sync-timing,,rng-in-jit "]
+    assert suppressed_checks(src, 1) == {
+        "host-in-jit", "sync-timing", "rng-in-jit"}
+
+
+def test_bare_disable_is_empty_set_meaning_all():
+    src = ["x = 1  # apex-lint: disable"]
+    ids = suppressed_checks(src, 1)
+    assert ids == set()
+    assert is_suppressed(_f(check="anything-at-all"), src)
+
+
+def test_named_disable_only_suppresses_named_checks():
+    src = ["x = 1  # apex-lint: disable=host-in-jit"]
+    assert is_suppressed(_f(check="host-in-jit"), src)
+    assert not is_suppressed(_f(check="sync-timing"), src)
+
+
+def test_same_line_and_line_above_ids_merge():
+    src = ["# apex-lint: disable=rng-in-jit",
+           "x = 1  # apex-lint: disable=host-in-jit"]
+    assert suppressed_checks(src, 2) == {"rng-in-jit", "host-in-jit"}
+
+
+def test_out_of_range_lineno_is_none():
+    assert suppressed_checks(["x = 1"], 0) is None
+    assert suppressed_checks(["x = 1"], 99) is None
+
+
+# ------------------------------------------------------------- baseline
+
+def test_two_same_key_findings_occupy_two_slots(tmp_path):
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [_f(line=3), _f(line=9)])
+    baseline = load_baseline(path)
+    assert baseline[_f().key] == 2
+    # two current findings of the key: fully covered
+    assert not new_findings([_f(line=3), _f(line=9)], baseline)
+    # a third occurrence exceeds the budget
+    fresh = new_findings([_f(line=3), _f(line=9), _f(line=30)], baseline)
+    assert len(fresh) == 1
+
+
+def test_unrelated_same_check_same_file_finding_is_not_absorbed(tmp_path):
+    """Adding a finding of the SAME check in the SAME file but another
+    symbol must not eat the grandfathered slot (keys include the
+    symbol)."""
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [_f(symbol="old_fn")])
+    baseline = load_baseline(path)
+    current = [_f(symbol="old_fn"), _f(symbol="new_fn")]
+    fresh = new_findings(current, baseline)
+    assert [f.symbol for f in fresh] == ["new_fn"]
+
+
+def test_line_number_churn_does_not_invalidate_baseline(tmp_path):
+    """Keys exclude the line: edits above a grandfathered finding must
+    not churn the baseline."""
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [_f(line=10)])
+    baseline = load_baseline(path)
+    assert not new_findings([_f(line=999)], baseline)
+
+
+def test_fixed_finding_leaves_budget_unused(tmp_path):
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [_f()])
+    baseline = load_baseline(path)
+    assert new_findings([], baseline) == []
+
+
+def test_baseline_round_trip_is_sorted_and_counted(tmp_path):
+    path = tmp_path / "baseline.json"
+    findings = [_f(check="b-check"), _f(check="a-check"),
+                _f(check="a-check")]
+    save_baseline(path, findings)
+    loaded = load_baseline(path)
+    assert loaded == collections.Counter({
+        _f(check="a-check").key: 2, _f(check="b-check").key: 1})

@@ -1,0 +1,328 @@
+"""Precision-flow checks: each of the five gets a true-positive snippet
+it MUST flag and an idiomatic clean snippet it must NOT flag, plus the
+ISSUE's seeded regressions against the real library entry points."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis.precision_checks import (
+    PRECISION_CHECKS,
+    analyze_precision,
+)
+
+
+def _by_check(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# -------------------------------------------------------- lowprec-accum
+
+def test_half_dot_without_fp32_accum_flagged():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    found = _by_check(
+        analyze_precision(lambda x, w: jnp.matmul(x, w), x, x.T),
+        "lowprec-accum")
+    assert len(found) == 1 and "preferred_element_type" in found[0].message
+
+
+def test_half_dot_with_fp32_accum_clean():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    found = analyze_precision(
+        lambda x, w: jnp.matmul(
+            x, w, preferred_element_type=jnp.float32), x, x.T)
+    assert not _by_check(found, "lowprec-accum"), found
+
+
+def test_half_reduce_sum_flagged_and_upcast_clean():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    bad = analyze_precision(
+        lambda x: jax.lax.reduce_sum_p.bind(x, axes=(0, 1)), x)
+    assert _by_check(bad, "lowprec-accum")
+    # jnp.sum upcasts f16/bf16 internally — the idiomatic path is clean
+    ok = analyze_precision(lambda x: jnp.sum(x), x)
+    assert not _by_check(ok, "lowprec-accum"), ok
+
+
+# ------------------------------------------------------- master-weights
+
+def test_master_input_in_half_flagged():
+    m = jnp.ones((4,), jnp.bfloat16)
+    found = _by_check(
+        analyze_precision(lambda m: m * 0.9, m, roles={0: "master"}),
+        "master-weights")
+    assert found and "arrives in bfloat16" in found[0].message
+
+
+def test_master_touched_in_half_flagged():
+    m = jnp.ones((4,), jnp.float32)
+
+    def fn(m):
+        return m.astype(jnp.bfloat16) * 0.9
+
+    found = _by_check(
+        analyze_precision(fn, m, roles={0: "master"}), "master-weights")
+    assert found and "touched in bfloat16" in found[0].message
+
+
+def test_master_output_in_half_flagged_and_model_copy_clean():
+    m = jnp.ones((4,), jnp.float32)
+    # storing the master itself in bf16 -> flagged
+    bad = analyze_precision(lambda m: m.astype(jnp.bfloat16), m,
+                            roles={0: "master"}, master_outs=(0,))
+    assert _by_check(bad, "master-weights")
+    # the O2 re-materialized half model copy is a NON-master output slot
+    ok = analyze_precision(lambda m: (m, m.astype(jnp.bfloat16)), m,
+                           roles={0: "master"}, master_outs=(0,))
+    assert not _by_check(ok, "master-weights"), ok
+
+
+def test_fp32_master_update_clean():
+    m = jnp.ones((4,), jnp.float32)
+    g = jnp.ones((4,), jnp.float32)
+    found = analyze_precision(
+        lambda m, g: m - 1e-3 * g, m, g, roles={0: "master"},
+        master_outs=(0,))
+    assert not _by_check(found, "master-weights"), found
+
+
+# ----------------------------------------------------------- unsafe-exp
+
+def test_softmax_without_max_subtract_flagged():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+
+    def naive(x):
+        e = jnp.exp(x)
+        return e / jnp.sum(e.astype(jnp.float32),
+                           axis=-1, keepdims=True).astype(x.dtype)
+
+    found = _by_check(analyze_precision(naive, x), "unsafe-exp")
+    assert found and found[0].severity == "error"
+
+
+def test_softmax_with_max_subtract_clean():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+
+    def stable(x):
+        m = jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(x - m)
+        return e / jnp.sum(e.astype(jnp.float32),
+                           axis=-1, keepdims=True).astype(x.dtype)
+
+    assert not _by_check(analyze_precision(stable, x), "unsafe-exp")
+
+
+def test_jax_nn_softmax_clean():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    found = analyze_precision(lambda x: jax.nn.softmax(x, axis=-1), x)
+    assert not _by_check(found, "unsafe-exp"), found
+
+
+def test_log_on_fp16_flagged():
+    x = jnp.ones((4,), jnp.float16)
+    found = _by_check(analyze_precision(lambda x: jnp.log(x), x),
+                      "unsafe-exp")
+    assert found and found[0].severity == "warning"
+
+
+# ----------------------------------------------------------- cast-churn
+
+def test_noop_round_trip_flagged():
+    x = jnp.ones((4,), jnp.bfloat16)
+    found = _by_check(
+        analyze_precision(
+            lambda x: x.astype(jnp.float32).astype(jnp.bfloat16), x),
+        "cast-churn")
+    assert len(found) == 1
+
+
+def test_down_up_down_cycle_flagged():
+    x = jnp.ones((4,), jnp.float32)
+    found = _by_check(
+        analyze_precision(
+            lambda x: x.astype(jnp.bfloat16).astype(jnp.float32)
+            .astype(jnp.bfloat16), x),
+        "cast-churn")
+    assert found
+
+
+def test_storage_boundary_downcast_then_upcast_not_flagged():
+    """Producer downcasts its output, consumer upcasts to compute:
+    that's the storage-dtype contract, not churn."""
+    x = jnp.ones((4,), jnp.float32)
+    found = analyze_precision(
+        lambda x: x.astype(jnp.bfloat16).astype(jnp.float32), x)
+    assert not _by_check(found, "cast-churn"), found
+
+
+def test_compute_between_casts_not_flagged():
+    x = jnp.ones((4,), jnp.bfloat16)
+    found = analyze_precision(
+        lambda x: (x.astype(jnp.float32) * 2.0).astype(jnp.bfloat16), x)
+    assert not _by_check(found, "cast-churn"), found
+
+
+# ---------------------------------------------------- loss-scale-bypass
+
+def _bypass_roles():
+    return {0: "grad", 1: "master", 2: "scale"}
+
+
+def test_bypass_flagged():
+    g = jnp.ones((4,), jnp.float32)
+    p = jnp.ones((4,), jnp.float32)
+    s = jnp.asarray(2.0 ** 10, jnp.float32)
+    found = _by_check(
+        analyze_precision(lambda g, p, s: p - 1e-3 * g, g, p, s,
+                          roles=_bypass_roles()),
+        "loss-scale-bypass")
+    assert len(found) == 1 and "unscale" in found[0].message
+
+
+def test_unscaled_grads_clean():
+    g = jnp.ones((4,), jnp.float32)
+    p = jnp.ones((4,), jnp.float32)
+    s = jnp.asarray(2.0 ** 10, jnp.float32)
+
+    def step(g, p, s):
+        g = g * (1.0 / s)
+        return p - 1e-3 * g
+
+    found = analyze_precision(step, g, p, s, roles=_bypass_roles())
+    assert not _by_check(found, "loss-scale-bypass"), found
+
+
+def test_bypass_detected_through_cond():
+    """The update hiding inside a lax.cond branch (the overflow-skip
+    idiom) is still seen."""
+    g = jnp.ones((4,), jnp.float32)
+    p = jnp.ones((4,), jnp.float32)
+    s = jnp.asarray(2.0 ** 10, jnp.float32)
+
+    def step(g, p, s):
+        ok = jnp.all(jnp.isfinite(g))
+        return jax.lax.cond(ok, lambda _: p - 1e-3 * g,
+                            lambda _: p, None)
+
+    found = _by_check(
+        analyze_precision(step, g, p, s, roles=_bypass_roles()),
+        "loss-scale-bypass")
+    assert len(found) == 1
+
+
+def test_scaled_update_protocol_clean():
+    """The shipped scaler protocol (unscale -> overflow cond -> update)
+    end to end."""
+    import optax
+
+    from apex_tpu.amp.scaler import LossScaler, scaled_update
+    from apex_tpu.optimizers import fused_adam
+
+    master = {"w": jnp.zeros((8, 16), jnp.float32)}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.ones_like(p, jnp.bfloat16), master)
+    tx = fused_adam(lr=1e-3, flat=True)
+    state = tx.init(master)
+    scaler = LossScaler("dynamic")
+    sstate = scaler.init()
+
+    def update(grads, opt_state, master, sstate):
+        updates, new_opt, new_ss, _ = scaled_update(
+            tx, scaler, grads, opt_state, master, sstate)
+        return optax.apply_updates(master, updates), new_opt, new_ss
+
+    found = analyze_precision(
+        update, grads, state, master, sstate,
+        roles={0: "grad", 1: "master", 2: "master", 3: "scale"})
+    assert not _by_check(found, "loss-scale-bypass"), found
+    assert not _by_check(found, "master-weights"), found
+
+
+# --------------------------------------------- seeded regressions (ISSUE)
+
+def test_seeded_regression_mlp_without_fp32_accum(monkeypatch):
+    """Drop the preferred_element_type from the MLP matmul (the exact
+    regression the ISSUE names) and the registered tier-1 target must
+    light up."""
+    from apex_tpu import mlp as mlp_mod
+    from apex_tpu.analysis import targets
+
+    def naive_forward(bias, activation, x, wb):
+        step = 2 if bias else 1
+        n = len(wb) // step
+        y = x
+        for i in range(n):
+            y = jnp.matmul(y, wb[i * step])
+            if bias:
+                y = y + wb[i * step + 1]
+            if i < n - 1:
+                y = mlp_mod._act(y, activation)
+        return y
+
+    monkeypatch.setattr(mlp_mod, "_forward", naive_forward)
+    findings, errors = targets.run_targets(("mlp_train_step",))
+    assert not errors, errors
+    assert _by_check(findings, "lowprec-accum"), findings
+
+
+def test_seeded_regression_fused_adam_half_moments():
+    """Let fused_adam store m in bf16 — the master-weight discipline
+    check must catch the narrowed state."""
+    import optax
+
+    from apex_tpu.optimizers import fused_adam
+
+    master = {"w": jnp.zeros((8, 16), jnp.float32)}
+    tx = fused_adam(lr=1e-3, flat=False)
+    state = tx.init(master)
+    grads = jax.tree_util.tree_map(jnp.ones_like, master)
+
+    def bad_step(grads, state, master):
+        updates, new_state = tx.update(grads, state, master)
+        new_state = new_state._replace(mu=jax.tree_util.tree_map(
+            lambda m: m.astype(jnp.bfloat16), new_state.mu))
+        return optax.apply_updates(master, updates), new_state
+
+    n_out = (len(jax.tree_util.tree_leaves(master))
+             + len(jax.tree_util.tree_leaves(state)))
+    found = analyze_precision(
+        bad_step, grads, state, master,
+        roles={1: "master", 2: "master"},
+        master_outs=tuple(range(n_out)))
+    assert _by_check(found, "master-weights"), found
+
+
+def test_registered_precision_targets_are_clean():
+    """The acceptance bar: all five checks over all precision targets,
+    trace-only on the CPU backend, 0 findings."""
+    from apex_tpu.analysis.targets import PRECISION_TARGETS, run_targets
+
+    findings, errors = run_targets(PRECISION_TARGETS)
+    assert not errors, errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------- plumbing
+
+def test_unknown_precision_check_raises():
+    with pytest.raises(ValueError, match="unknown precision check"):
+        analyze_precision(lambda x: x, jnp.ones(()),
+                          checks=("no-such-check",))
+
+
+def test_report_to_registry_counts():
+    from apex_tpu.analysis.findings import Finding
+    from apex_tpu.analysis.precision_checks import report_to_registry
+    from apex_tpu.observability.registry import MetricRegistry
+
+    reg = MetricRegistry()
+    fake = [Finding("cast-churn", "warning", "<jaxpr:t>", 0, "t", "m"),
+            Finding("cast-churn", "warning", "<jaxpr:t>", 0, "t", "m2"),
+            Finding("unsafe-exp", "error", "<jaxpr:t>", 0, "t", "m3")]
+    counts = report_to_registry(fake, registry=reg)
+    assert counts["cast-churn"] == 2 and counts["unsafe-exp"] == 1
+    assert set(counts) == set(PRECISION_CHECKS)
+    recs = reg.to_records()
+    total = [r for r in recs
+             if r["name"] == "analysis/precision_findings_total"]
+    assert total and total[0]["value"] == 3

@@ -97,5 +97,71 @@ def test_cli_list_checks():
     for cid in ("donation", "recompile", "collective-axis",
                 "pallas-block", "sync-timing", "host-in-jit",
                 "rng-in-jit", "mutable-default",
-                "kernel-auto-provenance"):
+                "kernel-auto-provenance", "lowprec-accum",
+                "master-weights", "unsafe-exp", "cast-churn",
+                "loss-scale-bypass"):
         assert cid in proc.stdout, cid
+
+
+def test_cli_json_carries_schema_version():
+    """tools/metrics_report.py dispatches on schema_version + kind;
+    the contract lives here."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--no-jaxpr",
+         "--json", "--checks", "mutable-default"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    data = json.loads(proc.stdout)
+    assert data["schema_version"] == 1
+    assert data["kind"] == "apex_tpu.analysis"
+    assert "findings" in data and "target_errors" in data
+
+
+def test_metrics_report_ingests_analysis_json(tmp_path):
+    import json
+
+    report = tmp_path / "lint.json"
+    report.write_text(json.dumps({
+        "schema_version": 1, "kind": "apex_tpu.analysis",
+        "findings": [{"check": "cast-churn", "severity": "warning",
+                      "path": "<jaxpr:t>", "line": 0, "symbol": "t",
+                      "message": "m"}],
+        "grandfathered": 2, "target_errors": {}}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_report.py"),
+         str(report)],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "cast-churn" in proc.stdout
+    assert "1 new" in proc.stdout and "2 grandfathered" in proc.stdout
+
+
+def test_metrics_report_rejects_future_schema(tmp_path):
+    import json
+
+    report = tmp_path / "lint.json"
+    report.write_text(json.dumps({
+        "schema_version": 99, "kind": "apex_tpu.analysis",
+        "findings": []}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_report.py"),
+         str(report)],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode != 0
+    assert "schema_version 99" in proc.stderr
+
+
+def test_lint_sh_changed_only_gate():
+    """--changed-only must agree with the full gate on a clean tree
+    (jaxpr targets always run; AST narrows to the diff)."""
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "lint.sh"),
+         "--changed-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])

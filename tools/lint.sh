@@ -5,6 +5,10 @@
 #
 #   bash tools/lint.sh                 # the tier-1 gate (run by
 #                                      # tests/run_analysis/test_repo_selfcheck.py)
+#   bash tools/lint.sh --changed-only  # AST engine over files changed vs
+#                                      # the merge base only (LINT_BASE,
+#                                      # default main); jaxpr/dataflow
+#                                      # targets still run in full
 #   bash tools/lint.sh --write-baseline tests/run_analysis/baseline.json
 #
 # Extra args are forwarded to `python -m apex_tpu.analysis` (which
@@ -17,6 +21,35 @@ cd "$(dirname "$0")/.."
 # target sees a multi-device mesh without hardware.
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+if [[ "${1:-}" == "--changed-only" ]]; then
+    shift
+    # Narrow the AST engine to python files changed since the merge base
+    # (working tree + index + committed-vs-base; deleted files drop out
+    # via the existence filter). The jaxpr + dataflow targets are NOT
+    # narrowed: they trace whole entry points, so an edit anywhere in a
+    # traced module can move their verdicts.
+    base="$(git merge-base HEAD "${LINT_BASE:-main}" 2>/dev/null || true)"
+    changed="$(
+        { git diff --name-only "${base:-HEAD}" -- 2>/dev/null;
+          git diff --name-only --cached 2>/dev/null;
+          git diff --name-only 2>/dev/null; } \
+        | sort -u \
+        | grep -E '^(apex_tpu|examples|tools)/.*\.py$|^bench\.py$' || true)"
+    ast_paths=()
+    while IFS= read -r f; do
+        [[ -n "$f" && -e "$f" ]] && ast_paths+=("$f")
+    done <<< "$changed"
+    if [[ ${#ast_paths[@]} -eq 0 ]]; then
+        # nothing changed under the linted paths: skip the AST engine
+        # entirely (an empty explicit path list would be rejected as a
+        # typo by the CLI's loud-failure rule)
+        exec python -m apex_tpu.analysis \
+            --baseline tests/run_analysis/baseline.json --no-ast "$@"
+    fi
+    exec python -m apex_tpu.analysis \
+        --baseline tests/run_analysis/baseline.json "${ast_paths[@]}" "$@"
+fi
 
 exec python -m apex_tpu.analysis \
     --baseline tests/run_analysis/baseline.json \

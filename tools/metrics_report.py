@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Summarize apex_tpu metrics JSONL dumps.
+"""Summarize apex_tpu metrics JSONL dumps and analysis JSON reports.
 
 Thin wrapper over ``python -m apex_tpu.observability report`` so the
 tools/ directory carries the complete telemetry workflow next to
@@ -7,10 +7,20 @@ tpu_profile.py / trace_report.py:
 
     python tools/metrics_report.py BENCH_METRICS.jsonl
     python tools/metrics_report.py run1.jsonl run2.jsonl --json
+
+It also ingests ``python -m apex_tpu.analysis --json`` dumps (detected
+by their ``schema_version`` + ``kind`` header), printing a per-check
+finding summary — so one command reads every machine report the repo
+emits:
+
+    python -m apex_tpu.analysis --json > lint.json
+    python tools/metrics_report.py lint.json BENCH_METRICS.jsonl
 """
 
 from __future__ import annotations
 
+import collections
+import json
 import os
 import sys
 
@@ -18,6 +28,66 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from apex_tpu.observability.cli import main  # noqa: E402
 
+# analysis --json schema versions this reader understands
+KNOWN_ANALYSIS_SCHEMAS = (1,)
+
+
+def load_analysis_report(path):
+    """Parse ``path`` as an apex_tpu.analysis --json dump; returns the
+    payload dict or None when the file is something else (e.g. a
+    metrics JSONL). Unknown schema versions fail loudly rather than
+    mis-summarizing."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "schema_version" not in data:
+        return None
+    if data.get("kind") != "apex_tpu.analysis":
+        return None
+    version = data["schema_version"]
+    if version not in KNOWN_ANALYSIS_SCHEMAS:
+        raise SystemExit(
+            f"{path}: analysis schema_version {version} is newer than "
+            f"this reader (knows {list(KNOWN_ANALYSIS_SCHEMAS)}) — "
+            f"update tools/metrics_report.py")
+    return data
+
+
+def summarize_analysis(path, data):
+    findings = data.get("findings", [])
+    by_check = collections.Counter(f.get("check", "?") for f in findings)
+    print(f"{path}: apex_tpu.analysis report "
+          f"(schema v{data['schema_version']})")
+    print(f"  findings: {len(findings)} new, "
+          f"{data.get('grandfathered', 0)} grandfathered")
+    for check, n in sorted(by_check.items()):
+        print(f"    {check:24s} {n}")
+    errors = data.get("target_errors", {})
+    for name, err in sorted(errors.items()):
+        print(f"  TARGET ERROR {name}: {err}")
+
+
 if __name__ == "__main__":
-    sys.argv.insert(1, "report")
-    sys.exit(main(sys.argv[1:]))
+    args = sys.argv[1:]
+    json_mode = "--json" in args
+    passthrough = []
+    handled_any = False
+    for arg in args:
+        data = load_analysis_report(arg) if os.path.isfile(arg) else None
+        if data is not None:
+            if json_mode:
+                # machine-readable passthrough: the payload already IS
+                # the machine format (schema_version and all)
+                print(json.dumps({"path": arg, **data}))
+            else:
+                summarize_analysis(arg, data)
+            handled_any = True
+        else:
+            passthrough.append(arg)
+    remaining_files = [a for a in passthrough if os.path.isfile(a)]
+    if handled_any and not remaining_files:
+        # flags were honored above; nothing left for the JSONL reader
+        sys.exit(0)
+    sys.exit(main(["report"] + passthrough))
