@@ -29,10 +29,20 @@ if not hasattr(_jax.lax, "axis_size"):
 if not hasattr(_jax, "shard_map"):
     # jax.shard_map was promoted out of jax.experimental after 0.4.37;
     # every caller here uses keyword mesh/in_specs/out_specs, which the
-    # experimental entry point accepts identically.
+    # experimental entry point accepts identically. The promotion also
+    # renamed check_rep -> check_vma (the rep tracker became the vma
+    # type system); translate so post-rename callers run unchanged.
+    import functools as _functools
+
     from jax.experimental.shard_map import shard_map as _shard_map
 
-    _jax.shard_map = _shard_map
+    @_functools.wraps(_shard_map)
+    def _shard_map_compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+    _jax.shard_map = _shard_map_compat
 
 if not hasattr(_jax.lax, "pvary"):
     # pvary annotates varying-over-mesh-axes types for the post-0.4.37
@@ -69,6 +79,22 @@ if not hasattr(_jax.sharding, "set_mesh"):
             yield mesh
 
     _jax.sharding.set_mesh = _set_mesh
+
+if not hasattr(_jax, "typeof"):
+    # jax.typeof (the public aval reader, post-0.4.37) is how vma-aware
+    # code asks "which mesh axes does this value vary over". 0.4.37
+    # avals carry no .vma, so callers written as
+    # getattr(jax.typeof(x), "vma", frozenset()) degrade to "invariant"
+    # — the right answer under pre-vma shard_map, where replicated-param
+    # grads arrive already psummed. Without the shim those callers
+    # (parallel.distributed.sync_autodiff_gradients and friends) die on
+    # AttributeError instead.
+    def _typeof(x):
+        import jax.core as _core
+
+        return _core.get_aval(x)
+
+    _jax.typeof = _typeof
 
 if not hasattr(_jax.sharding, "get_abstract_mesh"):
     # Public alias for the internal reader the set_mesh shim feeds; the

@@ -11,7 +11,13 @@ Two engines, one CLI, one pytest gate:
   forward abstract interpretation (dtype/cast/taint lattice) powering
   the **precision-flow checks** (:mod:`.precision_checks`):
   low-precision accumulation, master-weight discipline, unsafe exp,
-  cast churn, loss-scale bypass.
+  cast churn, loss-scale bypass. The **sharding engine**
+  (:mod:`.sharding_flow`) runs the placement analog (PartitionSpec /
+  distinctness lattice + liveness walk) powering the **sharding-flow
+  checks** (:mod:`.sharding_checks`): implicit reshards, replicated
+  large inputs, psum→slice reduce-scatter opportunities, dead
+  collectives, and the per-device peak-HBM budget — plus the
+  per-target comms-bytes/peak-HBM estimates bench.py reports.
 - **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
   examples/, tools/, bench.py) for host-sync anti-patterns — the
   ``block_until_ready``-as-timing bug that produced r5's impossible
@@ -38,15 +44,21 @@ from apex_tpu.analysis.precision_checks import (
     PRECISION_CHECKS,
     analyze_precision,
 )
+from apex_tpu.analysis.sharding_checks import (
+    SHARDING_CHECKS,
+    analyze_sharding,
+)
 from apex_tpu.analysis.targets import (
     TARGETS,
     run_precision_findings,
+    run_sharding_findings,
     run_targets,
 )
 
 __all__ = [
     "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PRECISION_CHECKS",
-    "TARGETS", "analyze_fn", "analyze_precision", "lint_paths",
-    "lint_source", "load_baseline", "new_findings",
-    "run_precision_findings", "run_targets", "save_baseline",
+    "SHARDING_CHECKS", "TARGETS", "analyze_fn", "analyze_precision",
+    "analyze_sharding", "lint_paths", "lint_source", "load_baseline",
+    "new_findings", "run_precision_findings", "run_sharding_findings",
+    "run_targets", "save_baseline",
 ]

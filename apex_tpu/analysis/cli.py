@@ -5,6 +5,8 @@
     python -m apex_tpu.analysis --no-jaxpr            # AST engine only
     python -m apex_tpu.analysis --baseline tests/run_analysis/baseline.json
     python -m apex_tpu.analysis --write-baseline tests/run_analysis/baseline.json
+    python -m apex_tpu.analysis --json > base.json   # on the base rev
+    python -m apex_tpu.analysis --diff base.json     # fail only on NEW
     python -m apex_tpu.analysis --allow my_target:master-weights
     python -m apex_tpu.analysis --list-checks
 
@@ -22,6 +24,7 @@ import sys
 from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
 from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
+from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
 
 DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 
@@ -37,7 +40,36 @@ def _default_paths(root):
 
 def known_checks():
     return (set(ast_checks.AST_CHECKS) | set(JAXPR_CHECKS)
-            | set(PRECISION_CHECKS) | set(targets.TARGET_CHECKS))
+            | set(PRECISION_CHECKS) | set(SHARDING_CHECKS)
+            | set(targets.TARGET_CHECKS))
+
+
+def load_diff_report(path):
+    """A stored ``--json`` dump -> Counter of finding keys (the --diff
+    base). Loud on anything that is not an apex_tpu.analysis report of
+    a schema this reader knows — a silently-ignored base would report
+    every finding as old forever."""
+    import collections
+
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except ValueError as e:
+            raise ValueError(f"--diff base {path} is not JSON: {e}")
+    if not isinstance(data, dict) or \
+            data.get("kind") != "apex_tpu.analysis":
+        raise ValueError(
+            f"--diff base {path} is not an apex_tpu.analysis --json "
+            f"dump (missing kind header)")
+    version = data.get("schema_version")
+    if version not in (JSON_SCHEMA_VERSION,):
+        raise ValueError(
+            f"--diff base {path} has schema_version {version}; this "
+            f"reader knows [{JSON_SCHEMA_VERSION}]")
+    keys = collections.Counter()
+    for f in data.get("findings", ()):
+        keys[f"{f.get('check')}:{f.get('path')}:{f.get('symbol')}"] += 1
+    return keys
 
 
 def parse_allow(entries):
@@ -135,6 +167,12 @@ def main(argv=None):
     ap.add_argument("--baseline", default=None,
                     help="JSON baseline of grandfathered findings; only "
                          "NEW findings fail the run")
+    ap.add_argument("--diff", default=None, metavar="REPORT.json",
+                    help="a stored --json dump to diff against: only "
+                         "findings not in that run fail (composes with "
+                         "--baseline; tools/lint.sh --changed-only "
+                         "feeds it a merge-base run via "
+                         "LINT_DIFF_REPORT)")
     ap.add_argument("--write-baseline", default=None, metavar="PATH",
                     help="write current findings as the baseline and exit")
     ap.add_argument("--json", action="store_true",
@@ -149,6 +187,8 @@ def main(argv=None):
             print(f"{cid:24s} [jaxpr]")
         for cid in PRECISION_CHECKS:
             print(f"{cid:24s} [jaxpr/dataflow]")
+        for cid in SHARDING_CHECKS:
+            print(f"{cid:24s} [jaxpr/sharding]")
         for cid in targets.TARGET_CHECKS:
             print(f"{cid:24s} [jaxpr]")
         return 0
@@ -159,10 +199,13 @@ def main(argv=None):
 
     try:
         allow = parse_allow(args.allow)
+        # validate the diff base BEFORE the (expensive) run: a bad base
+        # should fail in milliseconds, not after tracing every target
+        diff_keys = load_diff_report(args.diff) if args.diff else None
         found, errors = run(paths=args.paths or None, root=args.root,
                             ast=args.ast, jaxpr=args.jaxpr, checks=checks,
                             allow=allow)
-    except (FileNotFoundError, ValueError) as e:
+    except (OSError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
     found.sort(key=lambda f: (f.path, f.line, f.check))
@@ -178,9 +221,17 @@ def main(argv=None):
 
     fresh = found
     grandfathered = 0
+    base_keys = None
     if args.baseline:
-        baseline = findings_mod.load_baseline(args.baseline)
-        fresh = findings_mod.new_findings(found, baseline)
+        base_keys = findings_mod.load_baseline(args.baseline)
+    if diff_keys is not None:
+        # per-key MAX, not sum: a finding present in both bases must
+        # not double its grandfather budget (a second, genuinely new
+        # occurrence of the same key has to fail the gate)
+        base_keys = diff_keys if base_keys is None \
+            else base_keys | diff_keys
+    if base_keys is not None:
+        fresh = findings_mod.new_findings(found, base_keys)
         grandfathered = len(found) - len(fresh)
 
     if args.json:
@@ -194,7 +245,8 @@ def main(argv=None):
     else:
         for f in fresh:
             print(f.render())
-        tail = f" ({grandfathered} grandfathered)" if args.baseline else ""
+        tail = f" ({grandfathered} grandfathered)" \
+            if base_keys is not None else ""
         print(f"{len(fresh)} finding(s){tail}", file=sys.stderr)
 
     if errors:

@@ -1,0 +1,982 @@
+"""Forward sharding propagation over closed jaxprs — the flow engine
+under the sharding checks (ISSUE 4 tentpole).
+
+:mod:`.dataflow` answers dtype-flow questions; this module answers the
+*placement* questions that decide whether a distributed step is fast or
+silently all-gathers itself to death: where does every value live on
+the mesh, which collectives actually move data, and how much HBM does
+the live set peak at. The lattice tracks, per jaxpr ``Var``,
+
+- ``spec``            the GSPMD-world partitioning: one entry per array
+  dim, each a tuple of mesh axis names (or ``()`` for replicated);
+  ``None`` means unknown (the analysis stays quiet rather than guess);
+- ``pending``         mesh axes holding *unreduced partial sums* — a
+  ``dot_general`` whose contracting dim was sharded produces per-shard
+  partials that some later psum / sharding boundary must combine;
+- ``distinct``        shard_map-world truth: the mesh axes across which
+  the per-shard data can actually *differ*. ``pbroadcast``/``pvary``
+  re-type a value without changing its bytes, so they do NOT add axes
+  here — which is exactly how a psum of replicated data is caught as a
+  dead collective;
+- ``from_axis_index`` axes this (integer) value derives from
+  ``lax.axis_index`` over — the signal that a dynamic_slice start is
+  "my rank's chunk";
+- ``psum_axes``       set while the value is (a preserve-chain of) a
+  fresh ``psum`` result over those axes — the psum→slice
+  reduce-scatter pattern detector's memory.
+
+Sub-jaxprs are entered like :mod:`.dataflow` (``pjit``/``remat``/
+``custom_vjp``/``scan``/``while``/``cond`` one-pass). ``shard_map`` is
+the world boundary: entering strips the manual axes into ``distinct``;
+leaving rebuilds the outer ``spec`` from ``out_names``. ``pallas_call``
+stays opaque via in/out avals.
+
+On top of the interpreter, :func:`estimate_hbm_and_comms` runs the
+liveness walk: per-value local bytes (global aval bytes over the
+product of the sharded axis sizes), last-use liveness with donation
+credit (a donated input's buffer dies at its last read; a non-donated
+input is caller-owned for the whole step), plus a per-collective
+comms-bytes model. Clients subscribe with visitor callbacks;
+:mod:`.sharding_checks` builds the five shipped analyses on top. The
+engine itself never emits a Finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+__all__ = [
+    "ShardVal", "MeshCtx", "COLLECTIVE_PRIMS", "interpret_sharding",
+    "shard_val_for_aval", "spec_from_partition_spec", "local_bytes",
+    "collective_bytes", "estimate_hbm_and_comms", "normalize_spec",
+]
+
+# Call-like primitives whose bodies run in the caller's value world.
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+               "checkpoint"}
+
+# Ops that preserve the value's identity: psum_axes / from_axis_index
+# flow through (a reshaped psum result is still "the psum result").
+_PRESERVE_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+    "stop_gradient", "copy", "convert_element_type", "neg",
+    "pbroadcast", "pvary",
+})
+
+# Collectives with an axis-name param and (per-device, per-byte) comms
+# cost factors as a function of the axis size n. psum is a ring
+# allreduce (reduce-scatter + all-gather): 2(n-1)/n. all_gather
+# receives the other n-1 shards. ppermute moves the whole block once.
+COLLECTIVE_PRIMS = {
+    "psum": "axes", "psum2": "axes", "pmin": "axes", "pmax": "axes",
+    "all_gather": "axis_name", "all_gather_invariant": "axis_name",
+    "all_to_all": "axis_name", "reduce_scatter": "axis_name",
+    "psum_scatter": "axis_name", "ppermute": "axis_name",
+}
+
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin",
+})
+
+
+def _axis_names_of(value):
+    if value is None:
+        return ()
+    if isinstance(value, (tuple, list, frozenset, set)):
+        out = []
+        for v in value:
+            out.extend(_axis_names_of(v))
+        return tuple(out)
+    return (str(value),)
+
+
+def normalize_spec(partition_spec, ndim):
+    """A PartitionSpec (or None) -> canonical per-dim tuple of
+    axis-name tuples, padded to ``ndim``."""
+    if partition_spec is None:
+        return tuple(() for _ in range(ndim))
+    entries = []
+    for entry in tuple(partition_spec):
+        entries.append(_axis_names_of(entry))
+    while len(entries) < ndim:
+        entries.append(())
+    return tuple(entries[:ndim])
+
+
+spec_from_partition_spec = normalize_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardVal:
+    """One point of the sharding lattice (see module docstring)."""
+
+    spec: tuple = None  # per-dim tuples of axis names; None = unknown
+    pending: frozenset = frozenset()
+    distinct: frozenset = frozenset()
+    from_axis_index: frozenset = frozenset()
+    psum_axes: frozenset = frozenset()
+
+    def with_(self, **kw) -> "ShardVal":
+        return dataclasses.replace(self, **kw)
+
+    def axes_used(self) -> frozenset:
+        if self.spec is None:
+            return frozenset()
+        return frozenset(a for entry in self.spec for a in entry)
+
+
+def shard_val_for_aval(aval, partition_spec=None,
+                       distinct=frozenset()) -> ShardVal:
+    ndim = len(getattr(aval, "shape", ()) or ())
+    return ShardVal(spec=normalize_spec(partition_spec, ndim),
+                    distinct=frozenset(distinct))
+
+
+class MeshCtx:
+    """Axis universe the interpretation runs under: name -> size, plus
+    the manual (shard_map-consumed) axes at the current depth."""
+
+    def __init__(self, axis_sizes=None, manual_axes=frozenset()):
+        self.axis_sizes = dict(axis_sizes or {})
+        self.manual_axes = frozenset(manual_axes)
+
+    def size(self, axis, default=1) -> int:
+        return int(self.axis_sizes.get(axis, default))
+
+    def child(self, extra_sizes=None, extra_manual=()):
+        sizes = dict(self.axis_sizes)
+        if extra_sizes:
+            sizes.update({str(k): int(v) for k, v in extra_sizes.items()})
+        return MeshCtx(sizes, self.manual_axes | frozenset(extra_manual))
+
+
+def _aval_bytes(aval) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = np.dtype(str(getattr(aval, "dtype", "float32")))
+    return math.prod(shape or (1,)) * dtype.itemsize
+
+
+def local_bytes(aval, val, ctx: MeshCtx) -> int:
+    """Per-device bytes of ``aval`` under ``val``'s sharding (global
+    bytes over the product of the sharded axis sizes; unknown specs
+    count as replicated — the conservative direction for HBM)."""
+    nbytes = _aval_bytes(aval)
+    if val is None or val.spec is None:
+        return nbytes
+    denom = 1
+    for entry in val.spec:
+        for axis in entry:
+            denom *= ctx.size(axis)
+    return max(1, nbytes // max(1, denom))
+
+
+def collective_bytes(prim: str, nbytes: int, axis_sizes) -> int:
+    """Per-device bytes moved by collective ``prim`` over a per-shard
+    operand of ``nbytes`` riding axes of the given sizes."""
+    n = 1
+    for s in axis_sizes:
+        n *= max(1, int(s))
+    if n <= 1:
+        return 0
+    if prim in ("psum", "psum2", "pmin", "pmax"):
+        return int(2 * nbytes * (n - 1) / n)
+    if prim in ("all_gather", "all_gather_invariant"):
+        return nbytes * (n - 1)
+    if prim in ("reduce_scatter", "psum_scatter", "all_to_all"):
+        return int(nbytes * (n - 1) / n)
+    if prim == "ppermute":
+        return nbytes
+    return nbytes
+
+
+# ----------------------------------------------------------- transfer
+
+def _merge_specs(specs):
+    """Elementwise join of same-rank specs. Returns (spec, conflicts)
+    where conflicts is a list of (dim, entry_a, entry_b) that disagree
+    (both sharded, differently) — GSPMD has to reshard one side."""
+    known = [s for s in specs if s is not None]
+    if not known:
+        return None, []
+    rank = max(len(s) for s in known)
+    out, conflicts = [], []
+    for d in range(rank):
+        entries = [s[d] for s in known if len(s) == rank and s[d]]
+        if not entries:
+            out.append(())
+            continue
+        first = entries[0]
+        for other in entries[1:]:
+            if other != first:
+                conflicts.append((d, first, other))
+        out.append(first)
+    # one mesh axis cannot shard two dims: keep the first occurrence
+    seen = set()
+    cleaned = []
+    for entry in out:
+        kept = tuple(a for a in entry if a not in seen)
+        seen.update(kept)
+        cleaned.append(kept)
+    return tuple(cleaned), conflicts
+
+
+def _join(ins, out_aval):
+    present = [v for v in ins if v is not None]
+    ndim = len(getattr(out_aval, "shape", ()) or ())
+    same_rank = [v.spec for v in present
+                 if v.spec is not None and len(v.spec) == ndim]
+    spec, _ = _merge_specs(same_rank) if same_rank else (None, [])
+    if spec is None and ndim == 0:
+        spec = ()
+    return ShardVal(
+        spec=spec,
+        pending=frozenset().union(*(v.pending for v in present))
+        if present else frozenset(),
+        distinct=frozenset().union(*(v.distinct for v in present))
+        if present else frozenset(),
+        from_axis_index=frozenset().union(
+            *(v.from_axis_index for v in present))
+        if present else frozenset(),
+    )
+
+
+def _reshape_spec(spec, in_shape, out_shape):
+    """Map a spec across reshape. Dims whose sizes match positionally
+    from the front/back keep their entries; anything in the mixed
+    middle goes unknown-replicated (the quiet, no-false-positive
+    choice)."""
+    if spec is None:
+        return None
+    out = [()] * len(out_shape)
+    i = 0
+    while (i < len(in_shape) and i < len(out_shape)
+           and in_shape[i] == out_shape[i]):
+        out[i] = spec[i]
+        i += 1
+    j = 0
+    while (j < len(in_shape) - i and j < len(out_shape) - i
+           and in_shape[-1 - j] == out_shape[-1 - j]):
+        out[len(out_shape) - 1 - j] = spec[len(in_shape) - 1 - j]
+        j += 1
+    # an axis must not survive twice after the positional match
+    seen = set()
+    cleaned = []
+    for entry in out:
+        kept = tuple(a for a in entry if a not in seen)
+        seen.update(kept)
+        cleaned.append(kept)
+    return tuple(cleaned)
+
+
+def _dot_general_transfer(eqn, ins, out_aval):
+    lhs, rhs = (ins + (None, None))[:2]
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lhs_spec = lhs.spec if lhs is not None else None
+    rhs_spec = rhs.spec if rhs is not None else None
+    base = _join(ins, out_aval)
+    pending = set(base.pending)
+    for spec, cdims in ((lhs_spec, lc), (rhs_spec, rc)):
+        if spec is None:
+            continue
+        for d in cdims:
+            if d < len(spec):
+                pending.update(spec[d])
+    out_spec = None
+    if lhs_spec is not None and rhs_spec is not None:
+        entries = [lhs_spec[d] for d in lb]
+        entries += [lhs_spec[d] for d in range(len(lhs_spec))
+                    if d not in lc and d not in lb]
+        entries += [rhs_spec[d] for d in range(len(rhs_spec))
+                    if d not in rc and d not in rb]
+        seen = set()
+        cleaned = []
+        for entry in entries:
+            kept = tuple(a for a in entry if a not in seen)
+            seen.update(kept)
+            cleaned.append(kept)
+        ndim = len(getattr(out_aval, "shape", ()) or ())
+        while len(cleaned) < ndim:
+            cleaned.append(())
+        out_spec = tuple(cleaned[:ndim])
+    return base.with_(spec=out_spec, pending=frozenset(pending),
+                      from_axis_index=frozenset())
+
+
+def _transfer(eqn, ins, out_avals, ctx: MeshCtx):
+    prim = eqn.primitive.name
+    src = next((v for v in ins if v is not None), None)
+
+    if prim in _PRESERVE_PRIMS:
+        outs = []
+        for aval in out_avals:
+            ndim = len(getattr(aval, "shape", ()) or ())
+            if src is None:
+                outs.append(shard_val_for_aval(aval))
+                continue
+            if prim == "reshape":
+                spec = _reshape_spec(src.spec,
+                                     tuple(eqn.invars[0].aval.shape),
+                                     tuple(aval.shape))
+            elif prim == "broadcast_in_dim":
+                spec = [()] * ndim
+                bdims = eqn.params.get("broadcast_dimensions", ())
+                if src.spec is not None:
+                    for sdim, odim in enumerate(bdims):
+                        if sdim < len(src.spec) and odim < ndim:
+                            spec[odim] = src.spec[sdim]
+                spec = tuple(spec)
+            elif src.spec is not None and len(src.spec) == ndim:
+                spec = src.spec
+            else:
+                spec = normalize_spec(None, ndim)
+            outs.append(src.with_(spec=spec))
+        return tuple(outs)
+
+    if prim == "transpose":
+        perm = eqn.params.get("permutation", ())
+        spec = None
+        if src is not None and src.spec is not None:
+            spec = tuple(src.spec[p] if p < len(src.spec) else ()
+                         for p in perm)
+        base = src if src is not None else ShardVal()
+        return tuple(base.with_(spec=spec) for _ in out_avals)
+
+    if prim == "dot_general":
+        return tuple(_dot_general_transfer(eqn, tuple(ins), a)
+                     for a in out_avals)
+
+    if prim in _REDUCE_PRIMS or prim in ("reduce_window_sum",):
+        dims = set(eqn.params.get("axes", ()) or ())
+        base = _join(ins, out_avals[0])
+        pending = set(base.pending)
+        spec = None
+        if src is not None and src.spec is not None:
+            spec = []
+            for d, entry in enumerate(src.spec):
+                if d in dims:
+                    pending.update(entry)
+                else:
+                    spec.append(entry)
+            spec = tuple(spec)
+        return tuple(base.with_(spec=spec, pending=frozenset(pending),
+                                from_axis_index=frozenset())
+                     for _ in out_avals)
+
+    if prim == "axis_index":
+        axis = str(eqn.params.get("axis_name"))
+        return tuple(ShardVal(spec=normalize_spec(None, 0),
+                              distinct=frozenset({axis}),
+                              from_axis_index=frozenset({axis}))
+                     for _ in out_avals)
+
+    if prim in ("psum", "psum2", "pmin", "pmax"):
+        axes = frozenset(_axis_names_of(eqn.params.get("axes")))
+        base = _join(ins, out_avals[0])
+        return tuple(base.with_(
+            pending=base.pending - axes,
+            distinct=base.distinct - axes,
+            psum_axes=axes if prim in ("psum", "psum2") else frozenset(),
+            from_axis_index=frozenset(),
+        ) for _ in out_avals)
+
+    if prim in ("all_gather", "all_gather_invariant"):
+        axes = frozenset(_axis_names_of(eqn.params.get("axis_name")))
+        base = _join(ins, out_avals[0])
+        ndim = len(getattr(out_avals[0], "shape", ()) or ())
+        return tuple(base.with_(spec=normalize_spec(None, ndim),
+                                distinct=base.distinct - axes,
+                                psum_axes=frozenset(),
+                                from_axis_index=frozenset())
+                     for _ in out_avals)
+
+    if prim in ("psum_scatter", "reduce_scatter"):
+        axes = frozenset(_axis_names_of(eqn.params.get("axis_name")))
+        base = _join(ins, out_avals[0])
+        return tuple(base.with_(distinct=base.distinct | axes,
+                                pending=base.pending - axes,
+                                psum_axes=frozenset(),
+                                from_axis_index=frozenset())
+                     for _ in out_avals)
+
+    if prim in ("ppermute", "all_to_all"):
+        base = _join(ins, out_avals[0])
+        return tuple(base.with_(psum_axes=frozenset(),
+                                from_axis_index=frozenset())
+                     for _ in out_avals)
+
+    if prim == "sharding_constraint":
+        sharding = eqn.params.get("sharding")
+        pspec = getattr(sharding, "spec", None)
+        base = src if src is not None else ShardVal()
+        outs = []
+        for aval in out_avals:
+            ndim = len(getattr(aval, "shape", ()) or ())
+            outs.append(base.with_(spec=normalize_spec(pspec, ndim),
+                                   pending=frozenset()))
+        return tuple(outs)
+
+    if prim in ("slice", "dynamic_slice", "rev", "squeeze", "gather",
+                "dynamic_update_slice", "scatter", "scatter-add",
+                "select_n", "pad", "concatenate", "iota"):
+        base = _join(ins, out_avals[0])
+        if prim == "dynamic_slice" and ins and ins[0] is not None:
+            # the slice keeps the operand's provenance so a following
+            # check can see "this is a chunk of a psum result"
+            base = base.with_(psum_axes=ins[0].psum_axes)
+        outs = []
+        for aval in out_avals:
+            ndim = len(getattr(aval, "shape", ()) or ())
+            spec = base.spec
+            if spec is not None and len(spec) != ndim:
+                spec = normalize_spec(None, ndim)
+            elif spec is not None and prim in ("slice", "dynamic_slice",
+                                               "dynamic_update_slice"):
+                in_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+                out_shape = tuple(getattr(aval, "shape", ()) or ())
+                if len(in_shape) == ndim:
+                    spec = tuple(
+                        entry if in_shape[d] == out_shape[d] else ()
+                        for d, entry in enumerate(spec))
+            outs.append(base.with_(spec=spec))
+        return tuple(outs)
+
+    if prim == "pallas_call":
+        present = [v for v in ins if v is not None]
+        distinct = frozenset().union(*(v.distinct for v in present)) \
+            if present else frozenset()
+        return tuple(shard_val_for_aval(a, distinct=distinct)
+                     for a in out_avals)
+
+    base = _join(ins, out_avals[0])
+    outs = []
+    for aval in out_avals:
+        ndim = len(getattr(aval, "shape", ()) or ())
+        spec = base.spec
+        if spec is not None and len(spec) != ndim:
+            spec = None if ndim else ()
+        outs.append(base.with_(spec=spec))
+    return tuple(outs)
+
+
+# ----------------------------------------------------------- interp
+
+def _is_var(v):
+    import jax.core as core
+    return isinstance(v, core.Var)
+
+
+def _closed_jaxprs_in(value):
+    import jax.core as core
+    out = []
+    if isinstance(value, (core.ClosedJaxpr, core.Jaxpr)):
+        out.append(value)
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(_closed_jaxprs_in(v))
+    return out
+
+
+def _jaxpr_of(obj):
+    import jax.core as core
+    return obj.jaxpr if isinstance(obj, core.ClosedJaxpr) else obj
+
+
+def _consts_of(obj):
+    import jax.core as core
+    return obj.consts if isinstance(obj, core.ClosedJaxpr) else ()
+
+
+def _names_to_spec(names, ndim):
+    """shard_map in_names/out_names entry ({dim: (axes,)}) -> spec."""
+    spec = [()] * ndim
+    for dim, axes in dict(names or {}).items():
+        if int(dim) < ndim:
+            spec[int(dim)] = tuple(str(a) for a in axes)
+    return tuple(spec)
+
+
+class _Interp:
+    def __init__(self, visit):
+        self.visit = visit
+
+    def run(self, jaxpr, consts, in_vals, ctx: MeshCtx):
+        env = {}
+
+        def write(var, val):
+            if _is_var(var):
+                env[var] = val
+
+        def read(atom):
+            return env.get(atom) if _is_var(atom) else None
+
+        for var in jaxpr.constvars:
+            write(var, shard_val_for_aval(var.aval))
+        for var, val in zip(jaxpr.invars, in_vals):
+            write(var, val if val is not None
+                  else shard_val_for_aval(var.aval))
+        for var in jaxpr.invars:
+            if var not in env:
+                write(var, shard_val_for_aval(var.aval))
+
+        for eqn in jaxpr.eqns:
+            ins = tuple(read(v) for v in eqn.invars)
+            sub = self._maybe_call(eqn, ins, ctx)
+            if sub is not None:
+                outs = sub
+            else:
+                outs = _transfer(
+                    eqn, ins, tuple(v.aval for v in eqn.outvars), ctx)
+            if self.visit is not None:
+                self.visit(eqn, ins, outs, ctx)
+            for var, val in zip(eqn.outvars, outs):
+                write(var, val)
+
+        return tuple(
+            env.get(v) if _is_var(v)
+            else shard_val_for_aval(getattr(v, "aval", None))
+            for v in jaxpr.outvars)
+
+    def _maybe_call(self, eqn, ins, ctx):
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        if prim in _CALL_PRIMS:
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                if key in params:
+                    subs = _closed_jaxprs_in(params[key])
+                    if subs:
+                        return self._run_sub(subs[0], ins, eqn, ctx)
+            return None
+
+        if prim == "scan":
+            subs = _closed_jaxprs_in(params.get("jaxpr"))
+            if not subs:
+                return None
+            n_consts = params.get("num_consts", 0)
+            n_carry = params.get("num_carry", 0)
+            mapped = list(ins)
+            # xs lose their leading (scan) dim inside the body
+            for i in range(n_consts + n_carry, len(mapped)):
+                v = mapped[i]
+                if v is not None and v.spec:
+                    mapped[i] = v.with_(spec=v.spec[1:])
+            # two-pass carry fixpoint: a loop-carried value picks up
+            # distinctness/taints on iteration 1 (e.g. a pipeline carry
+            # init'd to zeros but fed by a ppermute) — run the body once
+            # silently, join the output carries into the input carries,
+            # then run visited so the checks see steady-state values
+            silent = _Interp(None)
+            warm = silent._run_sub(subs[0], tuple(mapped), eqn, ctx,
+                                   restack_from=n_carry)
+            for k in range(min(n_carry, len(warm))):
+                i = n_consts + k
+                if i < len(mapped):
+                    mapped[i] = self._join_branch(mapped[i], warm[k])
+            return self._run_sub(subs[0], tuple(mapped), eqn, ctx,
+                                 restack_from=n_carry)
+
+        if prim == "while":
+            subs = _closed_jaxprs_in(params.get("body_jaxpr"))
+            if not subs:
+                return None
+            n_cond = params.get("cond_nconsts", 0)
+            body_ins = list(ins[n_cond:])
+            n_body = params.get("body_nconsts", 0)
+            silent = _Interp(None)
+            warm = silent._run_sub(subs[0], tuple(body_ins), eqn, ctx)
+            for k in range(len(warm)):
+                i = n_body + k
+                if i < len(body_ins):
+                    body_ins[i] = self._join_branch(body_ins[i], warm[k])
+            return self._run_sub(subs[0], tuple(body_ins), eqn, ctx)
+
+        if prim == "cond":
+            branches = _closed_jaxprs_in(params.get("branches", ()))
+            if not branches:
+                return None
+            outs = None
+            for br in branches:
+                br_outs = self._run_sub(br, ins[1:], eqn, ctx)
+                if outs is None:
+                    outs = list(br_outs)
+                else:
+                    outs = [self._join_branch(a, b)
+                            for a, b in zip(outs, br_outs)]
+            return tuple(outs)
+
+        if prim == "shard_map":
+            subs = _closed_jaxprs_in(params.get("jaxpr", ()))
+            if not subs:
+                return None
+            mesh = params.get("mesh")
+            shape = getattr(mesh, "shape", None)
+            sizes = {str(k): int(v) for k, v in dict(shape).items()} \
+                if shape else {}
+            in_names = params.get("in_names", ())
+            out_names = params.get("out_names", ())
+            inner_ctx = ctx.child(sizes, sizes.keys())
+            sub = _jaxpr_of(subs[0])
+            mapped = []
+            for i, var in enumerate(sub.invars):
+                ndim = len(getattr(var.aval, "shape", ()) or ())
+                names = in_names[i] if i < len(in_names) else {}
+                consumed = frozenset(
+                    str(a) for axes in dict(names or {}).values()
+                    for a in axes)
+                outer = ins[i] if i < len(ins) else None
+                distinct = consumed | (outer.distinct if outer else
+                                       frozenset())
+                mapped.append(ShardVal(spec=normalize_spec(None, ndim),
+                                       distinct=distinct))
+            inner_outs = _Interp(self.visit).run(
+                sub, _consts_of(subs[0]), tuple(mapped), inner_ctx)
+            outs = []
+            for i, var in enumerate(eqn.outvars):
+                ndim = len(getattr(var.aval, "shape", ()) or ())
+                names = out_names[i] if i < len(out_names) else {}
+                inner = inner_outs[i] if i < len(inner_outs) else None
+                pending = inner.pending if inner else frozenset()
+                outs.append(ShardVal(spec=_names_to_spec(names, ndim),
+                                     pending=pending,
+                                     distinct=ctx.manual_axes & (
+                                         inner.distinct if inner
+                                         else frozenset())))
+            return tuple(outs)
+
+        return None
+
+    @staticmethod
+    def _join_branch(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        spec, _ = _merge_specs([a.spec, b.spec]) \
+            if a.spec is not None and b.spec is not None \
+            and len(a.spec) == len(b.spec) else (None, [])
+        return a.with_(
+            spec=spec if spec is not None else a.spec,
+            pending=a.pending | b.pending,
+            distinct=a.distinct | b.distinct,
+            from_axis_index=a.from_axis_index | b.from_axis_index,
+            psum_axes=a.psum_axes & b.psum_axes,
+        )
+
+    def _run_sub(self, closed_or_jaxpr, ins, eqn, ctx, restack_from=None):
+        jaxpr = _jaxpr_of(closed_or_jaxpr)
+        consts = _consts_of(closed_or_jaxpr)
+        n = len(jaxpr.invars)
+        bound = list(ins[:n]) + [None] * max(0, n - len(ins))
+        mapped = []
+        for var, val in zip(jaxpr.invars, bound):
+            ndim = len(getattr(var.aval, "shape", ()) or ())
+            if val is None:
+                mapped.append(shard_val_for_aval(var.aval))
+            elif val.spec is not None and len(val.spec) != ndim:
+                mapped.append(val.with_(spec=normalize_spec(None, ndim)))
+            else:
+                mapped.append(val)
+        outs = self.run(jaxpr, consts, tuple(mapped), ctx)
+        out_avals = tuple(v.aval for v in eqn.outvars)
+        fixed = []
+        for i, aval in enumerate(out_avals):
+            ndim = len(getattr(aval, "shape", ()) or ())
+            o = outs[i] if i < len(outs) else None
+            if o is None:
+                fixed.append(shard_val_for_aval(aval))
+            elif o.spec is not None and len(o.spec) != ndim:
+                if restack_from is not None and i >= restack_from \
+                        and len(o.spec) == ndim - 1:
+                    # stacked scan ys grow a leading (replicated) dim
+                    fixed.append(o.with_(spec=((),) + o.spec))
+                else:
+                    fixed.append(o.with_(spec=normalize_spec(None, ndim)))
+            else:
+                fixed.append(o)
+        return tuple(fixed)
+
+
+def interpret_sharding(closed, in_vals, axis_sizes=None, visit=None):
+    """Run the forward sharding propagation over ``closed`` (a
+    ``ClosedJaxpr``).
+
+    ``in_vals``: one :class:`ShardVal` (or None) per flat invar.
+    ``axis_sizes``: the mesh axis universe (name -> size); defaults to
+    the live ``parallel_state`` mesh when initialized.
+    ``visit(eqn, in_vals, out_vals, mesh_ctx)`` runs for every equation
+    at every depth. Returns the abstract output values.
+    """
+    if axis_sizes is None:
+        axis_sizes = live_mesh_axis_sizes()
+    ctx = MeshCtx(axis_sizes)
+    jaxpr = closed.jaxpr
+    vals = list(in_vals) + [None] * max(
+        0, len(jaxpr.invars) - len(in_vals))
+    return _Interp(visit).run(jaxpr, closed.consts, tuple(vals), ctx)
+
+
+def live_mesh_axis_sizes() -> dict:
+    """Axis sizes of the live ``parallel_state`` mesh, {} when none."""
+    try:
+        from apex_tpu.transformer import parallel_state
+        if parallel_state.model_parallel_is_initialized():
+            return {str(k): int(v) for k, v in
+                    dict(parallel_state.get_mesh().shape).items()}
+    except Exception:
+        pass
+    return {}
+
+
+# ----------------------------------------------- liveness / HBM walk
+
+def _linearize(jaxpr, env, steps):
+    """Flatten call-like primitives into one step list (var identity
+    mapped into the caller world, as in jaxpr_checks._linearize);
+    control flow / shard_map / pallas stay opaque single steps."""
+    def canon(v):
+        while v in env:
+            v = env[v]
+        return v
+
+    for eqn in jaxpr.eqns:
+        sub = None
+        if eqn.primitive.name in _CALL_PRIMS:
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                if key in eqn.params:
+                    subs = _closed_jaxprs_in(eqn.params[key])
+                    if subs:
+                        sub = _jaxpr_of(subs[0])
+                        break
+        if sub is not None and len(sub.invars) == len(eqn.invars):
+            for iv, ov in zip(sub.invars, eqn.invars):
+                if _is_var(ov):
+                    env[iv] = canon(ov)
+            _linearize(sub, env, steps)
+            for inner_ov, outer_ov in zip(sub.outvars, eqn.outvars):
+                if _is_var(inner_ov):
+                    env[outer_ov] = canon(inner_ov)
+            continue
+        reads = [canon(v) if _is_var(v) else None for v in eqn.invars]
+        steps.append((eqn, reads))
+
+
+def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
+                           axis_sizes=None):
+    """Liveness walk over the linearized program.
+
+    ``donated``: flat invar indices whose buffers die at their last
+    read (jit donation); everything else is caller-owned for the whole
+    step. Returns ``{"peak_hbm_bytes", "input_bytes", "output_bytes",
+    "comms_bytes", "peak_step"}`` — all per-device estimates under the
+    propagated shardings.
+    """
+    if axis_sizes is None:
+        axis_sizes = live_mesh_axis_sizes()
+    ctx = MeshCtx(axis_sizes)
+    jaxpr = closed.jaxpr
+
+    env: dict = {}
+    steps: list = []
+    _linearize(jaxpr, env, steps)
+
+    def canon(v):
+        while v in env:
+            v = env[v]
+        return v
+
+    # forward-propagate ShardVals over the linearized steps so every
+    # var (at any inlined depth) has a sharding for its byte estimate
+    vals: dict = {}
+    comms = 0
+    for i, var in enumerate(jaxpr.invars):
+        v = in_vals[i] if i < len(in_vals) else None
+        vals[var] = v if v is not None else shard_val_for_aval(var.aval)
+    for var in jaxpr.constvars:
+        vals[var] = shard_val_for_aval(var.aval)
+
+    manual = ctx.manual_axes
+    for eqn, reads in steps:
+        prim = eqn.primitive.name
+        ins = tuple(vals.get(r) if r is not None else None
+                    for r in reads)
+        if prim == "shard_map":
+            out_names = eqn.params.get("out_names", ())
+            outs = []
+            for k, ov in enumerate(eqn.outvars):
+                ndim = len(getattr(ov.aval, "shape", ()) or ())
+                names = out_names[k] if k < len(out_names) else {}
+                outs.append(ShardVal(spec=_names_to_spec(names, ndim)))
+            # collectives inside the opaque body still cost comms
+            # (trip-count aware: a psum in a scanned body runs once
+            # per iteration)
+            comms += _control_flow_comms(eqn, ctx)
+        elif prim in ("scan", "while", "cond"):
+            outs = _transfer(eqn, ins,
+                             tuple(v.aval for v in eqn.outvars), ctx)
+            comms += _control_flow_comms(eqn, ctx)
+        else:
+            outs = _transfer(eqn, ins,
+                             tuple(v.aval for v in eqn.outvars), ctx)
+            if prim in _CALL_PRIMS:
+                # a call prim _linearize could not inline (arity
+                # mismatch): still sweep its body for collectives
+                comms += _control_flow_comms(eqn, ctx)
+            param = COLLECTIVE_PRIMS.get(prim)
+            if param is not None:
+                axes = _axis_names_of(eqn.params.get(param))
+                # sum over ALL array operands: a tree psum moves every
+                # leaf, not just the first
+                nbytes = sum(
+                    local_bytes(v.aval, ins[k] if k < len(ins) else
+                                None, ctx)
+                    for k, v in enumerate(eqn.invars) if _is_var(v))
+                comms += collective_bytes(
+                    prim, nbytes, [ctx.size(a) for a in axes])
+            if prim == "sharding_constraint" and ins and \
+                    ins[0] is not None:
+                before = ins[0]
+                after = outs[0]
+                if before.pending:
+                    # the boundary resolves partial sums: GSPMD inserts
+                    # the allreduce the row-parallel pattern relies on
+                    nb = local_bytes(eqn.invars[0].aval, after, ctx)
+                    comms += collective_bytes(
+                        "psum", nb, [ctx.size(a) for a in before.pending])
+                if before.spec is not None and \
+                        before.spec != after.spec:
+                    gone = before.axes_used() - after.axes_used()
+                    if gone:  # all-gather'd axes move the other shards
+                        nb = local_bytes(eqn.invars[0].aval, before, ctx)
+                        n = 1
+                        for a in gone:
+                            n *= ctx.size(a)
+                        comms += nb * (n - 1)
+        for var, val in zip(eqn.outvars, outs):
+            vals[var] = val
+
+    # liveness: birth/death step per canonical var
+    last_use: dict = {}
+    for idx, (eqn, reads) in enumerate(steps):
+        for r in reads:
+            if r is not None:
+                last_use[r] = idx
+    out_vars = {canon(v) for v in jaxpr.outvars if _is_var(v)}
+    donated_vars = {canon(jaxpr.invars[i]) for i in donated
+                    if i < len(jaxpr.invars)}
+    n_steps = len(steps)
+
+    def var_bytes(v):
+        return local_bytes(v.aval, vals.get(v), ctx)
+
+    births: dict = {}
+    deaths: dict = {}
+    for i, var in enumerate(jaxpr.invars):
+        cv = canon(var)
+        births[cv] = 0
+        if cv in donated_vars and cv not in out_vars:
+            deaths[cv] = last_use.get(cv, 0) + 1
+        else:
+            deaths[cv] = n_steps + 1
+    for var in jaxpr.constvars:
+        cv = canon(var)
+        births[cv] = 0
+        deaths[cv] = n_steps + 1
+    for idx, (eqn, _reads) in enumerate(steps):
+        for var in eqn.outvars:
+            cv = canon(var)
+            if cv in births:
+                continue
+            births[cv] = idx
+            if cv in out_vars:
+                deaths[cv] = n_steps + 1
+            else:
+                deaths[cv] = last_use.get(cv, idx) + 1
+
+    events: dict = {}
+    for cv, b in births.items():
+        nb = var_bytes(cv)
+        events[b] = events.get(b, 0) + nb
+        events[deaths[cv]] = events.get(deaths[cv], 0) - nb
+    peak, cur, peak_step = 0, 0, 0
+    for step in sorted(events):
+        cur += events[step]
+        if cur > peak:
+            peak, peak_step = cur, step
+
+    input_bytes = sum(var_bytes(canon(v)) for v in jaxpr.invars)
+    output_bytes = sum(var_bytes(canon(v)) for v in jaxpr.outvars
+                       if _is_var(v))
+    # partial sums still pending at an output: GSPMD resolves them to
+    # the (replicated) out sharding with an allreduce at the boundary
+    for v in jaxpr.outvars:
+        if not _is_var(v):
+            continue
+        val = vals.get(canon(v))
+        if val is not None and val.pending:
+            comms += collective_bytes(
+                "psum", var_bytes(canon(v)),
+                [ctx.size(a) for a in val.pending])
+    return {
+        "peak_hbm_bytes": int(peak),
+        "input_bytes": int(input_bytes),
+        "output_bytes": int(output_bytes),
+        "comms_bytes": int(comms),
+        "peak_step": int(peak_step),
+    }
+
+
+def _jaxpr_comms(jaxpr, ctx: MeshCtx, mult: int) -> int:
+    """Per-device comms bytes of the collectives in ``jaxpr``, each
+    weighted by ``mult`` executions."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        param = COLLECTIVE_PRIMS.get(eqn.primitive.name)
+        if param is not None:
+            axes = _axis_names_of(eqn.params.get(param))
+            nbytes = sum(_aval_bytes(v.aval)
+                         for v in eqn.invars if _is_var(v))
+            total += mult * collective_bytes(
+                eqn.primitive.name, nbytes,
+                [ctx.size(a) for a in axes])
+        else:
+            total += _control_flow_comms(eqn, ctx, mult)
+    return total
+
+
+def _control_flow_comms(eqn, ctx: MeshCtx, mult: int = 1) -> int:
+    """Comms bytes from collectives nested anywhere inside ``eqn``.
+    Scan bodies are weighted by their trip count, cond counts its most
+    expensive branch (not the sum), while-loop bodies count one
+    iteration (an unknowable trip count — a documented floor)."""
+    prim = eqn.primitive.name
+    params = eqn.params
+
+    if prim == "shard_map":
+        shape = getattr(params.get("mesh"), "shape", None)
+        sizes = {str(k): int(v) for k, v in dict(shape).items()} \
+            if shape else {}
+        inner = ctx.child(sizes, sizes.keys())
+        return sum(_jaxpr_comms(_jaxpr_of(s), inner, mult)
+                   for s in _closed_jaxprs_in(params.get("jaxpr", ())))
+
+    if prim == "scan":
+        length = params.get("length") or 1
+        return sum(
+            _jaxpr_comms(_jaxpr_of(s), ctx, mult * int(length))
+            for s in _closed_jaxprs_in(params.get("jaxpr", ())))
+
+    if prim == "cond":
+        branches = _closed_jaxprs_in(params.get("branches", ()))
+        return max((_jaxpr_comms(_jaxpr_of(b), ctx, mult)
+                    for b in branches), default=0)
+
+    total = 0
+    for value in params.values():
+        for sub in _closed_jaxprs_in(value):
+            total += _jaxpr_comms(_jaxpr_of(sub), ctx, mult)
+    return total

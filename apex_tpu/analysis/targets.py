@@ -16,8 +16,17 @@ from apex_tpu.analysis.precision_checks import (
     PRECISION_CHECKS,
     analyze_precision,
 )
+from apex_tpu.analysis.sharding_checks import (
+    SHARDING_CHECKS,
+    analyze_sharding,
+)
 
 TARGETS = {}
+
+# Per-target comms-bytes / peak-HBM estimates from the last
+# analyze_sharding run of each sharding target (filled as the targets
+# execute; read by run_sharding_findings and bench.py).
+SHARDING_STATS = {}
 
 # Per-target grandfather lists (the jaxpr analog of `# apex-lint:
 # disable`, which only reaches AST findings): @target(..., allow=(...))
@@ -34,7 +43,8 @@ TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 
 # Check ids that require running the tracing targets (the CLI runs the
 # full target suite when any of these is requested).
-TRACING_CHECKS = tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
+TRACING_CHECKS = (tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
+                  + tuple(SHARDING_CHECKS))
 
 
 def target(name, allow=()):
@@ -465,6 +475,335 @@ def _tp_fused_softmax():
         x, name="tp_fused_softmax")
 
 
+# ------------------------------------------------ sharding-flow targets
+# (ISSUE 4): the parallelism entry points whose comms/HBM behavior the
+# sharding checks pin down — TP layers fwd+bwd under GSPMD constraints,
+# the shard_map collectives (PP 1F1B, DDP buckets, MoE all_to_all), and
+# the TP-sharded optimizer master step. Trace-only, CPU backend.
+
+def _world():
+    import jax
+    return len(jax.devices())
+
+
+def _tp_size():
+    world = _world()
+    for tp in (4, 2):
+        if world % tp == 0 and world >= tp:
+            return tp
+    return 1
+
+
+def _owned_mesh(**kw):
+    """(mesh, axis_sizes, owned) against parallel_state, honoring a mesh
+    a caller already installed (same pattern as _tp_collectives)."""
+    from apex_tpu.transformer import parallel_state
+
+    owned = not parallel_state.model_parallel_is_initialized()
+    if owned:
+        parallel_state.initialize_model_parallel(**kw)
+    mesh = parallel_state.get_mesh()
+    sizes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    return mesh, sizes, owned
+
+
+def _release_mesh(owned):
+    if owned:
+        from apex_tpu.transformer import parallel_state
+        parallel_state.destroy_model_parallel()
+
+
+def _tp_linear_fwd_bwd(kind, name):
+    """Column/row-parallel fwd+bwd under GSPMD: partitioned params +
+    the layers' own with_sharding_constraint boundaries. The propagated
+    shardings must agree with every boundary (implicit-reshard), the
+    params must actually shard (replicated-large), and the step must
+    fit the HBM budget."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.tensor_parallel.layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        param_partition_specs,
+    )
+
+    mesh, sizes, owned = _owned_mesh(
+        tensor_model_parallel_size_=_tp_size())
+    try:
+        if kind == "column":
+            mod = ColumnParallelLinear(output_size=64,
+                                       gather_output=False,
+                                       params_dtype=jnp.float32)
+            x = jnp.zeros((8, 32), jnp.bfloat16)
+        else:
+            mod = RowParallelLinear(output_size=32,
+                                    input_is_parallel=True,
+                                    params_dtype=jnp.float32)
+            x = jnp.zeros((8, 64), jnp.bfloat16)
+        with jax.sharding.set_mesh(mesh):
+            variables = mod.init(jax.random.PRNGKey(0), x)
+            specs = param_partition_specs(variables)
+
+            def loss(variables, x):
+                y, _ = mod.apply(variables, x)
+                return jnp.sum(y.astype(jnp.float32))
+
+            stats = SHARDING_STATS.setdefault(name, {})
+            return analyze_sharding(
+                jax.value_and_grad(loss), variables, x,
+                in_specs=[specs, P(None, None)], axis_sizes=sizes,
+                stats_out=stats, name=name)
+    finally:
+        _release_mesh(owned)
+
+
+@target("tp_column_parallel_fwd_bwd")
+def _tp_column_parallel_fwd_bwd():
+    return _tp_linear_fwd_bwd("column", "tp_column_parallel_fwd_bwd")
+
+
+@target("tp_row_parallel_fwd_bwd")
+def _tp_row_parallel_fwd_bwd():
+    """Row-parallel: the tp-contracted gemm leaves partial sums that
+    the output constraint must resolve (the allreduce shows up in the
+    target's comms-bytes estimate, not as a finding)."""
+    return _tp_linear_fwd_bwd("row", "tp_row_parallel_fwd_bwd")
+
+
+@target("tp_fused_softmax_sharded")
+def _tp_fused_softmax_sharded():
+    """The TP fused softmax under shard_map with the batch/head dim
+    sharded over tp — collective-free by construction; the sharding
+    pass proves it stays that way (0 comms bytes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.functional.fused_softmax import (
+        scaled_upper_triang_masked_softmax,
+    )
+
+    mesh, sizes, owned = _owned_mesh(
+        tensor_model_parallel_size_=_tp_size())
+    try:
+        fn = jax.shard_map(
+            lambda x: scaled_upper_triang_masked_softmax(x, None, 1.0),
+            mesh=mesh, in_specs=P("tp"), out_specs=P("tp"))
+        stats = SHARDING_STATS.setdefault("tp_fused_softmax_sharded", {})
+        return analyze_sharding(
+            fn, jnp.zeros((8, 64, 64), jnp.bfloat16), axis_sizes=sizes,
+            stats_out=stats, name="tp_fused_softmax_sharded")
+    finally:
+        _release_mesh(owned)
+
+
+def _pp_1f1b(name, forward_only):
+    """Shared builder for the two 1F1B pipeline targets (same stage
+    model, shapes and mesh — one is the fwd+bwd step, the other the
+    forward-only slice)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+
+    world = _world()
+    pp = 4 if world % 4 == 0 and world >= 4 else (
+        2 if world % 2 == 0 else 1)
+    mesh, sizes, owned = _owned_mesh(pipeline_model_parallel_size_=pp)
+    try:
+        dim, m_count, mb = 8, 4, 2
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        params = {"w": jnp.zeros((pp, dim, dim)),
+                  "b": jnp.zeros((pp, dim))}
+        x = jnp.zeros((m_count, mb, dim))
+        tgt = jnp.zeros((m_count, mb, dim))
+
+        def step(params, x, tgt):
+            local = jax.tree_util.tree_map(lambda p: p[0], params)
+            loss, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, local, x, tgt,
+                forward_only=forward_only, axis_name="pp")
+            if forward_only:
+                return loss
+            return loss, jax.tree_util.tree_map(
+                lambda g: g[None], grads)
+
+        out_specs = P() if forward_only else (P(), P("pp"))
+        fn = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P("pp"), P(), P()),
+                           out_specs=out_specs)
+        stats = SHARDING_STATS.setdefault(name, {})
+        return analyze_sharding(fn, params, x, tgt, axis_sizes=sizes,
+                                stats_out=stats, name=name)
+    finally:
+        _release_mesh(owned)
+
+
+@target("pp_1f1b_microbatch_step", allow=("dead-collective",))
+def _pp_1f1b_microbatch_step():
+    """1F1B microbatch train step (fwd+bwd) over the 'pp' ring.
+
+    allow=dead-collective: differentiating the collective schedule
+    makes AD transpose pbroadcasts into psums of replicated cotangents
+    (summing n identical per-device contributions IS the chain rule —
+    a scale by axis size, statically resolvable but AD-emitted, not
+    user-written). The check stays armed for hand-written code via the
+    forward-only slice of this very schedule below."""
+    return _pp_1f1b("pp_1f1b_microbatch_step", forward_only=False)
+
+
+@target("pp_1f1b_forward")
+def _pp_1f1b_forward():
+    """Forward-only slice of the 1F1B schedule: every collective here
+    is hand-written (the scan ppermutes, the last-stage loss psum), so
+    dead-collective stays fully armed on the pipeline family."""
+    return _pp_1f1b("pp_1f1b_forward", forward_only=True)
+
+
+@target("ddp_bucket_allreduce_step")
+def _ddp_bucket_allreduce_step():
+    """DDP gradient sync over 'dp': per-leaf and flat-bucket allreduce.
+    The axis-size probes must be static (the psum(ones) pattern this
+    target caught in parallel/distributed.py was a dead collective
+    riding every bucket)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.distributed import (
+        sync_gradients,
+        sync_gradients_flat,
+    )
+
+    world = _world()
+    tp = 2 if world % 2 == 0 and world > 1 else 1
+    mesh, sizes, owned = _owned_mesh(tensor_model_parallel_size_=tp)
+    try:
+        grads = {"w": jnp.zeros((128, 128)), "b": jnp.zeros((128,))}
+        spec = {"w": P("dp"), "b": P("dp")}
+
+        def step(grads):
+            # both reduction paths over the SAME raw grads (chaining
+            # them would double-reduce — which this target's own
+            # dead-collective check correctly flags)
+            flat = sync_gradients_flat(grads, axis_name="dp")
+            plain = sync_gradients(grads, axis_name="dp",
+                                   gradient_predivide_factor=2.0)
+            return jax.tree_util.tree_map(jnp.add, flat, plain)
+
+        fn = jax.shard_map(step, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec)
+        stats = SHARDING_STATS.setdefault("ddp_bucket_allreduce_step", {})
+        return analyze_sharding(fn, grads, axis_sizes=sizes,
+                                stats_out=stats,
+                                name="ddp_bucket_allreduce_step")
+    finally:
+        _release_mesh(owned)
+
+
+@target("fused_adam_master_sharded_step")
+def _fused_adam_master_sharded_step():
+    """Per-tensor FusedAdam over tp-sharded fp32 master params under
+    GSPMD, donated state: master/m/v shard like the params they mirror
+    (replicated-large's canonical customer) and the donated buffers
+    earn their HBM credit in the budget walk."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.optimizers import fused_adam
+
+    mesh, sizes, owned = _owned_mesh(
+        tensor_model_parallel_size_=_tp_size())
+    try:
+        master = {"w": jnp.zeros((256, 1024), jnp.float32),
+                  "b": jnp.zeros((1024,), jnp.float32)}
+        tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=False)
+        state = tx.init(master)
+        grads = jax.tree_util.tree_map(jnp.ones_like, master)
+
+        def step(grads, state, master):
+            updates, new_state = tx.update(grads, state, master)
+            return optax.apply_updates(master, updates), new_state
+
+        wspec = {"w": P(None, "tp"), "b": P("tp")}
+        state_spec = jax.tree_util.tree_map(
+            lambda s: (wspec["w"] if getattr(s, "ndim", 0) == 2 else
+                       wspec["b"] if getattr(s, "ndim", 0) == 1 else P()),
+            state, is_leaf=lambda s: hasattr(s, "shape"))
+        with jax.sharding.set_mesh(mesh):
+            stats = SHARDING_STATS.setdefault(
+                "fused_adam_master_sharded_step", {})
+            return analyze_sharding(
+                step, grads, state, master,
+                in_specs=[wspec, state_spec, wspec],
+                donate_argnums=(1, 2), axis_sizes=sizes,
+                stats_out=stats, name="fused_adam_master_sharded_step")
+    finally:
+        _release_mesh(owned)
+
+
+@target("moe_dispatch")
+def _moe_dispatch():
+    """GShard MoE dispatch over 'ep': tokens shard over dp×ep so the
+    all_to_all pair actually moves expert slabs (with replicated
+    tokens it would be a dead collective — the seeded regression)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_mlp,
+    )
+
+    world = _world()
+    ep = 4 if world % 4 == 0 and world >= 4 else (
+        2 if world % 2 == 0 else 1)
+    dp = world // ep
+    mesh = Mesh(np.asarray(jax.devices()).reshape(dp, ep), ("dp", "ep"))
+    sizes = {"dp": dp, "ep": ep}
+    cfg = MoEConfig(hidden_size=16, ffn_hidden_size=32,
+                    num_experts=max(ep, 2), top_k=2)
+    params = init_moe_params(jax.random.PRNGKey(0), cfg)
+
+    def step(p, x):
+        y, aux = moe_mlp(p, x, cfg, ep_axis="ep")
+        return y, jax.lax.pmean(aux, "dp")
+
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=({"router": P(), "wi": P("ep"), "wo": P("ep")},
+                  P(("dp", "ep"))),
+        out_specs=(P(("dp", "ep")), P()), check_vma=False)
+    stats = SHARDING_STATS.setdefault("moe_dispatch", {})
+    return analyze_sharding(
+        fn, params, jnp.zeros((8 * max(dp * ep, 1), 16)),
+        axis_sizes=sizes, stats_out=stats, name="moe_dispatch")
+
+
+SHARDING_TARGETS = (
+    "tp_column_parallel_fwd_bwd", "tp_row_parallel_fwd_bwd",
+    "tp_fused_softmax_sharded", "pp_1f1b_microbatch_step",
+    "pp_1f1b_forward", "ddp_bucket_allreduce_step",
+    "fused_adam_master_sharded_step", "moe_dispatch",
+)
+
+
 def run_targets(names=None, extra_allow=None):
     """Run the registered targets; returns (findings, errors) where
     errors maps target name -> repr of an exception that kept the target
@@ -512,3 +851,38 @@ PRECISION_TARGETS = (
     "fused_layer_norm_fwd_bwd", "fused_rms_norm_fwd_bwd",
     "tp_fused_softmax",
 )
+
+
+def run_sharding_findings(registry=None, names=None):
+    """Run only the sharding-flow targets and publish finding counts +
+    per-target comms-bytes / peak-HBM estimates to the observability
+    registry (``analysis/sharding_*`` family) — the hook bench.py
+    reports through. Returns (findings, errors, stats) where stats is
+    {target: {"comms_bytes", "peak_hbm_bytes", ...}}."""
+    from apex_tpu.analysis.sharding_checks import (
+        SHARDING_CHECKS as _SC,
+        report_to_registry,
+    )
+
+    wanted = tuple(names) if names is not None else SHARDING_TARGETS
+    unknown = set(wanted) - set(TARGETS)
+    if unknown:
+        # a typo'd name silently yielding an all-zero stats row would
+        # read as "analyzed and clean" forever — same loud-failure rule
+        # as the CLI's unknown-check/path validation
+        raise ValueError(
+            f"unknown sharding target(s) {sorted(unknown)}; valid: "
+            f"{sorted(SHARDING_TARGETS)}")
+    findings, errors = run_targets(set(wanted))
+    findings = [f for f in findings if f.check in _SC]
+    results = {}
+    for name in wanted:
+        if name in errors:
+            continue
+        results[name] = (
+            [f for f in findings if f.symbol == name],
+            dict(SHARDING_STATS.get(name, {})),
+        )
+    report_to_registry(results, registry=registry)
+    stats = {name: s for name, (_, s) in results.items()}
+    return findings, errors, stats

@@ -208,6 +208,46 @@ def device_vmem_bytes(kind: "str | None" = None) -> int:
     return _VMEM_BYTES_DEFAULT
 
 
+# Per-device HBM by generation, same substring scheme as _VMEM_BYTES.
+# Used by the hbm-budget sharding check in apex_tpu.analysis as the
+# default live-set budget; APEX_TPU_HBM_BYTES overrides for odd
+# topologies (e.g. a budget held back for XLA scratch).
+_HBM_BYTES_DEFAULT = 16 << 30
+# Per jax DEVICE, which on v2/v3 is one TensorCore (half the chip's
+# HBM); v4+ expose one megacore device per chip.
+_HBM_BYTES = (
+    ("v5p", 95 << 30), ("v5 lite", 16 << 30), ("v5e", 16 << 30),
+    ("v6", 32 << 30), ("trillium", 32 << 30), ("v4", 32 << 30),
+    ("v3", 16 << 30), ("v2", 8 << 30),
+)
+
+
+def device_hbm_bytes(kind: "str | None" = None) -> int:
+    """Per-device HBM budget in bytes for ``kind`` (a device_kind
+    string; default: the current backend's first device, or the
+    conservative 16 GiB planning figure off-TPU). The
+    ``APEX_TPU_HBM_BYTES`` env var overrides everything — the knob the
+    hbm-budget analysis check documents in docs/runtime.md."""
+    env = os.environ.get("APEX_TPU_HBM_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(
+                f"APEX_TPU_HBM_BYTES must be an integer byte count, "
+                f"got {env!r}")
+    if kind is None:
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return _HBM_BYTES_DEFAULT
+        kind = dev.device_kind
+    kind = kind.lower()
+    for key, nbytes in _HBM_BYTES:
+        if key in kind:
+            return nbytes
+    return _HBM_BYTES_DEFAULT
+
+
 def out_struct(shape, dtype, *like):
     """``jax.ShapeDtypeStruct`` for a ``pallas_call`` out_shape that works
     inside ``shard_map``: with jax's check_vma on, pallas outputs must

@@ -64,8 +64,11 @@ def sync_gradients(grads, axis_name: str = "data", gradient_average: bool = True
             g = g / gradient_predivide_factor
         g = jax.lax.psum(g, axis_name)
         if gradient_average:
-            n = jax.lax.psum(jnp.ones((), g.dtype), axis_name)
-            g = g * (gradient_predivide_factor / n)
+            # axis_size is a compile-time constant; psum(ones) here
+            # would emit a real collective for it (apex_tpu.analysis
+            # dead-collective)
+            n = jax.lax.axis_size(axis_name)
+            g = g * jnp.asarray(gradient_predivide_factor / n, g.dtype)
         return g
 
     with scope("ddp/allreduce"):
@@ -85,8 +88,10 @@ def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool =
             with scope(f"ddp/bucket/{k}"):
                 r = jax.lax.psum(buf, axis_name)
                 if gradient_average:
-                    n = jax.lax.psum(jnp.ones((), buf.dtype), axis_name)
-                    r = r / n
+                    # static axis size, not psum(ones): the probe would
+                    # be a dead collective riding every bucket
+                    r = r / jnp.asarray(jax.lax.axis_size(axis_name),
+                                        r.dtype)
             reduced[k] = r
         return unflatten_tree(reduced, meta)
 
@@ -175,7 +180,8 @@ class Reducer:
 
     def reduce(self, tree=None):
         tree = tree if tree is not None else self.params
-        n_fn = lambda x: jax.lax.psum(jnp.ones((), x.dtype), self.axis_name)
+        n_fn = lambda x: jnp.asarray(
+            jax.lax.axis_size(self.axis_name), x.dtype)
         return jax.tree_util.tree_map(
             lambda x: jax.lax.psum(x, self.axis_name) / n_fn(x), tree)
 

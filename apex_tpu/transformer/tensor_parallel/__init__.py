@@ -25,6 +25,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
     reduce_scatter_to_sequence_parallel_region,
+    reduce_scatter_to_tensor_model_parallel_region,
     scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
@@ -71,6 +72,7 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "reduce_scatter_to_tensor_model_parallel_region",
     "MemoryBuffer",
     "RingMemBuffer",
     "allocate_mem_buff",

@@ -146,6 +146,21 @@ def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
         return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
+def reduce_scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
+    """psum_scatter over the LAST dim: the fused form of ``reduce_from``
+    followed by ``scatter_to``. A full allreduce whose result is then
+    sliced back to this rank's chunk moves ~2x the bytes and throws
+    (n-1)/n of them away — the pattern the ``psum-scatter`` analysis
+    check flags; this is the one-call fix it points at."""
+    axis = _axis(axis_name)
+    if not _axis_bound(axis):
+        return x
+    with scope("tp/reduce_scatter"):
+        return jax.lax.psum_scatter(x, axis,
+                                    scatter_dimension=x.ndim - 1,
+                                    tiled=True)
+
+
 # --------------------------------------------------- sequence-parallel duals
 # (ref: Megatron-LM sequence parallelism; the apex snapshot gates these behind
 # sequence_parallel_enabled on the layers.)

@@ -798,6 +798,25 @@ def worker():
     except Exception as e:  # never let the sanitizer cost the JSON line
         extras["precision_findings_error"] = repr(e)[:120]
 
+    # sharding-flow verdict + comms/HBM estimates (ISSUE 4): per-target
+    # estimated bytes-moved and peak live HBM land in the JSON line and
+    # the analysis/sharding_* metric family, so a perf number always
+    # ships with its distributed-placement lint status
+    try:
+        from apex_tpu.analysis import run_sharding_findings
+
+        sfindings, serrors, sstats = run_sharding_findings(registry=reg)
+        extras["sharding_findings"] = len(sfindings)
+        extras["sharding_targets"] = {
+            name: {"comms_bytes": int(s.get("comms_bytes", 0)),
+                   "peak_hbm_bytes": int(s.get("peak_hbm_bytes", 0))}
+            for name, s in sorted(sstats.items())}
+        if serrors:
+            extras["sharding_target_errors"] = dict(sorted(
+                serrors.items()))
+    except Exception as e:  # same contract as the precision hook
+        extras["sharding_findings_error"] = repr(e)[:120]
+
     def finalize_metrics():
         """Fold recompile counts into extras and (re)write the metrics
         JSONL — called before EVERY emit so even a timed-out worker

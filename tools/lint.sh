@@ -26,9 +26,24 @@ if [[ "${1:-}" == "--changed-only" ]]; then
     shift
     # Narrow the AST engine to python files changed since the merge base
     # (working tree + index + committed-vs-base; deleted files drop out
-    # via the existence filter). The jaxpr + dataflow targets are NOT
-    # narrowed: they trace whole entry points, so an edit anywhere in a
-    # traced module can move their verdicts.
+    # via the existence filter). The jaxpr + dataflow/sharding targets
+    # are NOT narrowed: they trace whole entry points, so an edit
+    # anywhere in a traced module can move their verdicts.
+    #
+    # LINT_DIFF_REPORT: path to a stored `--json` dump from the merge
+    # base (generate once per base rev: `python -m apex_tpu.analysis
+    # --json > base.json`). When set, the gate fails only on findings
+    # NEW relative to that run — pre-existing base findings and their
+    # churn never block a branch, which is what keeps --changed-only
+    # usable as the fast CI gate.
+    diff_args=()
+    if [[ -n "${LINT_DIFF_REPORT:-}" ]]; then
+        if [[ ! -f "${LINT_DIFF_REPORT}" ]]; then
+            echo "LINT_DIFF_REPORT=${LINT_DIFF_REPORT} does not exist" >&2
+            exit 2
+        fi
+        diff_args+=(--diff "${LINT_DIFF_REPORT}")
+    fi
     base="$(git merge-base HEAD "${LINT_BASE:-main}" 2>/dev/null || true)"
     changed="$(
         { git diff --name-only "${base:-HEAD}" -- 2>/dev/null;
@@ -45,10 +60,12 @@ if [[ "${1:-}" == "--changed-only" ]]; then
         # entirely (an empty explicit path list would be rejected as a
         # typo by the CLI's loud-failure rule)
         exec python -m apex_tpu.analysis \
-            --baseline tests/run_analysis/baseline.json --no-ast "$@"
+            --baseline tests/run_analysis/baseline.json --no-ast \
+            ${diff_args[@]+"${diff_args[@]}"} "$@"
     fi
     exec python -m apex_tpu.analysis \
-        --baseline tests/run_analysis/baseline.json "${ast_paths[@]}" "$@"
+        --baseline tests/run_analysis/baseline.json \
+        ${diff_args[@]+"${diff_args[@]}"} "${ast_paths[@]}" "$@"
 fi
 
 exec python -m apex_tpu.analysis \

@@ -15,6 +15,11 @@ emits:
 
     python -m apex_tpu.analysis --json > lint.json
     python tools/metrics_report.py lint.json BENCH_METRICS.jsonl
+
+Metrics JSONL dumps carrying the ``analysis/sharding_*`` family (bench
+runs since ISSUE 4) additionally get a per-target table of estimated
+comms bytes/step and peak live HBM. Unknown ``schema_version`` values
+in analysis reports fail loudly rather than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -55,6 +60,76 @@ def load_analysis_report(path):
     return data
 
 
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{int(n)} B"
+
+
+def render_sharding_family(path):
+    """Per-target table of the ``analysis/sharding_*`` gauge/counter
+    family from a metrics JSONL dump (None when the file carries none).
+    Lines that are not JSON are skipped (truncated dumps), matching the
+    tolerant observability reader."""
+    targets = {}  # name -> {"comms_bytes": .., "peak_hbm_bytes": ..}
+    checks = {}
+    total = None
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        name = rec.get("name", "")
+        if not isinstance(name, str) or \
+                not name.startswith("analysis/sharding_"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        if name == "analysis/sharding_findings_total":
+            total = rec.get("value")
+        elif name == "analysis/sharding_findings":
+            checks[labels.get("check", "?")] = rec.get("value")
+        elif name in ("analysis/sharding_comms_bytes",
+                      "analysis/sharding_peak_hbm_bytes"):
+            key = name.rsplit("_bytes", 1)[0].split("sharding_")[-1]
+            targets.setdefault(labels.get("target", "?"), {})[
+                key + "_bytes"] = rec.get("value")
+    if not targets and total is None and not checks:
+        return None
+    return {"targets": targets, "checks": checks,
+            "findings_total": total}
+
+
+def summarize_sharding(path, fam):
+    print(f"{path}: analysis/sharding_* family")
+    if fam["findings_total"] is not None:
+        print(f"  findings: {fam['findings_total']}")
+    for check, n in sorted(fam["checks"].items()):
+        print(f"    {check:24s} {n}")
+    if fam["targets"]:
+        width = max(len(t) for t in fam["targets"])
+        print(f"  {'target':{width}s}  {'comms/step':>12s}  "
+              f"{'peak HBM':>12s}")
+        for t, vals in sorted(fam["targets"].items()):
+            print(f"  {t:{width}s}  "
+                  f"{_fmt_bytes(vals.get('comms_bytes', 0)):>12s}  "
+                  f"{_fmt_bytes(vals.get('peak_hbm_bytes', 0)):>12s}")
+
+
 def summarize_analysis(path, data):
     findings = data.get("findings", [])
     by_check = collections.Counter(f.get("check", "?") for f in findings)
@@ -85,6 +160,17 @@ if __name__ == "__main__":
                 summarize_analysis(arg, data)
             handled_any = True
         else:
+            # a metrics JSONL carrying the sharding family gets its
+            # per-target comms/HBM table in addition to the generic
+            # observability summary below
+            fam = render_sharding_family(arg) if os.path.isfile(arg) \
+                else None
+            if fam is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "sharding_family": fam}))
+                else:
+                    summarize_sharding(arg, fam)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
