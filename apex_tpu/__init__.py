@@ -134,6 +134,7 @@ from apex_tpu import mlp
 from apex_tpu import models
 from apex_tpu import pyprof
 from apex_tpu import reparameterization
+from apex_tpu import resilience
 from apex_tpu import rnn
 
 __version__ = "0.1.0"
@@ -153,5 +154,6 @@ __all__ = [
     "models",
     "pyprof",
     "reparameterization",
+    "resilience",
     "rnn",
 ]

@@ -27,6 +27,16 @@ lived. Checks:
                       time bug. Driver code (bench.py, tools/,
                       examples/) may read clocks — sync-timing still
                       polices HOW it times.
+- ``swallowed-exception-in-step-loop``
+                      ``except Exception/BaseException/bare: pass`` (or
+                      ``continue``) inside a ``for``/``while`` body in
+                      ``apex_tpu/`` or ``examples/``: a step loop that
+                      silently eats per-iteration failures hides NaN
+                      storms, torn checkpoint writes and dying
+                      collectives until the run is unrecoverable.
+                      Resilience must be explicit — retry transient
+                      classes via ``apex_tpu.resilience.retry.Policy``,
+                      or at least count/log before continuing.
 
 Suppress with ``# apex-lint: disable=<id>`` on (or above) the line.
 """
@@ -39,16 +49,20 @@ import os
 from apex_tpu.analysis.findings import Finding, is_suppressed
 
 AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
-              "mutable-default", "raw-clock")
+              "mutable-default", "raw-clock",
+              "swallowed-exception-in-step-loop")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
 
 # raw-clock applies only to library code under apex_tpu/; these own the
 # sanctioned clocks (timing.py implements the corrected sync, the
-# observability layer's Timer/StepReporter are built on it).
+# observability layer's Timer/StepReporter are built on it;
+# resilience/ reads wall time for retry backoff/deadlines — host-side
+# scheduling, not device phase timing).
 _RAW_CLOCK_ALLOW_FILES = {"apex_tpu/runtime/timing.py"}
-_RAW_CLOCK_ALLOW_PREFIXES = ("apex_tpu/observability/",)
+_RAW_CLOCK_ALLOW_PREFIXES = ("apex_tpu/observability/",
+                             "apex_tpu/resilience/")
 
 
 def _raw_clock_applies(path: str) -> bool:
@@ -65,6 +79,46 @@ def _raw_clock_applies(path: str) -> bool:
     if tail in _RAW_CLOCK_ALLOW_FILES:
         return False
     return not any(tail.startswith(p) for p in _RAW_CLOCK_ALLOW_PREFIXES)
+
+
+def _swallowed_exc_applies(path: str) -> bool:
+    """Is ``path`` governed by swallowed-exception-in-step-loop? Library
+    code under an ``apex_tpu`` package dir, or anything under an
+    ``examples`` dir — the two places step loops live. Driver plumbing
+    (bench.py launcher, tools/) may legitimately blanket-continue over
+    secondary work."""
+    parts = path.replace("\\", "/").split("/")[:-1]
+    return "apex_tpu" in parts or "examples" in parts
+
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(type_node) -> bool:
+    """Bare ``except:``, ``except Exception``, ``except BaseException``
+    — including inside a tuple of classes."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_handler(e) for e in type_node.elts)
+    chain = _attr_chain(type_node)
+    return bool(chain) and chain[-1] in _BROAD_EXC
+
+
+def _body_only_swallows(body) -> bool:
+    """True when the handler body does nothing but pass/continue/... —
+    no logging, no counter, no re-raise, no fallback value."""
+    if not body:
+        return True
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant) and stmt.value.value is ...:
+            continue
+        return False
+    return True
+
 
 _CLOCK_CALLS = {("time", "perf_counter"), ("time", "time"),
                 ("time", "monotonic"), ("time", "perf_counter_ns"),
@@ -143,6 +197,9 @@ class _Visitor(ast.NodeVisitor):
         self.stack = [("<module>", False)]
         # per-function-frame call records for sync-timing
         self.frames = [{"clock": [], "block": []}]
+        # per-function-frame lexical loop depth (a handler inside a def
+        # nested in a loop is NOT per-iteration code — depth resets)
+        self.loop_depth = [0]
         # local name -> imported dotted module, so `from jax import
         # random` is not mistaken for the stdlib `random` module
         self.imports = {}
@@ -207,6 +264,7 @@ class _Visitor(ast.NodeVisitor):
                         f"default to None and build inside"))
         self.stack.append((name, jit))
         self.frames.append({"clock": [], "block": []})
+        self.loop_depth.append(0)
 
     def _exit_function(self):
         frame = self.frames.pop()
@@ -231,6 +289,7 @@ class _Visitor(ast.NodeVisitor):
             self.frames[-1]["block"] += frame["block"]
             self.frames[-1]["clock"] += frame["clock"]
         self.stack.pop()
+        self.loop_depth.pop()
 
     def visit_FunctionDef(self, node):
         self._enter_function(node)
@@ -243,6 +302,36 @@ class _Visitor(ast.NodeVisitor):
         self._enter_function(node)
         self.generic_visit(node)
         self._exit_function()
+
+    # ------------------------------------------------- loops / handlers
+
+    def visit_For(self, node):
+        self.loop_depth[-1] += 1
+        self.generic_visit(node)
+        self.loop_depth[-1] -= 1
+
+    visit_AsyncFor = visit_For
+    visit_While = visit_For
+
+    def visit_Try(self, node):
+        if self.loop_depth[-1] > 0:
+            for handler in node.handlers:
+                if _is_broad_handler(handler.type) and \
+                        _body_only_swallows(handler.body):
+                    caught = "except:" if handler.type is None else \
+                        f"except {ast.unparse(handler.type)}:"
+                    self._emit(
+                        "swallowed-exception-in-step-loop", "error",
+                        handler.lineno,
+                        f"'{caught} pass/continue' inside a loop body "
+                        f"silently swallows per-step failures (NaN "
+                        f"storms, torn checkpoint writes, dying "
+                        f"collectives) — retry transient classes via "
+                        f"apex_tpu.resilience.retry.Policy, or count/"
+                        f"log the failure before continuing")
+        self.generic_visit(node)
+
+    visit_TryStar = visit_Try
 
     # ------------------------------------------------------ call sites
 
@@ -328,6 +417,9 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # the modules that implement the sanctioned clocks themselves
     if not _raw_clock_applies(abspath or relpath):
         checks = checks - {"raw-clock"}
+    # swallowed-exception: step loops live in apex_tpu/ and examples/
+    if not _swallowed_exc_applies(abspath or relpath):
+        checks = checks - {"swallowed-exception-in-step-loop"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:

@@ -11,14 +11,46 @@ async_save=True)``) copy device arrays to host, then write in a
 background thread while the TPU keeps training — on a chip whose step
 time is milliseconds, a blocking multi-GB write is the difference
 between checkpointing every 15 minutes and every minute.
+
+Durability protocol (ISSUE 5): every save is *atomic* — data lands in
+``step_XXXXXXXX.tmp``, a commit marker (``_APEX_COMMIT.json``: a file
+manifest with sizes + crc32 checksums) is written inside, and the tmp
+dir is renamed to its final name. A process killed mid-write leaves only
+a ``.tmp`` dir, which :func:`latest_valid_step` ignores and
+:func:`gc_partial_checkpoints` removes — ``restore`` can never pick up a
+torn write. :mod:`apex_tpu.resilience` injects simulated write failures
+through the module-level ``_FAULT_HOOK`` so the failure paths are
+testable on CPU.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
+import zlib
 from typing import Any, Optional
 
 import jax
+
+#: Name of the commit marker written inside every committed step dir.
+COMMIT_MARKER = "_APEX_COMMIT.json"
+
+#: Suffix of in-flight (uncommitted) step dirs.
+TMP_SUFFIX = ".tmp"
+
+# Fault-injection hook (set by apex_tpu.resilience.faults injectors):
+# called as hook(stage, step, path) at "pre_write" (before any data is
+# written — the ENOSPC point) and "pre_commit" (after the data, before
+# the marker + rename — the torn-write point). Raising aborts the save
+# exactly where a real kill/disk-full would.
+_FAULT_HOOK = None
+
+
+def _fault_point(stage: str, step, path: str) -> None:
+    hook = _FAULT_HOOK
+    if hook is not None:
+        hook(stage, step, path)
 
 
 def _ocp():
@@ -27,31 +59,212 @@ def _ocp():
     return ocp
 
 
+def _step_dirname(step: int) -> str:
+    return f"step_{step:08d}"
+
+
+# --------------------------------------------------------------- manifest
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def build_manifest(dirpath: str) -> dict:
+    """File manifest of a checkpoint dir: relpath -> {size, crc32}.
+    The commit marker itself is excluded (it is written after)."""
+    files = {}
+    for root, _dirs, names in os.walk(dirpath):
+        for name in sorted(names):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, dirpath)
+            if rel == COMMIT_MARKER:
+                continue
+            files[rel] = {"size": os.path.getsize(full),
+                          "crc32": _file_crc32(full)}
+    return {"files": files}
+
+
+def write_commit_marker(dirpath: str, step: Optional[int] = None) -> str:
+    """Write the manifest/commit marker into ``dirpath`` (atomically
+    within the dir: marker.part + rename). The marker is the LAST write
+    of a checkpoint — its presence asserts every listed file landed."""
+    payload = {"format": 1, "step": step, **build_manifest(dirpath)}
+    marker = os.path.join(dirpath, COMMIT_MARKER)
+    part = marker + ".part"
+    with open(part, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(part, marker)
+    return marker
+
+
+def validate_step_dir(dirpath: str, deep: bool = False) -> bool:
+    """Is ``dirpath`` a committed, intact checkpoint?
+
+    Requires the commit marker, and every manifest file present with its
+    recorded size; ``deep=True`` additionally re-checksums the files
+    (crc32) — use for paranoid resume, skip for fast polling.
+    """
+    marker = os.path.join(dirpath, COMMIT_MARKER)
+    try:
+        with open(marker) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return False
+    files = payload.get("files")
+    if not isinstance(files, dict):
+        return False
+    for rel, meta in files.items():
+        full = os.path.join(dirpath, rel)
+        try:
+            if os.path.getsize(full) != meta.get("size"):
+                return False
+            if deep and _file_crc32(full) != meta.get("crc32"):
+                return False
+        except OSError:
+            return False
+    return True
+
+
+# ---------------------------------------------------------- dir scanning
+
+def _committed_steps(path: str) -> dict:
+    """{step: dirname} of committed (non-``.tmp``) step dirs."""
+    steps = {}
+    if not os.path.isdir(path):
+        return steps
+    for d in os.listdir(path):
+        if not d.startswith("step_"):
+            continue
+        try:
+            steps[int(d[5:])] = d
+        except ValueError:
+            # .tmp dirs, orbax in-flight temp dirs, anything non-numeric
+            continue
+    return steps
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest committed ``step_*`` subdirectory, or None. Makes no
+    validity claim — prefer :func:`latest_valid_step` for resume."""
+    steps = _committed_steps(path)
+    return max(steps) if steps else None
+
+
+def valid_steps(path: str, deep: bool = False) -> list:
+    """Ascending list of committed steps whose dirs validate."""
+    return sorted(s for s, d in _committed_steps(path).items()
+                  if validate_step_dir(os.path.join(path, d), deep=deep))
+
+
+def latest_valid_step(path: str, deep: bool = False) -> Optional[int]:
+    """Largest committed step with an intact commit marker/manifest, or
+    None — the step auto-resume is allowed to trust."""
+    steps = valid_steps(path, deep=deep)
+    return steps[-1] if steps else None
+
+
+def gc_partial_checkpoints(path: str, keep=()) -> list:
+    """Remove torn-write leftovers under ``path``: ``step_*.tmp`` dirs,
+    orbax in-flight temp dirs, and committed step dirs whose commit
+    marker exists but no longer validates (corrupted/truncated data).
+
+    Marker-less committed dirs are left alone — they may be checkpoints
+    from a pre-marker writer, and deleting data this module did not
+    provably write is not this function's call. ``keep``: path PREFIXES
+    to spare — an in-flight async write, including orbax's own
+    ``<path>.orbax-checkpoint-tmp-*`` staging dirs for it. Returns the
+    removed paths.
+    """
+    removed = []
+    if not os.path.isdir(path):
+        return removed
+    keep = tuple(os.path.abspath(k) for k in keep)
+    for d in sorted(os.listdir(path)):
+        if not d.startswith("step_"):
+            continue
+        full = os.path.abspath(os.path.join(path, d))
+        if any(full.startswith(k) for k in keep) or not os.path.isdir(full):
+            continue
+        is_tmp = d.endswith(TMP_SUFFIX) or ".orbax-checkpoint-tmp" in d
+        has_marker = os.path.exists(os.path.join(full, COMMIT_MARKER))
+        if is_tmp or (has_marker and not validate_step_dir(full)):
+            shutil.rmtree(full, ignore_errors=True)
+            removed.append(full)
+    return removed
+
+
+# ------------------------------------------------------------ save/restore
+
+def _check_overwrite(final: str, overwrite: bool) -> None:
+    """Fail BEFORE any data is written, and with a non-retryable class
+    (ValueError, matching the pre-atomic orbax behavior): an existing
+    checkpoint is a permanent condition, not I/O weather — it must not
+    look transiently retryable to a retry.Policy's OSError rule."""
+    if not overwrite and os.path.isdir(final):
+        raise ValueError(
+            f"checkpoint already exists at {final} and overwrite=False")
+
+
+def _commit(tmp: str, final: str, step, overwrite: bool) -> str:
+    """Marker + rename: the atomic tail of every save path."""
+    _fault_point("pre_commit", step, tmp)
+    write_commit_marker(tmp, step=step)
+    if os.path.isdir(final):
+        _check_overwrite(final, overwrite)  # lost the entry-check race
+        shutil.rmtree(final)
+    os.rename(tmp, final)
+    return final
+
+
 def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
                     overwrite: bool = True):
     """Save a pytree (params / opt state / amp state / rng — anything).
 
-    ``step`` appends a step subdirectory (``path/step_000010``).
+    ``step`` appends a step subdirectory (``path/step_000010``). The
+    write is atomic: data lands in ``<dir>.tmp``, the commit marker is
+    written, then the dir is renamed — a crash at any point leaves
+    either the previous checkpoint or an ignorable ``.tmp`` dir.
     """
     ocp = _ocp()
     if step is not None:
-        path = os.path.join(path, f"step_{step:08d}")
-    path = os.path.abspath(path)
+        path = os.path.join(path, _step_dirname(step))
+    final = os.path.abspath(path)
+    _check_overwrite(final, overwrite)
+    tmp = final + TMP_SUFFIX
+    if os.path.isdir(tmp):  # stale torn write from a previous crash
+        shutil.rmtree(tmp, ignore_errors=True)
+    _fault_point("pre_write", step, tmp)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state, force=overwrite)
-    return path
+    ckptr.save(tmp, state, force=True)
+    return _commit(tmp, final, step, overwrite)
 
 
 def restore_checkpoint(path: str, target: Optional[Any] = None,
                        step: Optional[int] = None):
     """Restore; ``target`` (a matching pytree of arrays/ShapeDtypeStructs)
-    pins structure, dtypes and shardings."""
+    pins structure, dtypes and shardings.
+
+    ``step=None`` resumes from the newest *valid* (committed + intact
+    manifest) step; when no step carries a marker at all (a dir written
+    by a pre-marker writer) it falls back to the newest step dir.
+    """
     ocp = _ocp()
     if step is None:
-        # resume semantics: a stepped checkpoint dir restores its newest step
-        step = latest_step(path)
+        # resume semantics: a stepped checkpoint dir restores its newest
+        # VALID step — an uncommitted/torn dir must never win
+        step = latest_valid_step(path)
+        if step is None:
+            step = latest_step(path)
     if step is not None:
-        path = os.path.join(path, f"step_{step:08d}")
+        path = os.path.join(path, _step_dirname(step))
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
     if target is None:
@@ -66,54 +279,74 @@ class AsyncCheckpointWriter:
     the serialization/write runs concurrently with subsequent training
     steps. A second ``save`` (or ``wait``) blocks until the previous
     write lands — at most one write is ever in flight.
+
+    Writes follow the atomic protocol: the background write targets
+    ``<dir>.tmp``; ``wait()`` (or the fence inside the next ``save``)
+    finalizes it — commit marker, then rename. A process killed while a
+    write is in flight leaves only the ``.tmp`` dir.
     """
 
     def __init__(self):
         ocp = _ocp()
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending = None  # (tmp, final, step, overwrite)
+
+    @property
+    def in_flight_tmp(self) -> Optional[str]:
+        """Abs path of the uncommitted ``.tmp`` dir, if a write is in
+        flight — GC must spare it."""
+        return self._pending[0] if self._pending else None
 
     def save(self, path: str, state: Any, step: Optional[int] = None,
              overwrite: bool = True) -> str:
         if step is not None:
-            path = os.path.join(path, f"step_{step:08d}")
-        path = os.path.abspath(path)
-        self._ckptr.save(path, state, force=overwrite)
-        return path
+            path = os.path.join(path, _step_dirname(step))
+        final = os.path.abspath(path)
+        _check_overwrite(final, overwrite)
+        tmp = final + TMP_SUFFIX
+        # fence + finalize the PREVIOUS write before issuing a new one —
+        # keeps the single-write-in-flight contract and commits in order
+        self.wait()
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        _fault_point("pre_write", step, tmp)
+        self._ckptr.save(tmp, state, force=True)
+        self._pending = (tmp, final, step, overwrite)
+        return final
 
     def wait(self):
-        """Block until the in-flight write (if any) is durable."""
+        """Block until the in-flight write (if any) is durable AND
+        committed (marker + rename)."""
         self._ckptr.wait_until_finished()
+        if self._pending is not None:
+            tmp, final, step, overwrite = self._pending
+            # clear first: a failed commit leaves a torn .tmp behind (as
+            # a real crash would) rather than wedging every later save
+            self._pending = None
+            _commit(tmp, final, step, overwrite)
 
     def close(self):
         self.wait()
         self._ckptr.close()
 
 
-def latest_step(path: str) -> Optional[int]:
-    """Largest ``step_*`` subdirectory, or None."""
-    if not os.path.isdir(path):
-        return None
-    steps = []
-    for d in os.listdir(path):
-        if d.startswith("step_"):
-            try:
-                steps.append(int(d[5:]))
-            except ValueError:
-                pass
-    return max(steps) if steps else None
-
-
 class CheckpointManager:
     """Thin rotation/bookkeeping wrapper (orbax CheckpointManager analog
     with the apex-era torch.save ergonomics).
 
-    Async mode (``async_save=True``): retention runs *before* the
-    just-issued write lands, so up to ``max_to_keep + 1`` finalized step
-    dirs can transiently exist between saves — that is by design, not a
-    leak. Call :meth:`wait_until_finished` at the end of the training
-    loop: it flushes the in-flight write AND applies final retention; a
-    caller that skips it only gets the last write flushed at interpreter
-    exit (orbax's atexit hook) and keeps the extra step dir on disk."""
+    Async mode (``async_save=True``): each ``save`` fences and commits
+    the previous write before issuing the new one, so retention always
+    runs over committed dirs only; the in-flight ``.tmp`` dir is never
+    GC'd. Call :meth:`wait_until_finished` at the end of the training
+    loop: it flushes + commits the last write and applies final
+    retention; a caller that skips it leaves the last write as an
+    uncommitted ``.tmp`` dir (recovered as "previous step" semantics —
+    exactly what a kill at that moment would have produced).
+
+    Retention never deletes the newest *valid* checkpoint, even when it
+    has aged out of the ``max_to_keep`` window — a run whose recent
+    saves were all torn/corrupted must still have something to resume
+    from."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = False):
@@ -124,10 +357,6 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any):
         if self._writer is not None:
-            # AsyncCheckpointer.save fences the PREVIOUS write internally,
-            # so by the time the new write is issued every older step has
-            # landed — retention can run immediately (the in-flight step
-            # is the newest and always survives _gc)
             p = self._writer.save(self.directory, state, step=step)
             self._gc()
             return p
@@ -136,15 +365,18 @@ class CheckpointManager:
         return p
 
     def wait_until_finished(self):
-        """Async mode: block until pending writes land, then apply
-        retention. No-op in blocking mode."""
+        """Async mode: block until pending writes land and commit, then
+        apply retention. No-op in blocking mode."""
         if self._writer is not None:
             self._writer.wait()
             self._gc()
 
     def restore(self, target: Optional[Any] = None,
                 step: Optional[int] = None):
-        step = step if step is not None else latest_step(self.directory)
+        if step is None:
+            step = self.latest_valid_step()
+            if step is None:
+                step = latest_step(self.directory)
         if step is None:
             return None
         return restore_checkpoint(self.directory, target, step=step)
@@ -152,20 +384,28 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return latest_step(self.directory)
 
-    def _gc(self):
-        import shutil
+    def latest_valid_step(self, deep: bool = False) -> Optional[int]:
+        return latest_valid_step(self.directory, deep=deep)
 
-        steps = []
-        for d in os.listdir(self.directory):
-            # skip orbax in-flight temp dirs
-            # (step_X.orbax-checkpoint-tmp-*) and anything non-numeric —
-            # a crash can leave them behind and they must not kill _gc
-            if not d.startswith("step_"):
-                continue
-            try:
-                steps.append(int(d[5:]))
-            except ValueError:
-                continue
-        for s in sorted(steps)[:-self.max_to_keep]:
-            shutil.rmtree(os.path.join(self.directory, f"step_{s:08d}"),
-                          ignore_errors=True)
+    def _gc(self):
+        in_flight = self._writer.in_flight_tmp if self._writer else None
+        # torn-write leftovers first (never the in-flight tmp dir)
+        gc_partial_checkpoints(
+            self.directory, keep=(in_flight,) if in_flight else ())
+        steps = _committed_steps(self.directory)
+        if not steps or self.max_to_keep <= 0:
+            # max_to_keep<=0 keeps everything (the pre-atomic slicing
+            # semantics: [:-0] deleted nothing); tmp cleanup already ran
+            return
+        keep = set(sorted(steps)[-self.max_to_keep:])
+        valid = [s for s in sorted(steps)
+                 if validate_step_dir(os.path.join(self.directory,
+                                                   steps[s]))]
+        if valid and not any(s in keep for s in valid):
+            # every survivor would be invalid/legacy: spare the newest
+            # valid checkpoint — never delete the only resumable state
+            keep.add(valid[-1])
+        for s, d in steps.items():
+            if s not in keep:
+                shutil.rmtree(os.path.join(self.directory, d),
+                              ignore_errors=True)

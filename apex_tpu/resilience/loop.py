@@ -1,0 +1,498 @@
+"""ResilientTrainLoop (ISSUE 5 tentpole piece 4): the runtime safety
+net around a user step function.
+
+Guarantees (proved by the chaos suite in ``tests/run_resilience/``):
+
+- **Auto-resume**: ``run`` restores from the newest *valid* checkpoint
+  (commit marker + manifest, ``apex_tpu.checkpoint``), garbage-collects
+  torn-write leftovers first, and falls back to the previous valid step
+  when the newest one fails to load. A run preempted and restarted
+  reaches **bit-identical** params to an uninterrupted run, provided
+  ``step_fn(state, step)`` is deterministic in its arguments (derive
+  per-step randomness with ``jax.random.fold_in(key, step)``).
+- **Periodic + emergency checkpointing** through
+  :class:`~apex_tpu.checkpoint.CheckpointManager` (async-capable);
+  preemption forces a synchronous, retry-wrapped emergency save, then
+  raises :class:`Preempted` (or exits with
+  :data:`~apex_tpu.resilience.preemption.EXIT_PREEMPTED`).
+- **Graceful-degradation ladder** on failure:
+  1. *skip step* — an amp-scaler overflow (``metrics["overflow"]``
+     truthy, the ``amp.scaled_update`` protocol) is counted and
+     trusted: the scaler already kept params/opt state via its in-graph
+     ``lax.cond`` skip, so a non-finite loss that step is expected;
+  2. *restore last checkpoint* — non-finite state/metrics (or a step
+     that kept failing through the retry policy) rolls back to the
+     newest valid checkpoint and replays;
+  3. *abort with a structured report* — more than ``max_rollbacks``
+     rollbacks *without intervening progress* (the budget resets once a
+     completed step passes the failure point) raises
+     :class:`TrainAborted` carrying the full report dict (also emitted
+     as a ``train_aborted`` registry event).
+
+Every decision lands as a ``resilience/*`` counter/event in the
+:mod:`apex_tpu.observability` registry.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Optional
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.resilience import faults as faults_mod
+from apex_tpu.resilience.preemption import EXIT_PREEMPTED
+
+__all__ = ["Preempted", "TrainAborted", "ResilientTrainLoop",
+           "chaos_probe"]
+
+
+class Preempted(RuntimeError):
+    """Raised after the emergency checkpoint when preemption tripped.
+
+    ``exit_code`` is the resumable-exit contract
+    (:data:`~apex_tpu.resilience.preemption.EXIT_PREEMPTED`); ``step``
+    is the last COMPLETED step (resume continues at ``step + 1``);
+    ``checkpoint_path`` is the emergency save (None if it failed — the
+    last periodic checkpoint then covers resume, replaying the gap).
+    """
+
+    def __init__(self, step: int, checkpoint_path: Optional[str],
+                 reason: str = ""):
+        super().__init__(
+            f"preempted after step {step}"
+            + (f" ({reason})" if reason else "")
+            + (f"; emergency checkpoint at {checkpoint_path}"
+               if checkpoint_path else "; emergency checkpoint FAILED"))
+        self.exit_code = EXIT_PREEMPTED
+        self.step = step
+        self.checkpoint_path = checkpoint_path
+        self.reason = reason
+
+
+class TrainAborted(RuntimeError):
+    """The ladder's last rung: training cannot make progress.
+
+    ``report`` is a structured dict (step, rollbacks, last error,
+    resume provenance, counter snapshot) — the artifact an oncall
+    actually needs, not a bare traceback."""
+
+    def __init__(self, report: dict):
+        super().__init__(f"training aborted at step {report.get('step')}: "
+                         f"{report.get('reason')}")
+        self.report = report
+
+
+def _is_finite_number(v) -> bool:
+    import math
+
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return True  # non-numeric metric values are not health signals
+
+
+class ResilientTrainLoop:
+    """Wrap ``step_fn(state, step) -> (state, metrics)`` with
+    auto-resume, checkpointing, retries and the degradation ladder.
+
+    Parameters
+    ----------
+    step_fn: the user step. ``state`` is any pytree (include the amp
+        scaler state and anything else that must survive preemption);
+        ``metrics`` is a dict — ``loss`` (and any float values) feed
+        the health check, ``overflow`` marks an amp-scaler skip step.
+    directory: checkpoint dir; None disables persistence (the ladder
+        then degrades to "rollback to the run's starting state").
+    save_every: periodic-save cadence in steps (a save also lands on
+        the final step); 0 disables periodic saves.
+    retry_policy: :class:`~apex_tpu.resilience.retry.Policy` wrapping
+        the step call AND checkpoint I/O. None = no retries.
+    fault_plan: :class:`~apex_tpu.resilience.faults.FaultPlan` — chaos
+        mode. Checkpoint faults additionally need
+        :func:`~apex_tpu.resilience.faults.inject_checkpoint_failures`
+        armed (``run`` arms it automatically when a plan is present).
+    watcher: :class:`~apex_tpu.resilience.preemption.PreemptionWatcher`
+        polled after every step.
+    validate: ``f(state, metrics, step) -> bool`` health check override.
+        Default: every float metric is finite, and every
+        ``check_state_every`` steps all inexact state leaves are finite
+        (reduced on device, one host sync — set it to k>1 or 0 on real
+        hardware if the per-step fetch matters).
+    auto_resume: restore from ``directory`` on :meth:`run` entry.
+    exit_on_preempt: call ``sys.exit(EXIT_PREEMPTED)`` instead of
+        raising :class:`Preempted` (process-boundary behavior for real
+        deployments; tests keep the exception).
+    on_resume: callback ``f(step)`` after a successful restore.
+    """
+
+    def __init__(self, step_fn: Callable[[Any, int], tuple], *,
+                 directory: Optional[str] = None, save_every: int = 0,
+                 max_to_keep: int = 3, async_save: bool = False,
+                 retry_policy=None, fault_plan=None, watcher=None,
+                 validate=None, check_state_every: int = 1,
+                 max_rollbacks: int = 2, auto_resume: bool = True,
+                 deep_validate_resume: bool = False,
+                 exit_on_preempt: bool = False, on_resume=None,
+                 registry=None):
+        self.step_fn = step_fn
+        self.directory = directory
+        self.save_every = save_every
+        self.retry_policy = retry_policy
+        self.fault_plan = fault_plan
+        self.watcher = watcher
+        self.validate = validate
+        self.check_state_every = check_state_every
+        self.max_rollbacks = max_rollbacks
+        self.auto_resume = auto_resume
+        self.deep_validate_resume = deep_validate_resume
+        self.exit_on_preempt = exit_on_preempt
+        self.on_resume = on_resume
+        self._registry = registry
+        self.manager = (ckpt.CheckpointManager(
+            directory, max_to_keep=max_to_keep, async_save=async_save)
+            if directory else None)
+        #: step the last run() resumed from (None = cold start).
+        self.resumed_from: Optional[int] = None
+
+    # -------------------------------------------------------- plumbing
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from apex_tpu.observability import get_registry
+        return get_registry()
+
+    def _call(self, fn, *args, **kwargs):
+        if self.retry_policy is not None:
+            return self.retry_policy.call(fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    # ------------------------------------------------------ checkpoints
+
+    def _save(self, state, step: int) -> Optional[str]:
+        """Periodic save; a failure (after retries) degrades to a
+        counter + event — training continues on the last good save."""
+        try:
+            return self._call(self.manager.save, step, {"state": state})
+        except Exception as e:  # noqa: BLE001 — degradation rung 0
+            reg = self._reg()
+            reg.counter("resilience/checkpoint_failures").inc()
+            reg.event("checkpoint_failed", step=step, error=repr(e)[:200])
+            return None
+
+    def _emergency_save(self, state, step: int) -> Optional[str]:
+        """Synchronous, retry-wrapped save issued on preemption — the
+        process is about to die, so flush any in-flight async write
+        first and write blocking."""
+        if self.manager is None:
+            return None
+        reg = self._reg()
+        try:
+            self.manager.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — the async write may be
+            # the thing that is broken; the sync save below still counts
+            reg.event("emergency_flush_failed", step=step,
+                      error=repr(e)[:200])
+        try:
+            path = self._call(ckpt.save_checkpoint, self.directory,
+                              {"state": state}, step=step)
+            reg.counter("resilience/emergency_saves").inc()
+            return path
+        except Exception as e:  # noqa: BLE001
+            reg.counter("resilience/checkpoint_failures").inc()
+            reg.event("emergency_save_failed", step=step,
+                      error=repr(e)[:200])
+            return None
+
+    def _resume(self, state):
+        """(state, start_step): restore the newest valid checkpoint,
+        walking back to older valid steps when a restore itself fails."""
+        reg = self._reg()
+        removed = ckpt.gc_partial_checkpoints(
+            self.directory,
+            keep=() if self.manager is None
+            else ((self.manager._writer.in_flight_tmp,)
+                  if self.manager._writer is not None
+                  and self.manager._writer.in_flight_tmp else ()))
+        if removed:
+            reg.counter("resilience/gc_partial").inc(len(removed))
+            reg.event("gc_partial_checkpoints",
+                      removed=[p.rsplit("/", 1)[-1] for p in removed])
+        candidates = list(reversed(ckpt.valid_steps(
+            self.directory, deep=self.deep_validate_resume)))
+        if not candidates:
+            # no marker-bearing step at all: a dir written by a
+            # pre-marker writer. Honor restore_checkpoint's legacy
+            # fallback rather than silently restarting from step 0 over
+            # (and then overwriting) the old progress.
+            legacy = ckpt.latest_step(self.directory)
+            if legacy is not None:
+                candidates = [legacy]
+        for step in candidates:
+            try:
+                restored = ckpt.restore_checkpoint(
+                    self.directory, target={"state": state}, step=step)
+            except Exception as e:  # noqa: BLE001 — fall back to the
+                # previous valid step rather than dying on a bad restore
+                reg.counter("resilience/restore_failures").inc()
+                reg.event("restore_failed", step=step,
+                          error=repr(e)[:200])
+                continue
+            reg.counter("resilience/resumes").inc()
+            reg.event("resumed", step=step)
+            self.resumed_from = step
+            if self.on_resume is not None:
+                self.on_resume(step)
+            return restored["state"], step + 1
+        return state, 0
+
+    # ----------------------------------------------------- health check
+
+    def _healthy(self, state, metrics, step: int) -> bool:
+        if self.validate is not None:
+            return bool(self.validate(state, metrics, step))
+        for key, value in (metrics or {}).items():
+            if key == "overflow":
+                continue
+            if not _is_finite_number(value):
+                return False
+        if self.check_state_every and step % self.check_state_every == 0:
+            import jax
+            import jax.numpy as jnp
+
+            # reduce per-leaf finiteness on DEVICE, pull one scalar —
+            # a per-leaf bool() would serialize the loop on host fetches
+            ok = None
+            for leaf in jax.tree_util.tree_leaves(state):
+                if hasattr(leaf, "dtype") and jnp.issubdtype(
+                        leaf.dtype, jnp.inexact):
+                    finite = jnp.all(jnp.isfinite(leaf))
+                    ok = finite if ok is None else jnp.logical_and(
+                        ok, finite)
+            if ok is not None and not bool(ok):
+                return False
+        return True
+
+    # -------------------------------------------------------------- run
+
+    def run(self, state, num_steps: int):
+        """Drive ``step_fn`` to ``num_steps`` completed steps; returns
+        the final state. ``state`` doubles as the restore template
+        (structure/dtype/sharding of every leaf must match what was
+        saved)."""
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            if self.fault_plan is not None:
+                stack.enter_context(faults_mod.inject_checkpoint_failures(
+                    self.fault_plan, registry=self._registry))
+            return self._run(state, num_steps)
+
+    def _run(self, state, num_steps: int):
+        reg = self._reg()
+        self.resumed_from = None
+        start = 0
+        if self.manager is not None and self.auto_resume:
+            state, start = self._resume(state)
+        fallback_state, fallback_step = state, start
+        plan = self.fault_plan
+        step, rollbacks = start, 0
+        # rollbacks bound failures WITHOUT intervening progress: once a
+        # completed step passes the one that triggered the last
+        # rollback, the failure provably recovered and the budget resets
+        recovery_target = -1
+        last_error = None
+
+        while step < num_steps:
+            # ---- the step itself (transient failures retried)
+            def attempt(_step=step, _state=state):
+                if plan is not None and plan.should_fire("step_exc",
+                                                         _step):
+                    reg.counter("resilience/faults_injected",
+                                kind="step_exc").inc()
+                    raise faults_mod.TransientStepError(
+                        f"injected transient failure at step {_step}")
+                return self.step_fn(_state, _step)
+
+            try:
+                new_state, metrics = self._call(attempt)
+            except (Preempted, TrainAborted, KeyboardInterrupt,
+                    SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — ladder rung 2
+                last_error = e
+                recovery_target = max(recovery_target, step)
+                state, step, rollbacks = self._rollback(
+                    fallback_state, fallback_step, rollbacks, step, e)
+                continue
+
+            if plan is not None and plan.should_fire("nan_grads", step):
+                reg.counter("resilience/faults_injected",
+                            kind="nan_grads").inc()
+                new_state = faults_mod.corrupt_tree(new_state)
+
+            # ---- health ladder
+            overflow = bool((metrics or {}).get("overflow", False))
+            if overflow:
+                # rung 1: the amp scaler's in-graph cond already skipped
+                # the update — params/opt state are last step's, by design
+                reg.counter("resilience/overflow_skips").inc()
+            elif not self._healthy(new_state, metrics, step):
+                last_error = ValueError(
+                    f"non-finite state/metrics at step {step}")
+                recovery_target = max(recovery_target, step)
+                state, step, rollbacks = self._rollback(
+                    fallback_state, fallback_step, rollbacks, step,
+                    last_error)
+                continue
+
+            state = new_state
+            if rollbacks and step > recovery_target:
+                rollbacks = 0  # made it past the failure point
+
+            # ---- preemption poll (after the completed step, so the
+            # emergency checkpoint carries it and resume never replays
+            # into a re-drawn preemption fault)
+            tripped = self.watcher is not None and self.watcher.check()
+            if plan is not None and plan.should_fire("preempt", step):
+                reg.counter("resilience/faults_injected",
+                            kind="preempt").inc()
+                if self.watcher is not None:
+                    self.watcher.trip("fault-plan")
+                else:
+                    reg.counter("resilience/preemptions").inc()
+                    reg.event("preemption", reason="fault-plan")
+                tripped = True
+            if tripped:
+                reason = (self.watcher.reason or "preempted"
+                          if self.watcher is not None else "fault-plan")
+                path = self._emergency_save(state, step)
+                reg.event("preempt_exit", step=step, reason=reason,
+                          checkpoint=bool(path))
+                if self.exit_on_preempt:
+                    sys.exit(EXIT_PREEMPTED)
+                raise Preempted(step, path, reason)
+
+            # ---- periodic checkpoint
+            if self.manager is not None and self.save_every and (
+                    step % self.save_every == 0
+                    or step == num_steps - 1):
+                self._save(state, step)
+
+            step += 1
+
+        if self.manager is not None:
+            try:
+                self.manager.wait_until_finished()
+            except Exception as e:  # noqa: BLE001 — the final async
+                # commit failing must not cost the trained state; the
+                # last committed checkpoint stands (degradation rung 0)
+                reg.counter("resilience/checkpoint_failures").inc()
+                reg.event("checkpoint_failed", step=num_steps - 1,
+                          error=repr(e)[:200])
+        return state
+
+    # --------------------------------------------------------- rollback
+
+    def _rollback(self, fallback_state, fallback_step: int,
+                  rollbacks: int, step: int, error):
+        """Rung 2: restore the newest valid checkpoint (or the run's
+        starting state) and hand back the replay position. Rung 3:
+        past ``max_rollbacks``, abort with the structured report."""
+        reg = self._reg()
+        rollbacks += 1
+        reg.counter("resilience/rollbacks").inc()
+        reg.event("rollback", step=step, attempt=rollbacks,
+                  error=repr(error)[:200])
+        if rollbacks > self.max_rollbacks:
+            report = {
+                "step": step,
+                "rollbacks": rollbacks - 1,
+                "max_rollbacks": self.max_rollbacks,
+                "reason": "rollback budget exhausted",
+                "last_error": repr(error)[:500],
+                "resumed_from": self.resumed_from,
+                "directory": self.directory,
+                "counters": {
+                    m.name: m.value for m in reg.metrics()
+                    if m.kind == "counter"
+                    and m.name.startswith("resilience/")},
+            }
+            reg.event("train_aborted", **report)
+            raise TrainAborted(report)
+        if self.manager is not None:
+            for s in reversed(ckpt.valid_steps(self.directory)):
+                try:
+                    restored = ckpt.restore_checkpoint(
+                        self.directory, target={"state": fallback_state},
+                        step=s)
+                except Exception as e:  # noqa: BLE001
+                    reg.counter("resilience/restore_failures").inc()
+                    reg.event("restore_failed", step=s,
+                              error=repr(e)[:200])
+                    continue
+                return restored["state"], s + 1, rollbacks
+        return fallback_state, fallback_step, rollbacks
+
+
+# --------------------------------------------------------------- probe
+
+def chaos_probe(spec: str, directory: str, *, steps: int = 24,
+                save_every: int = 4, seed: int = 0, max_restarts: int = 8,
+                registry=None) -> dict:
+    """Self-contained chaos smoke: a tiny deterministic SGD loop run
+    under fault plan ``spec``, restarted on every preemption the way a
+    scheduler would (fresh :class:`FaultPlan` per restart = fresh
+    process semantics). Used by ``bench.py``'s ``APEX_TPU_FAULT_PLAN``
+    knob; returns a summary dict whose counters also land in the
+    registry (→ BENCH_METRICS.jsonl).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.resilience.retry import Policy
+
+    faults_mod.FaultPlan.parse(spec)  # validate before any work
+    key = jax.random.PRNGKey(seed)
+    template = {"w": jnp.ones((16, 16), jnp.float32)}
+
+    def step_fn(state, step):
+        g = jax.random.normal(jax.random.fold_in(key, step), (16, 16))
+        w = state["w"] - 0.01 * (g + 0.1 * state["w"])
+        return {"w": w}, {"loss": float(jnp.mean(w * w))}
+
+    restarts = 0
+    completed = False
+    final = None
+    for _ in range(max_restarts + 1):
+        loop = ResilientTrainLoop(
+            step_fn, directory=directory, save_every=save_every,
+            fault_plan=faults_mod.FaultPlan.parse(spec),
+            retry_policy=Policy(max_attempts=3, initial_backoff=0.001,
+                                retry_on=(OSError,
+                                          faults_mod.FaultInjected),
+                                sleep=lambda s: None, seed=seed,
+                                name="chaos_probe", registry=registry),
+            registry=registry)
+        try:
+            final = loop.run(template, steps)
+            completed = True
+            break
+        except Preempted:
+            restarts += 1
+    reg = registry
+    if reg is None:
+        from apex_tpu.observability import get_registry
+        reg = get_registry()
+    summary = {"completed": completed, "restarts": restarts,
+               "steps": steps, "plan": spec}
+    for m in reg.metrics():
+        if m.kind == "counter" and m.name.startswith("resilience/"):
+            label = ",".join(f"{k}={v}" for k, v in
+                             sorted(m.labels.items()))
+            summary[m.name + (f"{{{label}}}" if label else "")] = m.value
+    if final is not None:
+        summary["final_param_sum"] = float(jnp.sum(final["w"]))
+    reg.event("chaos_probe", **{k: v for k, v in summary.items()
+                                if isinstance(v, (int, float, str, bool))})
+    return summary

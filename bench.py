@@ -817,6 +817,27 @@ def worker():
     except Exception as e:  # same contract as the precision hook
         extras["sharding_findings_error"] = repr(e)[:120]
 
+    # chaos mode (ISSUE 5): APEX_TPU_FAULT_PLAN=<spec> (e.g.
+    # "seed=1,preempt@7,ckpt_torn@4,step_exc~0.05") runs the bench step
+    # loop under the fault plan — a tiny deterministic train loop driven
+    # through ResilientTrainLoop with scheduler-style restarts — so the
+    # resilience/{retries,preemptions,rollbacks,resumes} counter family
+    # lands in the metrics JSONL next to the perf numbers
+    # (tools/metrics_report.py renders it as the resilience table)
+    fault_spec = os.environ.get("APEX_TPU_FAULT_PLAN")
+    if fault_spec:
+        try:
+            import tempfile
+
+            from apex_tpu.resilience import chaos_probe
+
+            with tempfile.TemporaryDirectory() as chaos_dir:
+                extras["resilience"] = chaos_probe(
+                    fault_spec, chaos_dir, registry=reg)
+        except Exception as e:  # the chaos knob must not cost the
+            # JSON line (same contract as the lint hooks above)
+            extras["resilience_error"] = repr(e)[:200]
+
     def finalize_metrics():
         """Fold recompile counts into extras and (re)write the metrics
         JSONL — called before EVERY emit so even a timed-out worker

@@ -30,7 +30,9 @@ def ensure_devices(n: int) -> None:
     for key, val in (("jax_platforms", "cpu"), ("jax_num_cpu_devices", n)):
         try:
             jax.config.update(key, val)
-        except Exception:
+        except (AttributeError, ValueError):
+            # this jax predates the option (0.4.37 has no
+            # jax_num_cpu_devices); XLA_FLAGS above covers it
             pass
     if len(jax.devices()) < n or jax.devices()[0].platform != "cpu":
         from jax.extend import backend as _backend

@@ -12,12 +12,19 @@ embedding/head grads — the reference's embedding-group allreduce; tp psum
 of sequence-parallel norm grads). XLA overlaps the collectives with
 compute; there is no NCCL-style schedule code.
 
+The step loop is driven by ``apex_tpu.resilience.ResilientTrainLoop``
+(ISSUE 5): auto-resume from the newest *valid* checkpoint, periodic +
+emergency saves, retry/rollback on transient failures, SIGTERM/env
+preemption handling — and ``APEX_TPU_FAULT_PLAN=preempt@7,...`` turns
+any invocation into a chaos run (docs/resilience.md).
+
     python examples/llama_train.py --pp 2 --dp 2 --tp 2 --steps 10
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -47,8 +54,7 @@ def main():
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
-    from examples._common import (
-        ensure_devices, opt_partition_specs, resume_exhausted)
+    from examples._common import ensure_devices, opt_partition_specs
 
     ensure_devices(n_dev)
 
@@ -168,66 +174,78 @@ def main():
             out_specs=(stage_specs, io_specs, opt_specs, P()),
         ))
 
-        # checkpoint/resume of the SHARDED train state (the ref-style
-        # epoch checkpointing of main_amp.py, applied to the 3D-parallel
-        # flagship: params + opt state round-trip through orbax intact)
-        manager = start_it = None
-        if args.checkpoint_dir:
-            from apex_tpu.checkpoint import CheckpointManager
-
-            manager = CheckpointManager(args.checkpoint_dir, max_to_keep=2)
-            if args.resume and manager.latest_step() is not None:
-                template = {"stage": stage_params, "io": io_params,
-                            "opt": opt_state,
-                            "it": np.zeros((), np.int32)}
-                st = manager.restore(template)
-                stage_params, io_params = st["stage"], st["io"]
-                opt_state = st["opt"]
-                start_it = int(st["it"]) + 1
-                print(f"=> resumed from step {int(st['it'])}")
-                if resume_exhausted(start_it, args.steps):
-                    return
-
         # per-step telemetry through the shared layer: structured step
         # records (step time, tokens/s, loss) land in the process
         # registry; APEX_TPU_METRICS=<path> dumps the run as JSONL for
         # `python -m apex_tpu.observability report`
         from apex_tpu import observability as obs
+        from apex_tpu import resilience
 
         reporter = obs.StepReporter("llama_train",
                                     tokens_per_step=M * mb * dp * s)
         key = jax.random.PRNGKey(1)
-        first = None
-        fixed = None
-        for it in range(start_it or 0, args.steps):
-            if args.fixed_data and fixed is not None:
-                tokens, targets = fixed
-            else:
-                key, sub = jax.random.split(key)
-                tokens = jax.random.randint(sub, (M, mb * dp, s), 0,
-                                            cfg.vocab_size)
-                targets = jnp.roll(tokens, -1, axis=-1)
-                fixed = (tokens, targets)
+        stats = {"first": None, "last": None}
+
+        def make_batch(it):
+            # the data stream is a pure function of the step index
+            # (fold_in) — the property the loop's bit-identical
+            # resume-replay guarantee rests on
+            sub = jax.random.fold_in(key, 0 if args.fixed_data else it)
+            tokens = jax.random.randint(sub, (M, mb * dp, s), 0,
+                                        cfg.vocab_size)
+            return tokens, jnp.roll(tokens, -1, axis=-1)
+
+        def train_step_fn(state, it):
+            tokens, targets = make_batch(it)
             t0 = time.perf_counter()
-            stage_params, io_params, opt_state, loss = step(
-                stage_params, io_params, opt_state, tokens, targets)
+            new_stage, new_io, new_opt, loss = step(
+                state["stage"], state["io"], state["opt"], tokens,
+                targets)
             loss = float(loss)  # host pull: syncs the whole step chain
             rec = reporter.step(time.perf_counter() - t0, loss=loss)
-            if first is None:
-                first = loss
+            if stats["first"] is None:
+                stats["first"] = loss
+            stats["last"] = loss
             print(f"step {it:3d}  loss {loss:.4f}  "
                   f"({rec['step_time_ms']:.0f} ms  "
                   f"{rec['tokens_per_sec']:.0f} tok/s)")
-            if manager is not None and (it % args.save_every == 0
-                                        or it == args.steps - 1):
-                manager.save(it, {"stage": stage_params, "io": io_params,
-                                  "opt": opt_state,
-                                  "it": np.asarray(it, np.int32)})
+            return ({"stage": new_stage, "io": new_io, "opt": new_opt},
+                    {"loss": loss})
 
-    print(f"mesh pp={pp} dp={dp} tp={tp} sp={sp}: "
-          f"loss {first:.4f} -> {loss:.4f} "
-          f"({'decreased' if loss < first else 'NOT decreased'})")
-    import os
+        # resilient driver (ISSUE 5): the ref-style epoch checkpointing
+        # of main_amp.py upgraded to the production contract — sharded
+        # train state round-trips through orbax with commit markers,
+        # SIGTERM/APEX_TPU_PREEMPT forces an emergency save + exit 75,
+        # checkpoint I/O is retried, APEX_TPU_FAULT_PLAN injects chaos
+        fault_spec = os.environ.get("APEX_TPU_FAULT_PLAN")
+        watcher = resilience.PreemptionWatcher(
+            sensors=[resilience.env_sensor()]).install()
+        loop = resilience.ResilientTrainLoop(
+            train_step_fn,
+            directory=args.checkpoint_dir or None,
+            save_every=args.save_every, max_to_keep=2,
+            retry_policy=resilience.Policy(max_attempts=3, name="llama"),
+            fault_plan=(resilience.FaultPlan.parse(fault_spec)
+                        if fault_spec else None),
+            watcher=watcher, auto_resume=args.resume,
+            check_state_every=0,  # loss is the health signal; skip the
+            # per-step full-state device fetch on the 3D-sharded tree
+            exit_on_preempt=True,  # the scheduler-facing contract:
+            # emergency checkpoint, then exit 75 (EX_TEMPFAIL) = rerun me
+            on_resume=lambda it: print(f"=> resumed from step {it}"))
+        try:
+            loop.run({"stage": stage_params, "io": io_params,
+                      "opt": opt_state}, args.steps)
+        finally:
+            watcher.uninstall()
+
+    if stats["first"] is None:
+        print(f"nothing to do: resumed step + 1 "
+              f"({(loop.resumed_from or 0) + 1}) >= --steps {args.steps}")
+    else:
+        print(f"mesh pp={pp} dp={dp} tp={tp} sp={sp}: "
+              f"loss {stats['first']:.4f} -> {stats['last']:.4f} "
+              f"({'decreased' if stats['last'] < stats['first'] else 'NOT decreased'})")
 
     if os.environ.get("APEX_TPU_METRICS"):
         obs.get_registry().dump(os.environ["APEX_TPU_METRICS"])

@@ -371,7 +371,9 @@ def test_raw_clock_not_applied_outside_apex_tpu():
 def test_raw_clock_allowlists_sanctioned_clock_owners():
     for path in ("apex_tpu/runtime/timing.py",
                  "apex_tpu/observability/registry.py",
-                 "apex_tpu/observability/recompile.py"):
+                 "apex_tpu/observability/recompile.py",
+                 # retry backoff/deadlines are host wall-time by design
+                 "apex_tpu/resilience/retry.py"):
         assert not _by_check(lint_source(_CLOCK_SRC, path), "raw-clock")
 
 
@@ -488,3 +490,117 @@ def test_baseline_roundtrip_and_multiplicity(tmp_path):
     assert new_findings([f1, f2, f3], baseline) == [f3]
     # a THIRD occurrence of the same key no longer fits the budget
     assert new_findings([f1, f2, f1], baseline) == [f1]
+
+
+# ------------------------------------ swallowed-exception-in-step-loop
+
+_SWALLOW = "swallowed-exception-in-step-loop"
+
+
+def test_swallowed_exception_in_for_loop_flagged():
+    src = """
+def train(steps):
+    for step in range(steps):
+        try:
+            run_step(step)
+        except Exception:
+            continue
+"""
+    found = _by_check(lint_source(src, "apex_tpu/train.py"), _SWALLOW)
+    assert len(found) == 1
+    assert found[0].line == 6 and found[0].symbol == "train"
+    assert "retry.Policy" in found[0].message
+
+
+def test_swallowed_bare_except_pass_in_while_flagged_in_examples():
+    src = """
+while True:
+    try:
+        step()
+    except:
+        pass
+"""
+    found = _by_check(lint_source(src, "examples/train.py"), _SWALLOW)
+    assert len(found) == 1 and found[0].symbol == "<module>"
+
+
+def test_swallowed_broad_class_in_tuple_flagged():
+    src = """
+def loop(xs):
+    for x in xs:
+        try:
+            f(x)
+        except (ValueError, Exception):
+            pass
+"""
+    assert _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)
+
+
+def test_narrow_class_or_handled_body_not_flagged():
+    src = """
+def loop(xs, log):
+    for x in xs:
+        try:
+            f(x)
+        except ValueError:
+            continue
+        try:
+            g(x)
+        except Exception as e:
+            log(e)
+            continue
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)
+
+
+def test_swallow_outside_loop_not_flagged():
+    src = """
+def probe():
+    try:
+        f()
+    except Exception:
+        pass
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)
+
+
+def test_swallow_in_nested_def_inside_loop_not_flagged():
+    """A handler in a function *defined* in a loop body is not
+    per-iteration control flow — depth resets at the def boundary."""
+    src = """
+def outer(xs):
+    for x in xs:
+        def cb():
+            try:
+                f()
+            except Exception:
+                pass
+        register(cb)
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)
+
+
+def test_swallow_not_applied_outside_apex_tpu_and_examples():
+    src = """
+for x in xs:
+    try:
+        f(x)
+    except Exception:
+        continue
+"""
+    for path in ("bench.py", "tools/relay_hunter.py", "snippet.py"):
+        assert not _by_check(lint_source(src, path), _SWALLOW)
+    assert _by_check(lint_source(src, "train.py",
+                                 abspath="/ck/apex_tpu/train.py"),
+                     _SWALLOW)
+
+
+def test_swallow_suppressible():
+    src = """
+for x in xs:
+    try:
+        f(x)
+    except Exception:  # apex-lint: disable=swallowed-exception-in-step-loop
+        pass
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)

@@ -1,0 +1,192 @@
+"""Async/atomic checkpoint FAILURE paths (ISSUE 5 satellite): torn
+writes, ENOSPC, corrupted commits, restore-from-previous-valid-step —
+the cases the old happy-path suite never exercised."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.resilience import (
+    DiskFull,
+    FaultPlan,
+    Policy,
+    TornWrite,
+    inject_checkpoint_failures,
+)
+from apex_tpu.observability import MetricRegistry
+
+
+def _state(v: float):
+    return {"w": jnp.full((4, 4), v), "step": jnp.asarray(int(v))}
+
+
+def _corrupt_one_file(step_dir: str):
+    """Truncate the first manifest-listed file (a post-commit bitrot /
+    partial-copy scenario)."""
+    with open(os.path.join(step_dir, ckpt.COMMIT_MARKER)) as f:
+        manifest = json.load(f)
+    rel = sorted(r for r, m in manifest["files"].items()
+                 if m["size"] > 0)[0]
+    with open(os.path.join(step_dir, rel), "w") as f:
+        f.write("")
+    return rel
+
+
+def test_torn_write_leaves_only_tmp_and_is_invisible(tmp_path):
+    plan = FaultPlan(steps={"ckpt_torn": {2}})
+    ckpt.save_checkpoint(str(tmp_path), _state(1), step=1)
+    with inject_checkpoint_failures(plan, registry=MetricRegistry()):
+        with pytest.raises(TornWrite):
+            ckpt.save_checkpoint(str(tmp_path), _state(2), step=2)
+    # the torn write is a .tmp dir: not a committed step, never restored
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    assert ckpt.latest_valid_step(str(tmp_path)) == 1
+    leftovers = [d for d in os.listdir(tmp_path) if d.endswith(".tmp")]
+    assert leftovers == ["step_00000002.tmp"]
+    got = ckpt.restore_checkpoint(str(tmp_path), target=_state(0))
+    assert float(np.asarray(got["w"])[0, 0]) == 1.0
+    # gc removes the leftover; the valid step survives
+    removed = ckpt.gc_partial_checkpoints(str(tmp_path))
+    assert len(removed) == 1 and removed[0].endswith(".tmp")
+    assert ckpt.latest_valid_step(str(tmp_path)) == 1
+
+
+def test_enospc_injection_is_retryable(tmp_path):
+    """A disk-full save fails; a retry policy rides through it (the
+    fault is spent, like a real transient) and the checkpoint lands."""
+    reg = MetricRegistry()
+    # un-retried, the injected ENOSPC surfaces as a (retryable) OSError
+    with inject_checkpoint_failures(FaultPlan(steps={"ckpt_enospc": {5}}),
+                                    registry=reg):
+        with pytest.raises(DiskFull) as ei:
+            ckpt.save_checkpoint(str(tmp_path / "raw"), _state(5), step=5)
+    assert ei.value.errno == 28  # ENOSPC
+    # a fresh plan (fresh process semantics) + retry policy ride through:
+    # attempt 1 hits the fault (spending it), attempt 2 lands the save
+    policy = Policy(max_attempts=3, initial_backoff=0.001,
+                    sleep=lambda s: None, name="ckpt", registry=reg)
+    with inject_checkpoint_failures(FaultPlan(steps={"ckpt_enospc": {5}}),
+                                    registry=reg):
+        path = policy.call(ckpt.save_checkpoint, str(tmp_path / "ok"),
+                           _state(5), step=5)
+    assert ckpt.validate_step_dir(path, deep=True)
+    assert reg.counter("resilience/retries", scope="ckpt").value == 1
+
+
+def test_restore_falls_back_to_previous_valid_step(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path), _state(1), step=1)
+    p2 = ckpt.save_checkpoint(str(tmp_path), _state(2), step=2)
+    _corrupt_one_file(p2)
+    assert not ckpt.validate_step_dir(p2)
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    assert ckpt.latest_valid_step(str(tmp_path)) == 1
+    got = ckpt.restore_checkpoint(str(tmp_path), target=_state(0))
+    assert float(np.asarray(got["w"])[0, 0]) == 1.0
+
+
+def test_deep_validation_catches_same_size_corruption(tmp_path):
+    p = ckpt.save_checkpoint(str(tmp_path), _state(3), step=3)
+    with open(os.path.join(p, ckpt.COMMIT_MARKER)) as f:
+        manifest = json.load(f)
+    rel, meta = max(manifest["files"].items(),
+                    key=lambda kv: kv[1]["size"])
+    full = os.path.join(p, rel)
+    with open(full, "r+b") as f:  # flip bytes, keep the size
+        f.seek(0)
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+    assert ckpt.validate_step_dir(p, deep=False)  # size unchanged
+    assert not ckpt.validate_step_dir(p, deep=True)
+    assert ckpt.latest_valid_step(str(tmp_path), deep=True) is None
+
+
+def test_async_writer_raise_mid_write_keeps_previous_step(tmp_path):
+    """The satellite case: an async writer that fails between data and
+    commit. The failure surfaces at the fence (wait/next save), the
+    torn dir stays uncommitted, and the writer keeps working."""
+    plan = FaultPlan(steps={"ckpt_torn": {2}})
+    w = ckpt.AsyncCheckpointWriter()
+    with inject_checkpoint_failures(plan, registry=MetricRegistry()):
+        w.save(str(tmp_path), _state(1), step=1)
+        w.save(str(tmp_path), _state(2), step=2)  # fences+commits step 1
+        with pytest.raises(TornWrite):
+            w.wait()
+    assert ckpt.latest_valid_step(str(tmp_path)) == 1
+    assert os.path.isdir(tmp_path / "step_00000002.tmp")
+    # the writer is not wedged: the next save (re)writes step 2 cleanly
+    w.save(str(tmp_path), _state(2), step=2)
+    w.close()
+    assert ckpt.latest_valid_step(str(tmp_path)) == 2
+    got = ckpt.restore_checkpoint(str(tmp_path), target=_state(0))
+    assert float(np.asarray(got["w"])[0, 0]) == 2.0
+
+
+def test_manager_gc_never_deletes_the_only_valid_checkpoint(tmp_path):
+    # lay down 4 steps WITHOUT intermediate retention, then strip the
+    # markers of the two newest (a pre-marker writer / lost-marker
+    # scenario gc treats as legacy, not partial)
+    for s in (1, 2, 3, 4):
+        ckpt.save_checkpoint(str(tmp_path), _state(s), step=s)
+    for s in (3, 4):
+        os.remove(os.path.join(
+            str(tmp_path), f"step_{s:08d}", ckpt.COMMIT_MARKER))
+    m = ckpt.CheckpointManager(str(tmp_path), max_to_keep=2)
+    # retention window is {3, 4} (both invalid); the newest VALID step
+    # (2) must survive even though it aged out of the window
+    m._gc()
+    assert ckpt.latest_valid_step(str(tmp_path)) == 2
+    assert not os.path.isdir(tmp_path / "step_00000001")
+    assert os.path.isdir(tmp_path / "step_00000004")  # legacy: untouched
+    got = m.restore(target=_state(0))
+    # restore prefers the newest VALID step over the newer legacy dirs
+    assert float(np.asarray(got["w"])[0, 0]) == 2.0
+
+
+def test_manager_async_gc_spares_in_flight_write(tmp_path):
+    m = ckpt.CheckpointManager(str(tmp_path), max_to_keep=1,
+                               async_save=True)
+    for s in (1, 2, 3):
+        m.save(s, _state(s))
+    m.wait_until_finished()
+    assert ckpt.latest_valid_step(str(tmp_path)) == 3
+    kept = [d for d in os.listdir(tmp_path) if d.startswith("step_")]
+    assert kept == ["step_00000003"]
+
+
+def test_markerless_legacy_dir_still_restores_and_survives_gc(tmp_path):
+    p = ckpt.save_checkpoint(str(tmp_path), _state(7), step=7)
+    os.remove(os.path.join(p, ckpt.COMMIT_MARKER))  # pre-marker writer
+    assert ckpt.latest_valid_step(str(tmp_path)) is None
+    assert ckpt.gc_partial_checkpoints(str(tmp_path)) == []
+    got = ckpt.restore_checkpoint(str(tmp_path), target=_state(0))
+    assert float(np.asarray(got["w"])[0, 0]) == 7.0
+
+
+def test_overwrite_false_fails_fast_and_is_not_retryable(tmp_path):
+    p = ckpt.save_checkpoint(str(tmp_path), _state(1), step=1)
+    # ValueError (permanent condition), raised BEFORE any data lands:
+    # no .tmp dir may be left behind and no retry policy should bite
+    with pytest.raises(ValueError, match="overwrite=False"):
+        ckpt.save_checkpoint(str(tmp_path), _state(2), step=1,
+                             overwrite=False)
+    assert not os.path.isdir(p + ckpt.TMP_SUFFIX)
+    w = ckpt.AsyncCheckpointWriter()
+    with pytest.raises(ValueError, match="overwrite=False"):
+        w.save(str(tmp_path), _state(2), step=1, overwrite=False)
+    w.close()
+    got = ckpt.restore_checkpoint(str(tmp_path), target=_state(0))
+    assert float(np.asarray(got["w"])[0, 0]) == 1.0
+
+
+def test_max_to_keep_zero_keeps_everything(tmp_path):
+    m = ckpt.CheckpointManager(str(tmp_path), max_to_keep=0)
+    for s in (1, 2, 3, 4):
+        m.save(s, _state(s))
+    kept = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("step_"))
+    assert len(kept) == 4

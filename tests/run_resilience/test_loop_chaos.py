@@ -1,0 +1,376 @@
+"""ResilientTrainLoop chaos suite — the ISSUE 5 headline proof.
+
+A CPU training run preempted and crash-restarted at a fault-plan-drawn
+step must auto-resume and reach **bit-identical** params to the
+uninterrupted run under the same RNG; torn-checkpoint injection must
+never restore from an uncommitted step dir.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.resilience import (
+    EXIT_PREEMPTED,
+    FaultPlan,
+    Policy,
+    Preempted,
+    ResilientTrainLoop,
+    TrainAborted,
+    TransientStepError,
+    chaos_probe,
+)
+
+_KEY = jax.random.PRNGKey(0)
+_TX = fused_adam(lr=1e-2)
+
+
+def _init_state():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    return {"params": params, "opt": _TX.init(params)}
+
+
+def _step_fn(state, step):
+    """Deterministic in (state, step): per-step RNG via fold_in."""
+    sub = jax.random.fold_in(_KEY, step)
+    grads = {
+        "w": jax.random.normal(jax.random.fold_in(sub, 0), (4, 4)),
+        "b": jax.random.normal(jax.random.fold_in(sub, 1), (4,)),
+    }
+    updates, opt = _TX.update(grads, state["opt"], state["params"])
+    params = jax.tree_util.tree_map(jnp.add, state["params"], updates)
+    loss = float(sum(jnp.sum(p * p) for p in
+                     jax.tree_util.tree_leaves(params)))
+    return {"params": params, "opt": opt}, {"loss": loss}
+
+
+def _assert_trees_bit_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _clean_run(directory, steps=12, save_every=4):
+    return ResilientTrainLoop(
+        _step_fn, directory=directory, save_every=save_every).run(
+        _init_state(), steps)
+
+
+# ------------------------------------------------------------- headline
+
+def test_preempt_crash_restart_bit_identical(tmp_path):
+    clean = _clean_run(str(tmp_path / "clean"))
+
+    chaos_dir = str(tmp_path / "chaos")
+    reg = MetricRegistry()
+    spec = "preempt@6"
+    with pytest.raises(Preempted) as ei:
+        ResilientTrainLoop(
+            _step_fn, directory=chaos_dir, save_every=4,
+            fault_plan=FaultPlan.parse(spec), registry=reg).run(
+            _init_state(), 12)
+    assert ei.value.step == 6
+    assert ei.value.exit_code == EXIT_PREEMPTED
+    assert ei.value.checkpoint_path is not None
+    assert ckpt.validate_step_dir(ei.value.checkpoint_path, deep=True)
+
+    # "crash restart": a fresh loop + fresh FaultPlan (new process)
+    resumed_from = []
+    loop2 = ResilientTrainLoop(
+        _step_fn, directory=chaos_dir, save_every=4,
+        fault_plan=FaultPlan.parse(spec), registry=reg,
+        on_resume=resumed_from.append)
+    final = loop2.run(_init_state(), 12)
+    assert resumed_from == [6] and loop2.resumed_from == 6
+    assert reg.counter("resilience/resumes").value == 1
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_torn_emergency_checkpoint_resumes_from_previous_valid(tmp_path):
+    """Acceptance criterion: a torn write is never restored. The
+    emergency save at the preemption step is itself torn — resume must
+    fall back to the last committed periodic step and replay the gap,
+    still reaching bit-identical params."""
+    clean = _clean_run(str(tmp_path / "clean"), steps=10, save_every=2)
+
+    chaos_dir = str(tmp_path / "chaos")
+    reg = MetricRegistry()
+    spec = "preempt@5,ckpt_torn@5"
+    with pytest.raises(Preempted) as ei:
+        ResilientTrainLoop(
+            _step_fn, directory=chaos_dir, save_every=2,
+            fault_plan=FaultPlan.parse(spec), registry=reg).run(
+            _init_state(), 10)
+    assert ei.value.step == 5
+    assert ei.value.checkpoint_path is None  # emergency save torn
+    # the torn dir exists but is invisible to resume
+    assert os.path.isdir(os.path.join(chaos_dir, "step_00000005.tmp"))
+    assert ckpt.latest_valid_step(chaos_dir) == 4
+
+    # restart: the maintenance event is over (preemption is wall-clock
+    # driven, not step-driven — a replayed step does not re-preempt),
+    # but the torn-write schedule stays armed
+    loop2 = ResilientTrainLoop(
+        _step_fn, directory=chaos_dir, save_every=2,
+        fault_plan=FaultPlan.parse("ckpt_torn@5"), registry=reg)
+    final = loop2.run(_init_state(), 10)
+    assert loop2.resumed_from == 4  # previous valid step, gap replayed
+    assert reg.counter("resilience/gc_partial").value >= 1
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_torn_periodic_save_retried_through_policy(tmp_path):
+    clean = _clean_run(str(tmp_path / "clean"), steps=8, save_every=2)
+    reg = MetricRegistry()
+    final = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "chaos"), save_every=2,
+        fault_plan=FaultPlan.parse("ckpt_torn@4"),
+        retry_policy=Policy(max_attempts=3, initial_backoff=0.001,
+                            sleep=lambda s: None, name="loop",
+                            registry=reg),
+        registry=reg).run(_init_state(), 8)
+    assert reg.counter("resilience/retries", scope="loop").value == 1
+    assert reg.counter("resilience/checkpoint_failures").value == 0
+    assert ckpt.latest_valid_step(str(tmp_path / "chaos")) == 7
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_nan_storm_rolls_back_and_stays_bit_identical(tmp_path):
+    clean = _clean_run(str(tmp_path / "clean"), steps=10, save_every=2)
+    reg = MetricRegistry()
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "chaos"), save_every=2,
+        fault_plan=FaultPlan.parse("nan_grads@5"), registry=reg)
+    final = loop.run(_init_state(), 10)
+    assert reg.counter("resilience/rollbacks").value == 1
+    assert reg.counter("resilience/faults_injected",
+                       kind="nan_grads").value == 1
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_transient_step_exception_retried(tmp_path):
+    clean = _clean_run(str(tmp_path / "clean"), steps=8, save_every=0)
+    reg = MetricRegistry()
+    final = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "chaos"),
+        fault_plan=FaultPlan.parse("step_exc@3"),
+        retry_policy=Policy(max_attempts=3, initial_backoff=0.001,
+                            retry_on=(OSError, TransientStepError),
+                            sleep=lambda s: None, name="loop",
+                            registry=reg),
+        registry=reg).run(_init_state(), 8)
+    assert reg.counter("resilience/retries", scope="loop").value == 1
+    assert reg.counter("resilience/rollbacks").value == 0
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_unretried_step_exception_takes_rollback_rung(tmp_path):
+    """No retry policy: the transient lands on the restore-and-replay
+    rung instead, and the run still converges bit-identically."""
+    clean = _clean_run(str(tmp_path / "clean"), steps=8, save_every=2)
+    reg = MetricRegistry()
+    final = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "chaos"), save_every=2,
+        fault_plan=FaultPlan.parse("step_exc@5"), registry=reg).run(
+        _init_state(), 8)
+    assert reg.counter("resilience/rollbacks").value == 1
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_abort_ladder_emits_structured_report(tmp_path):
+    reg = MetricRegistry()
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "c"), save_every=2,
+        validate=lambda state, metrics, step: step < 3,  # sick from 3 on
+        max_rollbacks=2, registry=reg)
+    with pytest.raises(TrainAborted) as ei:
+        loop.run(_init_state(), 10)
+    report = ei.value.report
+    assert report["step"] == 3
+    assert report["rollbacks"] == 2
+    assert report["reason"] == "rollback budget exhausted"
+    assert "counters" in report and \
+        report["counters"]["resilience/rollbacks"] == 3
+    assert any(e["name"] == "train_aborted" for e in reg.events())
+
+
+def test_overflow_metric_is_a_skip_not_a_rollback(tmp_path):
+    """amp scaled_update semantics: overflow=True means the in-graph
+    cond already kept params/opt state — the loop must count a skip and
+    NOT roll back, even though the loss that step is non-finite."""
+    reg = MetricRegistry()
+
+    def step_fn(state, step):
+        if step == 2:  # the scaler's skip step
+            return state, {"loss": float("inf"), "overflow": True}
+        return _step_fn(state, step)
+
+    loop = ResilientTrainLoop(step_fn, registry=reg)
+    loop.run(_init_state(), 5)
+    assert reg.counter("resilience/overflow_skips").value == 1
+    assert reg.counter("resilience/rollbacks").value == 0
+
+
+def test_amp_scaler_state_survives_preempt_resume(tmp_path):
+    """The loss-scale automaton rides in the checkpointed state: an
+    overflow before the preemption must still be visible (halved scale,
+    overflow count) after crash-restart."""
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.amp import scaled_update
+
+    scaler = LossScaler(init_scale=2.0 ** 8, scale_window=1000)
+
+    def init_state():
+        base = _init_state()
+        base["scaler"] = scaler.init()
+        return base
+
+    def step_fn(state, step):
+        sub = jax.random.fold_in(_KEY, step)
+        grads = {
+            "w": jax.random.normal(jax.random.fold_in(sub, 0), (4, 4)),
+            "b": jax.random.normal(jax.random.fold_in(sub, 1), (4,)),
+        }
+        if step == 2:  # inject a genuine overflow through the scaler
+            grads = jax.tree_util.tree_map(
+                lambda g: g * jnp.inf, grads)
+        updates, opt, sstate, overflow = scaled_update(
+            _TX, scaler, grads, state["opt"], state["params"],
+            state["scaler"])
+        params = jax.tree_util.tree_map(
+            jnp.add, state["params"], updates)
+        return ({"params": params, "opt": opt, "scaler": sstate},
+                {"loss": float(jnp.sum(params["w"])),
+                 "overflow": bool(overflow)})
+
+    clean = ResilientTrainLoop(
+        step_fn, directory=str(tmp_path / "clean"), save_every=3).run(
+        init_state(), 9)
+    assert int(clean["scaler"].overflows) == 1
+    assert float(clean["scaler"].loss_scale) == 2.0 ** 7  # halved once
+
+    chaos_dir = str(tmp_path / "chaos")
+    with pytest.raises(Preempted):
+        ResilientTrainLoop(
+            step_fn, directory=chaos_dir, save_every=3,
+            fault_plan=FaultPlan.parse("preempt@4")).run(init_state(), 9)
+    final = ResilientTrainLoop(
+        step_fn, directory=chaos_dir, save_every=3).run(init_state(), 9)
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_no_directory_still_preempts_cleanly():
+    with pytest.raises(Preempted) as ei:
+        ResilientTrainLoop(
+            _step_fn, fault_plan=FaultPlan.parse("preempt@3"),
+            registry=MetricRegistry()).run(_init_state(), 8)
+    assert ei.value.step == 3 and ei.value.checkpoint_path is None
+
+
+def test_resume_past_num_steps_runs_zero_steps(tmp_path):
+    d = str(tmp_path / "c")
+    ResilientTrainLoop(_step_fn, directory=d, save_every=2).run(
+        _init_state(), 6)
+    loop = ResilientTrainLoop(_step_fn, directory=d, save_every=2)
+    # resumed start (6) >= num_steps (4): nothing to do, no crash
+    loop.run(_init_state(), 4)
+    assert loop.resumed_from == 5
+
+
+def test_chaos_probe_summary(tmp_path):
+    reg = MetricRegistry()
+    summary = chaos_probe("preempt@7,ckpt_torn@4,step_exc@2,nan_grads@9",
+                          str(tmp_path), steps=14, registry=reg)
+    assert summary["completed"] is True
+    assert summary["restarts"] == 1
+    assert summary["resilience/resumes"] == 1
+    assert any(k.startswith("resilience/faults_injected")
+               for k in summary)
+
+
+@pytest.mark.slow
+def test_chaos_matrix_probabilistic_plans_bit_identical(tmp_path):
+    """Full chaos matrix: seeded probabilistic storms of every fault
+    kind, restart-driven to completion, always bit-identical to the
+    clean run."""
+    clean = _clean_run(str(tmp_path / "clean"), steps=20, save_every=3)
+    for seed in range(4):
+        spec = (f"seed={seed},preempt~0.1,ckpt_torn~0.15,"
+                f"ckpt_enospc~0.1,step_exc~0.15,nan_grads~0.1")
+        chaos_dir = str(tmp_path / f"chaos{seed}")
+        reg = MetricRegistry()
+        final = None
+        for _restart in range(20):
+            loop = ResilientTrainLoop(
+                _step_fn, directory=chaos_dir, save_every=3,
+                fault_plan=FaultPlan.parse(spec),
+                retry_policy=Policy(
+                    max_attempts=3, initial_backoff=0.001,
+                    retry_on=(OSError, TransientStepError),
+                    sleep=lambda s: None, seed=seed, registry=reg),
+                max_rollbacks=50, registry=reg)
+            try:
+                final = loop.run(_init_state(), 20)
+                break
+            except Preempted:
+                continue
+        assert final is not None, f"seed {seed} never completed"
+        _assert_trees_bit_identical(clean, final)
+
+
+def test_async_final_commit_failure_does_not_cost_trained_state(tmp_path):
+    """A torn commit surfacing at the end-of-run fence must degrade to a
+    counter (the last committed checkpoint stands), not crash run()
+    after training completed."""
+    reg = MetricRegistry()
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "c"), save_every=3,
+        async_save=True, fault_plan=FaultPlan.parse("ckpt_torn@7"),
+        registry=reg)
+    final = loop.run(_init_state(), 8)  # final save at step 7 is torn
+    clean = _clean_run(str(tmp_path / "clean"), steps=8, save_every=3)
+    _assert_trees_bit_identical(clean, final)
+    assert reg.counter("resilience/checkpoint_failures").value == 1
+    assert ckpt.latest_valid_step(str(tmp_path / "c")) == 6
+
+
+def test_legacy_markerless_checkpoint_still_resumed(tmp_path):
+    """A dir written by the pre-marker writer must resume (at its
+    newest step), not silently restart from 0 over the old progress."""
+    d = str(tmp_path / "c")
+    ResilientTrainLoop(_step_fn, directory=d, save_every=2).run(
+        _init_state(), 6)
+    for name in os.listdir(d):  # strip every commit marker
+        marker = os.path.join(d, name, ckpt.COMMIT_MARKER)
+        if os.path.exists(marker):
+            os.remove(marker)
+    assert ckpt.latest_valid_step(d) is None
+    loop = ResilientTrainLoop(_step_fn, directory=d, save_every=2)
+    final = loop.run(_init_state(), 10)
+    assert loop.resumed_from == 5
+    clean = _clean_run(str(tmp_path / "clean"), steps=10, save_every=2)
+    _assert_trees_bit_identical(clean, final)
+
+
+def test_rollback_budget_resets_after_recovered_progress(tmp_path):
+    """Isolated, successfully-recovered failures spread across a run
+    must not accumulate toward TrainAborted: the budget bounds failures
+    WITHOUT intervening progress."""
+    clean = _clean_run(str(tmp_path / "clean"), steps=20, save_every=2)
+    reg = MetricRegistry()
+    final = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path / "chaos"), save_every=2,
+        fault_plan=FaultPlan.parse("nan_grads@4+9+14"),
+        max_rollbacks=1, registry=reg).run(_init_state(), 20)
+    # three isolated storms, budget 1: each recovered, none aborted
+    assert reg.counter("resilience/rollbacks").value == 3
+    _assert_trees_bit_identical(clean, final)

@@ -1,0 +1,73 @@
+"""PreemptionWatcher: sensors, signals, thread-safety of the flag."""
+
+import os
+import signal
+
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.resilience import (
+    EXIT_PREEMPTED,
+    PreemptionWatcher,
+    env_sensor,
+    file_sensor,
+)
+
+
+def test_exit_code_contract():
+    # sysexits EX_TEMPFAIL: schedulers treat it as "re-run me"
+    assert EXIT_PREEMPTED == 75
+
+
+def test_trip_is_idempotent_and_counts_once():
+    reg = MetricRegistry()
+    w = PreemptionWatcher(registry=reg)
+    assert not w.preempted and w.reason is None
+    w.trip("maintenance event")
+    w.trip("second reason ignored")
+    assert w.preempted and w.reason == "maintenance event"
+    assert reg.counter("resilience/preemptions").value == 1
+
+
+def test_file_sensor(tmp_path):
+    sentinel = str(tmp_path / "preempt")
+    reg = MetricRegistry()
+    w = PreemptionWatcher(sensors=[file_sensor(sentinel)], registry=reg)
+    assert not w.check()
+    open(sentinel, "w").close()
+    assert w.check()
+    assert "sentinel" in w.reason
+
+
+def test_env_sensor(monkeypatch):
+    reg = MetricRegistry()
+    w = PreemptionWatcher(sensors=[env_sensor("APEX_TPU_TEST_PREEMPT")],
+                          registry=reg)
+    monkeypatch.setenv("APEX_TPU_TEST_PREEMPT", "0")
+    assert not w.check()
+    monkeypatch.setenv("APEX_TPU_TEST_PREEMPT", "1")
+    assert w.check()
+
+
+def test_broken_sensor_counts_but_does_not_kill_polling(tmp_path):
+    sentinel = str(tmp_path / "s")
+
+    def broken():
+        raise RuntimeError("metadata server down")
+
+    reg = MetricRegistry()
+    w = PreemptionWatcher(sensors=[broken, file_sensor(sentinel)],
+                          registry=reg)
+    assert not w.check()
+    open(sentinel, "w").close()
+    assert w.check()  # the healthy sensor after the broken one still won
+    assert reg.counter("resilience/sensor_errors").value >= 1
+
+
+def test_signal_handler_installs_trips_and_restores():
+    reg = MetricRegistry()
+    prev = signal.getsignal(signal.SIGUSR1)
+    with PreemptionWatcher(signals=(signal.SIGUSR1,),
+                           registry=reg) as w:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert w.check()
+        assert "SIGUSR1" in w.reason
+    assert signal.getsignal(signal.SIGUSR1) is prev

@@ -1,0 +1,122 @@
+"""Retry policy: backoff determinism, budgets, class rules, deadlines,
+counters."""
+
+import pytest
+
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.resilience import Deadline, Policy, TransientStepError
+
+
+class _Flaky:
+    def __init__(self, fail_times, exc=OSError("transient")):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.exc = exc
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc
+        return "ok"
+
+
+def _policy(reg, **kw):
+    kw.setdefault("initial_backoff", 0.001)
+    kw.setdefault("sleep", lambda s: None)
+    return Policy(registry=reg, **kw)
+
+
+def test_retries_then_succeeds_and_counts():
+    reg = MetricRegistry()
+    fn = _Flaky(2)
+    assert _policy(reg, max_attempts=4, name="io").call(fn) == "ok"
+    assert fn.calls == 3
+    assert reg.counter("resilience/retries", scope="io").value == 2
+    assert reg.counter("resilience/give_ups", scope="io").value == 0
+
+
+def test_give_up_reraises_last_exception_and_counts():
+    reg = MetricRegistry()
+    fn = _Flaky(10, exc=OSError("still down"))
+    with pytest.raises(OSError, match="still down"):
+        _policy(reg, max_attempts=3, name="io").call(fn)
+    assert fn.calls == 3
+    assert reg.counter("resilience/give_ups", scope="io").value == 1
+    # the give-up is also a structured event
+    assert any(e["name"] == "resilience_give_up" for e in reg.events())
+
+
+def test_non_retryable_classes_pass_straight_through():
+    reg = MetricRegistry()
+    fn = _Flaky(1, exc=TypeError("a bug, not weather"))
+    with pytest.raises(TypeError):
+        _policy(reg, max_attempts=5).call(fn)
+    assert fn.calls == 1
+
+
+def test_per_class_rules_override_budget():
+    reg = MetricRegistry()
+    # TransientStepError gets 5 attempts while the default is 2
+    p = _policy(reg, max_attempts=2,
+                retry_on=(OSError, TransientStepError),
+                rules={TransientStepError: 5})
+    fn = _Flaky(3, exc=TransientStepError("flaky collective"))
+    assert p.call(fn) == "ok" and fn.calls == 4
+    # and a {cls: 1} rule means never retry that class
+    p2 = _policy(reg, max_attempts=5, rules={PermissionError: 1})
+    fn2 = _Flaky(1, exc=PermissionError("denied"))
+    with pytest.raises(PermissionError):
+        p2.call(fn2)
+    assert fn2.calls == 1
+
+
+def test_no_retry_wins_over_retry_on():
+    reg = MetricRegistry()
+    fn = _Flaky(1, exc=FileNotFoundError("gone"))
+    p = _policy(reg, max_attempts=5, no_retry=(FileNotFoundError,))
+    with pytest.raises(FileNotFoundError):
+        p.call(fn)
+    assert fn.calls == 1
+
+
+def test_backoff_is_seeded_deterministic_and_capped():
+    a = Policy(seed=42, initial_backoff=0.1, max_backoff=0.5,
+               multiplier=2.0, jitter=0.25)
+    b = Policy(seed=42, initial_backoff=0.1, max_backoff=0.5,
+               multiplier=2.0, jitter=0.25)
+    seq_a = [a.backoff(i) for i in range(1, 8)]
+    seq_b = [b.backoff(i) for i in range(1, 8)]
+    assert seq_a == seq_b
+    assert all(d <= 0.5 * 1.25 + 1e-9 for d in seq_a)
+    assert all(d >= 0.0 for d in seq_a)
+
+
+def test_deadline_expiry_aborts_retries():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    d = Deadline(10.0, clock=clock)
+    assert d.remaining() == 10.0 and not d.expired()
+    t[0] = 11.0
+    assert d.expired() and d.remaining() == 0.0
+
+    # policy-level: the clock advances past the deadline on each sleep
+    reg = MetricRegistry()
+
+    def slow_sleep(s):
+        pass
+
+    p = Policy(max_attempts=100, deadline_s=0.0, initial_backoff=0.001,
+               sleep=slow_sleep, registry=reg, name="dl")
+    fn = _Flaky(50)
+    with pytest.raises(OSError):
+        p.call(fn)
+    assert fn.calls == 1  # deadline 0: first failure is final
+    assert reg.counter("resilience/give_ups", scope="dl").value == 1
+
+
+def test_wrap_decorator_form():
+    reg = MetricRegistry()
+    fn = _Flaky(1)
+    wrapped = _policy(reg, max_attempts=3).wrap(lambda: fn())
+    assert wrapped() == "ok"
+    assert fn.calls == 2

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# apex_tpu chaos gate: the seeded fault-injection suite
+# (tests/run_resilience + the checkpoint failure paths) on the same
+# 8-device virtual CPU mesh as the tier-1 run.
+#
+#   bash tools/chaos.sh           # tier-1 subset (-m 'not slow'): the
+#                                 # deterministic headline cases —
+#                                 # preempt/crash-restart bit-identical
+#                                 # resume, torn-write fallback, NaN
+#                                 # rollback, retry/abort ladder
+#   bash tools/chaos.sh --full    # + the slow probabilistic chaos
+#                                 # matrix (every fault kind, seeded
+#                                 # storms, restart-driven to
+#                                 # completion)
+#
+# Extra args are forwarded to pytest. A standalone chaos run of any
+# workload: APEX_TPU_FAULT_PLAN="seed=1,preempt@7,ckpt_torn@4" wired
+# through bench.py or examples/llama_train.py (docs/resilience.md).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+marker=(-m "not slow")
+if [[ "${1:-}" == "--full" ]]; then
+    shift
+    marker=()
+fi
+
+exec python -m pytest tests/run_resilience tests/run_checkpoint -q \
+    -p no:cacheprovider ${marker[@]+"${marker[@]}"} "$@"

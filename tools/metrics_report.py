@@ -130,6 +130,57 @@ def summarize_sharding(path, fam):
                   f"{_fmt_bytes(vals.get('peak_hbm_bytes', 0)):>12s}")
 
 
+def render_resilience_family(path):
+    """The ``resilience/*`` counter family from a metrics JSONL dump
+    (None when the file carries none): retries, give-ups, preemptions,
+    rollbacks, resumes, injected faults — the chaos-run scoreboard
+    emitted by apex_tpu.resilience / bench.py's APEX_TPU_FAULT_PLAN."""
+    counters = {}
+    events = 0
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        name = rec.get("name", "")
+        if not isinstance(name, str) or \
+                not name.startswith("resilience/"):
+            if rec.get("type") == "event" and isinstance(name, str) and \
+                    name in ("preemption", "rollback", "resumed",
+                             "train_aborted", "chaos_probe",
+                             "checkpoint_failed", "resilience_give_up"):
+                events += 1
+            continue
+        if rec.get("type") != "counter":
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = name[len("resilience/"):]
+        if labels:
+            key += "{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(labels.items())) + "}"
+        counters[key] = rec.get("value")
+    if not counters and not events:
+        return None
+    return {"counters": counters, "events": events}
+
+
+def summarize_resilience(path, fam):
+    print(f"{path}: resilience/* family")
+    width = max(len(k) for k in fam["counters"]) if fam["counters"] else 0
+    for key, value in sorted(fam["counters"].items()):
+        print(f"  {key:{width}s}  {value}")
+    if fam["events"]:
+        print(f"  ({fam['events']} resilience event(s) — see the "
+              f"generic summary below)")
+
+
 def summarize_analysis(path, data):
     findings = data.get("findings", [])
     by_check = collections.Counter(f.get("check", "?") for f in findings)
@@ -160,9 +211,9 @@ if __name__ == "__main__":
                 summarize_analysis(arg, data)
             handled_any = True
         else:
-            # a metrics JSONL carrying the sharding family gets its
-            # per-target comms/HBM table in addition to the generic
-            # observability summary below
+            # a metrics JSONL carrying the sharding or resilience
+            # families gets its dedicated table(s) in addition to the
+            # generic observability summary below
             fam = render_sharding_family(arg) if os.path.isfile(arg) \
                 else None
             if fam is not None:
@@ -171,6 +222,14 @@ if __name__ == "__main__":
                                       "sharding_family": fam}))
                 else:
                     summarize_sharding(arg, fam)
+            res = render_resilience_family(arg) if os.path.isfile(arg) \
+                else None
+            if res is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "resilience_family": res}))
+                else:
+                    summarize_resilience(arg, res)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
