@@ -37,6 +37,19 @@ lived. Checks:
                       Resilience must be explicit — retry transient
                       classes via ``apex_tpu.resilience.retry.Policy``,
                       or at least count/log before continuing.
+- ``hardcoded-tile-size``
+                      an integer tile constant fed to ``pl.BlockSpec``
+                      outside ``ops/pallas_config.py`` and the tuner's
+                      search-space tables (``tuning/search_space.py``):
+                      a literal >= 8 (tile-sized — sublane multiples
+                      start at 8) directly in a block shape, or a
+                      module-level ``_BLOCK*``/``_TILE*``/``*_COLS``-
+                      style int constant in a file that builds
+                      BlockSpecs. The right tile is a per-device,
+                      per-shape search result (the fixed flat-adam
+                      (rows, 1024) slab lost 3.2x on v5e to the tiling
+                      it shipped with) — route geometry through
+                      ``apex_tpu.tuning``.
 
 Suppress with ``# apex-lint: disable=<id>`` on (or above) the line.
 """
@@ -45,12 +58,14 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 
 from apex_tpu.analysis.findings import Finding, is_suppressed
 
 AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "mutable-default", "raw-clock",
-              "swallowed-exception-in-step-loop")
+              "swallowed-exception-in-step-loop",
+              "hardcoded-tile-size")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -89,6 +104,27 @@ def _swallowed_exc_applies(path: str) -> bool:
     secondary work."""
     parts = path.replace("\\", "/").split("/")[:-1]
     return "apex_tpu" in parts or "examples" in parts
+
+
+# hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
+# in — the dispatch-config defaults and the tuner's search-space tables.
+_TILE_SIZE_ALLOW = ("apex_tpu/ops/pallas_config.py",
+                    "apex_tpu/tuning/search_space.py")
+
+# Below the fp32 sublane tile (8): a 1-singleton or a tiny scalar-block
+# dim (the flat-adam (1, 4) scalar spec) is layout plumbing, not a
+# tunable tile.
+_TILE_LITERAL_MIN = 8
+
+# Module-constant names that smell like a tile: _BLOCK_ROWS, _BLOCKED_BK,
+# _TILE_N, _COLS, BQ/BK... (matched against the upper-cased name).
+_TILE_NAME_RE = re.compile(r"(?:^|_)(BLOCK|TILE|COLS|ROWS|BQ|BKV|BK)"
+                           r"(?:_|E?D?_|$)")
+
+
+def _tile_size_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return not any(norm.endswith(allow) for allow in _TILE_SIZE_ALLOW)
 
 
 _BROAD_EXC = {"Exception", "BaseException"}
@@ -203,6 +239,11 @@ class _Visitor(ast.NodeVisitor):
         # local name -> imported dotted module, so `from jax import
         # random` is not mistaken for the stdlib `random` module
         self.imports = {}
+        # hardcoded-tile-size state: module-level tile-named int
+        # constants only become findings when the file also builds
+        # BlockSpecs (lint_source pairs the two after the walk)
+        self.blockspec_seen = False
+        self.tile_consts = []  # (lineno, name, value)
 
     def visit_Import(self, node):
         for alias in node.names:
@@ -335,9 +376,48 @@ class _Visitor(ast.NodeVisitor):
 
     # ------------------------------------------------------ call sites
 
+    def visit_Assign(self, node):
+        if len(self.stack) == 1 and "hardcoded-tile-size" in self.checks:
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        _TILE_NAME_RE.search(target.id.upper()) and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, int) and \
+                        not isinstance(node.value.value, bool) and \
+                        node.value.value >= _TILE_LITERAL_MIN:
+                    self.tile_consts.append(
+                        (node.lineno, target.id, node.value.value))
+        self.generic_visit(node)
+
+    def _check_blockspec_shape(self, node):
+        """Flag tile-sized integer literals in a BlockSpec block shape
+        (first positional arg or block_shape kwarg)."""
+        self.blockspec_seen = True
+        shape = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords
+             if kw.arg == "block_shape"), None)
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return
+        for elt in shape.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, int) and \
+                    not isinstance(elt.value, bool) and \
+                    elt.value >= _TILE_LITERAL_MIN:
+                self._emit(
+                    "hardcoded-tile-size", "error", elt.lineno,
+                    f"integer tile size {elt.value} hardcoded in a "
+                    f"pl.BlockSpec block shape: the right tile is a "
+                    f"per-device, per-shape search result — take it "
+                    f"from apex_tpu.tuning (search space + cache) or "
+                    f"ops/pallas_config, the only modules tile numbers "
+                    f"may live in")
+
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
         tail = chain[-1] if chain else None
+
+        if tail == "BlockSpec" and "hardcoded-tile-size" in self.checks:
+            self._check_blockspec_shape(node)
 
         if tail == "block_until_ready" or (
                 isinstance(node.func, ast.Attribute)
@@ -420,6 +500,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # swallowed-exception: step loops live in apex_tpu/ and examples/
     if not _swallowed_exc_applies(abspath or relpath):
         checks = checks - {"swallowed-exception-in-step-loop"}
+    # hardcoded-tile-size: pallas_config + the tuner search space are
+    # the sanctioned homes for tile numbers
+    if not _tile_size_applies(abspath or relpath):
+        checks = checks - {"hardcoded-tile-size"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:
@@ -427,6 +511,19 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
                         "<module>", f"does not parse: {e.msg}")]
     visitor = _Visitor(relpath, relpath, checks)
     visitor.visit(tree)
+    # tile-named module constants are only tile sizes when the file
+    # actually builds BlockSpecs (a _TILE_ROWS in a data loader is not
+    # kernel geometry)
+    if "hardcoded-tile-size" in checks and visitor.blockspec_seen:
+        for lineno, name, value in visitor.tile_consts:
+            visitor.findings.append(Finding(
+                "hardcoded-tile-size", "error", relpath, lineno,
+                "<module>",
+                f"module tile constant {name} = {value} in a file that "
+                f"builds pl.BlockSpecs: tile geometry must come from "
+                f"apex_tpu.tuning (per-device search + cache) or "
+                f"ops/pallas_config — a hardcoded tile outlives the "
+                f"hardware it was guessed for"))
     # close the module-level frame (module-scope timing code, e.g. a
     # script body, gets the same sync-timing treatment)
     frame = visitor.frames[0]

@@ -9,10 +9,16 @@ races this kernel against — elementwise chains are XLA's home turf, so
 the kernel must EARN its default (``use_kernel=None`` defers to the
 pallas gate; the bench reports both).
 
-Layout: the 1-D buffer pads to a (rows, 1024) fp32-tileable slab and the
-grid walks row blocks; traced scalars (lr_t and the bias-correction
-denominators — step-dependent) ride a (1, 4) block, static hyperparams
-close over the kernel.
+Layout: the 1-D buffer pads to a fp32-tileable ``(rows, cols)`` slab and
+the grid walks ``block_rows``-row blocks; traced scalars (lr_t and the
+bias-correction denominators — step-dependent) ride a (1, 4) block,
+static hyperparams close over the kernel. The slab geometry is
+TUNER-SUPPLIED (apex_tpu.tuning): callers either pass ``(block_rows,
+cols)`` explicitly (the sweep does) or leave them None and get the
+tuned/default pick for the actual buffer size — the fixed (rows, 1024)
+slab with a constant 512-row block was the prime suspect for the
+measured 3.2x TPU inversion (BENCH_r05_live.json), and the old
+small-tensor path padded a scalar bias to 8x1024 fp32 x4 buffers.
 """
 
 from __future__ import annotations
@@ -24,9 +30,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from apex_tpu.ops import pallas_config
-
-_COLS = 1024
-_BLOCK_ROWS = 512
 
 
 def _adam_kernel(b1, b2, eps, weight_decay, adam_w_mode, bias_correction,
@@ -56,32 +59,63 @@ def _adam_kernel(b1, b2, eps, weight_decay, adam_w_mode, bias_correction,
     vo_ref[...] = v
 
 
-def _pad_to_slab(x, block_rows):
+def _pad_to_slab(x, block_rows, cols):
     n = x.size
-    per = _COLS * block_rows
-    rows = -(-n // _COLS)
+    rows = -(-n // cols)
     rows = -(-rows // block_rows) * block_rows
-    pad = rows * _COLS - n
+    pad = rows * cols - n
     if pad:
         x = jnp.pad(x.ravel(), (0, pad))
-    return x.reshape(rows, _COLS), n
+    return x.reshape(rows, cols), n
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "b1", "b2", "eps", "weight_decay", "adam_w_mode", "bias_correction",
-    "interpret"))
+def slab_geometry(n: int, block_rows=None, cols=None) -> tuple:
+    """Resolve the (block_rows, cols) slab for an ``n``-element buffer:
+    explicit values win (the tuner's sweep passes candidates through
+    here), otherwise the tuned/default pick from apex_tpu.tuning — which
+    sizes the pad block from the ACTUAL buffer, so tiny leaves no longer
+    over-pad."""
+    if block_rows is not None and cols is not None:
+        return int(block_rows), int(cols)
+    from apex_tpu.tuning import flat_adam_geometry
+
+    t_rows, t_cols = flat_adam_geometry(n)
+    return (int(block_rows) if block_rows is not None else t_rows,
+            int(cols) if cols is not None else t_cols)
+
+
 def adam_flat_pallas(g, p, m, v, lr_t, step, *, b1, b2, eps, weight_decay,
-                     adam_w_mode, bias_correction, interpret=False):
+                     adam_w_mode, bias_correction, block_rows=None,
+                     cols=None, interpret=False):
     """One fused Adam pass over 1-D buffers.
 
     ``g``/``m``/``v`` fp32, ``p`` any float dtype; ``lr_t``/``step``
     traced scalars. Returns ``(delta, m', v')`` with delta in p's dtype.
+    ``block_rows``/``cols`` pin the slab geometry; None defers to the
+    tuning cache / per-size default. Resolution happens HERE, outside
+    the jit, so the resolved geometry is part of the inner jit's static
+    key — a fresh tune (or a sweep override) changes the key and forces
+    a retrace instead of silently reusing the first-traced tile.
     """
-    block = _BLOCK_ROWS if g.size >= _COLS * _BLOCK_ROWS else 8
-    g2, n = _pad_to_slab(g.astype(jnp.float32), block)
-    p2, _ = _pad_to_slab(p, block)
-    m2, _ = _pad_to_slab(m, block)
-    v2, _ = _pad_to_slab(v, block)
+    block_rows, cols = slab_geometry(g.size, block_rows, cols)
+    return _adam_flat_pallas(g, p, m, v, lr_t, step, b1=b1, b2=b2,
+                             eps=eps, weight_decay=weight_decay,
+                             adam_w_mode=adam_w_mode,
+                             bias_correction=bias_correction,
+                             block_rows=block_rows, cols=cols,
+                             interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "b1", "b2", "eps", "weight_decay", "adam_w_mode", "bias_correction",
+    "block_rows", "cols", "interpret"))
+def _adam_flat_pallas(g, p, m, v, lr_t, step, *, b1, b2, eps,
+                      weight_decay, adam_w_mode, bias_correction,
+                      block_rows, cols, interpret=False):
+    g2, n = _pad_to_slab(g.astype(jnp.float32), block_rows, cols)
+    p2, _ = _pad_to_slab(p, block_rows, cols)
+    m2, _ = _pad_to_slab(m, block_rows, cols)
+    v2, _ = _pad_to_slab(v, block_rows, cols)
     rows = g2.shape[0]
     step = step.astype(jnp.float32)
     scalars = jnp.stack([
@@ -91,18 +125,18 @@ def adam_flat_pallas(g, p, m, v, lr_t, step, *, b1, b2, eps, weight_decay,
         jnp.float32(0.0),
     ]).reshape(1, 4)
 
-    row_spec = pl.BlockSpec((block, _COLS), lambda i: (i, 0))
+    row_spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0))
     sc_spec = pl.BlockSpec((1, 4), lambda i: (0, 0))
     d2, mo2, vo2 = pl.pallas_call(
         functools.partial(_adam_kernel, b1, b2, eps, weight_decay,
                           adam_w_mode, bias_correction),
-        grid=(rows // block,),
+        grid=(rows // block_rows,),
         in_specs=[sc_spec, row_spec, row_spec, row_spec, row_spec],
         out_specs=[row_spec, row_spec, row_spec],
         out_shape=[
-            pallas_config.out_struct((rows, _COLS), p.dtype, g, p, m, v),
-            pallas_config.out_struct((rows, _COLS), jnp.float32, g, p, m, v),
-            pallas_config.out_struct((rows, _COLS), jnp.float32, g, p, m, v),
+            pallas_config.out_struct((rows, cols), p.dtype, g, p, m, v),
+            pallas_config.out_struct((rows, cols), jnp.float32, g, p, m, v),
+            pallas_config.out_struct((rows, cols), jnp.float32, g, p, m, v),
         ],
         interpret=interpret,
     )(scalars, g2, p2, m2, v2)

@@ -29,8 +29,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops import pallas_config
 
-_BLOCK_ROWS = 256
-
 
 def _use_pallas(kernel: str = "layer_norm") -> bool:
     return pallas_config.use_pallas(kernel)
@@ -68,26 +66,21 @@ def _rms_fwd_kernel(eps, affine, x_ref, w_ref, y_ref, rstd_ref):
     rstd_ref[:] = rstd
 
 
-# Scoped VMEM budget for a kernel's fp32 scratch. Mosaic's stack limit is
-# 16MB (validated on a v5e: the bwd kernel at block=256, h=4096 was rejected
-# at 20.23M); stay under it with headroom. `f32_temps` is the number of
-# block×h fp32 intermediates the kernel holds live (measured ~5 for bwd,
-# ~3 for fwd).
-_VMEM_SCRATCH_BUDGET = 12 * 1024 * 1024
+# Row-block selection is TUNER-SUPPLIED (apex_tpu.tuning): a tuned cache
+# entry for (device_kind, kernel, shape-bucket) wins, otherwise the
+# search-space default ladder — the same VMEM-scoped heuristic that used
+# to live here as module constants (Mosaic's stack limit is 16MB,
+# validated on a v5e: the bwd kernel at block=256, h=4096 was rejected
+# at 20.23M). `f32_temps` is the number of block×h fp32 intermediates
+# the kernel holds live (measured ~5 for bwd, ~3 for fwd); the tuner
+# clamps a tuned block back down when the bwd's temps would bust VMEM.
 
 
-def _row_block(n_rows: int, h: int, f32_temps: int) -> int:
-    cap = _VMEM_SCRATCH_BUDGET // (h * 4 * f32_temps)
-    if cap < 8:
-        return 0  # even the smallest block busts VMEM — caller uses jnp
-    best = 8
-    for cand in (_BLOCK_ROWS, 128, 64, 32, 16, 8):
-        if cand > cap:
-            continue
-        if n_rows % cand == 0:
-            return cand
-        best = max(best, cand)
-    return best  # no clean split — caller pads
+def _row_block(n_rows: int, h: int, f32_temps: int,
+               kernel: str = "layer_norm") -> int:
+    from apex_tpu.tuning import norm_row_block
+
+    return norm_row_block(kernel, n_rows, h, f32_temps)
 
 
 def _pad_rows(x2, block):
@@ -133,7 +126,7 @@ def _ln_fwd_pallas(x2, w, b, eps):
 
 def _rms_fwd_pallas(x2, w, eps):
     affine = w is not None
-    block = _row_block(x2.shape[0], x2.shape[1], 3)
+    block = _row_block(x2.shape[0], x2.shape[1], 3, kernel="rms_norm")
     if not block:
         return _rms_fwd_jnp(x2, w, eps)
     x2p, n = _pad_rows(x2, block)
@@ -288,7 +281,7 @@ def _ln_bwd_pallas(x2, w, mu, rstd, dy):
 
 def _rms_bwd_pallas(x2, w, rstd, dy):
     affine = w is not None
-    block = _row_block(x2.shape[0], x2.shape[1], 5)
+    block = _row_block(x2.shape[0], x2.shape[1], 5, kernel="rms_norm")
     if not block:
         return _rms_bwd_jnp(x2, w, rstd, dy)
     x2p, n = _pad_rows(x2, block)

@@ -93,17 +93,57 @@ def _load_flash_tile_overrides():
                         for k, v in table.items()})
 
 
+# Lazy one-time application of the persistent tuning cache's race
+# verdicts (apex_tpu.tuning.cache.apply_verdicts): a tuned entry for the
+# current device kind flips _KERNEL_AUTO with `tuning:<cache-path>` as
+# its evidence artifact. Lazy because dispatch must not pay a file read
+# per call, and one-time because the cache is a process-stable artifact
+# (refresh_tuning() rearms after an in-process tune/write).
+_TUNING_APPLIED = False
+
+
+def _ensure_tuning_applied():
+    global _TUNING_APPLIED
+    if _TUNING_APPLIED:
+        return
+    from apex_tpu.tuning import cache as tuning_cache
+
+    if os.path.exists(tuning_cache.cache_path()):
+        # a malformed/mismatched cache raises here — loudly, by design:
+        # silently ignoring it would pin stale tiles forever. The flag
+        # flips only on SUCCESS, so a caller that swallowed one error
+        # doesn't convert every later dispatch into a silent skip — the
+        # bad cache keeps raising until fixed or removed.
+        tuning_cache.apply_verdicts()
+    _TUNING_APPLIED = True
+
+
+def refresh_tuning() -> None:
+    """Re-arm the lazy tuning-cache consultation (after tools/tune.sh
+    wrote new entries in-process, or a test repointed
+    APEX_TPU_TUNING_CACHE)."""
+    global _TUNING_APPLIED
+    from apex_tpu.tuning import cache as tuning_cache
+
+    tuning_cache.clear_memo()
+    _TUNING_APPLIED = False
+
+
 def use_pallas(kernel: str | None = None) -> bool:
     """Should fused ops take their Pallas path right now?
 
     ``kernel`` (optional) names the caller ('layer_norm', 'rms_norm',
     'flash_attention', 'fused_softmax', 'flat_adam') so measured
-    per-kernel verdicts from :data:`_KERNEL_AUTO` apply under 'auto'.
+    per-kernel verdicts from :data:`_KERNEL_AUTO` apply under 'auto' —
+    including verdicts the persistent tuning cache supplies for the
+    current device generation (see :func:`_ensure_tuning_applied`).
     """
     if _MODE == "off":
         return False
     if _MODE in ("on", "interpret"):
         return True
+    if kernel is not None:
+        _ensure_tuning_applied()
     on_tpu = jax.default_backend() == "tpu"
     verdict = _KERNEL_AUTO.get(kernel) if kernel is not None else None
     if verdict is not None:
@@ -156,8 +196,13 @@ def validate_kernel_auto_provenance(repo_root: "str | None" = None) -> list:
 
     Every key of :data:`_KERNEL_AUTO` must have an evidence entry, and
     path-like evidence (no ``tag:`` prefix) must exist relative to
-    ``repo_root`` (default: the checkout containing this file). Run by
-    the ``kernel-auto-provenance`` check in ``apex_tpu.analysis`` and by
+    ``repo_root`` (default: the checkout containing this file). A
+    ``tuning:<path>`` prefix names a persistent tuning-cache file
+    (apex_tpu.tuning) as the measurement record: the file must exist
+    (absolute, ~-expanded, or repo-relative) AND parse with the schema
+    this build knows — a vanished or version-drifted cache is exactly a
+    stale race result outliving its hardware. Run by the
+    ``kernel-auto-provenance`` check in ``apex_tpu.analysis`` and by
     tests/run_analysis, so a new pin cannot land without naming the
     measurement that justified it."""
     if repo_root is None:
@@ -171,6 +216,11 @@ def validate_kernel_auto_provenance(repo_root: "str | None" = None) -> list:
                 f"pinned verdict for {kernel!r} has no evidence artifact")
         elif ev.split(":", 1)[0] in ("env", "runtime"):
             pass  # deployment tags, set by the loaders themselves
+        elif ev.split(":", 1)[0] == "tuning":
+            problems.extend(
+                f"evidence for {kernel!r}: {p}"
+                for p in _validate_tuning_evidence(ev.split(":", 1)[1],
+                                                   repo_root))
         elif not os.path.exists(os.path.join(repo_root, ev)):
             problems.append(
                 f"evidence for {kernel!r} names a missing artifact: {ev}")
@@ -178,6 +228,24 @@ def validate_kernel_auto_provenance(repo_root: "str | None" = None) -> list:
         problems.append(
             f"evidence entry for {kernel!r} has no pinned verdict")
     return problems
+
+
+def _validate_tuning_evidence(path: str, repo_root: str) -> list:
+    """Problems with a ``tuning:<path>`` evidence artifact ([] = valid):
+    the named cache file must exist and load with the schema version
+    this build's apex_tpu.tuning knows."""
+    from apex_tpu.tuning import cache as tuning_cache
+
+    resolved = os.path.expanduser(path)
+    if not os.path.isabs(resolved):
+        resolved = os.path.join(repo_root, resolved)
+    if not os.path.exists(resolved):
+        return [f"tuning cache is a missing artifact: {path}"]
+    try:
+        tuning_cache.load(resolved)
+    except ValueError as e:
+        return [f"tuning cache is not a valid evidence artifact: {e}"]
+    return []
 
 
 # Per-core VMEM by device generation, matched by substring against
@@ -276,12 +344,19 @@ def mode() -> str:
 def flash_blocks(kind: str, sq: int, sk: int, d: int) -> tuple:
     """(block_q, block_k) for the flash-attention ``kind`` pass at shape
     (sq, sk, d). Explicit override via :func:`set_flash_blocks` wins;
-    otherwise a per-shape pick that keeps the kernel's VMEM residency
-    (q/k/v/acc tiles + the [bq, bk] fp32 score block) around ~4 MiB so
-    double-buffered pipelining still fits a ~16 MiB VMEM."""
+    then a tuned entry from the persistent tuning cache (the tuner's
+    sweep-time pin rides the same consult); otherwise a per-shape pick
+    that keeps the kernel's VMEM residency (q/k/v/acc tiles + the
+    [bq, bk] fp32 score block) around ~4 MiB so double-buffered
+    pipelining still fits a ~16 MiB VMEM."""
     override = _FLASH_BLOCKS.get(kind)
     if override is not None:
         return override
+    from apex_tpu.tuning import geometry as tuning_geometry
+
+    tuned = tuning_geometry.flash_tiles(kind, sq, sk, d)
+    if tuned is not None:
+        return tuned
     bq, bk = _FLASH_DEFAULTS[kind]
     # score block bq*bk*4B dominates at d=128; wide heads add bq*d + 2*bk*d
     # tile bytes, so shrink until the whole residency fits ~2 MiB

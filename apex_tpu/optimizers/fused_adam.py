@@ -119,6 +119,9 @@ def fused_adam(
                             adam_flat_pallas,
                         )
 
+                        # slab geometry is tuner-supplied: the wrapper
+                        # resolves it outside its inner jit, so a fresh
+                        # tune changes the static key and retraces
                         d, m, v = adam_flat_pallas(
                             gbuf, pbufs[k], state.mu[k], state.nu[k],
                             jnp.asarray(lr_t, jnp.float32), step,

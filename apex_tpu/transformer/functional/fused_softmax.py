@@ -58,7 +58,19 @@ _VMEM_ROW_BUDGET = 2 * 1024 * 1024
 # Rows up to this many keys use the single-pass whole-row kernel; longer
 # rows switch to the two-pass k-blocked kernels (no upper limit).
 _WHOLE_ROW_MAX_SK = 16384
-_BLOCKED_BK = 2048
+# Test/debug override for the blocked kernels' k-block; None defers to
+# the tuner (apex_tpu.tuning.softmax_block_k: tuned cache entry for the
+# device, else the search-space default — the 2048 that used to live
+# here as a hardcoded tile).
+_BLOCKED_BK = None
+
+
+def _blocked_bk(sk: int) -> int:
+    if _BLOCKED_BK is not None:
+        return _BLOCKED_BK
+    from apex_tpu.tuning import softmax_block_k
+
+    return softmax_block_k(sk)
 
 
 def _largest_divisor(s: int, target: int) -> int:
@@ -75,12 +87,17 @@ def _pick_block_rows(sq: int, sk: int) -> int:
 
 def _pallas_ok(sq: int, sk: int) -> bool:
     del sq  # k-blocking removed the sk cap (VERDICT weak #9)
-    if (sk > _WHOLE_ROW_MAX_SK
-            and _largest_divisor(sk, _BLOCKED_BK) < min(128, _BLOCKED_BK)):
-        # awkward sk (e.g. prime): the blocked kernel would degenerate to
-        # lane-dim blocks far below a TPU tile — jnp/XLA is faster there
-        # (min() keeps tests that shrink _BLOCKED_BK on the blocked path)
-        return False
+    if sk > _WHOLE_ROW_MAX_SK:
+        # only long rows consult the tuner for their k-block: the
+        # whole-row path never uses it, and must not pay a cache lookup
+        # (or inherit a cache error) per dispatch
+        bk = _blocked_bk(sk)
+        if _largest_divisor(sk, bk) < min(128, bk):
+            # awkward sk (e.g. prime): the blocked kernel would
+            # degenerate to lane-dim blocks far below a TPU tile —
+            # jnp/XLA is faster there (min() keeps tests that shrink
+            # _BLOCKED_BK on the blocked path)
+            return False
     return _use_pallas()
 
 
@@ -191,8 +208,9 @@ def _apply_kernel(scale, bq, bk, off, causal, x_ref, mask_ref, m_ref, l_ref,
 def _pallas_blocked(x, mask, scale, causal):
     """Shared two-pass driver; ``mask`` broadcast to x's shape or None."""
     b, sq, sk = x.shape
-    bq = _largest_divisor(sq, max(8, _VMEM_ROW_BUDGET // (4 * _BLOCKED_BK)))
-    bk = _largest_divisor(sk, _BLOCKED_BK)
+    bk_target = _blocked_bk(sk)
+    bq = _largest_divisor(sq, max(8, _VMEM_ROW_BUDGET // (4 * bk_target)))
+    bk = _largest_divisor(sk, bk_target)
     off = sk - sq
     grid = (b, sq // bq, sk // bk)
     xspec = pl.BlockSpec((1, bq, bk), lambda i, j, k: (i, j, k))

@@ -1,0 +1,222 @@
+"""Persistent per-device tuning cache.
+
+One JSON file (default ``~/.cache/apex_tpu/tuning_cache.json``,
+``APEX_TPU_TUNING_CACHE`` overrides — also how a repo-committed export is
+activated) holding every tuned tile and race verdict, keyed by
+``(device_kind, kernel, shape-bucket)``:
+
+.. code-block:: json
+
+    {"schema_version": 1, "kind": "apex_tpu.tuning",
+     "entries": {"TPU v5 lite": {"flat_adam": {"n~536870912": {
+         "params": {"block_rows": 256, "cols": 512},
+         "pallas_ms": 11.2, "xla_ms": 14.8, "use_pallas": true,
+         "source": "measured", "dims": {"n": 356515840}}}}}}
+
+The schema version is rejected LOUDLY on mismatch (a silently-ignored
+cache would pin stale tiles forever); ``source`` records whether the
+entry came from a real on-device race (``measured``) or the CPU roofline
+fallback (``roofline`` — deterministic, CI-testable, never applied to a
+TPU device_kind because the key is the device the tuner ran on).
+
+Dispatch consults this module through :mod:`apex_tpu.tuning.geometry`
+(tile lookup, hit/miss counters) and through :func:`apply_verdicts`
+(race verdicts flipped into ``pallas_config._KERNEL_AUTO`` with the
+cache file as the provenance evidence artifact — ``tuning:<path>``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+SCHEMA_VERSION = 1
+KIND = "apex_tpu.tuning"
+
+# process-level memo: resolved path -> parsed cache (invalidate with
+# clear_memo after writes or in tests that repoint the env override)
+_MEMO: dict = {}
+
+
+def cache_path() -> str:
+    """Resolved cache file location (env override wins)."""
+    env = os.environ.get("APEX_TPU_TUNING_CACHE")
+    if env:
+        return os.path.abspath(os.path.expanduser(env))
+    return os.path.join(os.path.expanduser("~"), ".cache", "apex_tpu",
+                        "tuning_cache.json")
+
+
+def empty() -> dict:
+    return {"schema_version": SCHEMA_VERSION, "kind": KIND, "entries": {}}
+
+
+def _validate(data, path):
+    if not isinstance(data, dict) or data.get("kind") != KIND:
+        raise ValueError(
+            f"tuning cache {path} is not an {KIND} file (missing kind "
+            f"header) — refusing to guess at its layout")
+    version = data.get("schema_version")
+    if version != SCHEMA_VERSION:
+        raise ValueError(
+            f"tuning cache {path} has schema_version {version}; this "
+            f"reader knows [{SCHEMA_VERSION}] — re-tune (tools/tune.sh) "
+            f"or delete the stale cache")
+    if not isinstance(data.get("entries"), dict):
+        raise ValueError(f"tuning cache {path} has no entries object")
+    return data
+
+
+def load(path=None) -> dict:
+    """Parse the cache at ``path`` (default :func:`cache_path`); an
+    absent file is an empty cache, a malformed or version-mismatched one
+    raises ValueError."""
+    path = path or cache_path()
+    if not os.path.exists(path):
+        return empty()
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except ValueError as e:
+            raise ValueError(f"tuning cache {path} is not JSON: {e}")
+    return _validate(data, path)
+
+
+def save(cache: dict, path=None) -> str:
+    """Atomically write ``cache`` (validated first — a writer bug must
+    not corrupt the dispatch-time artifact) and invalidate the memo."""
+    path = path or cache_path()
+    _validate(cache, "<in-memory cache>")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".tuning_cache.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    clear_memo()
+    return path
+
+
+def clear_memo() -> None:
+    _MEMO.clear()
+
+
+def _loaded(path=None) -> dict:
+    path = path or cache_path()
+    if path not in _MEMO:
+        _MEMO[path] = load(path)
+    return _MEMO[path]
+
+
+def current_device_kind() -> str:
+    """Cache key for the running backend: device_kind on TPU, the
+    platform name elsewhere (CPU roofline entries key as 'cpu')."""
+    import jax
+
+    dev = jax.devices()[0]
+    return dev.device_kind if dev.platform == "tpu" else dev.platform
+
+
+def lookup(kernel: str, bucket: str, device_kind=None, path=None):
+    """The tuned entry for ``(device_kind, kernel, bucket)`` or None.
+    Ticks the ``tuning/cache_hit`` / ``tuning/cache_miss`` counter so
+    every bench run records how much of its dispatch was tuned."""
+    if device_kind is None:
+        device_kind = current_device_kind()
+    entry = (_loaded(path).get("entries", {})
+             .get(device_kind, {}).get(kernel, {}).get(bucket))
+    try:
+        from apex_tpu.observability import get_registry
+
+        get_registry().counter(
+            "tuning/cache_hit" if entry is not None
+            else "tuning/cache_miss", kernel=kernel).inc()
+    except Exception:  # noqa: BLE001 — telemetry must never gate dispatch
+        pass
+    return entry
+
+
+def put(cache: dict, device_kind: str, kernel: str, bucket: str,
+        entry: dict) -> dict:
+    """Insert/replace one entry in an in-memory cache dict."""
+    cache.setdefault("entries", {}).setdefault(
+        device_kind, {}).setdefault(kernel, {})[bucket] = entry
+    return cache
+
+
+def merge(dst: dict, src: dict) -> dict:
+    """Fold every entry of ``src`` into ``dst`` (src wins per bucket).
+    The tuner's write path merges into the on-disk cache rather than
+    replacing it — a CPU roofline run must never destroy another
+    device's measured entries (they are provenance evidence for
+    _KERNEL_AUTO pins)."""
+    for device_kind, kernels in src.get("entries", {}).items():
+        for kernel, buckets in kernels.items():
+            for bucket, entry in buckets.items():
+                put(dst, device_kind, kernel, bucket, entry)
+    return dst
+
+
+def entries_for(device_kind=None, path=None) -> dict:
+    """All tuned entries for one device kind (the bench JSON-line's
+    'active tuning-cache entries' payload)."""
+    if device_kind is None:
+        device_kind = current_device_kind()
+    return dict(_loaded(path).get("entries", {}).get(device_kind, {}))
+
+
+# ------------------------------------------------- dispatch verdict flip
+
+# search-space kernel -> pallas_config.KNOWN_KERNELS dispatch name.
+# flash fwd/bwd share one dispatch gate: Pallas only when every tuned
+# pass won its race (a fwd win that taxes the bwd is not a win).
+_VERDICT_KERNEL = {
+    "flat_adam": "flat_adam",
+    "layer_norm": "layer_norm",
+    "rms_norm": "rms_norm",
+    "fused_softmax": "fused_softmax",
+    "flash_attention_fwd": "flash_attention",
+    "flash_attention_bwd": "flash_attention",
+}
+
+
+def verdicts_for(device_kind=None, path=None) -> dict:
+    """dispatch-kernel -> bool race verdicts derived from the cache
+    entries of ``device_kind`` (AND over buckets and over flash passes:
+    a kernel must win everywhere it was measured to keep the default)."""
+    out: dict = {}
+    for kernel, buckets in entries_for(device_kind, path).items():
+        name = _VERDICT_KERNEL.get(kernel)
+        if name is None:
+            continue
+        for entry in buckets.values():
+            won = entry.get("use_pallas")
+            if not isinstance(won, bool):
+                continue
+            out[name] = out.get(name, True) and won
+    return out
+
+
+def apply_verdicts(path=None, device_kind=None) -> dict:
+    """Flip ``pallas_config._KERNEL_AUTO`` from the cache's race
+    verdicts, with ``tuning:<path>`` as the evidence artifact (the
+    provenance check validates that the named cache exists and parses).
+    Explicit ``env:`` pins (the deployment knob) are never overridden.
+    Returns the verdicts actually applied."""
+    from apex_tpu.ops import pallas_config
+
+    path = path or cache_path()
+    verdicts = verdicts_for(device_kind, path)
+    current_ev = pallas_config.kernel_auto_evidence()
+    applied = {
+        k: v for k, v in verdicts.items()
+        if not current_ev.get(k, "").startswith("env:")}
+    if applied:
+        pallas_config.set_kernel_auto(evidence=f"tuning:{path}",
+                                      **applied)
+    return applied

@@ -1,0 +1,111 @@
+"""Dispatch-time tile resolution: override > tuned cache > default.
+
+Every Pallas kernel asks these helpers for its block geometry instead of
+reading module constants (the ``hardcoded-tile-size`` lint enforces it).
+Resolution order:
+
+1. an active :func:`override` context — how the tuner's measurement
+   harness pins one candidate at a time without touching the cache;
+2. the persistent tuning cache (:mod:`apex_tpu.tuning.cache`), keyed by
+   ``(device_kind, kernel, shape_bucket)``;
+3. the untuned default from :mod:`apex_tpu.tuning.search_space`.
+
+All of this runs at TRACE time (the helpers are called while building
+the pallas_call, never inside a kernel body), so the file read behind
+the cache happens once per process and the per-call cost is dict
+lookups.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from apex_tpu.tuning import cache, search_space
+
+# kernel -> params dict pinned by the innermost active override()
+_OVERRIDES: dict = {}
+
+
+@contextlib.contextmanager
+def override(kernel: str, params: dict):
+    """Pin ``kernel``'s geometry to ``params`` within the context — the
+    measurement harness races candidates through exactly the dispatch
+    path production uses (so a candidate that only wins with a special
+    code path can't win the sweep)."""
+    if kernel not in search_space.KERNELS:
+        raise ValueError(f"unknown kernel {kernel!r}; valid: "
+                         f"{list(search_space.KERNELS)}")
+    prev = _OVERRIDES.get(kernel)
+    _OVERRIDES[kernel] = dict(params)
+    try:
+        yield
+    finally:
+        if prev is None:
+            _OVERRIDES.pop(kernel, None)
+        else:
+            _OVERRIDES[kernel] = prev
+
+
+def _resolve(kernel: str, **dims):
+    """(params, source) for ``kernel`` at ``dims`` — params may be None
+    when neither an override nor a tuned entry exists."""
+    ov = _OVERRIDES.get(kernel)
+    if ov is not None:
+        return ov, "override"
+    entry = cache.lookup(kernel, search_space.shape_bucket(kernel, **dims))
+    if entry is not None and isinstance(entry.get("params"), dict):
+        return entry["params"], "tuned"
+    return None, "default"
+
+
+def flat_adam_geometry(n: int) -> tuple:
+    """(block_rows, cols) for the flat Adam slab over an ``n``-element
+    buffer. Tuned cols are clamped down for buffers too small for them
+    (a tile tuned at 350M elements must not pad a 100-element leaf to
+    its slab — the pad block follows the actual leaf size)."""
+    params, _ = _resolve("flat_adam", n=n)
+    if params is None:
+        return search_space.default_flat_adam_geometry(n)
+    block_rows = int(params["block_rows"])
+    cols = int(params["cols"])
+    d_rows, d_cols = search_space.default_flat_adam_geometry(n)
+    if block_rows * cols > max(2 * n, d_rows * d_cols):
+        return d_rows, d_cols
+    return block_rows, cols
+
+
+def flash_tiles(kind: str, sq: int, sk: int, d: int):
+    """Tuned (block_q, block_kv) for the flash ``kind`` pass, or None
+    when no override/tuned entry exists (pallas_config then applies its
+    per-shape heuristic). The kernel still clamps to sequence divisors."""
+    params, source = _resolve(f"flash_attention_{kind}",
+                              sq=sq, sk=sk, d=d)
+    if params is None or source == "default":
+        return None
+    return int(params["block_q"]), int(params["block_kv"])
+
+
+def norm_row_block(kernel: str, rows: int, h: int, f32_temps: int) -> int:
+    """Row block for layer_norm / rms_norm at (rows, h); 0 = take the
+    jnp fallback. A tuned block still respects the f32_temps VMEM bound
+    (the backward holds more live temps than the forward the tuner may
+    have raced)."""
+    params, _ = _resolve(kernel, rows=rows, h=h)
+    if params is None:
+        return search_space.default_norm_row_block(rows, h, f32_temps)
+    block = int(params["block_rows"])
+    floor = search_space.default_norm_row_block(rows, h, f32_temps)
+    if floor == 0:
+        return 0
+    while block > floor and block * h * 4 * f32_temps > \
+            search_space._vmem_budget() * 3 // 2:
+        block //= 2
+    return max(block, search_space._SUBLANE)
+
+
+def softmax_block_k(sk: int) -> int:
+    """k-block for the two-pass blocked fused softmax."""
+    params, _ = _resolve("fused_softmax", sk=sk)
+    if params is None:
+        return search_space.default_softmax_block_k()
+    return int(params["block_k"])

@@ -20,6 +20,20 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Hermetic tuning cache: dispatch consults the persistent per-device
+# tuning cache (apex_tpu.tuning), and a developer's real
+# ~/.cache/apex_tpu/tuning_cache.json would change tile geometry and
+# _KERNEL_AUTO verdicts under test (or, schema-drifted, error every
+# dispatch). Point the whole suite at a fresh per-session path unless
+# the invoker explicitly chose one; tests that need their own cache
+# (tests/run_tuning) still monkeypatch over this.
+if "APEX_TPU_TUNING_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["APEX_TPU_TUNING_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="apex_tpu_test_tuning_"),
+        "tuning_cache.json")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -604,3 +604,100 @@ for x in xs:
         pass
 """
     assert not _by_check(lint_source(src, "apex_tpu/a.py"), _SWALLOW)
+
+
+# ---------------------------------------------------- hardcoded-tile-size
+
+_TILE = "hardcoded-tile-size"
+
+_TILE_DIRECT_SRC = """
+from jax.experimental import pallas as pl
+
+def build(h):
+    row = pl.BlockSpec((512, 1024), lambda i: (i, 0))
+    sc = pl.BlockSpec((1, 4), lambda i: (0, 0))
+    var = pl.BlockSpec((h, 1), lambda i: (i, 0))
+    return row, sc, var
+"""
+
+
+def test_tile_literal_in_blockspec_flagged():
+    found = _by_check(_lint(_TILE_DIRECT_SRC), _TILE)
+    # 512 and 1024 are tile-sized; the (1, 4) scalar block and the
+    # variable/singleton dims are layout plumbing, not tunable tiles
+    assert len(found) == 2
+    assert "apex_tpu.tuning" in found[0].message
+
+
+def test_tile_blockspec_kwarg_form_flagged():
+    src = """
+import jax.experimental.pallas as pl
+s = pl.BlockSpec(block_shape=(256, 128), index_map=lambda i: (i, 0))
+"""
+    assert len(_by_check(_lint(src), _TILE)) == 2
+
+
+def test_tile_module_constant_flagged_only_with_blockspec():
+    src_const = """
+from jax.experimental import pallas as pl
+_BLOCK_ROWS = 512
+_COLS = 1024
+_BLOCKED_BK = 2048
+
+def f(block, h):
+    return pl.BlockSpec((block, h), lambda i: (i, 0))
+"""
+    found = _by_check(_lint(src_const), _TILE)
+    assert {f.line for f in found} == {3, 4, 5}
+    # the same constants in a file with no BlockSpec are not kernel
+    # geometry (e.g. a data loader's _TILE_ROWS)
+    src_nospec = "_BLOCK_ROWS = 512\n_COLS = 1024\n"
+    assert not _by_check(_lint(src_nospec), _TILE)
+    # non-tile names and sub-tile values stay quiet
+    src_clean = """
+from jax.experimental import pallas as pl
+_VMEM_ROW_BUDGET = 2 * 1024 * 1024
+_WHOLE_ROW_MAX_SK = 16384
+_SCALARS = 4
+
+def f(block, h):
+    return pl.BlockSpec((block, h), lambda i: (i, 0))
+"""
+    assert not _by_check(_lint(src_clean), _TILE)
+
+
+def test_tile_allowlisted_modules():
+    """pallas_config and the tuner's search-space tables are the two
+    sanctioned homes for tile numbers."""
+    for path in ("apex_tpu/ops/pallas_config.py",
+                 "apex_tpu/tuning/search_space.py"):
+        assert not _by_check(
+            lint_source(_TILE_DIRECT_SRC, path, abspath="/r/" + path),
+            _TILE)
+    assert _by_check(
+        lint_source(_TILE_DIRECT_SRC, "apex_tpu/ops/layer_norm.py",
+                    abspath="/r/apex_tpu/ops/layer_norm.py"), _TILE)
+
+
+def test_tile_suppressible():
+    src = """
+from jax.experimental import pallas as pl
+s = pl.BlockSpec((8, 128), lambda i: (0, 0))  # apex-lint: disable=hardcoded-tile-size
+"""
+    assert not _by_check(_lint(src), _TILE)
+
+
+def test_tile_clean_tree():
+    """The live tree is at 0 findings: every former offender
+    (fused_adam_kernel's slab constants, layer_norm's _BLOCK_ROWS,
+    fused_softmax's _BLOCKED_BK) is routed through apex_tpu.tuning."""
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "bench.py")],
+        root=repo, checks=(_TILE,)) if f.check == _TILE]
+    assert not found, "\n".join(f.render() for f in found)

@@ -1,0 +1,159 @@
+"""Interpret-mode parity across EVERY tiling candidate the tuner can
+emit (ISSUE 6 satellite): a tuned tile may change speed, never numerics.
+
+flat-adam is a pure elementwise chain, so every (block_rows, cols) slab
+must produce BIT-IDENTICAL fp32 results (and bit-identical bf16 deltas);
+flash attention's online softmax re-associates fp32 sums across tile
+boundaries, so candidates are held to tight tolerance against the jnp
+reference (loose for bf16 storage). All candidates run the actual kernel
+bodies through the Pallas interpreter on the CI mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.ops.fused_adam_kernel import adam_flat_pallas
+from apex_tpu.ops.flash_attention import (
+    _reference_attention,
+    flash_attention,
+)
+from apex_tpu.optimizers import _math
+from apex_tpu.tuning import candidates, geometry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_cache(tmp_path, monkeypatch):
+    """A developer's real ~/.cache must not leak tuned tiles into the
+    parity matrix — each candidate is pinned explicitly."""
+    from apex_tpu.tuning import cache
+
+    monkeypatch.setenv("APEX_TPU_TUNING_CACHE",
+                       str(tmp_path / "none.json"))
+    cache.clear_memo()
+    yield
+    cache.clear_memo()
+
+
+# ------------------------------------------------------------ flat adam
+
+_N = 5000  # small enough that the sweep stays ~10 candidates wide
+
+_ADAM_KW = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01,
+                adam_w_mode=True, bias_correction=True)
+
+
+def _adam_inputs(dtype):
+    k = jax.random.PRNGKey(0)
+    g = jax.random.normal(k, (_N,), jnp.float32)
+    p = jax.random.normal(jax.random.fold_in(k, 1), (_N,)).astype(dtype)
+    m = jnp.full((_N,), 0.1, jnp.float32)
+    v = jnp.full((_N,), 0.2, jnp.float32)
+    return g, p, m, v
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flat_adam_every_candidate_is_bit_identical(dtype):
+    g, p, m, v = _adam_inputs(dtype)
+    cands = candidates("flat_adam", n=_N)
+    assert len(cands) >= 4, cands
+    ref = None
+    for cand in cands:
+        d, mo, vo = adam_flat_pallas(
+            g, p, m, v, jnp.float32(1e-3), jnp.float32(3.0),
+            block_rows=cand["block_rows"], cols=cand["cols"],
+            interpret=True, **_ADAM_KW)
+        out = (np.asarray(d), np.asarray(mo), np.asarray(vo))
+        if ref is None:
+            ref = out
+            continue
+        for a, b in zip(out, ref):
+            # elementwise chain: the tile CANNOT change the math
+            np.testing.assert_array_equal(a, b, err_msg=str(cand))
+    # and the chain agrees with the reference math path
+    dw, mw, vw = _math.adam_step(g, p, m, v, lr=1e-3, step=3.0,
+                                 **_ADAM_KW)
+    np.testing.assert_allclose(ref[0].astype(np.float32),
+                               np.asarray(dw, np.float32),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ref[1], np.asarray(mw), rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(ref[2], np.asarray(vw), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_flat_adam_tuner_default_path_matches_explicit():
+    """adam_flat_pallas with no explicit geometry (the tuner/default
+    resolution inside the jit) matches an explicitly-pinned run."""
+    g, p, m, v = _adam_inputs(jnp.float32)
+    auto = adam_flat_pallas(g, p, m, v, jnp.float32(1e-3),
+                            jnp.float32(3.0), interpret=True, **_ADAM_KW)
+    from apex_tpu.tuning import flat_adam_geometry
+
+    br, cols = flat_adam_geometry(_N)
+    pinned = adam_flat_pallas(g, p, m, v, jnp.float32(1e-3),
+                              jnp.float32(3.0), block_rows=br, cols=cols,
+                              interpret=True, **_ADAM_KW)
+    for a, b in zip(auto, pinned):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------ flash attention
+
+_B, _S, _H, _D = 1, 256, 2, 32
+
+
+def _qkv(dtype):
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    return tuple(jax.random.normal(k, (_B, _S, _H, _D), dtype)
+                 for k in ks)
+
+
+def _flash_tol(dtype):
+    return 2e-2 if dtype == jnp.bfloat16 else 2e-5
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_every_candidate_matches_reference(dtype, causal):
+    q, k, v = _qkv(dtype)
+    qt = q.transpose(0, 2, 1, 3).reshape(_B * _H, _S, _D)
+    kt = k.transpose(0, 2, 1, 3).reshape(_B * _H, _S, _D)
+    vt = v.transpose(0, 2, 1, 3).reshape(_B * _H, _S, _D)
+    ref = _reference_attention(qt, kt, vt, causal, 1.0 / _D ** 0.5)
+    ref = np.asarray(ref, np.float32).reshape(_B, _H, _S, _D)
+    cands = candidates("flash_attention_fwd", sq=_S, sk=_S, d=_D)
+    assert len(cands) >= 4, cands
+    tol = _flash_tol(dtype)
+    for cand in cands:
+        with geometry.override("flash_attention_fwd", cand):
+            with pallas_config.force("interpret"):
+                out = flash_attention(q, k, v, causal=causal)
+        out = np.asarray(out, np.float32).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, atol=tol, rtol=tol,
+                                   err_msg=str(cand))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_every_candidate_matches_reference(causal):
+    q, k, v = _qkv(jnp.float32)
+
+    def loss(fn):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2))
+
+    ref = loss(lambda q, k, v: flash_attention(q, k, v, causal=causal))(
+        q, k, v)  # jnp reference VJP (pallas off outside force())
+    cands = candidates("flash_attention_bwd", sq=_S, sk=_S, d=_D)
+    assert len(cands) >= 4, cands
+    for cand in cands:
+        with geometry.override("flash_attention_bwd", cand):
+            with pallas_config.force("interpret"):
+                out = loss(lambda q, k, v: flash_attention(
+                    q, k, v, causal=causal))(q, k, v)
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(
+                np.asarray(o), np.asarray(r), atol=5e-5, rtol=5e-5,
+                err_msg=str(cand))

@@ -1,0 +1,27 @@
+"""Shared isolation for the tuning tests: every test gets its own cache
+file (APEX_TPU_TUNING_CACHE -> tmp) and leaves pallas_config's verdict
+table, evidence map and lazy tuning-consult flag exactly as it found
+them — a tuned verdict leaking out of a test would fail the provenance
+suite (the tmp cache evidence vanishes with tmp_path)."""
+
+import pytest
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.tuning import cache
+
+
+@pytest.fixture
+def tuning_env(tmp_path, monkeypatch):
+    path = tmp_path / "tuning_cache.json"
+    monkeypatch.setenv("APEX_TPU_TUNING_CACHE", str(path))
+    cache.clear_memo()
+    prev_auto = pallas_config.kernel_auto()
+    prev_ev = pallas_config.kernel_auto_evidence()
+    prev_applied = pallas_config._TUNING_APPLIED
+    yield str(path)
+    cache.clear_memo()
+    pallas_config._KERNEL_AUTO.clear()
+    pallas_config._KERNEL_AUTO.update(prev_auto)
+    pallas_config._KERNEL_AUTO_EVIDENCE.clear()
+    pallas_config._KERNEL_AUTO_EVIDENCE.update(prev_ev)
+    pallas_config._TUNING_APPLIED = prev_applied

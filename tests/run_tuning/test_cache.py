@@ -1,0 +1,149 @@
+"""Persistent tuning cache: round-trip, loud schema rejection, verdict
+application with the cache file as the provenance evidence artifact."""
+
+import json
+
+import pytest
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.tuning import cache
+
+
+def _entry(use_pallas=True, params=None):
+    return {"params": params or {"block_rows": 64, "cols": 512},
+            "pallas_ms": 1.0, "xla_ms": 2.0, "use_pallas": use_pallas,
+            "source": "roofline", "dims": {"n": 1000}}
+
+
+def test_round_trip_write_reload_lookup(tuning_env):
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry())
+    path = cache.save(c)
+    assert path == tuning_env
+    got = cache.lookup("flat_adam", "n~1024", device_kind="cpu")
+    assert got["params"] == {"block_rows": 64, "cols": 512}
+    assert cache.lookup("flat_adam", "n~2048", device_kind="cpu") is None
+    assert cache.lookup("layer_norm", "n~1024", device_kind="cpu") is None
+
+
+def test_missing_file_is_empty_cache(tuning_env):
+    assert cache.load()["entries"] == {}
+    assert cache.lookup("flat_adam", "n~1024", device_kind="cpu") is None
+
+
+def test_schema_mismatch_rejected_loudly(tuning_env):
+    bad = cache.empty()
+    bad["schema_version"] = 99
+    with open(tuning_env, "w") as f:
+        json.dump(bad, f)
+    cache.clear_memo()
+    with pytest.raises(ValueError, match="schema_version 99"):
+        cache.load()
+    with pytest.raises(ValueError, match="schema_version 99"):
+        cache.lookup("flat_adam", "n~1024", device_kind="cpu")
+
+
+def test_wrong_kind_and_garbage_rejected_loudly(tuning_env):
+    with open(tuning_env, "w") as f:
+        json.dump({"schema_version": 1, "entries": {}}, f)
+    with pytest.raises(ValueError, match="kind"):
+        cache.load()
+    with open(tuning_env, "w") as f:
+        f.write("{not json")
+    with pytest.raises(ValueError, match="not JSON"):
+        cache.load()
+
+
+def test_save_validates_before_writing(tuning_env):
+    with pytest.raises(ValueError):
+        cache.save({"schema_version": 99, "kind": cache.KIND,
+                    "entries": {}})
+
+
+def test_hit_miss_counters_tick(tuning_env):
+    from apex_tpu.observability import get_registry
+
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry())
+    cache.save(c)
+    reg = get_registry()
+    hit = reg.counter("tuning/cache_hit", kernel="flat_adam")
+    miss = reg.counter("tuning/cache_miss", kernel="flat_adam")
+    h0, m0 = hit.value, miss.value
+    cache.lookup("flat_adam", "n~1024", device_kind="cpu")
+    cache.lookup("flat_adam", "n~4096", device_kind="cpu")
+    assert hit.value == h0 + 1 and miss.value == m0 + 1
+
+
+# ------------------------------------------------- verdicts + provenance
+
+
+def test_apply_verdicts_flips_kernel_auto_with_cache_evidence(tuning_env):
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry(use_pallas=True))
+    cache.save(c)
+    applied = cache.apply_verdicts()
+    assert applied == {"flat_adam": True}
+    assert pallas_config.kernel_auto()["flat_adam"] is True
+    ev = pallas_config.kernel_auto_evidence()["flat_adam"]
+    assert ev == f"tuning:{tuning_env}"
+    # acceptance: the provenance check accepts a tuning-cache file as
+    # evidence (it exists and parses with the known schema)
+    assert pallas_config.validate_kernel_auto_provenance() == []
+
+
+def test_provenance_rejects_missing_or_mismatched_cache(tuning_env):
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry())
+    cache.save(c)
+    cache.apply_verdicts()
+    # rot the evidence artifact: schema drift must be called out
+    bad = cache.empty()
+    bad["schema_version"] = 99
+    with open(tuning_env, "w") as f:
+        json.dump(bad, f)
+    problems = pallas_config.validate_kernel_auto_provenance()
+    assert any("tuning cache" in p for p in problems), problems
+    # vanish it entirely
+    import os
+
+    os.unlink(tuning_env)
+    problems = pallas_config.validate_kernel_auto_provenance()
+    assert any("missing artifact" in p for p in problems), problems
+
+
+def test_flash_verdict_is_and_of_fwd_and_bwd(tuning_env):
+    c = cache.empty()
+    cache.put(c, "cpu", "flash_attention_fwd", "b",
+              _entry(params={"block_q": 256, "block_kv": 256}))
+    cache.put(c, "cpu", "flash_attention_bwd", "b",
+              _entry(use_pallas=False,
+                     params={"block_q": 256, "block_kv": 256}))
+    cache.save(c)
+    assert cache.verdicts_for("cpu") == {"flash_attention": False}
+
+
+def test_env_pins_beat_tuning_verdicts(tuning_env):
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry(use_pallas=True))
+    cache.save(c)
+    pallas_config.set_kernel_auto(
+        evidence="env:APEX_TPU_KERNEL_AUTO", flat_adam=False)
+    applied = cache.apply_verdicts()
+    assert "flat_adam" not in applied
+    assert pallas_config.kernel_auto()["flat_adam"] is False
+
+
+def test_use_pallas_lazily_applies_the_cache(tuning_env):
+    """Dispatch consults the cache: a tuned verdict lands in
+    _KERNEL_AUTO the first time use_pallas asks after refresh."""
+    c = cache.empty()
+    cache.put(c, "cpu", "flat_adam", "n~1024", _entry(use_pallas=True))
+    cache.save(c)
+    pallas_config.refresh_tuning()
+    # off-TPU the gate still returns False (verdict and on_tpu) — but
+    # the verdict + evidence must have been applied by the consult
+    assert pallas_config.use_pallas("flat_adam") is False
+    assert pallas_config.kernel_auto()["flat_adam"] is True
+    assert pallas_config.kernel_auto_evidence()["flat_adam"].startswith(
+        "tuning:")

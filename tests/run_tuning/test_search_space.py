@@ -1,0 +1,99 @@
+"""Search-space declarations: VMEM-bounded candidates, deterministic
+buckets, and the size-aware default geometries (incl. the small-tensor
+padding fix the ISSUE names)."""
+
+import pytest
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.tuning import search_space as ss
+
+
+def test_every_required_kernel_has_a_search_space():
+    for kernel in ("flat_adam", "flash_attention_fwd",
+                   "flash_attention_bwd", "layer_norm", "rms_norm"):
+        assert kernel in ss.KERNELS
+
+
+DIMS = {
+    "flat_adam": {"n": 356515840},
+    "flash_attention_fwd": {"sq": 2048, "sk": 2048, "d": 128},
+    "flash_attention_bwd": {"sq": 2048, "sk": 2048, "d": 128},
+    "layer_norm": {"rows": 8192, "h": 4096},
+    "rms_norm": {"rows": 8192, "h": 4096},
+    "fused_softmax": {"sk": 32768},
+}
+
+
+@pytest.mark.parametrize("kernel", ss.KERNELS)
+def test_candidates_nonempty_and_deterministic(kernel):
+    a = ss.candidates(kernel, **DIMS[kernel])
+    b = ss.candidates(kernel, **DIMS[kernel])
+    assert a and a == b
+
+
+def test_no_candidate_busts_the_vmem_budget():
+    """The compile-bomb guard: every candidate's resident-block estimate
+    stays inside the analyzer's per-core VMEM figure."""
+    budget = pallas_config.device_vmem_bytes()
+    for c in ss.candidates("flat_adam", n=356515840):
+        assert ss._flat_adam_vmem(c["block_rows"], c["cols"]) <= budget
+    for kind, name in (("fwd", "flash_attention_fwd"),
+                       ("bwd", "flash_attention_bwd")):
+        est = ss._flash_fwd_vmem if kind == "fwd" else ss._flash_bwd_vmem
+        for c in ss.candidates(name, **DIMS[name]):
+            assert est(c["block_q"], c["block_kv"], 128) <= budget
+    for c in ss.candidates("layer_norm", rows=8192, h=4096):
+        assert c["block_rows"] * 4096 * 4 * 5 <= budget
+
+
+def test_candidate_cols_are_swept_for_flat_adam():
+    cols = {c["cols"] for c in ss.candidates("flat_adam", n=356515840)}
+    assert len(cols) > 1, "the 1024-column width must be a swept " \
+                          "parameter, not a constant"
+    rows = {c["block_rows"] for c in ss.candidates("flat_adam",
+                                                   n=356515840)}
+    assert len(rows) > 1  # multi-row-per-grid-step variants in the sweep
+
+
+def test_shape_bucket_is_coarse_and_stable():
+    assert ss.shape_bucket("flat_adam", n=300_000_000) == \
+        ss.shape_bucket("flat_adam", n=350_000_000)
+    assert ss.shape_bucket("flat_adam", n=1000) != \
+        ss.shape_bucket("flat_adam", n=300_000_000)
+    assert ss.shape_bucket("flash_attention_fwd", sq=2048, sk=2048,
+                           d=128) != \
+        ss.shape_bucket("flash_attention_fwd", sq=2048, sk=2048, d=64)
+    with pytest.raises(ValueError):
+        ss.shape_bucket("not_a_kernel", n=1)
+
+
+# ------------------------------------------------ default slab geometry
+
+
+def test_tiny_leaf_no_longer_overpads():
+    """Satellite: the old path padded ANY small tensor to an 8x1024 fp32
+    slab (8192 elements for a scalar bias, x4 buffers); the pad block
+    must follow the actual leaf size."""
+    br, cols = ss.default_flat_adam_geometry(1)  # a scalar bias
+    assert br * cols <= 1024, (br, cols)
+    assert cols == 128 and br == 8
+
+
+@pytest.mark.parametrize("n", [1, 7, 100, 1024, 8192, 100_000,
+                               1024 * 520 + 7, 5_000_000])
+def test_padding_waste_is_bounded(n):
+    br, cols = ss.default_flat_adam_geometry(n)
+    rows = -(-n // cols)
+    padded = -(-rows // br) * br * cols
+    # never worse than 1.5x the buffer + one minimal slab of slack
+    assert padded <= max(n + n // 2 + 8 * cols, 8 * 128), (n, br, cols)
+    # and the geometry is always fp32-tileable
+    assert br >= 8 and cols % 128 == 0
+
+
+def test_default_norm_row_block_matches_old_ladder():
+    # rows divisible: the clean split wins; h=4096 caps the ladder at 128
+    assert ss.default_norm_row_block(8192, 4096, 5) == 128
+    assert ss.default_norm_row_block(256, 1024, 3) == 256
+    # giant h: even block 8 busts VMEM -> 0 = caller takes jnp
+    assert ss.default_norm_row_block(64, 3_000_000, 5) == 0

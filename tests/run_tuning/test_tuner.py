@@ -1,0 +1,159 @@
+"""Tuner sweeps on the CPU roofline fallback: deterministic, cache-
+writing, dispatch-consulted — the acceptance criterion's no-hardware CI
+story."""
+
+import pytest
+
+from apex_tpu.observability.registry import MetricRegistry
+from apex_tpu.ops import pallas_config
+from apex_tpu.tuning import cache, geometry, tuner
+
+
+def test_roofline_ranking_is_stable(tuning_env):
+    """CPU-deterministic tuner: two sweeps produce the identical
+    candidate ranking and winner (no RNG, stable tie-break)."""
+    a = tuner.tune_kernel("flat_adam", {"n": 2_000_000}, write=False,
+                          registry=MetricRegistry(), log=lambda m: None)
+    b = tuner.tune_kernel("flat_adam", {"n": 2_000_000}, write=False,
+                          registry=MetricRegistry(), log=lambda m: None)
+    assert a["ranking"] == b["ranking"]
+    assert a["entry"]["params"] == b["entry"]["params"]
+    assert a["entry"]["source"] == "roofline"
+
+
+def test_roofline_reproduces_the_cost_study_decisions(tuning_env):
+    """The offline fallback must agree with docs/kernel_cost_study.md:
+    Pallas wins flash/norms, flat_adam at best ties and loses."""
+    reg = MetricRegistry()
+    kw = dict(write=False, registry=reg, log=lambda m: None)
+    assert not tuner.tune_kernel("flat_adam", **kw)["entry"]["use_pallas"]
+    assert tuner.tune_kernel("flash_attention_fwd",
+                             **kw)["entry"]["use_pallas"]
+    assert tuner.tune_kernel("layer_norm", **kw)["entry"]["use_pallas"]
+    assert reg.counter("tuning/race_won_xla",
+                       kernel="flat_adam").value == 1
+    assert reg.counter("tuning/race_won_pallas",
+                       kernel="flash_attention_fwd").value == 1
+
+
+def test_tune_writes_cache_and_dispatch_consults_it(tuning_env):
+    r = tuner.tune_kernel("flash_attention_fwd",
+                          {"sq": 2048, "sk": 2048, "d": 128},
+                          registry=MetricRegistry(), log=lambda m: None)
+    assert r["cache_path"] == tuning_env
+    tuned = geometry.flash_tiles("fwd", 2048, 2048, 128)
+    assert tuned == (r["entry"]["params"]["block_q"],
+                     r["entry"]["params"]["block_kv"])
+    # pallas_config.flash_blocks takes the tuned tile (no explicit
+    # set_flash_blocks override active)
+    assert pallas_config.flash_blocks("fwd", 2048, 2048, 128) == tuned
+    # a different bucket still uses the heuristic, not the tuned entry
+    assert geometry.flash_tiles("fwd", 128, 128, 64) is None
+
+
+def test_explicit_flash_override_beats_tuned_entry(tuning_env):
+    tuner.tune_kernel("flash_attention_fwd",
+                      {"sq": 2048, "sk": 2048, "d": 128},
+                      registry=MetricRegistry(), log=lambda m: None)
+    with pallas_config.flash_block_override(fwd=(128, 128)):
+        assert pallas_config.flash_blocks("fwd", 2048, 2048, 128) == \
+            (128, 128)
+
+
+def test_flat_adam_geometry_consults_tuned_entry(tuning_env):
+    r = tuner.tune_kernel("flat_adam", {"n": 2_000_000},
+                          registry=MetricRegistry(), log=lambda m: None)
+    p = r["entry"]["params"]
+    assert geometry.flat_adam_geometry(2_000_000) == \
+        (p["block_rows"], p["cols"])
+    # a tiny leaf in another bucket keeps its size-aware default
+    assert geometry.flat_adam_geometry(1) == (8, 128)
+
+
+def test_geometry_override_wins_during_sweeps(tuning_env):
+    with geometry.override("flat_adam", {"block_rows": 16, "cols": 256}):
+        assert geometry.flat_adam_geometry(10_000_000) == (16, 256)
+    assert geometry.flat_adam_geometry(10_000_000) != (16, 256)
+    with pytest.raises(ValueError):
+        with geometry.override("nope", {}):
+            pass
+
+
+def test_tune_all_covers_every_kernel(tuning_env):
+    results = tuner.tune_all(
+        shapes={"flat_adam": {"n": 1_000_000},
+                "flash_attention_fwd": {"sq": 512, "sk": 512, "d": 64},
+                "flash_attention_bwd": {"sq": 512, "sk": 512, "d": 64},
+                "layer_norm": {"rows": 1024, "h": 1024},
+                "rms_norm": {"rows": 1024, "h": 1024},
+                "fused_softmax": {"rows": 64, "sk": 32768}},
+        registry=MetricRegistry(), log=lambda m: None)
+    kernels = {r["kernel"] for r in results}
+    assert kernels == set(tuner.search_space.KERNELS)
+    assert all("entry" in r for r in results), results
+    # one write at the end carries every kernel
+    entries = cache.entries_for(device_kind="cpu")
+    assert set(entries) == kernels
+
+
+def test_cli_json_and_export(tuning_env, tmp_path, capsys):
+    from apex_tpu.tuning.__main__ import main
+
+    export = tmp_path / "TUNING_EXPORT.json"
+    rc = main(["--kernel", "layer_norm", "--export", str(export),
+               "--json"])
+    assert rc == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert out["results"][0]["kernel"] == "layer_norm"
+    exported = json.load(open(export))
+    assert exported["schema_version"] == cache.SCHEMA_VERSION
+    assert "layer_norm" in exported["entries"]["cpu"]
+
+
+def test_write_merges_never_destroys_other_devices(tuning_env):
+    """Review regression: a CPU roofline write must merge into the
+    on-disk cache, not replace it — measured TPU entries are provenance
+    evidence for _KERNEL_AUTO pins."""
+    c = cache.empty()
+    cache.put(c, "TPU v5 lite", "flat_adam", "n~1024",
+              {"params": {"block_rows": 64, "cols": 512},
+               "pallas_ms": 1.0, "xla_ms": 2.0, "use_pallas": True,
+               "source": "measured", "dims": {}})
+    cache.save(c)
+    tuner.tune_kernel("layer_norm", {"rows": 1024, "h": 1024},
+                      cache_dict=cache.empty(), write=True, apply=False,
+                      registry=MetricRegistry(), log=lambda m: None)
+    final = cache.load()
+    assert "TPU v5 lite" in final["entries"]
+    assert "layer_norm" in final["entries"]["cpu"]
+
+
+def test_live_runner_sweep_sees_each_candidates_geometry(tuning_env):
+    """Review regression: the flat_adam live runner must hand EACH
+    candidate's geometry to the kernel's static jit key — a (None, None)
+    static would pin the first candidate's trace for the whole sweep."""
+    from unittest import mock
+
+    import jax
+
+    from apex_tpu.ops import fused_adam_kernel as fak
+    from apex_tpu.tuning import geometry, measure
+
+    make_fn, carry, chain, k = measure.live_runner("flat_adam",
+                                                   {"n": 40000})
+    seen = []
+    real = fak._adam_flat_pallas
+
+    def spy(*a, **kw):
+        seen.append((kw.get("block_rows"), kw.get("cols")))
+        return real(*a, **kw)
+
+    with mock.patch.object(fak, "_adam_flat_pallas", side_effect=spy):
+        for cand in ({"block_rows": 8, "cols": 256},
+                     {"block_rows": 16, "cols": 128}):
+            with geometry.override("flat_adam", cand):
+                with pallas_config.force("interpret"):
+                    jax.block_until_ready(make_fn()(*carry))
+    assert seen == [(8, 256), (16, 128)], seen

@@ -130,6 +130,65 @@ def summarize_sharding(path, fam):
                   f"{_fmt_bytes(vals.get('peak_hbm_bytes', 0)):>12s}")
 
 
+def render_tuning_family(path):
+    """The ``tuning/*`` family from a metrics JSONL dump (None when the
+    file carries none): per-kernel cache hit/miss and race-winner
+    counters plus the best-candidate vs XLA-fallback gauges the
+    autotuner emitted (apex_tpu.tuning / bench.py ISSUE 6)."""
+    kernels: dict = {}
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        name = rec.get("name", "")
+        if not isinstance(name, str) or not name.startswith("tuning/"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        kernel = labels.get("kernel", "?")
+        row = kernels.setdefault(kernel, {})
+        key = name[len("tuning/"):]
+        if key in ("cache_hit", "cache_miss", "race_won_pallas",
+                   "race_won_xla", "candidate_error"):
+            row[key] = row.get(key, 0) + (rec.get("value") or 0)
+        elif key in ("best_pallas_ms", "xla_ms"):
+            row[key] = rec.get("value")
+            if "bucket" in labels:
+                row["bucket"] = labels["bucket"]
+    return {"kernels": kernels} if kernels else None
+
+
+def summarize_tuning(path, fam):
+    print(f"{path}: tuning/* family")
+    width = max(len(k) for k in fam["kernels"])
+    print(f"  {'kernel':{width}s}  {'hit':>5s}  {'miss':>5s}  "
+          f"{'race':>9s}  {'pallas ms':>10s}  {'xla ms':>10s}")
+    for kernel, row in sorted(fam["kernels"].items()):
+        np_, nx = row.get("race_won_pallas", 0), row.get("race_won_xla", 0)
+        # mixed outcomes (several buckets / accumulated runs) must not
+        # read as a clean pallas win — dispatch ANDs its verdicts
+        race = ("-" if not (np_ or nx)
+                else "pallas" if not nx
+                else "xla" if not np_
+                else f"p:{np_}/x:{nx}")
+        def ms(key):
+            v = row.get(key)
+            return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+        line = (f"  {kernel:{width}s}  {row.get('cache_hit', 0):>5d}  "
+                f"{row.get('cache_miss', 0):>5d}  {race:>9s}  "
+                f"{ms('best_pallas_ms'):>10s}  {ms('xla_ms'):>10s}")
+        if row.get("candidate_error"):
+            line += f"  ({row['candidate_error']} candidate error(s))"
+        print(line)
+
+
 def render_resilience_family(path):
     """The ``resilience/*`` counter family from a metrics JSONL dump
     (None when the file carries none): retries, give-ups, preemptions,
@@ -230,6 +289,14 @@ if __name__ == "__main__":
                                       "resilience_family": res}))
                 else:
                     summarize_resilience(arg, res)
+            tun = render_tuning_family(arg) if os.path.isfile(arg) \
+                else None
+            if tun is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "tuning_family": tun}))
+                else:
+                    summarize_tuning(arg, tun)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:

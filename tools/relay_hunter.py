@@ -198,6 +198,15 @@ def main():
                 json.dump(parsed, f, indent=1)
             if rc_v == 0 and rc_b == 0:
                 log_probe(event="SUCCESS", file=LIVE_JSON)
+                # tile-sweep autotune while the chip answers (ISSUE 6):
+                # winners persist in the per-device tuning cache plus a
+                # repo-committable export, so tuned tiles + race
+                # verdicts survive the window (failure is non-fatal)
+                rc_t, _ = run_child(
+                    ["bash", "tools/tune.sh", "--export",
+                     os.path.join(REPO, "TUNING_CACHE.json")],
+                    timeout=3600, log_path=BENCH_LOG, header="tune")
+                log_probe(event="tune", rc=rc_t)
                 # bonus evidence while the window is open: an xplane
                 # trace of the flagship step (failure is non-fatal)
                 rc_p, _ = run_child(
