@@ -37,6 +37,19 @@ lived. Checks:
                       Resilience must be explicit — retry transient
                       classes via ``apex_tpu.resilience.retry.Policy``,
                       or at least count/log before continuing.
+- ``unclosed-span``   an ``apex_tpu.observability`` ``span(...)``/
+                      ``scope(...)`` call in ``apex_tpu/`` or
+                      ``examples/`` that is not the context expression
+                      of a ``with`` (or an ``ExitStack.enter_context``
+                      argument): a span opened without its guaranteed
+                      close leaks an entry on the tracer's open-span
+                      stack forever — the flight recorder then reports
+                      a phantom in-flight region on every dump, nesting
+                      depths of later spans are wrong, and the paired
+                      profiler TraceAnnotation never pops. Manual
+                      ``__enter__``/``__exit__`` pairing inside another
+                      context manager's protocol is the one sanctioned
+                      shape (suppress with a justification).
 - ``hardcoded-tile-size``
                       an integer tile constant fed to ``pl.BlockSpec``
                       outside ``ops/pallas_config.py`` and the tuner's
@@ -65,7 +78,7 @@ from apex_tpu.analysis.findings import Finding, is_suppressed
 AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "mutable-default", "raw-clock",
               "swallowed-exception-in-step-loop",
-              "hardcoded-tile-size")
+              "hardcoded-tile-size", "unclosed-span")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -104,6 +117,18 @@ def _swallowed_exc_applies(path: str) -> bool:
     secondary work."""
     parts = path.replace("\\", "/").split("/")[:-1]
     return "apex_tpu" in parts or "examples" in parts
+
+
+# unclosed-span polices the same ground as swallowed-exception: the
+# library + examples, where instrumented hot paths live. Span/scope
+# names must resolve (through the module's imports) into the
+# observability package — a local helper that happens to be called
+# `span` is not a tracer span.
+_SPAN_NAMES = ("span", "scope")
+
+
+def _unclosed_span_applies(path: str) -> bool:
+    return _swallowed_exc_applies(path)
 
 
 # hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
@@ -244,6 +269,10 @@ class _Visitor(ast.NodeVisitor):
         # BlockSpecs (lint_source pairs the two after the walk)
         self.blockspec_seen = False
         self.tile_consts = []  # (lineno, name, value)
+        # unclosed-span: Call nodes sanctioned as context-manager uses
+        # (a with item's context expression, an enter_context argument)
+        # — recorded by the parent before the call itself is visited
+        self._cm_calls: set = set()
 
     def visit_Import(self, node):
         for alias in node.names:
@@ -354,6 +383,14 @@ class _Visitor(ast.NodeVisitor):
     visit_AsyncFor = visit_For
     visit_While = visit_For
 
+    def visit_With(self, node):
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self._cm_calls.add(id(item.context_expr))
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
     def visit_Try(self, node):
         if self.loop_depth[-1] > 0:
             for handler in node.handlers:
@@ -418,6 +455,25 @@ class _Visitor(ast.NodeVisitor):
 
         if tail == "BlockSpec" and "hardcoded-tile-size" in self.checks:
             self._check_blockspec_shape(node)
+
+        if tail == "enter_context":
+            # stack.enter_context(span(...)) closes at stack exit —
+            # sanction the argument before visiting it
+            for arg in node.args:
+                if isinstance(arg, ast.Call):
+                    self._cm_calls.add(id(arg))
+        if tail in _SPAN_NAMES and "unclosed-span" in self.checks and \
+                id(node) not in self._cm_calls:
+            res = self._resolve(chain)
+            if "observability" in res:
+                self._emit(
+                    "unclosed-span", "error", node.lineno,
+                    f"'{'.'.join(chain)}(...)' opened outside a 'with' "
+                    f"(or ExitStack.enter_context): a span without its "
+                    f"guaranteed close leaks an open-span stack entry "
+                    f"the flight recorder reports forever and corrupts "
+                    f"later spans' nesting — use 'with "
+                    f"{'.'.join(chain)}(...):' around the region")
 
         if tail == "block_until_ready" or (
                 isinstance(node.func, ast.Attribute)
@@ -500,6 +556,9 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # swallowed-exception: step loops live in apex_tpu/ and examples/
     if not _swallowed_exc_applies(abspath or relpath):
         checks = checks - {"swallowed-exception-in-step-loop"}
+    # unclosed-span: same ground — instrumented library + example code
+    if not _unclosed_span_applies(abspath or relpath):
+        checks = checks - {"unclosed-span"}
     # hardcoded-tile-size: pallas_config + the tuner search space are
     # the sanctioned homes for tile numbers
     if not _tile_size_applies(abspath or relpath):

@@ -14,8 +14,12 @@ The single layer the whole stack reports through:
   budget guard that fails a run on steady-state retraces;
 - :mod:`~apex_tpu.observability.step_report` — per-training-step
   records (step time, tokens/s, MFU, loss scale, overflow count);
+- :mod:`~apex_tpu.observability.profiling` — span tracing (ring
+  buffer + Perfetto export), per-step phase attribution, xplane
+  device attribution, and the stall flight recorder (ISSUE 7);
 - ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
-  summary CLI (also ``tools/metrics_report.py``).
+  summary CLI (also ``tools/metrics_report.py``); ``... trace <run>``
+  exports a span dump or xplane capture as Perfetto JSON.
 
 The modules themselves import jax lazily and never force backend init —
 but importing them through the ``apex_tpu`` package still runs the
@@ -48,6 +52,14 @@ from apex_tpu.observability.recompile import (  # noqa: F401
 from apex_tpu.observability.recompile import (  # noqa: F401
     uninstall as uninstall_recompile_listener,
 )
+from apex_tpu.observability.profiling import (  # noqa: F401
+    FlightRecorder,
+    SpanTracer,
+    StepPhases,
+    get_tracer,
+    set_tracer,
+    span,
+)
 from apex_tpu.observability.scope import annotate, scope  # noqa: F401
 from apex_tpu.observability.step_report import (  # noqa: F401
     STEP_RECORD_FIELDS,
@@ -63,6 +75,8 @@ __all__ = [
     "RecompileListener", "RetraceBudgetExceeded", "retrace_guard",
     "install_recompile_listener", "uninstall_recompile_listener",
     "scope", "annotate",
+    "span", "SpanTracer", "get_tracer", "set_tracer",
+    "StepPhases", "FlightRecorder",
     "StepReporter", "STEP_RECORD_FIELDS", "peak_flops",
     "transformer_step_flops",
 ]

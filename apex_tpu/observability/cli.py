@@ -1,11 +1,17 @@
-"""``python -m apex_tpu.observability report <metrics.jsonl> [...]``
+"""``python -m apex_tpu.observability {report,trace} ...``
 
-Summarize one or more metrics JSONL dumps (bench.py's
-``BENCH_METRICS.jsonl``, a training run's step log): counters sum,
-gauges keep their last value, histogram/timer stats merge exactly,
-events print in order. ``--json`` emits the merged summary as JSON for
-scripting; ``--events`` limits how many event lines print (default 20,
-0 = all).
+``report <metrics.jsonl> [...]`` summarizes one or more metrics JSONL
+dumps (bench.py's ``BENCH_METRICS.jsonl``, a training run's step log):
+counters sum, gauges keep their last value, histogram/timer stats
+merge exactly, events print in order. ``--json`` emits the merged
+summary as JSON for scripting; ``--events`` limits how many event
+lines print (default 20, 0 = all).
+
+``trace <run> [--out trace.json]`` exports a Perfetto-loadable
+trace-event JSON (open at ``ui.perfetto.dev``) from any of:
+
+- a span dump (``SpanTracer.save`` / flight-recorder artifact);
+- an xplane capture (``jax.profiler`` logdir, run dir or .xplane.pb).
 
 Exit codes: 0 ok, 1 no records found, 2 bad usage / unreadable file.
 """
@@ -14,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from apex_tpu.observability.registry import read_jsonl, summarize
@@ -66,6 +73,57 @@ def _render(summary: dict, events_limit: int) -> str:
     return "\n".join(lines)
 
 
+def _trace_events_for(run: str):
+    """(events, source_kind) for a run path: a span dump / flight
+    record (host spans) or an xplane capture dir/file (device ops)."""
+    from apex_tpu.observability import profiling
+
+    if os.path.isfile(run) and run.endswith(".json"):
+        with open(run) as f:
+            head = json.load(f)
+        kind = head.get("kind") if isinstance(head, dict) else None
+        sources = {"apex_tpu.spans": "span-dump",
+                   "apex_tpu.flight_record": "flight-record"}
+        if kind in sources:
+            # both dump kinds embed the identical span/thread_names
+            # layout; decode the payload already in hand (a ring dump
+            # is multi-MB — re-parsing it via load_spans doubled the
+            # work) through the one shared schema gate
+            spans, names = profiling.decode_span_payload(
+                head, where=run, kinds=tuple(sources))
+            return profiling.to_trace_events(
+                spans, thread_names=names,
+                pid=head.get("pid", 0)), sources[kind]
+        raise ValueError(
+            f"{run}: JSON is neither a span dump nor a flight record")
+    # anything else: treat as an xplane capture location
+    return profiling.capture_trace_events(run), "xplane"
+
+
+def trace_main(args) -> int:
+    try:
+        events, source = _trace_events_for(args.run)
+    except (OSError, ValueError, ImportError) as e:
+        print(f"cannot read {args.run}: {e}", file=sys.stderr)
+        return 2
+    if not any(ev.get("ph") in ("B", "E", "X") for ev in events):
+        print(f"no trace events in {args.run}", file=sys.stderr)
+        return 1
+    base = args.run.rstrip("/")
+    out = args.out or (os.path.splitext(base)[0] + ".perfetto.json")
+    try:
+        with open(out, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                      f)
+    except OSError as e:
+        print(f"cannot write {out}: {e}", file=sys.stderr)
+        return 2
+    n = sum(1 for ev in events if ev.get("ph") in ("B", "X"))
+    print(f"wrote {out} ({n} span(s) from {source}; open at "
+          f"ui.perfetto.dev)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.observability",
@@ -77,7 +135,16 @@ def main(argv=None) -> int:
                     help="emit the merged summary as JSON")
     rp.add_argument("--events", type=int, default=20,
                     help="max event lines to print (0 = all)")
+    tp = sub.add_parser(
+        "trace", help="export a Perfetto trace-event JSON from a span "
+                      "dump, flight record, or xplane capture")
+    tp.add_argument("run", help="span dump .json, flight record .json, "
+                                "or jax.profiler logdir/.xplane.pb")
+    tp.add_argument("--out", default="",
+                    help="output path (default: <run>.perfetto.json)")
     args = ap.parse_args(argv)
+    if args.cmd == "trace":
+        return trace_main(args)
 
     records = []
     for path in args.paths:

@@ -173,7 +173,9 @@ class Timer(Histogram):
         if self._start is not None:
             raise RuntimeError(f"timer {self.name!r} is already running")
         from apex_tpu.observability.scope import scope
-        self._scope_cm = scope(f"timer/{self.name}")
+        # manual enter is the Timer's own CM protocol: stop()/cancel()
+        # guarantee the paired __exit__ on every path
+        self._scope_cm = scope(f"timer/{self.name}")  # apex-lint: disable=unclosed-span
         self._scope_cm.__enter__()
         self._start = time.perf_counter()
 

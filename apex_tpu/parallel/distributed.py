@@ -46,7 +46,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.observability import scope
+from apex_tpu.observability import span
 from apex_tpu.ops.flat import flatten_tree, unflatten_tree
 
 
@@ -71,7 +71,7 @@ def sync_gradients(grads, axis_name: str = "data", gradient_average: bool = True
             g = g * jnp.asarray(gradient_predivide_factor / n, g.dtype)
         return g
 
-    with scope("ddp/allreduce"):
+    with span("ddp/allreduce"):
         return jax.tree_util.tree_map(reduce_leaf, grads)
 
 
@@ -81,11 +81,11 @@ def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool =
     The explicit analog of the reference's flat NCCL buckets
     (ref apex/parallel/distributed.py:flat_dist_call).
     """
-    with scope("ddp/allreduce_flat"):
+    with span("ddp/allreduce_flat"):
         bufs, meta = flatten_tree(grads)
         reduced = {}
         for k, buf in bufs.items():
-            with scope(f"ddp/bucket/{k}"):
+            with span(f"ddp/bucket/{k}"):
                 r = jax.lax.psum(buf, axis_name)
                 if gradient_average:
                     # static axis size, not psum(ones): the probe would
@@ -130,7 +130,7 @@ def sync_gradients_bucketed(grads, axis_name: str = "data",
         n_buckets = max(bucket_ids) + 1 if bucket_ids else 0
         for b in range(n_buckets):
             members = [i for i, bid in zip(idxs, bucket_ids) if bid == b]
-            with scope(f"ddp/bucket{b}/{dt}"):
+            with span(f"ddp/bucket{b}/{dt}"):
                 flat = jnp.concatenate([leaves[i].ravel() for i in members])
                 red = jax.lax.psum(flat, axis_name)
                 if gradient_average:

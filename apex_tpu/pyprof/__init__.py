@@ -1,19 +1,22 @@
-"""Profiling (TPU re-design of ``apex.pyprof``; ref apex/pyprof/*).
+"""LEGACY shim — profiling lives in :mod:`apex_tpu.observability.profiling`.
 
-The reference has three parts: nvtx instrumentation
-(apex/pyprof/nvtx/nvmarker.py), an nvprof-database parser
-(apex/pyprof/parse/parse.py) and a per-op flops/bytes report
-(apex/pyprof/prof/prof.py). The TPU analogs:
+This package keeps the reference's ``apex.pyprof`` API names (``init``,
+``nvtx.range_push/pop``, ``annotate``, ``wrap``) so reference-style
+instrumentation ports unchanged, and hosts the xplane parser/report
+internals (:mod:`~apex_tpu.pyprof.parse`, :mod:`~apex_tpu.pyprof.prof`)
+the new layer consumes. Everything user-facing delegates:
 
-- instrumentation (this module): ``jax.profiler`` annotations under the
-  pyprof API names (``init``, ``nvtx.range_push/pop``, ``wrap``) so
-  reference-style instrumentation ports unchanged; traces land in
-  TensorBoard/Perfetto instead of nvprof;
-- :mod:`apex_tpu.pyprof.parse` — xplane capture → per-op records with
-  exclusive-time attribution;
-- :mod:`apex_tpu.pyprof.prof` — records → per-op / per-category report
-  (flops, bytes and roofline bound merged from the capture when a
-  device plane is present). CLI: ``tools/trace_report.py``.
+- instrumentation → :func:`apex_tpu.observability.profiling.span`
+  (ring buffer + ``TraceAnnotation`` + ``named_scope``) — an
+  ``annotate``/``wrap`` region now also lands in the span ring and in
+  Perfetto exports, not just the live profiler timeline;
+- trace analysis → :mod:`apex_tpu.observability.profiling.xplane`
+  (per-phase device attribution; ``tools/trace_report.py`` is the CLI);
+- stall diagnostics → the
+  :class:`~apex_tpu.observability.profiling.flight_recorder.FlightRecorder`.
+
+New code should import from ``apex_tpu.observability.profiling``
+directly; see docs/profiling.md.
 """
 
 from __future__ import annotations
@@ -21,8 +24,6 @@ from __future__ import annotations
 import contextlib
 import functools
 from typing import Optional
-
-import jax
 
 from apex_tpu.pyprof import parse, prof  # noqa: F401 (re-export)
 from apex_tpu.pyprof.prof import Report  # noqa: F401
@@ -41,22 +42,31 @@ def init(enable_trace: bool = True, trace_dir: str = "/tmp/apex_tpu_trace"):
 def start():
     """Begin a profiler trace (analog of cuda profiler start)."""
     if _enabled and _trace_dir:
+        import jax
+
         jax.profiler.start_trace(_trace_dir)
 
 
 def stop():
     if _enabled and _trace_dir:
+        import jax
+
         jax.profiler.stop_trace()
 
 
 class nvtx:
-    """nvtx-shaped annotation API; ranges become XLA trace annotations."""
+    """nvtx-shaped annotation API; ranges become spans on every
+    timeline (ring buffer, host TraceAnnotation, HLO metadata)."""
 
     _stack = []
 
     @staticmethod
     def range_push(name: str):
-        ctx = jax.profiler.TraceAnnotation(name)
+        from apex_tpu.observability.profiling.spans import span
+
+        # the push/pop pair IS the reference nvtx API — the stack
+        # guarantees the close that a `with` would
+        ctx = span(name)  # apex-lint: disable=unclosed-span
         ctx.__enter__()
         nvtx._stack.append(ctx)
 
@@ -68,18 +78,22 @@ class nvtx:
 
 @contextlib.contextmanager
 def annotate(name: str):
-    with jax.profiler.TraceAnnotation(name):
+    from apex_tpu.observability.profiling.spans import span
+
+    with span(name):
         yield
 
 
 def wrap(fn, name: Optional[str] = None):
     """Decorate ``fn`` so every call is an annotated range (ref pyprof wraps
     torch functions module-wide; explicit opt-in here)."""
+    from apex_tpu.observability.profiling.spans import span
+
     label = name or getattr(fn, "__name__", "fn")
 
     @functools.wraps(fn)
     def wrapped(*a, **kw):
-        with jax.profiler.TraceAnnotation(label):
+        with span(label):
             return fn(*a, **kw)
 
     return wrapped

@@ -50,8 +50,14 @@ class OpRecord:
     category: str        # see CATEGORIES
     duration_ps: int     # inclusive span
     self_ps: int         # exclusive time (minus nested HLO children)
-    flops: float = 0.0   # model flops, when the plane carries them (TPU)
-    bytes_accessed: float = 0.0
+    # model flops when the plane carries them (TPU); None = unmeasured
+    # (same contract as bytes_accessed — a host-only capture must not
+    # fabricate a 0.0)
+    flops: Optional[float] = None
+    # None when the plane carried no bytes stat at all — "unmeasured"
+    # must stay distinguishable from a true measured zero, or every
+    # host-only capture reports a misleading bytes_accessed: 0.0
+    bytes_accessed: Optional[float] = None
     line: str = ""       # xplane line ('XLA Ops', 'Async XLA Ops', ...)
 
 
@@ -201,9 +207,11 @@ def _line_records(plane_name, line, ev_names, stat_names) -> List[OpRecord]:
 
     out = []
     for dur, stats, name, child_box in records:
-        flops = float(stats.get("model_flops", stats.get("flops", 0)) or 0)
-        nbytes = float(stats.get("bytes_accessed",
-                                 stats.get("bytes accessed", 0)) or 0)
+        raw_flops = stats.get("model_flops", stats.get("flops"))
+        flops = None if raw_flops is None else float(raw_flops or 0)
+        raw_bytes = stats.get("bytes_accessed",
+                              stats.get("bytes accessed"))
+        nbytes = None if raw_bytes is None else float(raw_bytes or 0)
         out.append(OpRecord(
             name=name,
             program=str(stats.get("hlo_module", "")),

@@ -43,9 +43,13 @@ class OpSummary:
     occurrences: int
     self_us: float
     total_us: float
-    share: float = 0.0           # of summed exclusive time
-    flops: float = 0.0
-    bytes_accessed: float = 0.0
+    share: float = 0.0           # of summed (measured) exclusive time
+    # None = the capture carried no flops stat for this op (host-only
+    # planes) — distinct from a measured zero, like bytes_accessed
+    flops: Optional[float] = None
+    # None = the capture carried no bytes stat for this op (host-only
+    # planes) — distinct from a measured zero
+    bytes_accessed: Optional[float] = None
     gflops_per_s: float = 0.0    # from xprof hlo_stats when merged
     bound_by: str = ""
 
@@ -120,8 +124,11 @@ class Report:
                 s.occurrences += 1
                 s.self_us += r.self_ps / 1e6
                 s.total_us += r.duration_ps / 1e6
-                s.flops += r.flops
-                s.bytes_accessed += r.bytes_accessed
+                if r.flops is not None:
+                    s.flops = (s.flops or 0.0) + r.flops
+                if r.bytes_accessed is not None:
+                    s.bytes_accessed = (s.bytes_accessed or 0.0) \
+                        + r.bytes_accessed
             return list(by_key.values())
 
         ops = aggregate(main)
@@ -163,15 +170,24 @@ class Report:
     # ---------------------------------------------------------- queries
 
     def by_category(self) -> Dict[str, Dict[str, float]]:
+        """Per-category rollup. ``bytes_accessed`` is ``None`` when no
+        op in the category carried a measured bytes stat (host-only
+        captures) — never a fabricated 0.0; ``share`` divides by the
+        summed *measured* self time (``total_self_us`` is exactly
+        that sum), so shares stay meaningful when some planes carry no
+        timing at all."""
         cats: Dict[str, Dict[str, float]] = {}
         for o in self.ops:
             c = cats.setdefault(o.category, {
-                "self_us": 0.0, "occurrences": 0, "flops": 0.0,
-                "bytes_accessed": 0.0})
+                "self_us": 0.0, "occurrences": 0, "flops": None,
+                "bytes_accessed": None})
             c["self_us"] += o.self_us
             c["occurrences"] += o.occurrences
-            c["flops"] += o.flops
-            c["bytes_accessed"] += o.bytes_accessed
+            if o.flops is not None:
+                c["flops"] = (c["flops"] or 0.0) + o.flops
+            if o.bytes_accessed is not None:
+                c["bytes_accessed"] = (c["bytes_accessed"] or 0.0) \
+                    + o.bytes_accessed
         for c in cats.values():
             c["share"] = (c["self_us"] / self.total_self_us
                           if self.total_self_us else 0.0)
@@ -183,16 +199,22 @@ class Report:
         carried per-op flops (device plane). MFU divides by the step wall
         time ('Steps' markers) when present — busy self-time would flatter
         a step with idle gaps."""
-        flops = sum(o.flops for o in self.ops)
+        flops = sum(o.flops for o in self.ops if o.flops is not None)
         busy_s = self.total_self_us / 1e6
         wall_s = sum(self.steps_us) / 1e6 or busy_s
         out = {"total_flops": flops, "busy_s": busy_s, "wall_s": wall_s,
                "mfu": (flops / wall_s / (peak_tflops * 1e12))
                if wall_s else 0.0}
         if peak_hbm_gbps:
-            nbytes = sum(o.bytes_accessed for o in self.ops)
-            out["hbm_util"] = (
-                nbytes / wall_s / (peak_hbm_gbps * 1e9) if wall_s else 0.0)
+            measured = [o.bytes_accessed for o in self.ops
+                        if o.bytes_accessed is not None]
+            # no op carried a bytes stat => HBM utilization is
+            # UNMEASURED, not zero — omit rather than mislead
+            if measured:
+                nbytes = sum(measured)
+                out["hbm_util"] = (
+                    nbytes / wall_s / (peak_hbm_gbps * 1e9)
+                    if wall_s else 0.0)
         return out
 
     # ----------------------------------------------------------- output

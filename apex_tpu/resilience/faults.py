@@ -9,7 +9,12 @@ kinds a preemptible TPU fleet actually produces:
 - ``ckpt_enospc``  a checkpoint write refused at open (disk full);
 - ``step_exc``     a transient exception out of the train step (the
                    flaky-collective / tunnel-hiccup class);
-- ``nan_grads``    a NaN/overflow storm poisoning the step's output.
+- ``nan_grads``    a NaN/overflow storm poisoning the step's output;
+- ``stall``        a step that hangs far past its normal duration (a
+                   wedged collective / tunnel lease): the loop sleeps
+                   ``stall_s`` inside the step, which is what the
+                   observability flight recorder's watchdog exists to
+                   catch (docs/profiling.md).
 
 Faults fire at fixed steps (``kind@7``) or at seeded per-step draws
 (``kind~0.05``); both are fully deterministic in (seed, kind, step), so
@@ -39,7 +44,8 @@ __all__ = [
     "inject_checkpoint_failures",
 ]
 
-KINDS = ("preempt", "ckpt_torn", "ckpt_enospc", "step_exc", "nan_grads")
+KINDS = ("preempt", "ckpt_torn", "ckpt_enospc", "step_exc", "nan_grads",
+         "stall")
 
 
 class FaultInjected(Exception):

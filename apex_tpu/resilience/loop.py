@@ -36,6 +36,7 @@ Every decision lands as a ``resilience/*`` counter/event in the
 from __future__ import annotations
 
 import sys
+import time
 from typing import Any, Callable, Optional
 
 from apex_tpu import checkpoint as ckpt
@@ -113,6 +114,15 @@ class ResilientTrainLoop:
         armed (``run`` arms it automatically when a plan is present).
     watcher: :class:`~apex_tpu.resilience.preemption.PreemptionWatcher`
         polled after every step.
+    stall_s: how long an injected ``stall`` fault sleeps inside the
+        step (the hang a flight-recorder watchdog is meant to catch).
+    flight_recorder: an
+        :class:`apex_tpu.observability.FlightRecorder` — the loop
+        brackets every step *attempt* with its
+        ``step_started``/``step_finished`` pair (injected faults
+        included, so a chaos ``stall`` is observed exactly like a real
+        hang) and its watchdog dumps a post-mortem when one stalls.
+        The loop does not install() it — callers own its lifecycle.
     validate: ``f(state, metrics, step) -> bool`` health check override.
         Default: every float metric is finite, and every
         ``check_state_every`` steps all inexact state leaves are finite
@@ -133,7 +143,8 @@ class ResilientTrainLoop:
                  max_rollbacks: int = 2, auto_resume: bool = True,
                  deep_validate_resume: bool = False,
                  exit_on_preempt: bool = False, on_resume=None,
-                 registry=None):
+                 registry=None, stall_s: float = 2.0,
+                 flight_recorder=None):
         self.step_fn = step_fn
         self.directory = directory
         self.save_every = save_every
@@ -148,6 +159,8 @@ class ResilientTrainLoop:
         self.exit_on_preempt = exit_on_preempt
         self.on_resume = on_resume
         self._registry = registry
+        self.stall_s = float(stall_s)
+        self.flight_recorder = flight_recorder
         self.manager = (ckpt.CheckpointManager(
             directory, max_to_keep=max_to_keep, async_save=async_save)
             if directory else None)
@@ -306,13 +319,37 @@ class ResilientTrainLoop:
         while step < num_steps:
             # ---- the step itself (transient failures retried)
             def attempt(_step=step, _state=state):
-                if plan is not None and plan.should_fire("step_exc",
-                                                         _step):
-                    reg.counter("resilience/faults_injected",
-                                kind="step_exc").inc()
-                    raise faults_mod.TransientStepError(
-                        f"injected transient failure at step {_step}")
-                return self.step_fn(_state, _step)
+                recorder = self.flight_recorder
+                if recorder is not None:
+                    recorder.step_started(_step)
+                try:
+                    if plan is not None and plan.should_fire(
+                            "step_exc", _step):
+                        reg.counter("resilience/faults_injected",
+                                    kind="step_exc").inc()
+                        raise faults_mod.TransientStepError(
+                            f"injected transient failure at step {_step}")
+                    if plan is not None and plan.should_fire("stall",
+                                                             _step):
+                        # a hung step, not a failed one: the step
+                        # completes after stall_s, so only a watchdog
+                        # (the flight recorder's) observes it — exactly
+                        # the production wedge this simulates
+                        reg.counter("resilience/faults_injected",
+                                    kind="stall").inc()
+                        time.sleep(self.stall_s)
+                    result = self.step_fn(_state, _step)
+                except BaseException:
+                    # a raised attempt's near-zero duration is NOT a
+                    # step time: under a retry storm it would collapse
+                    # the trailing median until every healthy step
+                    # read as a stall
+                    if recorder is not None:
+                        recorder.step_finished(record=False)
+                    raise
+                if recorder is not None:
+                    recorder.step_finished()
+                return result
 
             try:
                 new_state, metrics = self._call(attempt)

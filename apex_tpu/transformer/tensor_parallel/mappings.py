@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.observability import scope
+from apex_tpu.observability import span
 from apex_tpu.transformer import parallel_state
 
 
@@ -110,7 +110,7 @@ def copy_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("tp/copy"):
+    with span("tp/copy"):
         return _to_varying(x, axis)
 
 
@@ -119,7 +119,7 @@ def reduce_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("tp/allreduce"):
+    with span("tp/allreduce"):
         return jax.lax.psum(x, axis)
 
 
@@ -131,7 +131,7 @@ def scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] = None):
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     chunk = x.shape[-1] // n
-    with scope("tp/scatter"):
+    with span("tp/scatter"):
         x = _to_varying(x, axis)
         return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
                                             axis=x.ndim - 1)
@@ -142,7 +142,7 @@ def gather_from_tensor_model_parallel_region(x, axis_name: Optional[str] = None)
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("tp/all_gather"):
+    with span("tp/all_gather"):
         return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
 
@@ -155,7 +155,7 @@ def reduce_scatter_to_tensor_model_parallel_region(x, axis_name: Optional[str] =
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("tp/reduce_scatter"):
+    with span("tp/reduce_scatter"):
         return jax.lax.psum_scatter(x, axis,
                                     scatter_dimension=x.ndim - 1,
                                     tiled=True)
@@ -176,7 +176,7 @@ def scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = None,
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     chunk = x.shape[seq_dim] // n
-    with scope("sp/scatter"):
+    with span("sp/scatter"):
         x = _to_varying(x, axis)
         return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
                                             axis=seq_dim)
@@ -187,7 +187,7 @@ def gather_from_sequence_parallel_region(x, axis_name: Optional[str] = None,
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("sp/all_gather"):
+    with span("sp/all_gather"):
         return jax.lax.all_gather(x, axis, axis=seq_dim, tiled=True)
 
 
@@ -197,6 +197,6 @@ def reduce_scatter_to_sequence_parallel_region(x, axis_name: Optional[str] = Non
     axis = _axis(axis_name)
     if not _axis_bound(axis):
         return x
-    with scope("sp/reduce_scatter"):
+    with span("sp/reduce_scatter"):
         return jax.lax.psum_scatter(x, axis, scatter_dimension=seq_dim,
                                     tiled=True)

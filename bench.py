@@ -183,8 +183,41 @@ def bench_fused_adam(cpu_mode, extras):
     reg.event("kernel_dispatch", component="fused_adam", choice=choice,
               tree_ms=round(tree_t * 1e3, 3),
               flat_ms=round(flat_t * 1e3, 3))
+
+    # per-step phase attribution (ISSUE 7): one fresh instrumented step
+    # through the span layer — tracing runs inside the step window, so
+    # the fused_adam/* hot-path spans plus an explicit data span
+    # decompose the step wall into data/compute/host fractions; the
+    # fractions ride the StepReporter record and the JSON line (device-
+    # side fractions come from an xplane capture via
+    # `python -m apex_tpu.observability trace`)
+    phase_fields = {}
+    try:
+        phases = obs.StepPhases(name="bench/fused_adam_step")
+        tx_p = fused_adam(lr=1e-3, weight_decay=0.01)
+        # init outside the phases window: state allocation is setup,
+        # not step work, and would skew the fractions
+        state_p = tx_p.init(params)
+        t0 = time.perf_counter()
+        with phases.step():
+            with obs.span("data/batch"):
+                g_p = jax.tree_util.tree_map(jnp.copy, grads)
+            u_p, _ = tx_p.update(g_p, state_p, params)
+            _sync(u_p)
+        window_ms = (time.perf_counter() - t0) * 1e3
+        phase_fields = phases.last_fields()
+        # the fractions decompose THIS instrumented window (first
+        # instrumented call: spans fire during trace/eager execution),
+        # not the warm-median fused_t — carry its wall explicitly so
+        # step_time_ms x phases is never the implied (wrong) product
+        phase_fields["phase_window_ms"] = round(window_ms, 3)
+        extras["phase_breakdown"] = phase_fields
+        del g_p, state_p, u_p
+        gc.collect()
+    except Exception as e:  # telemetry must not cost the headline
+        extras["phase_breakdown_error"] = repr(e)[:120]
     obs.StepReporter("fused_adam", registry=reg).step(
-        fused_t, choice=choice)
+        fused_t, choice=choice, **phase_fields)
 
     # eager analog of the reference's baseline (unfused torch.optim.Adam:
     # one kernel per OP per tensor): op-by-op jax dispatch, no jit
@@ -927,6 +960,21 @@ def worker():
             extras["metrics_jsonl"] = os.path.basename(_metrics_path())
         except OSError as e:
             extras["metrics_jsonl_error"] = repr(e)[:120]
+        # span-ring Perfetto export (ISSUE 7): the host-side span
+        # timeline of everything this worker traced and dispatched,
+        # loadable at ui.perfetto.dev (APEX_TPU_PERFETTO overrides the
+        # path) — rewritten before every emit like the metrics JSONL so
+        # a timed-out worker still leaves the trace behind
+        try:
+            perfetto = os.environ.get(
+                "APEX_TPU_PERFETTO",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_SPANS.perfetto.json"))
+            n_spans = obs.get_tracer().write_chrome_trace(perfetto)
+            extras["profiling"] = {
+                "perfetto": os.path.basename(perfetto), "spans": n_spans}
+        except Exception as e:  # telemetry must not cost the JSON line
+            extras["profiling_error"] = repr(e)[:120]
 
     def emit():
         finalize_metrics()

@@ -183,6 +183,10 @@ def main():
 
         reporter = obs.StepReporter("llama_train",
                                     tokens_per_step=M * mb * dp * s)
+        # per-step phase attribution (ISSUE 7): every step runs inside a
+        # span window; the data/compute/comms/host fractions land on the
+        # StepReporter record, so the step log says WHERE the time went
+        phases = obs.StepPhases(name="llama_train/step")
         key = jax.random.PRNGKey(1)
         stats = {"first": None, "last": None}
 
@@ -196,13 +200,19 @@ def main():
             return tokens, jnp.roll(tokens, -1, axis=-1)
 
         def train_step_fn(state, it):
-            tokens, targets = make_batch(it)
-            t0 = time.perf_counter()
-            new_stage, new_io, new_opt, loss = step(
-                state["stage"], state["io"], state["opt"], tokens,
-                targets)
-            loss = float(loss)  # host pull: syncs the whole step chain
-            rec = reporter.step(time.perf_counter() - t0, loss=loss)
+            with phases.step():
+                # t0 before make_batch: step_time_ms must cover the same
+                # window as the phase fractions, or step_time × phases
+                # misattributes the excluded data time
+                t0 = time.perf_counter()
+                with obs.span("data/batch"):
+                    tokens, targets = make_batch(it)
+                new_stage, new_io, new_opt, loss = step(
+                    state["stage"], state["io"], state["opt"], tokens,
+                    targets)
+                loss = float(loss)  # host pull: syncs the step chain
+                dt = time.perf_counter() - t0
+            rec = reporter.step(dt, loss=loss, **phases.last_fields())
             if stats["first"] is None:
                 stats["first"] = loss
             stats["last"] = loss
@@ -218,10 +228,31 @@ def main():
         # SIGTERM/APEX_TPU_PREEMPT forces an emergency save + exit 75,
         # checkpoint I/O is retried, APEX_TPU_FAULT_PLAN injects chaos
         fault_spec = os.environ.get("APEX_TPU_FAULT_PLAN")
+        # stall flight recorder (ISSUE 7): a step that runs past 3x the
+        # trailing median (or APEX_TPU_STALL_DEADLINE seconds) dumps the
+        # span ring, all thread stacks and the last registry events to a
+        # flightrec_*.json post-mortem; its sensor feeds the preemption
+        # watcher so a hung fleet ALSO takes the emergency-checkpoint +
+        # exit-75 path instead of burning its allocation
+        deadline = os.environ.get("APEX_TPU_STALL_DEADLINE")
+        try:
+            deadline_s = float(deadline) if deadline else None
+        except ValueError:
+            raise SystemExit(
+                f"APEX_TPU_STALL_DEADLINE={deadline!r} is not a number "
+                f"(wall-deadline seconds, e.g. 120)")
+        recorder = obs.FlightRecorder(
+            directory=args.checkpoint_dir or None,
+            # 10x median, not the default 3x: a contended CI host can
+            # jitter a CPU step 3x without anything being wedged, and a
+            # false stall here escalates to exit 75 via the sensor
+            stall_factor=10.0,
+            deadline_s=deadline_s).install()
         watcher = resilience.PreemptionWatcher(
-            sensors=[resilience.env_sensor()]).install()
+            sensors=[resilience.env_sensor(), recorder.sensor()]).install()
         loop = resilience.ResilientTrainLoop(
             train_step_fn,
+            flight_recorder=recorder,
             directory=args.checkpoint_dir or None,
             save_every=args.save_every, max_to_keep=2,
             retry_policy=resilience.Policy(max_attempts=3, name="llama"),
@@ -238,6 +269,7 @@ def main():
                       "opt": opt_state}, args.steps)
         finally:
             watcher.uninstall()
+            recorder.uninstall()
 
     if stats["first"] is None:
         print(f"nothing to do: resumed step + 1 "

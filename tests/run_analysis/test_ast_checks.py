@@ -701,3 +701,124 @@ def test_tile_clean_tree():
         [os.path.join(repo, "apex_tpu"), os.path.join(repo, "bench.py")],
         root=repo, checks=(_TILE,)) if f.check == _TILE]
     assert not found, "\n".join(f.render() for f in found)
+
+
+# --------------------------------------------------------- unclosed-span
+
+_UNCLOSED = "unclosed-span"
+
+
+def test_unclosed_span_assignment_flagged():
+    src = """
+from apex_tpu.observability import span
+
+def hot_path():
+    ctx = span("pp/forward")
+    ctx.__enter__()
+"""
+    found = _by_check(lint_source(src, "apex_tpu/a.py",
+                                  abspath="/r/apex_tpu/a.py"), _UNCLOSED)
+    assert len(found) == 1
+    assert found[0].line == 5
+    assert "with" in found[0].message
+
+
+def test_unclosed_span_bare_statement_flagged():
+    """A span() whose CM is simply dropped never closes at all."""
+    src = """
+from apex_tpu.observability.profiling.spans import span
+
+def f():
+    span("lost")
+"""
+    assert len(_by_check(lint_source(
+        src, "apex_tpu/a.py", abspath="/r/apex_tpu/a.py"),
+        _UNCLOSED)) == 1
+
+
+def test_unclosed_scope_and_attribute_form_flagged():
+    """The legacy scope() helper and the obs.span attribute form are
+    policed identically."""
+    src = """
+from apex_tpu import observability as obs
+from apex_tpu.observability import scope
+
+def f():
+    cm = scope("timer/x")
+    cm2 = obs.span("step")
+    return cm, cm2
+"""
+    found = _by_check(lint_source(src, "apex_tpu/a.py",
+                                  abspath="/r/apex_tpu/a.py"), _UNCLOSED)
+    assert {f.line for f in found} == {6, 7}
+
+
+def test_with_and_enter_context_forms_clean():
+    src = """
+import contextlib
+
+from apex_tpu.observability import span, scope
+
+def f():
+    with span("outer"), scope("inner"):
+        pass
+    with contextlib.ExitStack() as st:
+        st.enter_context(span("stacked"))
+"""
+    assert not _by_check(lint_source(
+        src, "apex_tpu/a.py", abspath="/r/apex_tpu/a.py"), _UNCLOSED)
+
+
+def test_local_span_helper_not_flagged():
+    """A local function that happens to be named span is not a tracer
+    span — the name must resolve into the observability package."""
+    src = """
+def span(n):
+    return n
+
+def f():
+    return span("just a string")
+"""
+    assert not _by_check(lint_source(
+        src, "apex_tpu/a.py", abspath="/r/apex_tpu/a.py"), _UNCLOSED)
+
+
+def test_unclosed_span_scoped_to_library_and_examples():
+    src = """
+from apex_tpu.observability import span
+ctx = span("x")
+"""
+    assert _by_check(lint_source(src, "examples/a.py",
+                                 abspath="/r/examples/a.py"), _UNCLOSED)
+    # driver plumbing (tools/, bench.py) is out of scope
+    assert not _by_check(lint_source(src, "tools/a.py",
+                                     abspath="/r/tools/a.py"), _UNCLOSED)
+
+
+def test_unclosed_span_suppressible():
+    src = """
+from apex_tpu.observability import span
+
+class Managed:
+    def __enter__(self):
+        self._cm = span("managed")  # apex-lint: disable=unclosed-span
+        return self._cm.__enter__()
+"""
+    assert not _by_check(lint_source(
+        src, "apex_tpu/a.py", abspath="/r/apex_tpu/a.py"), _UNCLOSED)
+
+
+def test_unclosed_span_clean_tree():
+    """The live tree is at 0 findings: every hot-path span (pp/tp/ddp/
+    fused-adam), the pyprof shim and the registry Timer are either
+    with-form or carry a justified suppression."""
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
+        root=repo, checks=(_UNCLOSED,)) if f.check == _UNCLOSED]
+    assert not found, "\n".join(f.render() for f in found)

@@ -1,0 +1,336 @@
+"""Stall flight recorder (ISSUE 7 tentpole piece 4; acceptance: an
+injected stall produces a dump artifact with thread stacks) and the
+xplane phase-attribution rollup (tentpole piece 2)."""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.observability.profiling import (
+    FlightRecorder,
+    SpanTracer,
+    set_tracer,
+    span,
+)
+
+
+@pytest.fixture
+def tracer():
+    t = SpanTracer(capacity=128)
+    prev = set_tracer(t)
+    yield t
+    set_tracer(prev)
+
+
+def _recorder(tmp_path, tracer, reg, **kw):
+    kw.setdefault("deadline_s", 0.2)
+    kw.setdefault("poll_s", 0.05)
+    return FlightRecorder(directory=str(tmp_path), tracer=tracer,
+                          registry=reg, **kw)
+
+
+# ------------------------------------------------------------ watchdog
+
+def test_deadline_stall_dumps(tmp_path, tracer):
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg)
+    with rec:
+        rec.step_started(0)
+        with span("pp/forward"):
+            time.sleep(0.6)
+        rec.step_finished()
+    assert rec.stalled and rec.dumps
+    payload = json.loads(open(rec.dumps[0]).read())
+    assert payload["kind"] == "apex_tpu.flight_record"
+    assert payload["reason"].startswith("step 0 stalled")
+    assert payload["step"] == 0
+    # the dump says WHERE the run was stuck: the open span...
+    open_names = [f["name"] for frames in payload["open_spans"].values()
+                  for f in frames]
+    assert "pp/forward" in open_names
+    # ...and every thread's Python stack (the sleeping main thread
+    # shows the sleep frame)
+    stacks = payload["thread_stacks"]
+    assert any("time.sleep" in line for s in stacks.values()
+               for line in s["stack"])
+    assert any(s["thread"] == "MainThread" for s in stacks.values())
+    assert reg.counter("observability/flight_dumps").value == 1
+
+
+def test_replayed_step_stall_dumps_again(tmp_path, tracer):
+    """A rollback replays the same step index; a second stall on that
+    index must leave its own post-mortem (dedup is per-attempt, not
+    per-index-forever)."""
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg)
+    with rec:
+        for _ in range(2):
+            rec.step_started(7)
+            deadline = time.monotonic() + 5
+            seen = len(rec.dumps)
+            while len(rec.dumps) == seen and time.monotonic() < deadline:
+                time.sleep(0.02)
+            rec.step_finished(record=False)  # attempt "raised"
+    assert len(rec.dumps) == 2
+    assert reg.counter("observability/flight_dumps").value == 2
+
+
+def test_trailing_median_threshold(tmp_path, tracer):
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=None,
+                    stall_factor=3.0, min_history=3)
+    assert rec.threshold_s() is None  # unarmed: no history, no deadline
+    for _ in range(4):
+        rec.step_started(0)
+        rec.step_finished(duration_s=0.1)
+    assert rec.threshold_s() == pytest.approx(0.3)
+    # deadline tightens the median leg when smaller
+    rec.deadline_s = 0.05
+    assert rec.threshold_s() == pytest.approx(0.05)
+
+
+def test_healthy_steps_never_dump(tmp_path, tracer):
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=5.0)
+    with rec:
+        for i in range(3):
+            rec.step_started(i)
+            time.sleep(0.01)
+            rec.step_finished()
+    assert not rec.dumps and not rec.stalled
+    assert not list(tmp_path.glob("flightrec_*"))
+
+
+def test_stall_factor_must_exceed_one(tmp_path):
+    with pytest.raises(ValueError, match="stall_factor"):
+        FlightRecorder(directory=str(tmp_path), stall_factor=1.0)
+
+
+def test_manual_dump_and_sensor(tmp_path, tracer):
+    reg = MetricRegistry()
+    reg.event("train_started", step=0)
+    rec = _recorder(tmp_path, tracer, reg)
+    assert rec.sensor()() == ""  # no stall yet: sensor is falsy
+    path = rec.dump(reason="operator request")
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "operator request"
+    assert [e["name"] for e in payload["events"]] == ["train_started"]
+    assert not rec.sensor()()  # manual dump is not a stall
+
+
+def test_sigquit_dumps(tmp_path, tracer):
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=None)
+    with rec:
+        os.kill(os.getpid(), signal.SIGQUIT)
+        deadline = time.monotonic() + 5
+        while not rec.dumps and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert rec.dumps
+    payload = json.loads(open(rec.dumps[0]).read())
+    assert payload["trigger"] == "signal"
+    assert "SIGQUIT" in payload["reason"]
+    # handler restored on uninstall
+    assert signal.getsignal(signal.SIGQUIT) != rec._on_signal
+
+
+def test_double_install_keeps_original_handler(tmp_path, tracer):
+    """install() twice (e.g. ``with rec.install():``) must not save the
+    recorder's own handler as the 'previous' one — uninstall() has to
+    restore the process's ORIGINAL SIGQUIT disposition."""
+    original = signal.getsignal(signal.SIGQUIT)
+    rec = _recorder(tmp_path, tracer, MetricRegistry(), deadline_s=None)
+    with rec.install():  # __enter__ re-runs install()
+        assert signal.getsignal(signal.SIGQUIT) == rec._on_signal
+    assert signal.getsignal(signal.SIGQUIT) == original
+
+
+def test_dump_failure_is_counted_not_fatal(tmp_path, tracer):
+    reg = MetricRegistry()
+    rec = FlightRecorder(directory=str(tmp_path / "file-in-the-way"),
+                         tracer=tracer, registry=reg)
+    (tmp_path / "file-in-the-way").write_text("not a directory")
+    assert rec.dump(reason="will fail") is None
+    assert reg.counter("observability/flight_dump_failures").value == 1
+
+
+# --------------------------------------- resilience fault-hook stall
+
+def test_injected_stall_fault_produces_dump(tmp_path, tracer):
+    """The acceptance path: a FaultPlan ``stall`` injected through
+    ResilientTrainLoop stalls a recorded step; the watchdog dumps a
+    post-mortem with thread stacks while the loop completes normally."""
+    from apex_tpu.resilience import FaultPlan, ResilientTrainLoop
+
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=0.2)
+    steps = []
+
+    def step_fn(state, step):
+        steps.append(step)
+        return state, {"loss": 0.0}
+
+    loop = ResilientTrainLoop(
+        step_fn, fault_plan=FaultPlan.parse("stall@1"), stall_s=0.7,
+        flight_recorder=rec, check_state_every=0, registry=reg)
+    with rec:
+        loop.run({}, 3)
+    assert steps == [0, 1, 2]  # a stall hangs, it doesn't fail
+    assert rec.stalled
+    dumps = list(tmp_path.glob("flightrec_*_stall.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    assert payload["reason"].startswith("step 1 stalled")
+    assert any("time.sleep" in line
+               for s in payload["thread_stacks"].values()
+               for line in s["stack"])
+    assert reg.counter("resilience/faults_injected",
+                       kind="stall").value == 1
+    # the sensor now reads truthy: a PreemptionWatcher wired to it
+    # would escalate into the emergency-checkpoint + exit-75 path
+    assert "stalled" in rec.sensor()()
+
+
+def test_failed_attempts_do_not_feed_stall_history(tmp_path, tracer):
+    """A raised attempt closes the in-flight marker WITHOUT recording
+    its near-zero duration: under a step_exc retry storm the trailing
+    median would otherwise collapse until every healthy step read as a
+    stall (and, sensor-wired, falsely escalated to exit 75)."""
+    from apex_tpu.resilience import (
+        FaultPlan,
+        Policy,
+        ResilientTrainLoop,
+        TransientStepError,
+    )
+
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=None,
+                    min_history=1)
+
+    def step_fn(state, step):
+        time.sleep(0.05)
+        return state, {"loss": 0.0}
+
+    loop = ResilientTrainLoop(
+        step_fn, fault_plan=FaultPlan.parse("step_exc@0+1+2"),
+        retry_policy=Policy(max_attempts=2, initial_backoff=0.0,
+                            retry_on=(TransientStepError,), name="unit"),
+        flight_recorder=rec, check_state_every=0, registry=reg)
+    loop.run({}, 4)
+    hist = list(rec._history)
+    assert len(hist) == 4  # one entry per COMPLETED step, none per raise
+    assert min(hist) > 0.02, hist  # no near-zero retry entries
+    # manual wrap_step follows the same contract
+    wrapped = rec.wrap_step(lambda s, i: (_ for _ in ()).throw(
+        RuntimeError("boom")))
+    with pytest.raises(RuntimeError):
+        wrapped({}, 9)
+    assert len(rec._history) == 4
+
+
+def test_loop_brackets_attempts_without_plan(tmp_path, tracer):
+    """flight_recorder= wiring feeds the step history even on healthy
+    runs (the trailing-median leg arms from real steps)."""
+    from apex_tpu.resilience import ResilientTrainLoop
+
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg, deadline_s=None,
+                    min_history=3)
+    loop = ResilientTrainLoop(
+        lambda state, step: (state, {"loss": 0.0}),
+        flight_recorder=rec, check_state_every=0, registry=reg)
+    loop.run({}, 5)
+    assert rec.threshold_s() is not None  # median armed from history
+
+
+# ----------------------------------------------- xplane phase rollup
+
+class _StubReport:
+    """Duck-typed pyprof Report: by_category() + steps_us/async_ops."""
+
+    def __init__(self, cats, steps_us=(), async_us=()):
+        self._cats = cats
+        self.steps_us = list(steps_us)
+        self.async_ops = [type("A", (), {"total_us": u})()
+                          for u in async_us]
+
+    def by_category(self):
+        return self._cats
+
+
+def _cat(self_us, bytes_accessed=None, flops=0.0, occurrences=1):
+    return {"self_us": self_us, "occurrences": occurrences,
+            "flops": flops, "bytes_accessed": bytes_accessed,
+            "share": 0.0}
+
+
+def test_attribute_report_phase_rollup():
+    from apex_tpu.observability.profiling.xplane import attribute_report
+
+    report = _StubReport({
+        "matmul": _cat(600.0), "fusion-elementwise": _cat(100.0),
+        "collective": _cat(200.0), "attention-kernel": _cat(50.0),
+        "gather-scatter": _cat(30.0), "data-movement": _cat(20.0),
+    })
+    att = attribute_report(report)
+    assert att.phases["compute"]["self_us"] == pytest.approx(700.0)
+    assert att.phases["comms"]["self_us"] == pytest.approx(200.0)
+    assert sum(att.fractions().values()) == pytest.approx(1.0, abs=0.01)
+    # no bytes measured anywhere: None, never a fabricated 0.0
+    assert all(rec["bytes_accessed"] is None
+               for rec in att.phases.values())
+
+
+def test_attribute_report_bytes_only_when_measured():
+    from apex_tpu.observability.profiling.xplane import attribute_report
+
+    report = _StubReport({
+        "matmul": _cat(100.0, bytes_accessed=4096.0),
+        "collective": _cat(50.0),  # unmeasured
+    })
+    att = attribute_report(report)
+    assert att.phases["compute"]["bytes_accessed"] == 4096.0
+    assert att.phases["comms"]["bytes_accessed"] is None
+
+
+def test_overlap_efficiency_from_step_markers():
+    from apex_tpu.observability.profiling.xplane import attribute_report
+
+    # busy 600 compute + 400 comms over a 600us step wall: the whole
+    # comms side was hidden under compute
+    report = _StubReport({"matmul": _cat(600.0),
+                          "collective": _cat(400.0)},
+                         steps_us=[600.0])
+    att = attribute_report(report)
+    assert att.overlap_efficiency() == pytest.approx(1.0)
+    # fully serialized: wall == compute + comms, nothing hidden
+    report2 = _StubReport({"matmul": _cat(600.0),
+                           "collective": _cat(400.0)},
+                          steps_us=[1000.0])
+    assert attribute_report(report2).overlap_efficiency() == \
+        pytest.approx(0.0)
+    # no step markers (CPU capture): no wall reference
+    report3 = _StubReport({"matmul": _cat(600.0),
+                           "collective": _cat(400.0)})
+    assert attribute_report(report3).overlap_efficiency() is None
+
+
+def test_flight_record_exports_via_trace_cli(tmp_path, tracer):
+    """A flight-recorder artifact is itself a trace source: the CLI
+    turns its span ring into Perfetto JSON."""
+    from apex_tpu.observability.cli import main as cli_main
+
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg)
+    with span("pp/forward"):
+        pass
+    path = rec.dump(reason="unit")
+    out = tmp_path / "fr.perfetto.json"
+    assert cli_main(["trace", path, "--out", str(out)]) == 0
+    payload = json.loads(out.read_text())
+    assert "pp/forward" in {e["name"] for e in payload["traceEvents"]
+                            if e["ph"] == "B"}

@@ -1,0 +1,121 @@
+"""tools/metrics_report.py --compare — the observability regression
+gate (ISSUE 7 satellite: diff two metrics dumps, exit non-zero when
+step-time p50 or a tuning race verdict regresses)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "tools", "metrics_report.py")
+
+
+def _dump(path, p50=100.0, pallas=3, xla=0, extra=()):
+    records = [
+        {"type": "histogram", "name": "llama_0p9b/step_time_ms",
+         "count": 5, "total": 5 * p50, "min": p50, "max": p50,
+         "mean": p50, "p50": p50, "p90": p50, "p99": p50},
+        {"type": "counter", "name": "tuning/race_won_pallas",
+         "labels": {"kernel": "flat_adam"}, "value": pallas},
+        {"type": "counter", "name": "tuning/race_won_xla",
+         "labels": {"kernel": "flat_adam"}, "value": xla},
+        *extra,
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, _TOOL, *args],
+                          capture_output=True, text=True, timeout=240)
+
+
+def test_compare_within_threshold_passes(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p50=100.0)
+    cur = _dump(tmp_path / "cur.jsonl", p50=105.0)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 regression(s)" in proc.stdout
+
+
+def test_compare_p50_regression_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p50=100.0)
+    cur = _dump(tmp_path / "cur.jsonl", p50=150.0)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION llama_0p9b/step_time_ms" in proc.stdout
+    # a looser threshold lets the same diff pass
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.6").returncode == 0
+
+
+def test_compare_race_verdict_flip_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", pallas=3, xla=0)
+    cur = _dump(tmp_path / "cur.jsonl", pallas=1, xla=2)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "tuning race flat_adam" in proc.stdout
+
+
+def test_compare_race_share_wobble_passes(tmp_path):
+    """A noisy share decrease that flips no verdict (majority still
+    pallas, base already had xla wins) is not a regression."""
+    base = _dump(tmp_path / "base.jsonl", pallas=9, xla=1)
+    cur = _dump(tmp_path / "cur.jsonl", pallas=17, xla=3)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_compare_race_clean_kernel_dirtied_fails(tmp_path):
+    """A previously clean-pallas kernel picking up ANY xla win is a
+    dispatch flip even while the majority stays pallas."""
+    base = _dump(tmp_path / "base.jsonl", pallas=9, xla=0)
+    cur = _dump(tmp_path / "cur.jsonl", pallas=9, xla=1)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "tuning race flat_adam" in proc.stdout
+
+
+def test_compare_missing_metric_is_info_not_failure(tmp_path):
+    """A shorter current run (metric only in base) must not fail the
+    gate — absence is not a regression."""
+    base = _dump(tmp_path / "base.jsonl", extra=[
+        {"type": "histogram", "name": "resnet50/step_time_ms",
+         "count": 1, "total": 50.0, "min": 50.0, "max": 50.0,
+         "mean": 50.0, "p50": 50.0}])
+    cur = _dump(tmp_path / "cur.jsonl")
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0
+    assert "only in base" in proc.stdout
+
+
+def test_compare_json_mode(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p50=100.0)
+    cur = _dump(tmp_path / "cur.jsonl", p50=150.0)
+    proc = _run(cur, "--compare", base, "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["regressions"] and payload["base"] == base
+
+
+def test_compare_usage_errors(tmp_path):
+    cur = _dump(tmp_path / "cur.jsonl")
+    assert _run(cur, "--compare").returncode == 2
+    assert _run(cur, "--compare", str(tmp_path / "nope.jsonl")
+                ).returncode == 2
+    base = _dump(tmp_path / "base.jsonl")
+    extra = _dump(tmp_path / "extra.jsonl")
+    assert _run(cur, extra, "--compare", base).returncode == 2
+
+
+def test_compare_tolerates_truncated_dump(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p50=100.0)
+    cur = _dump(tmp_path / "cur.jsonl", p50=100.0)
+    with open(cur, "a") as f:
+        f.write('{"type": "histogram", "name": "x/step_time_ms", "p5')
+    assert _run(cur, "--compare", base).returncode == 0

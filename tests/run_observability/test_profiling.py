@@ -1,0 +1,353 @@
+"""Span tracer, Perfetto export and per-step phase attribution
+(ISSUE 7 tentpole pieces 1 + 3; acceptance: trace-event JSON is
+schema-valid — monotonic ts, balanced B/E, stable pid/tid — and
+StepReporter records carry phase fractions summing to ~1.0)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from apex_tpu.observability import MetricRegistry, StepReporter
+from apex_tpu.observability.profiling import (
+    Span,
+    SpanTracer,
+    StepPhases,
+    classify_span,
+    compute_breakdown,
+    get_tracer,
+    load_spans,
+    set_tracer,
+    span,
+    to_trace_events,
+    write_chrome_trace,
+)
+
+
+@pytest.fixture
+def tracer():
+    """A fresh process tracer, restored afterwards (span() always
+    records into the process-global one)."""
+    t = SpanTracer(capacity=256)
+    prev = set_tracer(t)
+    yield t
+    set_tracer(prev)
+
+
+# ------------------------------------------------------------ the ring
+
+def test_span_records_nesting(tracer):
+    with span("pp/forward"):
+        with span("tp/allreduce"):
+            pass
+    done = tracer.completed()
+    assert [(s.name, s.depth) for s in done] == [
+        ("tp/allreduce", 1), ("pp/forward", 0)]
+    assert done[0].end_ns <= done[1].end_ns
+    assert all(s.duration_ns >= 0 for s in done)
+
+
+def test_ring_wraps_and_reports_drops():
+    t = SpanTracer(capacity=4)
+    for i in range(10):
+        t.begin(f"s{i}")
+        t.end()
+    done = t.completed()
+    assert [s.name for s in done] == ["s6", "s7", "s8", "s9"]
+    assert t.dropped(0) == 6
+    assert t.dropped(done[0].seq) == 0
+
+
+def test_mark_scopes_reads(tracer):
+    with span("before"):
+        pass
+    mark = tracer.mark()
+    with span("after"):
+        pass
+    assert [s.name for s in tracer.completed(mark)] == ["after"]
+
+
+def test_unbalanced_end_is_dropped():
+    t = SpanTracer(capacity=8)
+    t.end()  # nothing open: must not corrupt the ring
+    t.begin("ok")
+    t.end()
+    assert [s.name for s in t.completed()] == ["ok"]
+
+
+def test_open_spans_visible_cross_thread(tracer):
+    release = threading.Event()
+    started = threading.Event()
+
+    def worker():
+        with span("worker/stuck"):
+            started.set()
+            release.wait(5)
+
+    th = threading.Thread(target=worker, name="stuck-thread")
+    th.start()
+    try:
+        assert started.wait(5)
+        open_spans = tracer.open_spans()
+        frames = [f for stack in open_spans.values() for f in stack]
+        assert any(name == "worker/stuck" for name, _age in frames)
+    finally:
+        release.set()
+        th.join()
+    assert not tracer.open_spans()  # closed after the thread finished
+
+
+def test_span_exception_safe(tracer):
+    with pytest.raises(ValueError):
+        with span("failing"):
+            raise ValueError("boom")
+    done = tracer.completed()
+    assert [s.name for s in done] == ["failing"]
+    assert not tracer.open_spans()
+
+
+def test_span_works_inside_jit(tracer):
+    """span() keeps scope()'s device contract: usable inside traced
+    code, where it tags the HLO like the helper it supersedes."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        with span("traced_region"):
+            return x * 2
+
+    assert float(f(jnp.ones(()))) == 2.0
+    assert "traced_region" in [s.name for s in tracer.completed()]
+
+
+# --------------------------------------------------- trace-event export
+
+def _validate_trace_events(events):
+    """The Perfetto schema contract: monotonic ts, per-(pid, tid)
+    balanced and properly nested B/E pairs."""
+    ts = [e["ts"] for e in events if e["ph"] in ("B", "E")]
+    assert ts == sorted(ts), "ts must be non-decreasing"
+    stacks = {}
+    for e in events:
+        if e["ph"] == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks.get(key), f"E without B on {key}"
+            assert stacks[key].pop() == e["name"], "misnested B/E"
+    assert all(not s for s in stacks.values()), "unclosed B events"
+
+
+def test_trace_events_schema_and_stability(tracer, tmp_path):
+    with span("step"):
+        with span("pp/forward"):
+            with span("tp/allreduce"):
+                pass
+        with span("fused_adam/tree"):
+            pass
+    events = tracer.to_trace_events()
+    _validate_trace_events(events)
+    names = {e["name"] for e in events if e["ph"] == "B"}
+    assert names == {"step", "pp/forward", "tp/allreduce",
+                     "fused_adam/tree"}
+    # thread metadata rows precede the events and use renumbered tids
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and all(e["tid"] >= 1 for e in meta)
+    # pid/tid stability: exporting the same ring twice is IDENTICAL
+    assert events == tracer.to_trace_events()
+    path = tmp_path / "trace.json"
+    write_chrome_trace(str(path), tracer.completed(),
+                       thread_names=tracer.thread_names())
+    payload = json.loads(path.read_text())  # valid JSON end to end
+    _validate_trace_events(payload["traceEvents"])
+
+
+def test_zero_duration_span_stays_balanced():
+    """A span whose start and end timestamps are equal (empty body on
+    a coarse monotonic clock) must still export B before its own E —
+    the tie-break regression that produced an unbalanced trace."""
+    spans = [  # commit order: inner pops first, then outer, then later
+        Span("inner", tid=1, start_ns=100, end_ns=100, depth=1, seq=0),
+        Span("outer", tid=1, start_ns=100, end_ns=100, depth=0, seq=1),
+        Span("later", tid=1, start_ns=100, end_ns=200, depth=0, seq=2),
+    ]
+    events = to_trace_events(spans)
+    _validate_trace_events(events)
+    order = [(e["name"], e["ph"]) for e in events if e["ph"] != "M"]
+    assert order == [("outer", "B"), ("inner", "B"), ("inner", "E"),
+                     ("outer", "E"), ("later", "B"), ("later", "E")]
+
+
+def test_span_dump_round_trip(tracer, tmp_path):
+    with span("a"):
+        with span("b"):
+            pass
+    path = tmp_path / "spans.json"
+    n = tracer.save(str(path))
+    assert n == 2
+    spans, names = load_spans(str(path))
+    assert [s.name for s in spans] == [
+        s.name for s in tracer.completed()]
+    assert set(names.values()) <= {t.name for t in threading.enumerate()}
+    _validate_trace_events(to_trace_events(spans, thread_names=names))
+
+
+def test_load_spans_rejects_foreign_json(tmp_path):
+    other = tmp_path / "other.json"
+    other.write_text(json.dumps({"kind": "something_else"}))
+    with pytest.raises(ValueError, match="not an apex_tpu span dump"):
+        load_spans(str(other))
+    future = tmp_path / "future.json"
+    future.write_text(json.dumps({"kind": "apex_tpu.spans",
+                                  "schema_version": 99}))
+    with pytest.raises(ValueError, match="schema_version 99"):
+        load_spans(str(future))
+
+
+# -------------------------------------------------- phase attribution
+
+def test_classify_span_rules():
+    assert classify_span("data/batch") == "data"
+    assert classify_span("tp/allreduce") == "comms"
+    assert classify_span("ddp/bucket/float32") == "comms"
+    # ordering: pp/send_recv is comms even though pp/ is a compute
+    # prefix — the token rules fire before the prefix catch-all
+    assert classify_span("pp/send_recv") == "comms"
+    assert classify_span("pp/forward") == "compute"
+    assert classify_span("fused_adam/flat/pallas") == "compute"
+    assert classify_span("timer/pp_phase/fwd") == "compute"
+    assert classify_span("checkpoint/save") is None
+
+
+def test_step_phases_fractions_sum_to_one(tracer):
+    phases = StepPhases()
+    with phases.step():
+        with span("data/batch"):
+            time.sleep(0.005)
+        with span("pp/forward"):
+            with span("tp/allreduce"):
+                time.sleep(0.005)
+            time.sleep(0.005)
+    fields = phases.last_fields()
+    fracs = fields["phases"]
+    assert set(fracs) == {"data", "compute", "comms", "host"}
+    assert sum(fracs.values()) == pytest.approx(1.0, abs=0.02)
+    # nesting must not double-count: the comms time inside pp/forward
+    # is attributed to comms, not also to compute
+    assert fracs["comms"] > 0.1 and fracs["compute"] > 0.1
+    assert fracs["data"] > 0.1
+
+
+def test_step_phases_feeds_step_reporter(tracer):
+    """The acceptance wiring: StepReporter records carry the phase
+    breakdown with fractions summing to ~1.0."""
+    reg = MetricRegistry()
+    reporter = StepReporter("unit", registry=reg, device_kind="cpu")
+    phases = StepPhases()
+    with phases.step():
+        with span("data/batch"):
+            time.sleep(0.002)
+        with span("fused_adam/tree"):
+            time.sleep(0.002)
+    rec = reporter.step(0.01, **phases.last_fields())
+    assert sum(rec["phases"].values()) == pytest.approx(1.0, abs=0.02)
+    event = [e for e in reg.events() if e["name"] == "step"][-1]
+    assert sum(event["fields"]["phases"].values()) == pytest.approx(
+        1.0, abs=0.02)
+
+
+def test_step_phases_empty_on_ring_overflow():
+    t = SpanTracer(capacity=2)
+    phases = StepPhases(tracer=t)
+    with phases.step():
+        for i in range(8):  # overwrite the step span's window
+            t.begin(f"s{i}")
+            t.end()
+    assert phases.last_fields() == {}
+
+
+def test_compute_breakdown_deep_nesting_no_double_subtraction():
+    """3+-deep nesting (pp/forward_backward > pp/forward >
+    pp/stage_compute — the real llama_train trace shape) must
+    attribute every instant exactly once: the per-span
+    self-minus-descendants formulation double-subtracted grandchildren
+    and misreported 20% of a fully-instrumented step as host."""
+    step = Span("step", tid=1, start_ns=0, end_ns=100, depth=0, seq=0)
+    spans = [
+        step,
+        Span("pp/forward_backward", 1, 0, 100, 1, 1),
+        Span("pp/forward", 1, 10, 90, 2, 2),
+        Span("pp/stage_compute", 1, 20, 80, 3, 3),
+    ]
+    out = compute_breakdown(spans, step)
+    assert out["phases"]["compute"] == pytest.approx(1.0)
+    assert out["phases"]["host"] == 0.0
+    # a comms leaf at depth 3 under two compute ancestors counts once
+    spans[3] = Span("tp/allreduce", 1, 20, 80, 3, 3)
+    out = compute_breakdown(spans, step)
+    assert out["phases"]["comms"] == pytest.approx(0.6)
+    assert out["phases"]["compute"] == pytest.approx(0.4)
+
+
+def test_compute_breakdown_other_thread_overlap():
+    """Classified spans on OTHER threads enter the overlap computation
+    but not the on-thread self-time attribution."""
+    step = Span("step", tid=1, start_ns=0, end_ns=1000, depth=0, seq=10)
+    spans = [
+        step,
+        Span("pp/forward", tid=1, start_ns=0, end_ns=1000, depth=1,
+             seq=11),
+        # an async comms span on another thread, fully overlapping
+        Span("tp/allreduce", tid=2, start_ns=100, end_ns=900, depth=0,
+             seq=12),
+    ]
+    out = compute_breakdown(spans, step)
+    assert out["phases"]["compute"] == pytest.approx(1.0, abs=0.01)
+    assert out["phases"]["comms"] == 0.0  # other thread: overlap only
+    assert out["overlap_efficiency"] == pytest.approx(1.0)
+
+
+def test_hot_paths_record_spans(tracer):
+    """The wired hot path: a fused_adam trace lands its dispatch span
+    in the ring (scope() call sites were upgraded to span())."""
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import fused_adam
+
+    tx = fused_adam(lr=1e-3)
+    params = {"w": jnp.ones((4, 4))}
+    state = tx.init(params)
+    tx.update({"w": jnp.full((4, 4), 1e-3)}, state, params)
+    assert "fused_adam/tree" in [s.name for s in tracer.completed()]
+
+
+# ------------------------------------------------------------ trace CLI
+
+def test_trace_cli_exports_span_dump(tracer, tmp_path):
+    from apex_tpu.observability.cli import main as cli_main
+
+    with span("pp/forward"):
+        with span("tp/allreduce"):
+            pass
+    dump = tmp_path / "spans.json"
+    tracer.save(str(dump))
+    out = tmp_path / "out.perfetto.json"
+    assert cli_main(["trace", str(dump), "--out", str(out)]) == 0
+    payload = json.loads(out.read_text())
+    _validate_trace_events(payload["traceEvents"])
+    assert {e["name"] for e in payload["traceEvents"]
+            if e["ph"] == "B"} == {"pp/forward", "tp/allreduce"}
+
+
+def test_trace_cli_rejects_foreign_json(tmp_path, capsys):
+    from apex_tpu.observability.cli import main as cli_main
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"hello": 1}))
+    assert cli_main(["trace", str(bad)]) == 2
+    assert "neither a span dump nor a flight record" in \
+        capsys.readouterr().err

@@ -7,6 +7,14 @@ tpu_profile.py / trace_report.py:
 
     python tools/metrics_report.py BENCH_METRICS.jsonl
     python tools/metrics_report.py run1.jsonl run2.jsonl --json
+    python tools/metrics_report.py NEW.jsonl --compare BASE.jsonl
+
+``--compare BASE.jsonl`` is the observability analog of the analyzer's
+``--diff`` gate (ISSUE 7): diff this dump against a stored base and
+exit non-zero when any ``*/step_time_ms`` p50 regresses past
+``--compare-threshold`` (default 10%) or any kernel's
+``tuning/race_won_*`` verdict flips toward the XLA fallback — runnable
+in CI against a committed ``BENCH_METRICS.jsonl``.
 
 It also ingests ``python -m apex_tpu.analysis --json`` dumps (detected
 by their ``schema_version`` + ``kind`` header), printing a per-check
@@ -32,9 +40,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from apex_tpu.observability.cli import main  # noqa: E402
+from apex_tpu.observability.registry import read_jsonl  # noqa: E402
 
 # analysis --json schema versions this reader understands
 KNOWN_ANALYSIS_SCHEMAS = (1,)
+
+
+def _read_records(path):
+    """Metrics JSONL via the registry's tolerant reader (its
+    parse-error records pass through harmlessly — every consumer here
+    keys on name/type); None when the file itself is unreadable."""
+    try:
+        return read_jsonl(path)
+    except OSError:
+        return None
 
 
 def load_analysis_report(path):
@@ -82,18 +101,10 @@ def render_sharding_family(path):
     targets = {}  # name -> {"comms_bytes": .., "peak_hbm_bytes": ..}
     checks = {}
     total = None
-    try:
-        with open(path) as f:
-            lines = f.readlines()
-    except OSError:
+    records = _read_records(path)
+    if records is None:
         return None
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        if not isinstance(rec, dict):
-            continue
+    for rec in records:
         name = rec.get("name", "")
         if not isinstance(name, str) or \
                 not name.startswith("analysis/sharding_"):
@@ -136,18 +147,10 @@ def render_tuning_family(path):
     counters plus the best-candidate vs XLA-fallback gauges the
     autotuner emitted (apex_tpu.tuning / bench.py ISSUE 6)."""
     kernels: dict = {}
-    try:
-        with open(path) as f:
-            lines = f.readlines()
-    except OSError:
+    records = _read_records(path)
+    if records is None:
         return None
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        if not isinstance(rec, dict):
-            continue
+    for rec in records:
         name = rec.get("name", "")
         if not isinstance(name, str) or not name.startswith("tuning/"):
             continue
@@ -196,18 +199,10 @@ def render_resilience_family(path):
     emitted by apex_tpu.resilience / bench.py's APEX_TPU_FAULT_PLAN."""
     counters = {}
     events = 0
-    try:
-        with open(path) as f:
-            lines = f.readlines()
-    except OSError:
+    records = _read_records(path)
+    if records is None:
         return None
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        if not isinstance(rec, dict):
-            continue
+    for rec in records:
         name = rec.get("name", "")
         if not isinstance(name, str) or \
                 not name.startswith("resilience/"):
@@ -240,6 +235,146 @@ def summarize_resilience(path, fam):
               f"generic summary below)")
 
 
+def _step_time_p50s(records):
+    """{metric name: p50} for every */step_time_ms histogram/timer
+    record that carries a sampled p50."""
+    out = {}
+    for rec in records:
+        name = rec.get("name", "")
+        if isinstance(name, str) and name.endswith("/step_time_ms") \
+                and rec.get("type") in ("histogram", "timer") \
+                and isinstance(rec.get("p50"), (int, float)):
+            out[name] = float(rec["p50"])
+    return out
+
+
+def _race_wins(records):
+    """{kernel: {"pallas": n, "xla": n}} from tuning/race_won_*
+    counters."""
+    wins = {}
+    for rec in records:
+        name = rec.get("name", "")
+        if rec.get("type") != "counter" or not isinstance(name, str) \
+                or not name.startswith("tuning/race_won_"):
+            continue
+        side = name[len("tuning/race_won_"):]
+        if side not in ("pallas", "xla"):
+            continue
+        kernel = (rec.get("labels") or {}).get("kernel", "?")
+        row = wins.setdefault(kernel, {"pallas": 0, "xla": 0})
+        row[side] += rec.get("value") or 0
+    return wins
+
+
+def compare_metrics(current_path, base_path, threshold=0.10):
+    """Regression diff of two metrics dumps; returns a list of
+    regression strings (empty = gate passes).
+
+    - step-time p50: any ``*/step_time_ms`` histogram present in BOTH
+      dumps whose p50 grew more than ``threshold`` (fractional);
+    - tuning race verdicts: any kernel whose majority winner flipped
+      pallas -> xla, or a previously clean-pallas kernel (zero xla
+      wins) picking up any xla win — binary, no threshold; a noisy
+      share wobble that flips no verdict passes.
+
+    Metrics present in only one dump are reported as info, never
+    failed on: a shorter run is not a regression.
+    """
+    cur = _read_records(current_path) or []
+    base = _read_records(base_path) or []
+    regressions, infos = [], []
+
+    cur_p50, base_p50 = _step_time_p50s(cur), _step_time_p50s(base)
+    for name in sorted(base_p50):
+        if name not in cur_p50:
+            infos.append(f"{name}: only in base (p50 {base_p50[name]:.3f})")
+            continue
+        b, c = base_p50[name], cur_p50[name]
+        if b > 0 and c > b * (1.0 + threshold):
+            regressions.append(
+                f"{name}: p50 {b:.3f} -> {c:.3f} ms "
+                f"(+{(c / b - 1) * 100:.1f}% > {threshold * 100:.0f}%)")
+        else:
+            infos.append(f"{name}: p50 {b:.3f} -> {c:.3f} ms ok")
+    for name in sorted(set(cur_p50) - set(base_p50)):
+        infos.append(f"{name}: new (p50 {cur_p50[name]:.3f})")
+
+    cur_race, base_race = _race_wins(cur), _race_wins(base)
+    for kernel in sorted(base_race):
+        if kernel not in cur_race:
+            infos.append(f"tuning race {kernel}: only in base")
+            continue
+        b, c = base_race[kernel], cur_race[kernel]
+        b_tot, c_tot = b["pallas"] + b["xla"], c["pallas"] + c["xla"]
+        if not b_tot or not c_tot:
+            continue
+        b_share = b["pallas"] / b_tot
+        c_share = c["pallas"] / c_tot
+        # binary flip detection, not share arithmetic: racing is noisy
+        # (one extra xla sample moves the share without any kernel
+        # actually flipping to the fallback)
+        majority_flip = b_share >= 0.5 and c_share < 0.5
+        dirtied = b["xla"] == 0 and c["xla"] > 0
+        if majority_flip or dirtied:
+            regressions.append(
+                f"tuning race {kernel}: pallas share "
+                f"{b_share:.2f} -> {c_share:.2f} "
+                f"(p:{c['pallas']}/x:{c['xla']} vs base "
+                f"p:{b['pallas']}/x:{b['xla']})")
+        else:
+            infos.append(f"tuning race {kernel}: "
+                         f"p:{c['pallas']}/x:{c['xla']} ok")
+    return regressions, infos
+
+
+def run_compare(argv):
+    """Handle ``CURRENT.jsonl --compare BASE.jsonl``; returns the
+    process exit code (0 pass, 1 regression, 2 usage)."""
+    args = list(argv)
+    json_mode = "--json" in args
+    if json_mode:
+        args.remove("--json")
+    threshold = 0.10
+    if "--compare-threshold" in args:
+        i = args.index("--compare-threshold")
+        try:
+            threshold = float(args[i + 1])
+        except (IndexError, ValueError):
+            print("--compare-threshold needs a float", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    i = args.index("--compare")
+    try:
+        base = args[i + 1]
+    except IndexError:
+        print("--compare needs a BASE.jsonl path", file=sys.stderr)
+        return 2
+    del args[i:i + 2]
+    files = [a for a in args if not a.startswith("-")]
+    if len(files) != 1:
+        print("--compare takes exactly one current dump, got "
+              f"{files or 'none'}", file=sys.stderr)
+        return 2
+    for path in (files[0], base):
+        if not os.path.isfile(path):
+            print(f"cannot read {path}", file=sys.stderr)
+            return 2
+    regressions, infos = compare_metrics(files[0], base, threshold)
+    if json_mode:
+        print(json.dumps({"current": files[0], "base": base,
+                          "threshold": threshold,
+                          "regressions": regressions, "info": infos}))
+    else:
+        print(f"{files[0]} vs base {base} "
+              f"(threshold {threshold * 100:.0f}%)")
+        for line in infos:
+            print(f"  {line}")
+        for line in regressions:
+            print(f"  REGRESSION {line}")
+        print(f"{len(regressions)} regression(s)")
+    return 1 if regressions else 0
+
+
 def summarize_analysis(path, data):
     findings = data.get("findings", [])
     by_check = collections.Counter(f.get("check", "?") for f in findings)
@@ -256,6 +391,8 @@ def summarize_analysis(path, data):
 
 if __name__ == "__main__":
     args = sys.argv[1:]
+    if "--compare" in args:
+        sys.exit(run_compare(args))
     json_mode = "--json" in args
     passthrough = []
     handled_any = False

@@ -224,6 +224,19 @@ def main():
                         timeout=600, log_path=BENCH_LOG,
                         header="trace_report")
                     log_probe(event="trace_report", rc=rc_r)
+                    # Perfetto-loadable export of the same capture
+                    # (ISSUE 7): the round's trace evidence opens at
+                    # ui.perfetto.dev without TensorBoard (host-side
+                    # analysis; does not touch the chip)
+                    rc_pf, _ = run_child(
+                        [sys.executable, "-m",
+                         "apex_tpu.observability", "trace",
+                         os.path.join(REPO, "TPU_TRACE_r05"), "--out",
+                         os.path.join(REPO,
+                                      "TPU_TRACE_r05.perfetto.json")],
+                        timeout=600, log_path=BENCH_LOG,
+                        header="perfetto_export")
+                    log_probe(event="perfetto_export", rc=rc_pf)
                 return 0
             log_probe(event="partial_tpu_result", validate_rc=rc_v,
                       bench_rc=rc_b)
