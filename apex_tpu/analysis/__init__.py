@@ -47,6 +47,13 @@ from apex_tpu.analysis.precision_checks import (
 from apex_tpu.analysis.sharding_checks import (
     SHARDING_CHECKS,
     analyze_sharding,
+    analyze_sharding_jaxpr,
+)
+from apex_tpu.analysis.planner import (
+    PLAN_MODELS,
+    Plan,
+    PlanError,
+    plan,
 )
 from apex_tpu.analysis.targets import (
     TARGETS,
@@ -56,9 +63,11 @@ from apex_tpu.analysis.targets import (
 )
 
 __all__ = [
-    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PRECISION_CHECKS",
+    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PLAN_MODELS",
+    "PRECISION_CHECKS", "Plan", "PlanError",
     "SHARDING_CHECKS", "TARGETS", "analyze_fn", "analyze_precision",
-    "analyze_sharding", "lint_paths", "lint_source", "load_baseline",
-    "new_findings", "run_precision_findings", "run_sharding_findings",
-    "run_targets", "save_baseline",
+    "analyze_sharding", "analyze_sharding_jaxpr", "lint_paths",
+    "lint_source", "load_baseline",
+    "new_findings", "plan", "run_precision_findings",
+    "run_sharding_findings", "run_targets", "save_baseline",
 ]

@@ -9,6 +9,7 @@
     python -m apex_tpu.analysis --diff base.json     # fail only on NEW
     python -m apex_tpu.analysis --allow my_target:master-weights
     python -m apex_tpu.analysis --list-checks
+    python -m apex_tpu.analysis plan --target llama  # auto-shard planner
 
 Exit codes: 0 clean (or all findings grandfathered), 1 new findings,
 2 a registered jaxpr target failed to trace.
@@ -20,6 +21,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
@@ -27,6 +29,11 @@ from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
 
 DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
+
+# Engines the per-target wall time rolls up into (the lint summary's
+# gate-latency line — the unified-interpreter speedup and any future
+# regression show up here, per ISSUE 8 satellite).
+ENGINE_NAMES = ("ast", "jaxpr", "dataflow", "sharding")
 
 # Version of the --json payload; bump when its shape changes so
 # downstream readers (tools/metrics_report.py) can dispatch on it.
@@ -98,11 +105,14 @@ def parse_allow(entries):
 
 
 def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
-        allow=None):
+        allow=None, engine_seconds=None):
     """Programmatic entry: returns (findings, target_errors).
 
     ``allow``: {target: {check ids}} per-target grandfather, merged over
-    the ``@target(allow=...)`` declarations.
+    the ``@target(allow=...)`` declarations. ``engine_seconds``: an
+    optional dict that receives per-engine wall time (keys
+    :data:`ENGINE_NAMES`) — the gate-latency breakdown the lint summary
+    prints.
     """
     if checks:
         unknown = set(checks) - known_checks()
@@ -128,8 +138,13 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
         ast_ids = (set(checks) & set(ast_checks.AST_CHECKS)
                    if checks else None)
         if ast_ids is None or ast_ids:
+            t0 = time.perf_counter()  # apex-lint: disable=raw-clock
             all_findings += ast_checks.lint_paths(use, root=root,
                                                  checks=ast_ids)
+            if engine_seconds is not None:
+                engine_seconds["ast"] = (
+                    engine_seconds.get("ast", 0.0)
+                    + time.perf_counter() - t0)  # apex-lint: disable=raw-clock
     if jaxpr:
         if checks is None or set(checks) & set(targets.TRACING_CHECKS):
             names = None  # tracing targets can emit any tracing check
@@ -138,7 +153,17 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
             # asked for — skips the kernel trace suite
             names = set(checks) & set(targets.TARGET_CHECKS)
         if names is None or names:
-            jf, errors = targets.run_targets(names, extra_allow=allow)
+            per_target = {} if engine_seconds is not None else None
+            jf, errors = targets.run_targets(names, extra_allow=allow,
+                                             timings=per_target)
+            if per_target is not None:
+                for target_name, seconds in per_target.items():
+                    engine = ("dataflow" if target_name in
+                              targets.PRECISION_TARGETS else
+                              "sharding" if target_name in
+                              targets.SHARDING_TARGETS else "jaxpr")
+                    engine_seconds[engine] = engine_seconds.get(
+                        engine, 0.0) + seconds
             if checks:
                 jf = [f for f in jf if f.check in checks]
             all_findings += jf
@@ -146,6 +171,14 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "plan":
+        # subcommand: the auto-sharding planner (ISSUE 8) rides the
+        # same module entry so `python -m apex_tpu.analysis plan
+        # --target llama` is the one front door to the analysis stack
+        from apex_tpu.analysis import planner
+        return planner.main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.analysis",
         description="apex_tpu static TPU lint (jaxpr + AST engines)")
@@ -197,6 +230,7 @@ def main(argv=None):
     if args.checks:
         checks = {c.strip() for c in args.checks.split(",") if c.strip()}
 
+    engine_seconds: dict = {}
     try:
         allow = parse_allow(args.allow)
         # validate the diff base BEFORE the (expensive) run: a bad base
@@ -204,7 +238,7 @@ def main(argv=None):
         diff_keys = load_diff_report(args.diff) if args.diff else None
         found, errors = run(paths=args.paths or None, root=args.root,
                             ast=args.ast, jaxpr=args.jaxpr, checks=checks,
-                            allow=allow)
+                            allow=allow, engine_seconds=engine_seconds)
     except (OSError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -234,6 +268,10 @@ def main(argv=None):
         fresh = findings_mod.new_findings(found, base_keys)
         grandfathered = len(found) - len(fresh)
 
+    timing = "  ".join(
+        f"{name} {engine_seconds.get(name, 0.0):.1f}s"
+        for name in ENGINE_NAMES)
+    total = sum(engine_seconds.values())
     if args.json:
         print(json.dumps({
             "schema_version": JSON_SCHEMA_VERSION,
@@ -241,13 +279,19 @@ def main(argv=None):
             "findings": [vars(f) for f in fresh],
             "grandfathered": grandfathered,
             "target_errors": errors,
+            "engine_seconds": {k: round(v, 3) for k, v in
+                               sorted(engine_seconds.items())},
         }, indent=2))
+        print(f"engine wall time: {timing}  (total {total:.1f}s)",
+              file=sys.stderr)
     else:
         for f in fresh:
             print(f.render())
         tail = f" ({grandfathered} grandfathered)" \
             if base_keys is not None else ""
         print(f"{len(fresh)} finding(s){tail}", file=sys.stderr)
+        print(f"engine wall time: {timing}  (total {total:.1f}s)",
+              file=sys.stderr)
 
     if errors:
         return 2

@@ -39,6 +39,11 @@ check and kernel unit tests, not by dataflow).
 Clients subscribe with visitor callbacks; :mod:`.precision_checks`
 builds the five shipped analyses on top. The engine itself never emits
 a Finding.
+
+The structural traversal (call prims, scan/while/cond, shard_map)
+lives ONCE in :mod:`.interp`; this module contributes the
+:class:`PrecisionLattice` value semantics, so precision and sharding
+checks can share a single walk (ISSUE 8).
 """
 
 from __future__ import annotations
@@ -47,8 +52,11 @@ import dataclasses
 
 import numpy as np
 
+from apex_tpu.analysis import interp
+
 __all__ = [
     "AbsVal", "HALF_DTYPES", "ADDITIVE_REDUCTIONS", "ARITH_PRIMS",
+    "PrecisionLattice", "PRECISION_LATTICE",
     "interpret", "abs_val_for_aval", "itemsize",
 ]
 
@@ -58,11 +66,6 @@ FLOAT_DTYPES = frozenset({
     "bfloat16", "float16", "float32", "float64",
     "float8_e4m3fn", "float8_e5m2",
 })
-
-# Call-like primitives whose bodies run in the caller's value world.
-_CALL_PRIMS = {"pjit", "closed_call", "core_call", "custom_jvp_call",
-               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
-               "checkpoint"}
 
 # Accumulating primitives: a low-precision operand here loses mass.
 ADDITIVE_REDUCTIONS = frozenset({
@@ -117,34 +120,6 @@ class AbsVal:
 def abs_val_for_aval(aval, taints=frozenset()) -> AbsVal:
     dtype = str(getattr(aval, "dtype", "float32"))
     return AbsVal(dtype=dtype, origin=dtype, taints=frozenset(taints))
-
-
-def _is_var(v):
-    import jax.core as core
-    return isinstance(v, core.Var)
-
-
-def _closed_jaxprs_in(value):
-    import jax.core as core
-    out = []
-    if isinstance(value, core.ClosedJaxpr):
-        out.append(value)
-    elif isinstance(value, core.Jaxpr):
-        out.append(value)
-    elif isinstance(value, (tuple, list)):
-        for v in value:
-            out.extend(_closed_jaxprs_in(v))
-    return out
-
-
-def _jaxpr_of(obj):
-    import jax.core as core
-    return obj.jaxpr if isinstance(obj, core.ClosedJaxpr) else obj
-
-
-def _consts_of(obj):
-    import jax.core as core
-    return obj.consts if isinstance(obj, core.ClosedJaxpr) else ()
 
 
 def _join(vals, out_aval):
@@ -232,114 +207,39 @@ def _transfer(eqn, in_vals, out_avals):
     return tuple(_join(in_vals, a) for a in out_avals)
 
 
-class _Interp:
-    def __init__(self, visit):
-        self.visit = visit
+class PrecisionLattice(interp.Lattice):
+    """The dtype/taint value semantics, plugged into the unified walk
+    (:mod:`.interp`). Call-transparent everywhere — including
+    ``shard_map``, which this engine enters like any call — and no
+    carry fixpoint (every precision check fires on iteration 1)."""
 
-    def run(self, jaxpr, consts, in_vals, env=None):
-        env = {} if env is None else env
+    name = "precision"
 
-        def write(var, val):
-            if _is_var(var):
-                env[var] = val
+    def for_aval(self, aval):
+        return abs_val_for_aval(aval)
 
-        def read(atom):
-            if _is_var(atom):
-                return env.get(atom)
-            return None  # Literal
+    def for_const(self, var, const):
+        aval = getattr(var, "aval", None)
+        return abs_val_for_aval(
+            aval if aval is not None else np.asarray(const))
 
-        for var, const in zip(jaxpr.constvars, consts):
-            aval = getattr(var, "aval", None)
-            write(var, abs_val_for_aval(
-                aval if aval is not None else np.asarray(const)))
-        # a sub-jaxpr reached with fewer caller vals than invars (or a
-        # constvar with no const) still needs *some* value
-        for var in jaxpr.constvars:
-            if var not in env:
-                write(var, abs_val_for_aval(var.aval))
-        for var, val in zip(jaxpr.invars, in_vals):
-            write(var, val if val is not None
-                  else abs_val_for_aval(var.aval))
-        for var in jaxpr.invars:
-            if var not in env:
-                write(var, abs_val_for_aval(var.aval))
+    def transfer(self, eqn, ins, out_avals, ctx):
+        return _transfer(eqn, ins, out_avals)
 
-        for eqn in jaxpr.eqns:
-            ins = tuple(read(v) for v in eqn.invars)
-            prim = eqn.primitive.name
-            sub_outs = self._maybe_call(eqn, ins)
-            if sub_outs is not None:
-                outs = sub_outs
-            else:
-                outs = _transfer(
-                    eqn, ins, tuple(v.aval for v in eqn.outvars))
-            if self.visit is not None:
-                self.visit(eqn, ins, outs)
-            for var, val in zip(eqn.outvars, outs):
-                write(var, val)
-        return tuple(
-            env.get(v) if _is_var(v)
-            else abs_val_for_aval(getattr(v, "aval", None) or v.aval)
-            for v in jaxpr.outvars)
+    def bind_sub(self, aval, val):
+        # positional binding keeps the caller taints; scan xs are
+        # sliced along the leading axis but keep dtype, which is all
+        # the lattice reads
+        if val is None:
+            return abs_val_for_aval(aval)
+        return val.with_(dtype=str(aval.dtype))
 
-    # ---- structured primitives ----------------------------------------
+    def fix_out(self, aval, val, restack=False):
+        if val is None:
+            return abs_val_for_aval(aval)
+        return val.with_(dtype=str(aval.dtype))
 
-    def _maybe_call(self, eqn, ins):
-        prim = eqn.primitive.name
-        params = eqn.params
-
-        if prim in _CALL_PRIMS:
-            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
-                if key in params:
-                    subs = _closed_jaxprs_in(params[key])
-                    if subs:
-                        return self._run_sub(subs[0], ins, eqn)
-            return None
-
-        if prim == "scan":
-            sub = params.get("jaxpr")
-            if sub is None:
-                return None
-            sub = _closed_jaxprs_in(sub)
-            if not sub:
-                return None
-            return self._run_sub(sub[0], ins, eqn)
-
-        if prim == "while":
-            body = params.get("body_jaxpr")
-            if body is None:
-                return None
-            body = _closed_jaxprs_in(body)
-            if not body:
-                return None
-            n_cond = params.get("cond_nconsts", 0)
-            carry_ins = ins[n_cond:]
-            return self._run_sub(body[0], carry_ins, eqn)
-
-        if prim == "cond":
-            branches = _closed_jaxprs_in(params.get("branches", ()))
-            if not branches:
-                return None
-            outs = None
-            for br in branches:
-                br_outs = self._run_sub(br, ins[1:], eqn)
-                if outs is None:
-                    outs = list(br_outs)
-                else:
-                    outs = [self._join_branch(a, b)
-                            for a, b in zip(outs, br_outs)]
-            return tuple(outs)
-
-        if prim == "shard_map":
-            sub = _closed_jaxprs_in(params.get("jaxpr", ()))
-            if sub:
-                return self._run_sub(sub[0], ins, eqn)
-            return None
-
-        return None
-
-    @staticmethod
-    def _join_branch(a, b):
+    def join_branch(self, a, b):
         if a is None:
             return b
         if b is None:
@@ -350,33 +250,8 @@ class _Interp:
             reduction_depth=max(a.reduction_depth, b.reduction_depth),
         )
 
-    def _run_sub(self, closed_or_jaxpr, ins, eqn):
-        jaxpr = _jaxpr_of(closed_or_jaxpr)
-        consts = _consts_of(closed_or_jaxpr)
-        n = len(jaxpr.invars)
-        # positional binding; pad/truncate defensively (scan xs are
-        # sliced along the leading axis but keep dtype, which is all
-        # the lattice reads)
-        bound = list(ins[:n]) + [None] * max(0, n - len(ins))
-        mapped = []
-        for var, val in zip(jaxpr.invars, bound):
-            if val is None:
-                mapped.append(abs_val_for_aval(var.aval))
-            else:
-                mapped.append(val.with_(dtype=str(var.aval.dtype)))
-        outs = self.run(jaxpr, consts, tuple(mapped))
-        out_avals = tuple(v.aval for v in eqn.outvars)
-        if len(outs) != len(out_avals):
-            # e.g. scan: sub outputs = carry + per-iter ys while eqn
-            # outputs = carry + stacked ys; lengths match there, but be
-            # safe for anything exotic
-            outs = tuple(
-                outs[i] if i < len(outs) else abs_val_for_aval(a)
-                for i, a in enumerate(out_avals))
-        return tuple(
-            o.with_(dtype=str(a.dtype)) if o is not None
-            else abs_val_for_aval(a)
-            for o, a in zip(outs, out_avals))
+
+PRECISION_LATTICE = PrecisionLattice()
 
 
 def interpret(closed, in_vals, visit=None):
@@ -388,7 +263,8 @@ def interpret(closed, in_vals, visit=None):
     for every equation at every depth, after its transfer function.
     Returns the abstract values of the jaxpr outputs.
     """
-    jaxpr = closed.jaxpr
-    vals = list(in_vals) + [None] * max(
-        0, len(jaxpr.invars) - len(in_vals))
-    return _Interp(visit).run(jaxpr, closed.consts, tuple(vals))
+    wrapped = None if visit is None else (
+        lambda eqn, ins, outs, ctx: visit(eqn, ins, outs))
+    (outs,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(PRECISION_LATTICE, in_vals, wrapped)])
+    return outs

@@ -1,0 +1,379 @@
+"""Unified multi-lattice forward abstract interpretation over closed
+jaxprs — the one traversal under the precision AND sharding engines
+(ISSUE 8 prerequisite refactor).
+
+:mod:`.dataflow` (dtype/taint lattice, precision checks) and
+:mod:`.sharding_flow` (PartitionSpec/distinctness lattice, sharding
+checks) used to carry two near-identical interpreters: the same env
+bookkeeping, the same ``pjit``/``remat``/``scan``/``while``/``cond``/
+``shard_map`` structural walk, duplicated and drifting independently.
+This module owns that walk ONCE; each engine plugs in as a
+:class:`Lattice` — a bundle of value semantics (initial values, the
+per-equation transfer function, branch/carry joins, call-boundary
+coercions, the shard_map world rule). Several lattices ride the same
+traversal: one pass over the jaxpr computes every engine's values and
+fires every engine's visitors, which is what makes the auto-sharding
+planner's inner loop (many spec candidates x one jaxpr) and the
+combined lint gate cheap.
+
+Structural semantics are lattice-selectable where the engines
+legitimately differ:
+
+- ``warm_carry_join``   scan/while bodies run once silently first and
+  the output carries are joined into the input carries (the sharding
+  engine's steady-state fixpoint); lattices that opt out (precision —
+  every check there fires on iteration 1) keep their original inputs,
+  so a mixed run changes neither engine's verdicts. The silent warm
+  pass is skipped entirely when no participating lattice wants it.
+- ``shard_map_enter/exit``  the sharding engine treats shard_map as a
+  world boundary (specs stripped to distinctness, outer spec rebuilt
+  from out_names); the precision engine enters it like any call. Both
+  are expressed as lattice hooks over the same single body traversal.
+
+Entry point: :func:`interpret_lattices`. The single-engine entry
+points (``dataflow.interpret``, ``sharding_flow.interpret_sharding``)
+are thin wrappers that pass exactly one lattice.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Lattice", "LatticeRun", "MeshCtx", "interpret_lattices"]
+
+# Call-like primitives whose bodies run in the caller's value world.
+CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
+})
+
+_SUB_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def is_var(v):
+    import jax.core as core
+    return isinstance(v, core.Var)
+
+
+def closed_jaxprs_in(value):
+    import jax.core as core
+    out = []
+    if isinstance(value, (core.ClosedJaxpr, core.Jaxpr)):
+        out.append(value)
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(closed_jaxprs_in(v))
+    return out
+
+
+def jaxpr_of(obj):
+    import jax.core as core
+    return obj.jaxpr if isinstance(obj, core.ClosedJaxpr) else obj
+
+
+def consts_of(obj):
+    import jax.core as core
+    return obj.consts if isinstance(obj, core.ClosedJaxpr) else ()
+
+
+class MeshCtx:
+    """Axis universe the interpretation runs under: name -> size, plus
+    the manual (shard_map-consumed) axes at the current depth."""
+
+    def __init__(self, axis_sizes=None, manual_axes=frozenset()):
+        self.axis_sizes = dict(axis_sizes or {})
+        self.manual_axes = frozenset(manual_axes)
+
+    def size(self, axis, default=1) -> int:
+        return int(self.axis_sizes.get(axis, default))
+
+    def child(self, extra_sizes=None, extra_manual=()):
+        sizes = dict(self.axis_sizes)
+        if extra_sizes:
+            sizes.update({str(k): int(v) for k, v in extra_sizes.items()})
+        return MeshCtx(sizes, self.manual_axes | frozenset(extra_manual))
+
+
+def shard_map_axis_sizes(eqn) -> dict:
+    """The mesh axis sizes a shard_map equation introduces."""
+    shape = getattr(eqn.params.get("mesh"), "shape", None)
+    return {str(k): int(v) for k, v in dict(shape).items()} \
+        if shape else {}
+
+
+class Lattice:
+    """Value semantics for one analysis domain (see module docstring).
+
+    Subclasses must implement :meth:`for_aval` and :meth:`transfer`;
+    everything else has call-transparent defaults matching the
+    precision engine's behavior."""
+
+    name = "lattice"
+    # scan/while: run the body once silently and join the output
+    # carries into the input carries before the visited pass.
+    warm_carry_join = False
+
+    # ---- values ------------------------------------------------------
+
+    def for_aval(self, aval):
+        raise NotImplementedError
+
+    def for_const(self, var, const):
+        return self.for_aval(getattr(var, "aval", None))
+
+    def transfer(self, eqn, ins, out_avals, ctx):
+        raise NotImplementedError
+
+    # ---- call boundaries ---------------------------------------------
+
+    def bind_sub(self, aval, val):
+        """Coerce a caller value onto a sub-jaxpr invar (None = derive
+        from the aval)."""
+        return self.for_aval(aval) if val is None else val
+
+    def fix_out(self, aval, val, restack=False):
+        """Coerce a sub-jaxpr output onto the caller's out aval.
+        ``restack`` marks stacked scan ys (which grow a leading dim)."""
+        return self.for_aval(aval) if val is None else val
+
+    # ---- joins -------------------------------------------------------
+
+    def join_branch(self, a, b):
+        """Join the same output slot across cond branches."""
+        return a if a is not None else b
+
+    def join_carry(self, orig, warm):
+        """Join a warm-pass output carry into the input carry; the
+        default keeps the original (no fixpoint)."""
+        return orig
+
+    # ---- scan / shard_map structure ----------------------------------
+
+    def map_scan_xs(self, val):
+        """Map an xs value across the scan boundary (the body sees it
+        without the leading scan dim)."""
+        return val
+
+    def shard_map_enter(self, eqn, ins, sub, ctx):
+        """Values bound to the shard_map body invars; the default enters
+        like a call."""
+        n = len(sub.invars)
+        bound = list(ins[:n]) + [None] * max(0, n - len(ins))
+        return [self.bind_sub(var.aval, val)
+                for var, val in zip(sub.invars, bound)]
+
+    def shard_map_exit(self, eqn, inner_outs, ctx):
+        """Caller-world values for the shard_map outputs; the default
+        exits like a call."""
+        outs = []
+        for i, var in enumerate(eqn.outvars):
+            o = inner_outs[i] if i < len(inner_outs) else None
+            outs.append(self.fix_out(var.aval, o))
+        return outs
+
+
+class LatticeRun:
+    """One lattice's participation in a traversal: the lattice, its
+    per-invar input values, and an optional
+    ``visit(eqn, ins, outs, mesh_ctx)`` callback."""
+
+    def __init__(self, lattice, in_vals=(), visit=None):
+        self.lattice = lattice
+        self.in_vals = list(in_vals or ())
+        self.visit = visit
+
+
+class _Walk:
+    def __init__(self, lattices, visits):
+        self.lattices = lattices
+        self.visits = visits
+
+    def _silent(self):
+        return _Walk(self.lattices, [None] * len(self.lattices))
+
+    def run(self, jaxpr, consts, in_cols, ctx):
+        lats = self.lattices
+        n_lat = len(lats)
+        env: dict = {}
+
+        def write(var, vals):
+            if is_var(var):
+                env[var] = vals
+
+        consts = list(consts or ())
+        for i, var in enumerate(jaxpr.constvars):
+            if i < len(consts):
+                write(var, [lat.for_const(var, consts[i])
+                            for lat in lats])
+            else:
+                write(var, [lat.for_aval(var.aval) for lat in lats])
+        for j, var in enumerate(jaxpr.invars):
+            vals = []
+            for k, lat in enumerate(lats):
+                v = in_cols[k][j] if j < len(in_cols[k]) else None
+                vals.append(v if v is not None else lat.for_aval(var.aval))
+            write(var, vals)
+
+        for eqn in jaxpr.eqns:
+            rows = [env.get(v) if is_var(v) else None for v in eqn.invars]
+            ins_cols = [tuple(row[k] if row is not None else None
+                              for row in rows) for k in range(n_lat)]
+            outs_cols = self._structured(eqn, ins_cols, ctx)
+            if outs_cols is None:
+                out_avals = tuple(v.aval for v in eqn.outvars)
+                outs_cols = [lats[k].transfer(eqn, ins_cols[k],
+                                              out_avals, ctx)
+                             for k in range(n_lat)]
+            for k, visit in enumerate(self.visits):
+                if visit is not None:
+                    visit(eqn, ins_cols[k], outs_cols[k], ctx)
+            for j, var in enumerate(eqn.outvars):
+                write(var, [outs_cols[k][j] for k in range(n_lat)])
+
+        results = []
+        for k, lat in enumerate(lats):
+            out = []
+            for v in jaxpr.outvars:
+                row = env.get(v) if is_var(v) else None
+                out.append(row[k] if row is not None
+                           else lat.for_aval(getattr(v, "aval", None)))
+            results.append(tuple(out))
+        return results
+
+    # ---- structured primitives ---------------------------------------
+
+    def _structured(self, eqn, ins_cols, ctx):
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        if prim in CALL_PRIMS:
+            for key in _SUB_JAXPR_KEYS:
+                if key in params:
+                    subs = closed_jaxprs_in(params[key])
+                    if subs:
+                        return self._run_sub(subs[0], ins_cols, eqn, ctx)
+            return None
+
+        if prim == "scan":
+            subs = closed_jaxprs_in(params.get("jaxpr"))
+            if not subs:
+                return None
+            n_consts = params.get("num_consts", 0)
+            n_carry = params.get("num_carry", 0)
+            mapped_cols = []
+            for k, lat in enumerate(self.lattices):
+                col = list(ins_cols[k])
+                for i in range(n_consts + n_carry, len(col)):
+                    if col[i] is not None:
+                        col[i] = lat.map_scan_xs(col[i])
+                mapped_cols.append(col)
+            self._warm_carries(subs[0], mapped_cols, eqn, ctx,
+                               carry_at=n_consts, n_carry=n_carry,
+                               restack_from=n_carry)
+            return self._run_sub(subs[0], mapped_cols, eqn, ctx,
+                                 restack_from=n_carry)
+
+        if prim == "while":
+            subs = closed_jaxprs_in(params.get("body_jaxpr"))
+            if not subs:
+                return None
+            n_cond = params.get("cond_nconsts", 0)
+            body_cols = [list(col[n_cond:]) for col in ins_cols]
+            n_body = params.get("body_nconsts", 0)
+            self._warm_carries(subs[0], body_cols, eqn, ctx,
+                               carry_at=n_body, n_carry=None)
+            return self._run_sub(subs[0], body_cols, eqn, ctx)
+
+        if prim == "cond":
+            branches = closed_jaxprs_in(params.get("branches", ()))
+            if not branches:
+                return None
+            pred_less = [col[1:] for col in ins_cols]
+            outs_cols = None
+            for br in branches:
+                br_cols = self._run_sub(br, pred_less, eqn, ctx)
+                if outs_cols is None:
+                    outs_cols = [list(c) for c in br_cols]
+                else:
+                    for k, lat in enumerate(self.lattices):
+                        outs_cols[k] = [
+                            lat.join_branch(a, b)
+                            for a, b in zip(outs_cols[k], br_cols[k])]
+            return [tuple(c) for c in outs_cols]
+
+        if prim == "shard_map":
+            subs = closed_jaxprs_in(params.get("jaxpr", ()))
+            if not subs:
+                return None
+            sizes = shard_map_axis_sizes(eqn)
+            inner_ctx = ctx.child(sizes, sizes.keys())
+            sub = jaxpr_of(subs[0])
+            inner_cols = [lat.shard_map_enter(eqn, ins_cols[k], sub, ctx)
+                          for k, lat in enumerate(self.lattices)]
+            inner_outs = _Walk(self.lattices, self.visits).run(
+                sub, consts_of(subs[0]), inner_cols, inner_ctx)
+            return [tuple(lat.shard_map_exit(eqn, inner_outs[k], ctx))
+                    for k, lat in enumerate(self.lattices)]
+
+        return None
+
+    def _warm_carries(self, sub, cols, eqn, ctx, carry_at, n_carry,
+                      restack_from=None):
+        """Silent warm pass + per-lattice carry join (in place) for the
+        lattices that want the fixpoint. No-op when none do."""
+        if not any(lat.warm_carry_join for lat in self.lattices):
+            return
+        warm_cols = self._silent()._run_sub(sub, cols, eqn, ctx,
+                                            restack_from=restack_from)
+        for k, lat in enumerate(self.lattices):
+            if not lat.warm_carry_join:
+                continue
+            warm = warm_cols[k]
+            stop = len(warm) if n_carry is None else min(n_carry,
+                                                         len(warm))
+            for c in range(stop):
+                i = carry_at + c
+                if i < len(cols[k]):
+                    cols[k][i] = lat.join_carry(cols[k][i], warm[c])
+
+    def _run_sub(self, closed_or_jaxpr, ins_cols, eqn, ctx,
+                 restack_from=None):
+        jaxpr = jaxpr_of(closed_or_jaxpr)
+        consts = consts_of(closed_or_jaxpr)
+        n = len(jaxpr.invars)
+        mapped_cols = []
+        for k, lat in enumerate(self.lattices):
+            col = list(ins_cols[k][:n]) + [None] * max(
+                0, n - len(ins_cols[k]))
+            mapped_cols.append([lat.bind_sub(var.aval, val)
+                                for var, val in zip(jaxpr.invars, col)])
+        outs_cols = self.run(jaxpr, consts, mapped_cols, ctx)
+        out_avals = tuple(v.aval for v in eqn.outvars)
+        fixed_cols = []
+        for k, lat in enumerate(self.lattices):
+            outs = outs_cols[k]
+            fixed = []
+            for i, aval in enumerate(out_avals):
+                o = outs[i] if i < len(outs) else None
+                restack = restack_from is not None and i >= restack_from
+                fixed.append(lat.fix_out(aval, o, restack=restack))
+            fixed_cols.append(tuple(fixed))
+        return fixed_cols
+
+
+def interpret_lattices(closed, runs, axis_sizes=None):
+    """Run every :class:`LatticeRun` in ``runs`` over ``closed`` (a
+    ``ClosedJaxpr``) in ONE traversal.
+
+    Each run's ``in_vals`` holds one abstract value (or None for
+    "derive from the aval") per flat invar; its ``visit`` fires for
+    every equation at every depth with that lattice's values. Returns
+    one tuple of abstract output values per run, in order."""
+    ctx = MeshCtx(axis_sizes or {})
+    jaxpr = closed.jaxpr
+    in_cols = []
+    for run in runs:
+        col = list(run.in_vals) + [None] * max(
+            0, len(jaxpr.invars) - len(run.in_vals))
+        in_cols.append(col)
+    walk = _Walk([run.lattice for run in runs],
+                 [run.visit for run in runs])
+    return walk.run(jaxpr, closed.consts, in_cols, ctx)

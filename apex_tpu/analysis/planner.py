@@ -1,0 +1,892 @@
+"""Auto-sharding planner — search the mesh/layout space on the unified
+sharding-flow cost model and emit executable PartitionSpecs (ISSUE 8
+tentpole).
+
+PR 4's :func:`~apex_tpu.analysis.sharding_flow.estimate_hbm_and_comms`
+prices any (jaxpr, mesh, specs) triple; this module inverts it into a
+GSPMD/Alpa-style layout search:
+
+1. **Enumerate** candidate mesh shapes (pp, dp, tp factorizations of
+   the device count that the model's shapes divide) and PartitionSpec
+   layout templates per mesh (replicated baseline, Megatron TP).
+2. **Trace once per pp** (stage depth changes the jaxpr; dp/tp/layout
+   do not), then price every (dp, tp, layout) candidate against that
+   one trace — the cheap inner loop the unified interpreter and the
+   memoized liveness linearization exist for.
+3. **Prune** candidates whose liveness-walk peak HBM exceeds the
+   per-device budget (:func:`apex_tpu.ops.pallas_config.device_hbm_bytes`).
+4. **Rank** survivors by a modeled step time: roofline compute
+   (PaLM-appendix FLOPs over the device-generation peak, the same
+   priors as the PR 6 tuning roofline) + HBM traffic over HBM
+   bandwidth + comms bytes over ICI bandwidth, with the pipeline
+   bubble factor (pp-1)/M on top.
+5. **Verify** the winner by re-running all five sharding checks on the
+   emitted specs — a plan that introduces an ``implicit-reshard`` or
+   ``hbm-budget`` finding is rejected before it ships and the next
+   survivor is tried.
+
+The emitted :class:`Plan` is deterministic (byte-identical JSON for
+identical inputs — no clocks, no RNG, stable tie-breaks) and
+executable: :mod:`apex_tpu.parallel.auto_shard` turns it into a mesh +
+``with_sharding_constraint``/``shard_map`` specs, and
+``examples/llama_train.py --auto-shard`` consumes it end to end.
+
+Comms terms the jaxpr estimator cannot see from a constraint-free
+GSPMD trace are modeled analytically and documented in
+docs/planner.md: per-layer Megatron activation allreduces (4/layer)
+and the pipeline's per-microbatch boundary ppermutes.
+
+CLI: ``python -m apex_tpu.analysis plan --target llama``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from apex_tpu.analysis.sharding_flow import (
+    collective_bytes,
+    shard_val_for_aval,
+)
+
+__all__ = [
+    "Plan", "PlanError", "PLAN_MODELS", "plan", "plan_model",
+    "publish_to_registry", "main",
+]
+
+PLAN_SCHEMA_VERSION = 1
+PLAN_KIND = "apex_tpu.plan"
+
+# Per-device HBM bandwidth (bytes/s) by generation, same substring
+# scheme as pallas_config._HBM_BYTES. The v5e figure matches the PR 6
+# tuning roofline (docs/kernel_cost_study.md); the others are public
+# spec-sheet numbers. Ratios between candidates are what the ranking
+# consumes, so one consistent table beats per-chip precision.
+_HBM_BW = (
+    ("v5p", 2765e9), ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v6", 1638e9), ("trillium", 1638e9), ("v4", 1228e9),
+    ("v3", 900e9), ("v2", 700e9),
+)
+_HBM_BW_DEFAULT = 819e9
+
+# Per-device ICI (inter-chip interconnect) bandwidth, bytes/s — the
+# denominator under every modeled collective.
+_ICI_BW = (
+    ("v5p", 90e9), ("v5 lite", 45e9), ("v5e", 45e9),
+    ("v6", 90e9), ("trillium", 90e9), ("v4", 50e9),
+    ("v3", 70e9), ("v2", 70e9),
+)
+_ICI_BW_DEFAULT = 45e9
+
+# Peak bf16 FLOP/s fallback when the observability table has no entry
+# for the kind (CPU planning runs): the v5e prior from the PR 6 tuning
+# roofline, so CPU plans reproduce the decisions a v5e plan would make.
+_PEAK_FLOPS_DEFAULT = 197e12
+
+
+def _by_kind(table, kind, default):
+    kind = (kind or "").lower()
+    for key, value in table:
+        if key in kind:
+            return value
+    return default
+
+
+def hbm_bandwidth(kind=None) -> float:
+    """Modeled per-device HBM bandwidth (bytes/s) for ``kind``."""
+    return _by_kind(_HBM_BW, kind, _HBM_BW_DEFAULT)
+
+
+def interconnect_bandwidth(kind=None) -> float:
+    """Modeled per-device ICI bandwidth (bytes/s) for ``kind``."""
+    return _by_kind(_ICI_BW, kind, _ICI_BW_DEFAULT)
+
+
+def planning_peak_flops(kind=None) -> float:
+    """Peak bf16 FLOP/s for ``kind`` (observability table first, v5e
+    planning prior for unknown/CPU kinds)."""
+    from apex_tpu.observability.step_report import peak_flops
+
+    return peak_flops(kind or "") or _PEAK_FLOPS_DEFAULT
+
+
+class PlanError(RuntimeError):
+    """No candidate survived pruning + verification (the message lists
+    every candidate and why it fell)."""
+
+
+# --------------------------------------------------------------- specs
+
+def spec_entries(spec, ndim=None):
+    """PartitionSpec -> JSON-safe per-dim entries (None | name |
+    [names]), optionally padded to ``ndim``."""
+    entries = []
+    for e in tuple(spec or ()):
+        if e is None:
+            entries.append(None)
+        elif isinstance(e, (tuple, list)):
+            entries.append([str(a) for a in e])
+        else:
+            entries.append(str(e))
+    if ndim is not None:
+        while len(entries) < ndim:
+            entries.append(None)
+    return entries
+
+
+def entries_to_spec(entries):
+    """Inverse of :func:`spec_entries`."""
+    from jax.sharding import PartitionSpec as P
+
+    out = []
+    for e in entries or ():
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(str(a) for a in e))
+        else:
+            out.append(str(e))
+    return P(*out)
+
+
+def _strip_axes(spec, active):
+    """Drop mesh axes not in ``active`` (size-1 axes shard nothing and
+    would fire spurious reshard findings)."""
+    from jax.sharding import PartitionSpec as P
+
+    out = []
+    for e in tuple(spec or ()):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if str(a) in active)
+            out.append(kept if kept else None)
+        else:
+            out.append(str(e) if str(e) in active else None)
+    return P(*out)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        parts.append(str(p) if key is None else str(key))
+    return "/".join(parts)
+
+
+def _flatten_with_names(tree, prefix):
+    """[(name, leaf)] in ``tree_leaves`` order, names prefixed."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(f"{prefix}/{_path_str(path)}" if path else prefix, leaf)
+            for path, leaf in flat]
+
+
+def _flatten_spec_tree(tree):
+    """Leaves of a PartitionSpec pytree in ``tree_leaves`` order."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: x is None or isinstance(x, P))
+
+
+# --------------------------------------------------------------- model
+
+@dataclasses.dataclass
+class TracedStep:
+    """One pp-depth's traced train step plus the analytic cost-model
+    ingredients the jaxpr cannot carry."""
+
+    closed: object                  # ClosedJaxpr
+    donated: frozenset              # flat invar indices with donation
+    flops_total: int                # whole-step FLOPs at pp=1 depth
+    act_bytes_global: int           # boundary activations, global batch
+    tp_collectives: int             # act-sized tp allreduces per step
+    microbatches: int
+
+
+class PlanModel:
+    """One searchable model family. Subclasses provide the trace and
+    the layout templates; everything is deterministic."""
+
+    name = "model"
+    layouts = ("replicated", "megatron")
+
+    def pp_candidates(self, devices):
+        return (1,)
+
+    def valid_tp(self, tp):
+        return tp == 1
+
+    def valid_dp(self, dp):
+        return True
+
+    def trace(self, pp) -> TracedStep:
+        raise NotImplementedError
+
+    def flat_specs(self, layout, traced, dp, tp):
+        """One PartitionSpec per flat invar of ``traced.closed``."""
+        raise NotImplementedError
+
+    def layout_divides_tp(self, layout):
+        return layout != "replicated"
+
+    def emit_specs(self, layout, dp, tp):
+        """The executable, JSON-safe spec table consumers apply."""
+        raise NotImplementedError
+
+
+PLAN_MODELS: dict = {}
+
+
+def plan_model(name):
+    """Register a :class:`PlanModel` subclass under ``name``."""
+    def deco(cls):
+        PLAN_MODELS[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+def _active_axes(dp, tp):
+    active = set()
+    if dp > 1:
+        active.add("dp")
+    if tp > 1:
+        active.add("tp")
+    return active
+
+
+@plan_model("llama")
+class LlamaPlanModel(PlanModel):
+    """The llama decoder train step (fwd+bwd+FusedAdam), traced per
+    stage depth with abstract avals only — shapes default small enough
+    to plan on CPU in seconds while dividing every tp/pp candidate up
+    to 8. Override via model_kw (layers/hidden/heads/kv_heads/
+    intermediate/vocab/seq/batch/microbatches)."""
+
+    def __init__(self, layers=8, hidden=64, heads=8, kv_heads=8,
+                 intermediate=128, vocab=256, seq=32, batch=8,
+                 microbatches=4):
+        self.layers = int(layers)
+        self.hidden = int(hidden)
+        self.heads = int(heads)
+        self.kv_heads = int(kv_heads)
+        self.intermediate = int(intermediate)
+        self.vocab = int(vocab)
+        self.seq = int(seq)
+        self.batch = int(batch)
+        self.microbatches = int(microbatches)
+        self._traced: dict = {}
+        self._shaped: dict = {}
+        self._n_params_full = None
+
+    def _cfg(self, n_layers):
+        from apex_tpu.models import llama
+
+        return llama.tiny(
+            num_layers=n_layers, hidden_size=self.hidden,
+            num_heads=self.heads, num_kv_heads=self.kv_heads,
+            intermediate_size=self.intermediate, vocab_size=self.vocab,
+            max_seq_len=self.seq)
+
+    def pp_candidates(self, devices):
+        return tuple(pp for pp in range(1, min(devices, self.layers) + 1)
+                     if devices % pp == 0 and self.layers % pp == 0)
+
+    def valid_tp(self, tp):
+        return (self.heads % tp == 0 and self.kv_heads % tp == 0
+                and self.vocab % tp == 0 and self.hidden % tp == 0
+                and self.intermediate % tp == 0)
+
+    def valid_dp(self, dp):
+        return self.batch % dp == 0
+
+    def _shapes(self, pp):
+        # memoized per pp: flat_specs re-enters this once per (dp, tp,
+        # layout) candidate and the eval_shape of the whole init is the
+        # expensive part of the inner loop
+        if pp in self._shaped:
+            return self._shaped[pp]
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models import llama
+        from apex_tpu.optimizers import fused_adam
+
+        cfg = self._cfg(self.layers // pp)
+        key = jax.random.PRNGKey(0)
+        params = jax.eval_shape(lambda: llama.init_params(key, cfg))
+        tx = fused_adam(lr=1e-3, flat=False)
+        opt = jax.eval_shape(tx.init, params)
+        tokens = jax.ShapeDtypeStruct((self.batch, self.seq), jnp.int32)
+        self._shaped[pp] = (cfg, tx, params, opt, tokens)
+        return self._shaped[pp]
+
+    def trace(self, pp) -> TracedStep:
+        if pp in self._traced:
+            return self._traced[pp]
+        import jax
+
+        from apex_tpu.models import llama
+        from apex_tpu.observability.step_report import (
+            transformer_step_flops,
+        )
+
+        cfg, tx, params, opt, tokens = self._shapes(pp)
+
+        def step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(llama.loss_fn)(
+                params, (tokens, targets), cfg, tp_axis=None,
+                cp_axis=None, remat=False)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(jax.numpy.add, params,
+                                            updates)
+            return params, opt_state, loss
+
+        closed = jax.make_jaxpr(step)(params, opt, tokens, tokens)
+        # pp-invariant (whole-model param count); compute it once, not
+        # once per stage depth
+        if self._n_params_full is None:
+            self._n_params_full = sum(
+                leaf.size for leaf in jax.tree_util.tree_leaves(
+                    jax.eval_shape(
+                        lambda: llama.init_params(
+                            jax.random.PRNGKey(0),
+                            self._cfg(self.layers)))))
+        n_params_full = self._n_params_full
+        n_state = len(jax.tree_util.tree_leaves(params)) + len(
+            jax.tree_util.tree_leaves(opt))
+        traced = TracedStep(
+            closed=closed,
+            donated=frozenset(range(n_state)),
+            flops_total=transformer_step_flops(
+                n_params_full, self.layers, self.hidden, self.seq,
+                self.batch),
+            act_bytes_global=self.batch * self.seq * self.hidden * 4,
+            # Megatron TP: 2 activation allreduces fwd (attention out +
+            # MLP down row-parallel boundaries) and 2 bwd, per layer of
+            # THIS stage depth
+            tp_collectives=4 * (self.layers // pp),
+            microbatches=self.microbatches,
+        )
+        self._traced[pp] = traced
+        return traced
+
+    def _spec_trees(self, pp, dp, tp):
+        import jax
+
+        from apex_tpu.models import llama
+        from apex_tpu.optimizers import opt_partition_specs
+        from jax.sharding import PartitionSpec as P
+
+        cfg, tx, params, opt, tokens = self._shapes(pp)
+        active = _active_axes(dp, tp)
+        pspecs = jax.tree_util.tree_map(
+            lambda s: _strip_axes(s, active), llama.param_specs(cfg),
+            is_leaf=lambda x: isinstance(x, P))
+        ospecs = opt_partition_specs(tx, params, pspecs)
+        data = P("dp") if dp > 1 else P()
+        return pspecs, ospecs, data
+
+    def flat_specs(self, layout, traced, dp, tp):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        # the traced step knows its stage depth; recover it from the
+        # trace cache rather than re-deriving it from shapes
+        pp = next(pp_key for pp_key, cached in self._traced.items()
+                  if cached is traced)
+        pspecs, ospecs, data = self._spec_trees(pp, dp, tp)
+        if layout == "replicated":
+            pspecs = jax.tree_util.tree_map(
+                lambda s: P(), pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            ospecs = jax.tree_util.tree_map(
+                lambda s: P(), ospecs,
+                is_leaf=lambda x: x is None or isinstance(x, P))
+        return (_flatten_spec_tree(pspecs) + _flatten_spec_tree(ospecs)
+                + [data, data])
+
+    def emit_specs(self, layout, dp, tp):
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self._cfg(self.layers)
+        active = _active_axes(dp, tp) if layout != "replicated" \
+            else (_active_axes(dp, tp) & {"dp"})
+        from apex_tpu.models import llama
+
+        specs = llama.param_specs(cfg)
+        layer = {k: spec_entries(_strip_axes(v, active))
+                 for k, v in specs["layers"].items()}
+        io = {"embed": spec_entries(_strip_axes(specs["embed"], active)),
+              "final_norm": spec_entries(specs["final_norm"]),
+              "lm_head": spec_entries(_strip_axes(
+                  specs.get("lm_head", P(None, None)), active))}
+        return {"layers": layer, "io": io,
+                "data": spec_entries(P("dp") if dp > 1 else P())}
+
+
+@plan_model("mlp")
+class MlpPlanModel(PlanModel):
+    """Two-layer MLP + SGD — the deterministic test workhorse (also the
+    smallest real customer: a Megatron column/row pair)."""
+
+    def __init__(self, hidden=64, batch=32):
+        self.hidden = int(hidden)
+        self.batch = int(batch)
+        self._traced: dict = {}
+
+    def pp_candidates(self, devices):
+        return (1,)
+
+    def valid_tp(self, tp):
+        return (4 * self.hidden) % tp == 0 and self.hidden % tp == 0
+
+    def valid_dp(self, dp):
+        return self.batch % dp == 0
+
+    def trace(self, pp) -> TracedStep:
+        if pp in self._traced:
+            return self._traced[pp]
+        import jax
+        import jax.numpy as jnp
+
+        h, b = self.hidden, self.batch
+        params = {
+            "w1": jax.ShapeDtypeStruct((h, 4 * h), jnp.float32),
+            "w2": jax.ShapeDtypeStruct((4 * h, h), jnp.float32),
+        }
+        x = jax.ShapeDtypeStruct((b, h), jnp.float32)
+
+        def step(params, x, y):
+            def loss_fn(p):
+                out = jax.nn.relu(x @ p["w1"]) @ p["w2"]
+                return jnp.mean(jnp.square(out - y))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(
+                lambda p, g: p - 0.01 * g, params, grads)
+            return new, loss
+
+        closed = jax.make_jaxpr(step)(params, x, x)
+        traced = TracedStep(
+            closed=closed, donated=frozenset(range(len(params))),
+            flops_total=2 * 3 * b * (h * 4 * h * 2),
+            act_bytes_global=b * 4 * h * 4,
+            tp_collectives=2, microbatches=1)
+        self._traced[pp] = traced
+        return traced
+
+    def flat_specs(self, layout, traced, dp, tp):
+        from jax.sharding import PartitionSpec as P
+
+        data = P("dp") if dp > 1 else P()
+        if layout == "megatron" and tp > 1:
+            return [P(None, "tp"), P("tp", None), data, data]
+        return [P(), P(), data, data]
+
+    def emit_specs(self, layout, dp, tp):
+        from jax.sharding import PartitionSpec as P
+
+        if layout == "megatron" and tp > 1:
+            w1, w2 = P(None, "tp"), P("tp", None)
+        else:
+            w1, w2 = P(), P()
+        return {"params": {"w1": spec_entries(w1),
+                           "w2": spec_entries(w2)},
+                "data": spec_entries(P("dp") if dp > 1 else P())}
+
+
+# ---------------------------------------------------------- evaluation
+
+@dataclasses.dataclass
+class Candidate:
+    """One priced point of the search space, ranked-table-ready."""
+
+    pp: int
+    dp: int
+    tp: int
+    layout: str
+    comms_bytes: int = 0
+    peak_hbm_bytes: int = 0
+    modeled_step_ms: float = 0.0
+    status: str = "ok"        # ok | chosen | pruned:hbm | rejected:checks
+    detail: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"pp{self.pp}.dp{self.dp}.tp{self.tp}/{self.layout}"
+
+    @property
+    def mesh(self) -> dict:
+        return {"pp": self.pp, "dp": self.dp, "tp": self.tp}
+
+    def row(self) -> dict:
+        return {"candidate": self.key, "mesh": self.mesh,
+                "layout": self.layout, "comms_bytes": self.comms_bytes,
+                "peak_hbm_bytes": self.peak_hbm_bytes,
+                "modeled_step_ms": self.modeled_step_ms,
+                "status": self.status, "detail": self.detail}
+
+
+@dataclasses.dataclass
+class Plan:
+    """The planner's verdict: the chosen mesh + layout, its executable
+    spec table, the prediction the choice rests on, and the full ranked
+    candidate record (chosen/ok/pruned/rejected)."""
+
+    model: str
+    devices: int
+    device_kind: str
+    hbm_budget_bytes: int
+    mesh: dict
+    layout: str
+    specs: dict
+    predicted: dict
+    candidates: list
+    model_kw: dict
+
+    @property
+    def chosen_key(self) -> str:
+        return (f"pp{self.mesh['pp']}.dp{self.mesh['dp']}"
+                f".tp{self.mesh['tp']}/{self.layout}")
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": PLAN_SCHEMA_VERSION,
+            "kind": PLAN_KIND,
+            "model": self.model,
+            "devices": self.devices,
+            "device_kind": self.device_kind,
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "mesh": self.mesh,
+            "layout": self.layout,
+            "chosen": self.chosen_key,
+            "specs": self.specs,
+            "predicted": self.predicted,
+            "candidates": [c.row() for c in self.candidates],
+            "model_kw": self.model_kw,
+        }
+
+    def to_json(self) -> str:
+        # deterministic on purpose: sorted keys, rounded floats, no
+        # clocks — the same (model, devices) input must yield a
+        # byte-identical plan across runs (regression-tested)
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True) + "\n"
+
+
+def _modeled_step_s(model, traced, cand, kind, stats):
+    """The documented cost model (docs/planner.md): roofline compute +
+    HBM traffic + comms over ICI, times the pipeline bubble."""
+    compute_ways = cand.pp * cand.dp * (
+        cand.tp if model.layout_divides_tp(cand.layout) else 1)
+    compute_s = traced.flops_total / compute_ways / planning_peak_flops(
+        kind)
+    mem_s = (stats["input_bytes"] + stats["output_bytes"]) \
+        / hbm_bandwidth(kind)
+    comms_s = cand.comms_bytes / interconnect_bandwidth(kind)
+    bubble = (cand.pp - 1) / max(1, traced.microbatches)
+    return (max(compute_s, mem_s) + comms_s) * (1.0 + bubble)
+
+
+def _candidate_comms(model, traced, cand, stats):
+    """GSPMD-estimated bytes plus the analytic terms a constraint-free
+    trace cannot carry (per-layer Megatron activation allreduces, the
+    pipeline's per-microbatch boundary hops)."""
+    comms = stats["comms_bytes"]
+    if cand.tp > 1 and model.layout_divides_tp(cand.layout):
+        act_local = traced.act_bytes_global // max(1, cand.dp)
+        comms += traced.tp_collectives * collective_bytes(
+            "psum", act_local, [cand.tp])
+    if cand.pp > 1:
+        comms += 2 * traced.act_bytes_global // max(1, cand.dp)
+    return int(comms)
+
+
+def _enumerate(model, devices, min_mesh=None):
+    min_mesh = dict(min_mesh or {})
+    cands = []
+    for pp in model.pp_candidates(devices):
+        if pp < min_mesh.get("pp", 1):
+            continue
+        rest = devices // pp
+        for dp in range(1, rest + 1):
+            if rest % dp or not model.valid_dp(dp):
+                continue
+            if dp < min_mesh.get("dp", 1):
+                continue
+            tp = rest // dp
+            if not model.valid_tp(tp) or tp < min_mesh.get("tp", 1):
+                continue
+            for layout in model.layouts:
+                # every mesh axis must be EXPLOITED by the layout: a
+                # tp>1 mesh under a replicated layout leaves tp-1 of
+                # the machine idle while scoring zero comms — it would
+                # always win and always be wrong
+                if model.layout_divides_tp(layout) != (tp > 1):
+                    continue
+                cands.append(Candidate(pp=pp, dp=dp, tp=tp,
+                                       layout=layout))
+    return cands
+
+
+def _in_vals_for(closed, flat_specs):
+    vals = []
+    for i, var in enumerate(closed.jaxpr.invars):
+        spec = flat_specs[i] if i < len(flat_specs) else None
+        vals.append(shard_val_for_aval(var.aval, spec))
+    return vals
+
+
+def plan(model="llama", devices=None, device_kind=None,
+         hbm_budget_bytes=None, registry=None, verify=True,
+         min_mesh=None, **model_kw) -> Plan:
+    """Search the mesh/layout space for ``model`` over ``devices`` and
+    return the verified :class:`Plan` (see module docstring for the
+    pipeline). Raises :class:`PlanError` when nothing survives.
+
+    ``min_mesh``: {axis: minimum size} executability floor from the
+    consumer — e.g. a step whose collectives require a bound tp axis
+    passes ``{"tp": 2}`` so the search never emits a mesh its runtime
+    cannot execute."""
+    from apex_tpu.analysis.sharding_checks import analyze_sharding_jaxpr
+    from apex_tpu.analysis.sharding_flow import estimate_hbm_and_comms
+
+    if model not in PLAN_MODELS:
+        raise ValueError(
+            f"unknown plan model {model!r}; valid: "
+            f"{sorted(PLAN_MODELS)}")
+    if devices is None:
+        import jax
+        devices = len(jax.devices())
+    devices = int(devices)
+    if device_kind is None:
+        import jax
+        dev = jax.devices()[0]
+        device_kind = dev.device_kind if dev.platform == "tpu" else "cpu"
+    if hbm_budget_bytes is None:
+        from apex_tpu.ops.pallas_config import device_hbm_bytes
+        hbm_budget_bytes = device_hbm_bytes(device_kind)
+
+    mdl = PLAN_MODELS[model](**(model_kw or {}))
+    candidates = _enumerate(mdl, devices, min_mesh=min_mesh)
+    if not candidates:
+        raise PlanError(
+            f"no candidate meshes for model={model} over {devices} "
+            f"device(s) (min_mesh={dict(min_mesh or {})}) — the "
+            f"model's shapes divide none of the factorizations")
+
+    seen_sigs = {}
+    evaluated = []
+    for cand in candidates:
+        traced = mdl.trace(cand.pp)
+        flat_specs = mdl.flat_specs(cand.layout, traced, cand.dp,
+                                    cand.tp)
+        in_vals = _in_vals_for(traced.closed, flat_specs)
+        sig = (cand.pp, cand.dp, cand.tp,
+               tuple(v.spec for v in in_vals))
+        if sig in seen_sigs:
+            # a layout that degenerates to an earlier one at this mesh
+            # (megatron at tp=1 == replicated) would double-report the
+            # same plan under two names
+            continue
+        seen_sigs[sig] = cand
+        stats = estimate_hbm_and_comms(
+            traced.closed, in_vals, donated=traced.donated,
+            axis_sizes={"dp": cand.dp, "tp": cand.tp})
+        cand.peak_hbm_bytes = stats["peak_hbm_bytes"]
+        cand.comms_bytes = _candidate_comms(mdl, traced, cand, stats)
+        cand.modeled_step_ms = round(
+            _modeled_step_s(mdl, traced, cand, device_kind, stats) * 1e3,
+            6)
+        if cand.peak_hbm_bytes > hbm_budget_bytes:
+            cand.status = "pruned:hbm"
+            cand.detail = (
+                f"peak HBM {cand.peak_hbm_bytes} B exceeds the "
+                f"{hbm_budget_bytes} B per-device budget")
+        evaluated.append((cand, traced, in_vals))
+
+    # deterministic ranking: modeled time, then comms, then peak HBM,
+    # then the candidate key — ties can never reorder across runs
+    evaluated.sort(key=lambda e: (e[0].modeled_step_ms,
+                                  e[0].comms_bytes,
+                                  e[0].peak_hbm_bytes, e[0].key))
+
+    chosen = None
+    for cand, traced, in_vals in evaluated:
+        if cand.status.startswith("pruned"):
+            continue
+        if verify:
+            findings = analyze_sharding_jaxpr(
+                traced.closed, in_vals, name=f"plan:{cand.key}",
+                donated=traced.donated,
+                axis_sizes={"dp": cand.dp, "tp": cand.tp},
+                hbm_budget_bytes=hbm_budget_bytes)
+            if findings:
+                cand.status = "rejected:checks"
+                cand.detail = "; ".join(
+                    f"{f.check}: {f.message[:120]}" for f in findings)
+                continue
+        chosen = cand
+        cand.status = "chosen"
+        break
+
+    ranked = [c for c, _t, _v in evaluated]
+    if chosen is None:
+        raise PlanError(
+            f"no feasible plan for model={model} over {devices} "
+            f"device(s) (budget {hbm_budget_bytes} B): "
+            + "; ".join(f"{c.key} -> {c.status} ({c.detail})"
+                        for c in ranked))
+
+    result = Plan(
+        model=model, devices=devices, device_kind=device_kind,
+        hbm_budget_bytes=int(hbm_budget_bytes),
+        mesh=chosen.mesh, layout=chosen.layout,
+        specs=mdl.emit_specs(chosen.layout, chosen.dp, chosen.tp),
+        predicted={
+            "step_ms": chosen.modeled_step_ms,
+            "comms_bytes": chosen.comms_bytes,
+            "peak_hbm_bytes": chosen.peak_hbm_bytes,
+            # the chosen candidate survived every check by construction
+            "findings": 0 if verify else None,
+        },
+        candidates=ranked,
+        model_kw={k: model_kw[k] for k in sorted(model_kw)},
+    )
+    publish_to_registry(result, registry=registry)
+    return result
+
+
+def modeled_single_device_ms(model="llama", device_kind=None,
+                             **model_kw) -> float:
+    """Modeled step time of the unsharded single-device candidate —
+    the number bench.py calibrates against its measured step."""
+    p = plan(model=model, devices=1, device_kind=device_kind,
+             registry=False, **model_kw)
+    return p.predicted["step_ms"]
+
+
+# ----------------------------------------------------------- reporting
+
+def publish_to_registry(result: Plan, registry=None):
+    """Publish the ranked table as the ``analysis/plan_*`` metric
+    family (the bench JSONL rows tools/metrics_report.py renders and
+    --compare gates plan flips on). ``registry=False`` skips."""
+    if registry is False:
+        return
+    from apex_tpu.observability import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    for cand in result.candidates:
+        labels = {"model": result.model, "candidate": cand.key}
+        reg.gauge("analysis/plan_modeled_step_ms", **labels).set(
+            cand.modeled_step_ms)
+        reg.gauge("analysis/plan_comms_bytes", **labels).set(
+            cand.comms_bytes)
+        reg.gauge("analysis/plan_peak_hbm_bytes", **labels).set(
+            cand.peak_hbm_bytes)
+        reg.gauge("analysis/plan_chosen", **labels).set(
+            1 if cand.status == "chosen" else 0)
+    reg.event("plan", model=result.model, devices=result.devices,
+              chosen=result.chosen_key,
+              predicted_step_ms=result.predicted["step_ms"])
+
+
+def render_table(result: Plan) -> str:
+    from apex_tpu.analysis.sharding_checks import _fmt_bytes
+
+    lines = [
+        f"auto-shard plan: {result.model} over {result.devices} "
+        f"device(s) ({result.device_kind}), HBM budget "
+        f"{_fmt_bytes(result.hbm_budget_bytes)}",
+        f"{'rank':>4s}  {'candidate':28s}  {'modeled':>12s}  "
+        f"{'comms/step':>12s}  {'peak HBM':>10s}  status",
+    ]
+    for rank, cand in enumerate(result.candidates, 1):
+        lines.append(
+            f"{rank:>4d}  {cand.key:28s}  "
+            f"{cand.modeled_step_ms:>9.3f} ms  "
+            f"{_fmt_bytes(cand.comms_bytes):>12s}  "
+            f"{_fmt_bytes(cand.peak_hbm_bytes):>10s}  {cand.status}")
+    mesh = result.mesh
+    verified = result.predicted["findings"]
+    lines.append(
+        f"chosen: pp={mesh['pp']} dp={mesh['dp']} tp={mesh['tp']} "
+        f"layout={result.layout} — "
+        + ("verification skipped (--no-verify)" if verified is None else
+           f"winning specs pass all sharding checks "
+           f"({verified} findings)"))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    """``python -m apex_tpu.analysis plan`` — search, rank, emit."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis plan",
+        description="auto-sharding planner: search mesh/layout space "
+                    "on the sharding-flow cost model")
+    ap.add_argument("--target", "--model", dest="model", default="llama",
+                    help=f"plan model (valid: {sorted(PLAN_MODELS)})")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="device count to plan for (default: visible)")
+    ap.add_argument("--device-kind", default=None,
+                    help="device generation for the cost tables "
+                         "(default: detected; 'cpu' uses v5e priors)")
+    ap.add_argument("--hbm-budget-bytes", type=int, default=None)
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="KEY=INT",
+                    help="model_kw override, e.g. --set layers=16")
+    ap.add_argument("--no-verify", dest="verify", action="store_false",
+                    help="skip the sharding-check vetting of the winner")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="also write the plan JSON to this path")
+    args = ap.parse_args(argv)
+
+    model_kw = {}
+    for entry in args.set:
+        key, sep, value = entry.partition("=")
+        if not sep or not key:
+            print(f"--set expects KEY=INT, got {entry!r}",
+                  file=sys.stderr)
+            return 2
+        try:
+            model_kw[key] = int(value)
+        except ValueError:
+            print(f"--set {key} needs an integer, got {value!r}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        result = plan(model=args.model, devices=args.devices,
+                      device_kind=args.device_kind,
+                      hbm_budget_bytes=args.hbm_budget_bytes,
+                      verify=args.verify, **model_kw)
+    except (ValueError, TypeError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    except PlanError as e:
+        print(f"no feasible plan: {e}", file=sys.stderr)
+        return 1
+
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(result.to_json())
+    if args.json:
+        print(result.to_json(), end="")
+    else:
+        print(render_table(result))
+        if args.out:
+            print(f"plan -> {args.out}")
+    return 0

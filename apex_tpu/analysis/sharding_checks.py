@@ -73,6 +73,8 @@ def _fmt_spec(spec):
 
 
 def _fmt_bytes(n):
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
     if n >= 1 << 20:
         return f"{n / (1 << 20):.1f} MiB"
     if n >= 1 << 10:
@@ -360,17 +362,7 @@ def analyze_sharding(fn, *example_args, name=None, in_specs=None,
     import jax
 
     name = name or getattr(fn, "__name__", "fn")
-    path = f"<jaxpr:{name}>"
-    run = set(checks or SHARDING_CHECKS)
-    unknown = run - set(SHARDING_CHECKS)
-    if unknown:
-        raise ValueError(
-            f"unknown sharding check(s) {sorted(unknown)}; valid: "
-            f"{list(SHARDING_CHECKS)}")
-    if axis_sizes is None:
-        axis_sizes = live_mesh_axis_sizes()
-    if replicated_threshold_bytes is None:
-        replicated_threshold_bytes = DEFAULT_REPLICATED_THRESHOLD
+    _validate_checks(checks)
 
     closed = jax.make_jaxpr(fn)(*example_args)
 
@@ -383,6 +375,58 @@ def analyze_sharding(fn, *example_args, name=None, in_specs=None,
         # an explicit P() asserts full replication and is checked
         in_vals.append(ShardVal(spec=None) if spec is None
                        else ShardVal(spec=normalize_spec(spec, ndim)))
+
+    donated = set()
+    if donate_argnums:
+        import jax as _jax
+        donate = {donate_argnums} if isinstance(donate_argnums, int) \
+            else set(donate_argnums)
+        idx = 0
+        for argnum, arg in enumerate(example_args):
+            n = len(_jax.tree_util.tree_leaves(arg))
+            if argnum in donate:
+                donated.update(range(idx, idx + n))
+            idx += n
+
+    return analyze_sharding_jaxpr(
+        closed, in_vals, name=name, donated=donated,
+        axis_sizes=axis_sizes, checks=checks,
+        hbm_budget_bytes=hbm_budget_bytes,
+        replicated_threshold_bytes=replicated_threshold_bytes,
+        stats_out=stats_out)
+
+
+def _validate_checks(checks):
+    """The requested check-id set, validated loudly (and BEFORE any
+    expensive trace a caller is about to pay for)."""
+    run = set(checks or SHARDING_CHECKS)
+    unknown = run - set(SHARDING_CHECKS)
+    if unknown:
+        raise ValueError(
+            f"unknown sharding check(s) {sorted(unknown)}; valid: "
+            f"{list(SHARDING_CHECKS)}")
+    return run
+
+
+def analyze_sharding_jaxpr(closed, in_vals, *, name, donated=frozenset(),
+                           axis_sizes=None, checks=None,
+                           hbm_budget_bytes=None,
+                           replicated_threshold_bytes=None,
+                           stats_out=None):
+    """Jaxpr-level entry: run the sharding-flow checks over an
+    already-traced ``ClosedJaxpr`` with explicit per-invar
+    :class:`ShardVal` inputs and flat donated indices.
+
+    This is :func:`analyze_sharding` minus the tracing — the hook the
+    auto-sharding planner (:mod:`.planner`) uses to re-check every
+    candidate layout against one trace, so the plan it emits is vetted
+    by exactly the analyses that gate the repo."""
+    path = f"<jaxpr:{name}>"
+    run = _validate_checks(checks)
+    if axis_sizes is None:
+        axis_sizes = live_mesh_axis_sizes()
+    if replicated_threshold_bytes is None:
+        replicated_threshold_bytes = DEFAULT_REPLICATED_THRESHOLD
 
     ctx = _Ctx(name, path)
     visitors = [_VISITORS[c] for c in SHARDING_CHECKS
@@ -398,18 +442,6 @@ def analyze_sharding(fn, *example_args, name=None, in_specs=None,
     if "replicated-large" in run:
         _check_replicated_large(ctx, closed, in_vals, axis_sizes,
                                 replicated_threshold_bytes)
-
-    donated = set()
-    if donate_argnums:
-        import jax as _jax
-        donate = {donate_argnums} if isinstance(donate_argnums, int) \
-            else set(donate_argnums)
-        idx = 0
-        for argnum, arg in enumerate(example_args):
-            n = len(_jax.tree_util.tree_leaves(arg))
-            if argnum in donate:
-                donated.update(range(idx, idx + n))
-            idx += n
 
     stats = estimate_hbm_and_comms(closed, in_vals, donated=donated,
                                    axis_sizes=axis_sizes)

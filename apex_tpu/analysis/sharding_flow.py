@@ -45,19 +45,22 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import weakref
 
 import numpy as np
 
+from apex_tpu.analysis import interp
+from apex_tpu.analysis.interp import MeshCtx
+
 __all__ = [
     "ShardVal", "MeshCtx", "COLLECTIVE_PRIMS", "interpret_sharding",
+    "ShardingLattice", "SHARDING_LATTICE",
     "shard_val_for_aval", "spec_from_partition_spec", "local_bytes",
     "collective_bytes", "estimate_hbm_and_comms", "normalize_spec",
 ]
 
 # Call-like primitives whose bodies run in the caller's value world.
-_CALL_PRIMS = {"pjit", "closed_call", "core_call", "custom_jvp_call",
-               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
-               "checkpoint"}
+_CALL_PRIMS = interp.CALL_PRIMS
 
 # Ops that preserve the value's identity: psum_axes / from_axis_index
 # flow through (a reshaped psum result is still "the psum result").
@@ -137,28 +140,18 @@ def shard_val_for_aval(aval, partition_spec=None,
                     distinct=frozenset(distinct))
 
 
-class MeshCtx:
-    """Axis universe the interpretation runs under: name -> size, plus
-    the manual (shard_map-consumed) axes at the current depth."""
-
-    def __init__(self, axis_sizes=None, manual_axes=frozenset()):
-        self.axis_sizes = dict(axis_sizes or {})
-        self.manual_axes = frozenset(manual_axes)
-
-    def size(self, axis, default=1) -> int:
-        return int(self.axis_sizes.get(axis, default))
-
-    def child(self, extra_sizes=None, extra_manual=()):
-        sizes = dict(self.axis_sizes)
-        if extra_sizes:
-            sizes.update({str(k): int(v) for k, v in extra_sizes.items()})
-        return MeshCtx(sizes, self.manual_axes | frozenset(extra_manual))
-
-
 def _aval_bytes(aval) -> int:
     shape = tuple(getattr(aval, "shape", ()) or ())
-    dtype = np.dtype(str(getattr(aval, "dtype", "float32")))
-    return math.prod(shape or (1,)) * dtype.itemsize
+    dtype = getattr(aval, "dtype", "float32")
+    try:
+        itemsize = np.dtype(str(dtype)).itemsize
+    except TypeError:
+        # exotic dtypes numpy cannot parse by name — jax's float0
+        # tangent (zero bytes) being the one AD actually produces (an
+        # int-input value_and_grad trace carries it); trust the dtype's
+        # own itemsize when it has one
+        itemsize = getattr(dtype, "itemsize", 0) or 0
+    return math.prod(shape or (1,)) * itemsize
 
 
 def local_bytes(aval, val, ctx: MeshCtx) -> int:
@@ -465,30 +458,10 @@ def _transfer(eqn, ins, out_avals, ctx: MeshCtx):
 
 # ----------------------------------------------------------- interp
 
-def _is_var(v):
-    import jax.core as core
-    return isinstance(v, core.Var)
-
-
-def _closed_jaxprs_in(value):
-    import jax.core as core
-    out = []
-    if isinstance(value, (core.ClosedJaxpr, core.Jaxpr)):
-        out.append(value)
-    elif isinstance(value, (tuple, list)):
-        for v in value:
-            out.extend(_closed_jaxprs_in(v))
-    return out
-
-
-def _jaxpr_of(obj):
-    import jax.core as core
-    return obj.jaxpr if isinstance(obj, core.ClosedJaxpr) else obj
-
-
-def _consts_of(obj):
-    import jax.core as core
-    return obj.consts if isinstance(obj, core.ClosedJaxpr) else ()
+_is_var = interp.is_var
+_closed_jaxprs_in = interp.closed_jaxprs_in
+_jaxpr_of = interp.jaxpr_of
+_consts_of = interp.consts_of
 
 
 def _names_to_spec(names, ndim):
@@ -500,158 +473,45 @@ def _names_to_spec(names, ndim):
     return tuple(spec)
 
 
-class _Interp:
-    def __init__(self, visit):
-        self.visit = visit
+class ShardingLattice(interp.Lattice):
+    """The placement value semantics, plugged into the unified walk
+    (:mod:`.interp`). Scan/while carries run the two-pass fixpoint (a
+    loop-carried value picks up distinctness on iteration 1 — e.g. a
+    pipeline carry init'd to zeros but fed by a ppermute — so the body
+    runs once silently and the output carries join into the input
+    carries before the visited pass). ``shard_map`` is the world
+    boundary: entering strips the manual axes into ``distinct``;
+    leaving rebuilds the outer ``spec`` from ``out_names``."""
 
-    def run(self, jaxpr, consts, in_vals, ctx: MeshCtx):
-        env = {}
+    name = "sharding"
+    warm_carry_join = True
 
-        def write(var, val):
-            if _is_var(var):
-                env[var] = val
+    def for_aval(self, aval):
+        return shard_val_for_aval(aval)
 
-        def read(atom):
-            return env.get(atom) if _is_var(atom) else None
+    def transfer(self, eqn, ins, out_avals, ctx):
+        return _transfer(eqn, ins, out_avals, ctx)
 
-        for var in jaxpr.constvars:
-            write(var, shard_val_for_aval(var.aval))
-        for var, val in zip(jaxpr.invars, in_vals):
-            write(var, val if val is not None
-                  else shard_val_for_aval(var.aval))
-        for var in jaxpr.invars:
-            if var not in env:
-                write(var, shard_val_for_aval(var.aval))
+    def bind_sub(self, aval, val):
+        ndim = len(getattr(aval, "shape", ()) or ())
+        if val is None:
+            return shard_val_for_aval(aval)
+        if val.spec is not None and len(val.spec) != ndim:
+            return val.with_(spec=normalize_spec(None, ndim))
+        return val
 
-        for eqn in jaxpr.eqns:
-            ins = tuple(read(v) for v in eqn.invars)
-            sub = self._maybe_call(eqn, ins, ctx)
-            if sub is not None:
-                outs = sub
-            else:
-                outs = _transfer(
-                    eqn, ins, tuple(v.aval for v in eqn.outvars), ctx)
-            if self.visit is not None:
-                self.visit(eqn, ins, outs, ctx)
-            for var, val in zip(eqn.outvars, outs):
-                write(var, val)
+    def fix_out(self, aval, val, restack=False):
+        ndim = len(getattr(aval, "shape", ()) or ())
+        if val is None:
+            return shard_val_for_aval(aval)
+        if val.spec is not None and len(val.spec) != ndim:
+            if restack and len(val.spec) == ndim - 1:
+                # stacked scan ys grow a leading (replicated) dim
+                return val.with_(spec=((),) + val.spec)
+            return val.with_(spec=normalize_spec(None, ndim))
+        return val
 
-        return tuple(
-            env.get(v) if _is_var(v)
-            else shard_val_for_aval(getattr(v, "aval", None))
-            for v in jaxpr.outvars)
-
-    def _maybe_call(self, eqn, ins, ctx):
-        prim = eqn.primitive.name
-        params = eqn.params
-
-        if prim in _CALL_PRIMS:
-            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
-                if key in params:
-                    subs = _closed_jaxprs_in(params[key])
-                    if subs:
-                        return self._run_sub(subs[0], ins, eqn, ctx)
-            return None
-
-        if prim == "scan":
-            subs = _closed_jaxprs_in(params.get("jaxpr"))
-            if not subs:
-                return None
-            n_consts = params.get("num_consts", 0)
-            n_carry = params.get("num_carry", 0)
-            mapped = list(ins)
-            # xs lose their leading (scan) dim inside the body
-            for i in range(n_consts + n_carry, len(mapped)):
-                v = mapped[i]
-                if v is not None and v.spec:
-                    mapped[i] = v.with_(spec=v.spec[1:])
-            # two-pass carry fixpoint: a loop-carried value picks up
-            # distinctness/taints on iteration 1 (e.g. a pipeline carry
-            # init'd to zeros but fed by a ppermute) — run the body once
-            # silently, join the output carries into the input carries,
-            # then run visited so the checks see steady-state values
-            silent = _Interp(None)
-            warm = silent._run_sub(subs[0], tuple(mapped), eqn, ctx,
-                                   restack_from=n_carry)
-            for k in range(min(n_carry, len(warm))):
-                i = n_consts + k
-                if i < len(mapped):
-                    mapped[i] = self._join_branch(mapped[i], warm[k])
-            return self._run_sub(subs[0], tuple(mapped), eqn, ctx,
-                                 restack_from=n_carry)
-
-        if prim == "while":
-            subs = _closed_jaxprs_in(params.get("body_jaxpr"))
-            if not subs:
-                return None
-            n_cond = params.get("cond_nconsts", 0)
-            body_ins = list(ins[n_cond:])
-            n_body = params.get("body_nconsts", 0)
-            silent = _Interp(None)
-            warm = silent._run_sub(subs[0], tuple(body_ins), eqn, ctx)
-            for k in range(len(warm)):
-                i = n_body + k
-                if i < len(body_ins):
-                    body_ins[i] = self._join_branch(body_ins[i], warm[k])
-            return self._run_sub(subs[0], tuple(body_ins), eqn, ctx)
-
-        if prim == "cond":
-            branches = _closed_jaxprs_in(params.get("branches", ()))
-            if not branches:
-                return None
-            outs = None
-            for br in branches:
-                br_outs = self._run_sub(br, ins[1:], eqn, ctx)
-                if outs is None:
-                    outs = list(br_outs)
-                else:
-                    outs = [self._join_branch(a, b)
-                            for a, b in zip(outs, br_outs)]
-            return tuple(outs)
-
-        if prim == "shard_map":
-            subs = _closed_jaxprs_in(params.get("jaxpr", ()))
-            if not subs:
-                return None
-            mesh = params.get("mesh")
-            shape = getattr(mesh, "shape", None)
-            sizes = {str(k): int(v) for k, v in dict(shape).items()} \
-                if shape else {}
-            in_names = params.get("in_names", ())
-            out_names = params.get("out_names", ())
-            inner_ctx = ctx.child(sizes, sizes.keys())
-            sub = _jaxpr_of(subs[0])
-            mapped = []
-            for i, var in enumerate(sub.invars):
-                ndim = len(getattr(var.aval, "shape", ()) or ())
-                names = in_names[i] if i < len(in_names) else {}
-                consumed = frozenset(
-                    str(a) for axes in dict(names or {}).values()
-                    for a in axes)
-                outer = ins[i] if i < len(ins) else None
-                distinct = consumed | (outer.distinct if outer else
-                                       frozenset())
-                mapped.append(ShardVal(spec=normalize_spec(None, ndim),
-                                       distinct=distinct))
-            inner_outs = _Interp(self.visit).run(
-                sub, _consts_of(subs[0]), tuple(mapped), inner_ctx)
-            outs = []
-            for i, var in enumerate(eqn.outvars):
-                ndim = len(getattr(var.aval, "shape", ()) or ())
-                names = out_names[i] if i < len(out_names) else {}
-                inner = inner_outs[i] if i < len(inner_outs) else None
-                pending = inner.pending if inner else frozenset()
-                outs.append(ShardVal(spec=_names_to_spec(names, ndim),
-                                     pending=pending,
-                                     distinct=ctx.manual_axes & (
-                                         inner.distinct if inner
-                                         else frozenset())))
-            return tuple(outs)
-
-        return None
-
-    @staticmethod
-    def _join_branch(a, b):
+    def join_branch(self, a, b):
         if a is None:
             return b
         if b is None:
@@ -667,38 +527,47 @@ class _Interp:
             psum_axes=a.psum_axes & b.psum_axes,
         )
 
-    def _run_sub(self, closed_or_jaxpr, ins, eqn, ctx, restack_from=None):
-        jaxpr = _jaxpr_of(closed_or_jaxpr)
-        consts = _consts_of(closed_or_jaxpr)
-        n = len(jaxpr.invars)
-        bound = list(ins[:n]) + [None] * max(0, n - len(ins))
+    join_carry = join_branch
+
+    def map_scan_xs(self, val):
+        # xs lose their leading (scan) dim inside the body
+        if val.spec:
+            return val.with_(spec=val.spec[1:])
+        return val
+
+    def shard_map_enter(self, eqn, ins, sub, ctx):
+        in_names = eqn.params.get("in_names", ())
         mapped = []
-        for var, val in zip(jaxpr.invars, bound):
+        for i, var in enumerate(sub.invars):
             ndim = len(getattr(var.aval, "shape", ()) or ())
-            if val is None:
-                mapped.append(shard_val_for_aval(var.aval))
-            elif val.spec is not None and len(val.spec) != ndim:
-                mapped.append(val.with_(spec=normalize_spec(None, ndim)))
-            else:
-                mapped.append(val)
-        outs = self.run(jaxpr, consts, tuple(mapped), ctx)
-        out_avals = tuple(v.aval for v in eqn.outvars)
-        fixed = []
-        for i, aval in enumerate(out_avals):
-            ndim = len(getattr(aval, "shape", ()) or ())
-            o = outs[i] if i < len(outs) else None
-            if o is None:
-                fixed.append(shard_val_for_aval(aval))
-            elif o.spec is not None and len(o.spec) != ndim:
-                if restack_from is not None and i >= restack_from \
-                        and len(o.spec) == ndim - 1:
-                    # stacked scan ys grow a leading (replicated) dim
-                    fixed.append(o.with_(spec=((),) + o.spec))
-                else:
-                    fixed.append(o.with_(spec=normalize_spec(None, ndim)))
-            else:
-                fixed.append(o)
-        return tuple(fixed)
+            names = in_names[i] if i < len(in_names) else {}
+            consumed = frozenset(
+                str(a) for axes in dict(names or {}).values()
+                for a in axes)
+            outer = ins[i] if i < len(ins) else None
+            distinct = consumed | (outer.distinct if outer else
+                                   frozenset())
+            mapped.append(ShardVal(spec=normalize_spec(None, ndim),
+                                   distinct=distinct))
+        return mapped
+
+    def shard_map_exit(self, eqn, inner_outs, ctx):
+        out_names = eqn.params.get("out_names", ())
+        outs = []
+        for i, var in enumerate(eqn.outvars):
+            ndim = len(getattr(var.aval, "shape", ()) or ())
+            names = out_names[i] if i < len(out_names) else {}
+            inner = inner_outs[i] if i < len(inner_outs) else None
+            pending = inner.pending if inner else frozenset()
+            outs.append(ShardVal(spec=_names_to_spec(names, ndim),
+                                 pending=pending,
+                                 distinct=ctx.manual_axes & (
+                                     inner.distinct if inner
+                                     else frozenset())))
+        return outs
+
+
+SHARDING_LATTICE = ShardingLattice()
 
 
 def interpret_sharding(closed, in_vals, axis_sizes=None, visit=None):
@@ -713,11 +582,10 @@ def interpret_sharding(closed, in_vals, axis_sizes=None, visit=None):
     """
     if axis_sizes is None:
         axis_sizes = live_mesh_axis_sizes()
-    ctx = MeshCtx(axis_sizes)
-    jaxpr = closed.jaxpr
-    vals = list(in_vals) + [None] * max(
-        0, len(jaxpr.invars) - len(in_vals))
-    return _Interp(visit).run(jaxpr, closed.consts, tuple(vals), ctx)
+    (outs,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(SHARDING_LATTICE, in_vals, visit)],
+        axis_sizes=axis_sizes)
+    return outs
 
 
 def live_mesh_axis_sizes() -> dict:
@@ -765,6 +633,30 @@ def _linearize(jaxpr, env, steps):
         steps.append((eqn, reads))
 
 
+# Linearization depends only on the jaxpr structure, never on in_vals
+# or the mesh — memoize it so the planner's inner loop (many spec
+# candidates x one jaxpr) pays the flattening walk once. Weak keys: the
+# cache must not keep a traced program alive after its caller drops it.
+_LINEARIZE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _linearized(jaxpr):
+    try:
+        hit = _LINEARIZE_CACHE.get(jaxpr)
+    except TypeError:  # unhashable/unweakrefable jaxpr: just rebuild
+        hit = None
+    if hit is None:
+        env: dict = {}
+        steps: list = []
+        _linearize(jaxpr, env, steps)
+        hit = (env, steps)
+        try:
+            _LINEARIZE_CACHE[jaxpr] = hit
+        except TypeError:
+            pass
+    return hit
+
+
 def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
                            axis_sizes=None):
     """Liveness walk over the linearized program.
@@ -780,9 +672,7 @@ def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
     ctx = MeshCtx(axis_sizes)
     jaxpr = closed.jaxpr
 
-    env: dict = {}
-    steps: list = []
-    _linearize(jaxpr, env, steps)
+    env, steps = _linearized(jaxpr)
 
     def canon(v):
         while v in env:

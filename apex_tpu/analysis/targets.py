@@ -804,7 +804,7 @@ SHARDING_TARGETS = (
 )
 
 
-def run_targets(names=None, extra_allow=None):
+def run_targets(names=None, extra_allow=None, timings=None):
     """Run the registered targets; returns (findings, errors) where
     errors maps target name -> repr of an exception that kept the target
     from tracing at all (itself a failure the caller should surface).
@@ -812,7 +812,11 @@ def run_targets(names=None, extra_allow=None):
     ``extra_allow``: {target name: set of check ids} merged over the
     ``@target(allow=...)`` lists — findings of an allowed check from
     that target are dropped (the per-target grandfather the CLI's
-    ``--allow target:check`` feeds)."""
+    ``--allow target:check`` feeds). ``timings``: optional dict that
+    receives per-target wall seconds (the CLI rolls these up into the
+    per-engine gate-latency summary)."""
+    import time
+
     findings, errors = [], {}
     for name, fn in TARGETS.items():
         if names is not None and name not in names:
@@ -820,11 +824,16 @@ def run_targets(names=None, extra_allow=None):
         allowed = set(TARGET_ALLOW.get(name, ()))
         if extra_allow:
             allowed |= set(extra_allow.get(name, ()))
+        t0 = time.perf_counter()  # apex-lint: disable=raw-clock
         try:
             got = fn()
         except Exception as e:  # noqa: BLE001 — report, don't abort the scan
             errors[name] = repr(e)[:300]
             continue
+        finally:
+            if timings is not None:
+                timings[name] = (
+                    time.perf_counter() - t0)  # apex-lint: disable=raw-clock
         if allowed:
             got = [f for f in got if f.check not in allowed]
         findings.extend(got)

@@ -13,7 +13,7 @@ from apex_tpu.parallel.distributed import (
 )
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
 from apex_tpu.parallel.larc import LARC, larc
-from apex_tpu.parallel import multiproc
+from apex_tpu.parallel import auto_shard, multiproc
 
 
 def create_syncbn_process_group(group_size, axis_name="data",
@@ -52,5 +52,5 @@ __all__ = [
     "sync_gradients", "sync_gradients_flat", "average_reduced",
     "sync_autodiff_gradients",
     "SyncBatchNorm", "convert_syncbn_model", "create_syncbn_process_group",
-    "LARC", "larc", "multiproc",
+    "LARC", "larc", "auto_shard", "multiproc",
 ]

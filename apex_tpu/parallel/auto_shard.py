@@ -1,0 +1,133 @@
+"""User-facing auto-sharding API (ISSUE 8): turn a planner
+:class:`~apex_tpu.analysis.planner.Plan` into things a training script
+can execute — a mesh, PartitionSpec trees, and
+``with_sharding_constraint`` application.
+
+    from apex_tpu.parallel import auto_shard
+
+    plan = auto_shard.plan_for("llama", devices=8)
+    mesh = auto_shard.mesh_for(plan)          # Mesh over (pp, dp, tp)
+    specs = auto_shard.spec_group(plan, "layers")   # {name: PartitionSpec}
+    data  = auto_shard.data_spec(plan)
+
+``examples/llama_train.py --auto-shard`` is the end-to-end customer:
+it replaces its hand-picked ``--pp/--dp/--tp`` and spec tables with the
+plan's. Plans round-trip through JSON (:func:`save_plan` /
+:func:`load_plan`) so a search run on a dev box can ship its verdict to
+the fleet; the file is byte-stable for identical inputs, so a committed
+plan doubles as a regression anchor (``tools/metrics_report.py
+--compare`` gates plan flips between runs).
+"""
+
+from __future__ import annotations
+
+import json
+
+from apex_tpu.analysis import planner
+from apex_tpu.analysis.planner import (  # noqa: F401  (re-exported API)
+    Plan,
+    PlanError,
+    entries_to_spec,
+    spec_entries,
+)
+
+__all__ = [
+    "Plan", "PlanError", "plan_for", "mesh_for", "spec_group",
+    "data_spec", "constrain", "save_plan", "load_plan",
+    "spec_entries", "entries_to_spec",
+]
+
+
+def plan_for(model="llama", devices=None, **kw) -> Plan:
+    """Search + verify a plan for ``model`` (see
+    :func:`apex_tpu.analysis.planner.plan`)."""
+    return planner.plan(model=model, devices=devices, **kw)
+
+
+def mesh_for(plan: Plan, devices=None):
+    """A ``jax.sharding.Mesh`` shaped like the plan's (pp, dp, tp).
+
+    ``devices``: explicit device list (default: the first
+    ``plan.devices`` visible devices)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = plan.mesh
+    n = mesh["pp"] * mesh["dp"] * mesh["tp"]
+    devs = list(devices) if devices is not None else jax.devices()[:n]
+    if len(devs) < n:
+        raise ValueError(
+            f"plan wants {n} devices (pp={mesh['pp']} dp={mesh['dp']} "
+            f"tp={mesh['tp']}), only {len(devs)} available")
+    return Mesh(np.asarray(devs[:n]).reshape(
+        mesh["pp"], mesh["dp"], mesh["tp"]), ("pp", "dp", "tp"))
+
+
+def spec_group(plan: Plan, group: str) -> dict:
+    """One named spec table of the plan ("layers", "io", "params", ...)
+    as {name: PartitionSpec}."""
+    table = plan.specs.get(group)
+    if table is None:
+        raise KeyError(
+            f"plan for {plan.model!r} has no spec group {group!r}; "
+            f"has {sorted(plan.specs)}")
+    return {name: entries_to_spec(entries)
+            for name, entries in table.items()}
+
+
+def data_spec(plan: Plan):
+    """The plan's input-batch PartitionSpec."""
+    return entries_to_spec(plan.specs.get("data", []))
+
+
+def constrain(x, plan: Plan, group: str, name=None):
+    """Apply the plan's sharding for ``group`` (or ``group[name]``) to
+    ``x`` via ``with_sharding_constraint`` — the GSPMD way to pin a
+    planned placement inside a jitted step."""
+    import jax
+
+    if name is None:
+        spec = data_spec(plan) if group == "data" \
+            else entries_to_spec(plan.specs[group])
+    else:
+        spec = spec_group(plan, group)[name]
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def save_plan(plan: Plan, path: str) -> str:
+    with open(path, "w") as f:
+        f.write(plan.to_json())
+    return path
+
+
+def load_plan(path: str) -> Plan:
+    """Re-hydrate a saved plan. Loud on schema drift — a stale plan
+    applied to a newer repo is exactly the silent failure the plan file
+    exists to prevent."""
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except ValueError as e:
+            raise ValueError(f"plan file {path} is not JSON: {e}")
+    if not isinstance(data, dict) or data.get("kind") != planner.PLAN_KIND:
+        raise ValueError(
+            f"{path} is not an {planner.PLAN_KIND} file")
+    version = data.get("schema_version")
+    if version != planner.PLAN_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path} has plan schema_version {version}; this reader "
+            f"knows {planner.PLAN_SCHEMA_VERSION}")
+    candidates = [planner.Candidate(
+        pp=c["mesh"]["pp"], dp=c["mesh"]["dp"], tp=c["mesh"]["tp"],
+        layout=c["layout"], comms_bytes=c["comms_bytes"],
+        peak_hbm_bytes=c["peak_hbm_bytes"],
+        modeled_step_ms=c["modeled_step_ms"], status=c["status"],
+        detail=c.get("detail", "")) for c in data.get("candidates", ())]
+    return Plan(
+        model=data["model"], devices=data["devices"],
+        device_kind=data["device_kind"],
+        hbm_budget_bytes=data["hbm_budget_bytes"], mesh=data["mesh"],
+        layout=data["layout"], specs=data["specs"],
+        predicted=data["predicted"], candidates=candidates,
+        model_kw=data.get("model_kw", {}))

@@ -430,6 +430,66 @@ def bench_llama(extras):
     extras["device_kind"] = kind
     print(f"llama: {step_t*1e3:.1f} ms/step  "
           f"{flops/step_t/1e12:.1f} TF/s on {kind}", file=sys.stderr)
+    _plan_calibration(extras, cfg, B_used, step_t, kind)
+
+
+def _plan_calibration(extras, cfg, B_used, step_t, kind):
+    """Auto-shard planner hook (ISSUE 8): the JSON line carries the
+    chosen plan for this machine's device count at the measured model
+    shape, plus the modeled-vs-measured single-device step-time ratio —
+    the cost model's drift signal, tracked per run in the metrics JSONL
+    (``analysis/plan_time_ratio``)."""
+    import jax
+
+    from apex_tpu import observability as obs
+
+    model_kw = dict(
+        layers=cfg.num_layers, hidden=cfg.hidden_size,
+        heads=cfg.num_heads, kv_heads=cfg.num_kv_heads,
+        intermediate=cfg.intermediate_size, vocab=cfg.vocab_size,
+        seq=cfg.max_seq_len, batch=B_used)
+    try:
+        from apex_tpu.analysis import planner
+
+        chosen = planner.plan(
+            model="llama", devices=jax.device_count(), device_kind=kind,
+            registry=obs.get_registry(), **model_kw)
+        extras["plan"] = {
+            "candidate": chosen.chosen_key, "mesh": chosen.mesh,
+            "layout": chosen.layout,
+            "predicted_step_ms": chosen.predicted["step_ms"],
+            "comms_bytes": chosen.predicted["comms_bytes"],
+            "peak_hbm_bytes": chosen.predicted["peak_hbm_bytes"]}
+    except Exception as e:  # the planner must not cost the JSON line
+        extras["plan_error"] = repr(e)[:160]
+    try:
+        from apex_tpu.analysis import planner
+
+        # calibration is about the cost model's TIME, not feasibility:
+        # the measured config already ran here, so bypass the HBM gate
+        # and price the unsharded single-device candidate it used
+        single = planner.plan(
+            model="llama", devices=1, device_kind=kind, registry=False,
+            verify=False, hbm_budget_bytes=1 << 62, **model_kw)
+        predicted_ms = single.predicted["step_ms"]
+        ratio = predicted_ms / (step_t * 1e3) if step_t > 0 else None
+        extras["llama_plan_predicted_ms"] = round(predicted_ms, 3)
+        if ratio is not None:
+            extras["llama_plan_time_ratio"] = round(ratio, 4)
+            reg = obs.get_registry()
+            reg.gauge("analysis/plan_time_ratio", model="llama").set(
+                round(ratio, 4))
+            reg.event("plan_calibration", model="llama",
+                      predicted_ms=round(predicted_ms, 3),
+                      measured_ms=round(step_t * 1e3, 3),
+                      ratio=round(ratio, 4))
+        print(f"llama plan calibration: modeled "
+              f"{predicted_ms:.2f} ms vs measured {step_t*1e3:.2f} ms "
+              f"(ratio {ratio:.3f})" if ratio is not None else
+              "llama plan calibration: no measured step",
+              file=sys.stderr)
+    except Exception as e:
+        extras["plan_calibration_error"] = repr(e)[:160]
 
 
 def bench_resnet(extras):

@@ -31,7 +31,14 @@ def test_auto_honors_verdict():
 
 def test_set_kernel_auto_roundtrip():
     on_tpu = jax.default_backend() == "tpu"
+    # snapshot BOTH tables: restoring the verdicts through
+    # set_kernel_auto(**prev) would re-tag every pin with
+    # "runtime:set_kernel_auto" evidence, clobbering flat_adam's
+    # shipped docs/kernel_cost_study.md (or tuning:) provenance — and
+    # tests/run_analysis/test_provenance.py then fails whenever a
+    # subset runs it after this file (any order must pass)
     prev = pallas_config.kernel_auto()
+    prev_ev = pallas_config.kernel_auto_evidence()
     try:
         pallas_config.set_kernel_auto(layer_norm=False, rms_norm=True)
         with pallas_config.force("auto"):
@@ -43,9 +50,12 @@ def test_set_kernel_auto_roundtrip():
         with pallas_config.force("auto"):
             assert pallas_config.use_pallas("layer_norm") == on_tpu
     finally:
-        pallas_config.set_kernel_auto(
-            **{k: None for k in pallas_config.kernel_auto()})
-        pallas_config.set_kernel_auto(**prev)
+        # exact-state restore (same pattern as tests/run_tuning's
+        # tuning_env fixture): verdicts AND per-key evidence
+        pallas_config._KERNEL_AUTO.clear()
+        pallas_config._KERNEL_AUTO.update(prev)
+        pallas_config._KERNEL_AUTO_EVIDENCE.clear()
+        pallas_config._KERNEL_AUTO_EVIDENCE.update(prev_ev)
 
 
 def test_fused_adam_flat_defers_to_table():

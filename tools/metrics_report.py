@@ -26,8 +26,12 @@ emits:
 
 Metrics JSONL dumps carrying the ``analysis/sharding_*`` family (bench
 runs since ISSUE 4) additionally get a per-target table of estimated
-comms bytes/step and peak live HBM. Unknown ``schema_version`` values
-in analysis reports fail loudly rather than mis-summarizing.
+comms bytes/step and peak live HBM; the ``analysis/plan_*`` family
+(ISSUE 8) renders the auto-shard planner's ranked candidate table and
+its predicted-vs-measured calibration ratio, and ``--compare`` gates a
+chosen-plan flip between runs as a regression. Unknown
+``schema_version`` values in analysis reports fail loudly rather than
+mis-summarizing.
 """
 
 from __future__ import annotations
@@ -192,6 +196,76 @@ def summarize_tuning(path, fam):
         print(line)
 
 
+def render_plan_family(path):
+    """The ``analysis/plan_*`` gauge family from a metrics JSONL dump
+    (None when the file carries none): the auto-shard planner's ranked
+    candidate table (modeled step time, comms bytes, peak HBM, chosen
+    flag) plus the predicted-vs-measured calibration ratio bench.py
+    emits after a planned step runs (ISSUE 8)."""
+    models: dict = {}
+    ratios: dict = {}
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str) or \
+                not name.startswith("analysis/plan_"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        model = labels.get("model", "?")
+        if name == "analysis/plan_time_ratio":
+            ratios[model] = rec.get("value")
+            continue
+        cand = labels.get("candidate")
+        if cand is None:
+            continue
+        key = name[len("analysis/plan_"):]
+        models.setdefault(model, {}).setdefault(cand, {})[key] = \
+            rec.get("value")
+    if not models and not ratios:
+        return None
+    return {"models": models, "ratios": ratios}
+
+
+def summarize_plan(path, fam):
+    print(f"{path}: analysis/plan_* family")
+    for model, cands in sorted(fam["models"].items()):
+        width = max(len(c) for c in cands)
+        print(f"  {model}: {'candidate':{width}s}  {'modeled':>11s}  "
+              f"{'comms/step':>12s}  {'peak HBM':>12s}  chosen")
+        ranked = sorted(
+            cands.items(),
+            key=lambda kv: (kv[1].get("modeled_step_ms") or 0, kv[0]))
+        for cand, row in ranked:
+            ms = row.get("modeled_step_ms")
+            ms_s = f"{ms:.3f} ms" if isinstance(ms, (int, float)) else "-"
+            comms = row.get("comms_bytes")
+            comms_s = _fmt_bytes(comms) \
+                if isinstance(comms, (int, float)) else "-"
+            hbm = row.get("peak_hbm_bytes")
+            hbm_s = _fmt_bytes(hbm) \
+                if isinstance(hbm, (int, float)) else "-"
+            mark = "*" if row.get("chosen") else ""
+            print(f"  {'':{len(model)}s}  {cand:{width}s}  {ms_s:>11s}  "
+                  f"{comms_s:>12s}  {hbm_s:>12s}  {mark}")
+    for model, ratio in sorted(fam["ratios"].items()):
+        print(f"  {model}: modeled/measured step-time ratio {ratio}")
+
+
+def _plan_choices(records):
+    """{model: chosen candidate} from analysis/plan_chosen gauges."""
+    chosen = {}
+    for rec in records:
+        if rec.get("name") != "analysis/plan_chosen":
+            continue
+        if not rec.get("value"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        chosen[labels.get("model", "?")] = labels.get("candidate", "?")
+    return chosen
+
+
 def render_resilience_family(path):
     """The ``resilience/*`` counter family from a metrics JSONL dump
     (None when the file carries none): retries, give-ups, preemptions,
@@ -298,6 +372,22 @@ def compare_metrics(current_path, base_path, threshold=0.10):
             infos.append(f"{name}: p50 {b:.3f} -> {c:.3f} ms ok")
     for name in sorted(set(cur_p50) - set(base_p50)):
         infos.append(f"{name}: new (p50 {cur_p50[name]:.3f})")
+
+    cur_plan, base_plan = _plan_choices(cur), _plan_choices(base)
+    for model in sorted(base_plan):
+        if model not in cur_plan:
+            infos.append(f"plan {model}: only in base "
+                         f"({base_plan[model]})")
+            continue
+        if cur_plan[model] != base_plan[model]:
+            # a plan flip is binary and gated like a race-verdict flip:
+            # the chosen layout changing between runs means either the
+            # cost model moved or the machine did — both need eyes
+            regressions.append(
+                f"plan {model}: chosen candidate flipped "
+                f"{base_plan[model]} -> {cur_plan[model]}")
+        else:
+            infos.append(f"plan {model}: {cur_plan[model]} ok")
 
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
@@ -418,6 +508,13 @@ if __name__ == "__main__":
                                       "sharding_family": fam}))
                 else:
                     summarize_sharding(arg, fam)
+            pl = render_plan_family(arg) if os.path.isfile(arg) \
+                else None
+            if pl is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg, "plan_family": pl}))
+                else:
+                    summarize_plan(arg, pl)
             res = render_resilience_family(arg) if os.path.isfile(arg) \
                 else None
             if res is not None:
