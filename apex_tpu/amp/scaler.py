@@ -40,11 +40,21 @@ def _promote_varying(x, axes):
 
 
 class LossScaleState(NamedTuple):
-    """Functional scaler state (carried through the jitted train step)."""
+    """Functional scaler state (carried through the jitted train step).
+
+    ``steps``/``last_overflow_step``/``skip_streak`` are the ISSUE 9
+    readout fields: the health detectors need *when* the last overflow
+    hit and *how many in a row*, not just the cumulative count — a
+    scaler stuck skipping every step looks identical to a healthy one
+    through ``overflows`` alone until the loss curve dies.
+    """
 
     loss_scale: jax.Array      # f32 scalar
     unskipped: jax.Array       # i32: clean steps since last rescale (ref scaler.py:_unskipped)
     overflows: jax.Array       # i32: total overflow count (diagnostics)
+    steps: jax.Array           # i32: total update() calls
+    last_overflow_step: jax.Array  # i32: step index of newest overflow (-1 = never)
+    skip_streak: jax.Array     # i32: consecutive overflow-skipped steps
 
 
 class LossScaler:
@@ -76,6 +86,9 @@ class LossScaler:
             loss_scale=jnp.asarray(self.init_scale if self.enabled else 1.0, jnp.float32),
             unskipped=jnp.zeros([], jnp.int32),
             overflows=jnp.zeros([], jnp.int32),
+            steps=jnp.zeros([], jnp.int32),
+            last_overflow_step=jnp.full([], -1, jnp.int32),
+            skip_streak=jnp.zeros([], jnp.int32),
         )
 
     # ---- in-graph protocol -------------------------------------------------
@@ -104,9 +117,29 @@ class LossScaler:
         return unscaled, jnp.logical_not(finite)
 
     def update(self, state: LossScaleState, overflow) -> LossScaleState:
-        """Dynamic-scale automaton (ref apex/amp/scaler.py:update_scale)."""
-        if not self.enabled or not self.dynamic:
+        """Dynamic-scale automaton (ref apex/amp/scaler.py:update_scale).
+
+        The diagnostics fields (overflow count/step/streak) advance for
+        ANY enabled scaler — a static scale still skips steps on
+        overflow via ``scaled_update``'s cond, and those skips must be
+        observable; only the scale value itself is dynamic-gated.
+        """
+        if not self.enabled:
             return state
+        overflow = jnp.asarray(overflow)
+        ovf_i = overflow.astype(jnp.int32)
+        # this update closes step index `state.steps` (0-based)
+        diag = dict(
+            overflows=state.overflows + ovf_i,
+            steps=state.steps + 1,
+            last_overflow_step=jnp.where(
+                overflow, state.steps,
+                state.last_overflow_step).astype(jnp.int32),
+            skip_streak=jnp.where(overflow, state.skip_streak + 1,
+                                  0).astype(jnp.int32),
+        )
+        if not self.dynamic:
+            return state._replace(**diag)
         halved = state.loss_scale * self.backoff_factor
         if self.min_loss_scale is not None:  # ref default: no floor
             halved = jnp.maximum(halved, self.min_loss_scale)
@@ -122,10 +155,10 @@ class LossScaler:
         new_unskipped = jnp.where(
             overflow | (state.unskipped + 1 >= self.scale_window),
             0, state.unskipped + 1).astype(jnp.int32)
-        return LossScaleState(
+        return state._replace(
             loss_scale=new_scale,
             unskipped=new_unskipped,
-            overflows=state.overflows + overflow.astype(jnp.int32),
+            **diag,
         )
 
     def loss_scale(self, state: LossScaleState):
@@ -145,11 +178,22 @@ class LossScaler:
         return int(jax.device_get(state.overflows))
 
     def report(self, state: LossScaleState, registry=None,
-               prefix: str = "amp") -> dict:
+               prefix: str = "amp", grads=None, top_k: int = 3) -> dict:
         """Publish scaler health to a metrics registry (default: the
         process registry): gauges ``<prefix>/loss_scale``,
-        ``<prefix>/overflow_count``, ``<prefix>/unskipped_steps``.
-        Returns the values as a dict. One host sync per call."""
+        ``<prefix>/overflow_count``, ``<prefix>/unskipped_steps``,
+        plus (ISSUE 9) ``<prefix>/last_overflow_step`` and
+        ``<prefix>/skip_streak`` — the fields the numerics
+        ``HealthMonitor``'s overflow-streak detector consumes.
+        Returns the values as a dict. One host sync per call.
+
+        ``grads``: pass the (scaled) grads pytree when the last update
+        overflowed and the readout should say WHICH tensors blew up —
+        one fused stats pass names the top-``top_k`` tensors by amax
+        (+ any outright non-finite paths) in an ``amp_overflow`` event
+        and a ``top_offenders`` key. Skipped on clean steps, so the
+        stats pass costs nothing in the steady state.
+        """
         from apex_tpu.observability import get_registry
 
         host = jax.device_get(state)
@@ -157,26 +201,50 @@ class LossScaler:
             "loss_scale": float(host.loss_scale),
             "overflow_count": int(host.overflows),
             "unskipped_steps": int(host.unskipped),
+            "last_overflow_step": int(host.last_overflow_step),
+            "skip_streak": int(host.skip_streak),
         }
         reg = registry if registry is not None else get_registry()
         for name, v in values.items():
             reg.gauge(f"{prefix}/{name}").set(v)
+        if grads is not None and values["skip_streak"] > 0:
+            from apex_tpu.observability import numerics
+
+            per_tensor = numerics.host_tensor_stats(grads)
+            summary = numerics.summarize_stats(per_tensor, top_k=top_k)
+            values["top_offenders"] = summary["worst_amax"]
+            reg.event("amp_overflow", prefix=prefix,
+                      step=values["last_overflow_step"],
+                      skip_streak=values["skip_streak"],
+                      loss_scale=values["loss_scale"],
+                      top_offenders=summary["worst_amax"],
+                      nonfinite_paths=summary["nonfinite_paths"])
         return values
 
     # ---- checkpointing (ref apex/amp/frontend.py:state_dict) --------------
 
     def state_dict(self, state: LossScaleState) -> dict:
+        host = jax.device_get(state)
         return {
-            "loss_scale": jax.device_get(state.loss_scale).item(),
-            "unskipped": jax.device_get(state.unskipped).item(),
-            "overflows": jax.device_get(state.overflows).item(),
+            "loss_scale": host.loss_scale.item(),
+            "unskipped": host.unskipped.item(),
+            "overflows": host.overflows.item(),
+            "steps": host.steps.item(),
+            "last_overflow_step": host.last_overflow_step.item(),
+            "skip_streak": host.skip_streak.item(),
         }
 
     def load_state_dict(self, d: dict) -> LossScaleState:
+        # .get defaults: dicts written before the ISSUE 9 fields load
+        # with the "never overflowed yet" readout
         return LossScaleState(
             loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
             unskipped=jnp.asarray(d["unskipped"], jnp.int32),
             overflows=jnp.asarray(d.get("overflows", 0), jnp.int32),
+            steps=jnp.asarray(d.get("steps", 0), jnp.int32),
+            last_overflow_step=jnp.asarray(
+                d.get("last_overflow_step", -1), jnp.int32),
+            skip_streak=jnp.asarray(d.get("skip_streak", 0), jnp.int32),
         )
 
 

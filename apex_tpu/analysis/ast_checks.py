@@ -50,6 +50,21 @@ lived. Checks:
                       ``__enter__``/``__exit__`` pairing inside another
                       context manager's protocol is the one sanctioned
                       shape (suppress with a justification).
+- ``host-isnan-in-step-loop``
+                      a ``jnp.isnan``/``jnp.isinf`` result pulled to
+                      host (``bool()``/``float()``/``.item()``/
+                      ``.tolist()``, or used directly as an ``if``
+                      condition) lexically inside a ``for``/``while``
+                      body in ``apex_tpu/`` or ``examples/``: each
+                      pull is a device round-trip PER TENSOR PER STEP
+                      that serializes the dispatch pipeline — the
+                      exact anti-pattern the numerics tier exists to
+                      replace. Route finiteness checks through
+                      ``apex_tpu.observability.numerics`` (one fused
+                      on-device reduction for the whole tree, host
+                      pull decimated to every N steps); the numerics
+                      module itself is exempt — it IS the sanctioned
+                      implementation.
 - ``hardcoded-tile-size``
                       an integer tile constant fed to ``pl.BlockSpec``
                       outside ``ops/pallas_config.py`` and the tuner's
@@ -78,7 +93,8 @@ from apex_tpu.analysis.findings import Finding, is_suppressed
 AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "mutable-default", "raw-clock",
               "swallowed-exception-in-step-loop",
-              "hardcoded-tile-size", "unclosed-span")
+              "hardcoded-tile-size", "unclosed-span",
+              "host-isnan-in-step-loop")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -129,6 +145,22 @@ _SPAN_NAMES = ("span", "scope")
 
 def _unclosed_span_applies(path: str) -> bool:
     return _swallowed_exc_applies(path)
+
+
+# host-isnan-in-step-loop polices the same ground (library +
+# examples step loops), minus the numerics package — it IS the
+# sanctioned decimated/fused implementation of these checks.
+_ISNAN_EXEMPT_PREFIX = "apex_tpu/observability/numerics/"
+
+
+def _host_isnan_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if _ISNAN_EXEMPT_PREFIX in norm:
+        return False
+    return _swallowed_exc_applies(path)
+
+
+_ISNAN_NAMES = frozenset({"isnan", "isinf"})
 
 
 # hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
@@ -273,6 +305,10 @@ class _Visitor(ast.NodeVisitor):
         # (a with item's context expression, an enter_context argument)
         # — recorded by the parent before the call itself is visited
         self._cm_calls: set = set()
+        # host-isnan-in-step-loop: Call nodes already reported through
+        # an enclosing pull (an `if` test, an outer bool()) — one
+        # finding per pull site, not one per nested call
+        self._isnan_handled: set = set()
 
     def visit_Import(self, node):
         for alias in node.names:
@@ -381,7 +417,57 @@ class _Visitor(ast.NodeVisitor):
         self.loop_depth[-1] -= 1
 
     visit_AsyncFor = visit_For
-    visit_While = visit_For
+
+    def visit_While(self, node):
+        # the While TEST re-evaluates every iteration: an isnan there
+        # is a per-step host pull even when the loop itself is
+        # top-level
+        self._check_isnan_condition(node.test)
+        self.loop_depth[-1] += 1
+        self.generic_visit(node)
+        self.loop_depth[-1] -= 1
+
+    def visit_If(self, node):
+        if self.loop_depth[-1] > 0:
+            self._check_isnan_condition(node.test)
+        self.generic_visit(node)
+
+    # ---------------------------------------------- host isnan pulls
+
+    def _isnan_call_in(self, node):
+        """First ``jnp.isnan``/``jnp.isinf`` Call in the subtree (the
+        jax one — resolved through the module's imports so a host-side
+        ``np.isnan(loss)`` on a Python float never matches)."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            chain = _attr_chain(sub.func)
+            if not chain or chain[-1] not in _ISNAN_NAMES:
+                continue
+            res = self._resolve(chain)
+            if res[0] in ("jax", "jnp"):
+                return sub
+        return None
+
+    def _emit_isnan_pull(self, container, line, via):
+        for sub in ast.walk(container):
+            if isinstance(sub, ast.Call):
+                self._isnan_handled.add(id(sub))
+        self._emit(
+            "host-isnan-in-step-loop", "error", line,
+            f"jnp.isnan/jnp.isinf result pulled to host ({via}) inside "
+            f"a step loop: one device round-trip per tensor per "
+            f"iteration, serializing the dispatch pipeline — use "
+            f"apex_tpu.observability.numerics (tensor_stats / "
+            f"StatsCollector: one fused on-device reduction for the "
+            f"whole tree, host pull decimated to every N steps)")
+
+    def _check_isnan_condition(self, test):
+        if "host-isnan-in-step-loop" not in self.checks:
+            return
+        if self._isnan_call_in(test) is not None:
+            self._emit_isnan_pull(test, test.lineno,
+                                  "used as a branch condition")
 
     def visit_With(self, node):
         for item in node.items:
@@ -452,6 +538,20 @@ class _Visitor(ast.NodeVisitor):
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
         tail = chain[-1] if chain else None
+
+        if "host-isnan-in-step-loop" in self.checks and \
+                self.loop_depth[-1] > 0 and \
+                id(node) not in self._isnan_handled:
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ("bool", "float") and node.args and \
+                    self._isnan_call_in(node.args[0]) is not None:
+                self._emit_isnan_pull(node, node.lineno,
+                                      f"via {node.func.id}()")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("item", "tolist") and \
+                    self._isnan_call_in(node.func.value) is not None:
+                self._emit_isnan_pull(node, node.lineno,
+                                      f"via .{node.func.attr}()")
 
         if tail == "BlockSpec" and "hardcoded-tile-size" in self.checks:
             self._check_blockspec_shape(node)
@@ -559,6 +659,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # unclosed-span: same ground — instrumented library + example code
     if not _unclosed_span_applies(abspath or relpath):
         checks = checks - {"unclosed-span"}
+    # host-isnan: step loops again, minus the numerics package (the
+    # sanctioned fused/decimated implementation)
+    if not _host_isnan_applies(abspath or relpath):
+        checks = checks - {"host-isnan-in-step-loop"}
     # hardcoded-tile-size: pallas_config + the tuner search space are
     # the sanctioned homes for tile numbers
     if not _tile_size_applies(abspath or relpath):

@@ -33,11 +33,22 @@ legitimately differ:
 Entry point: :func:`interpret_lattices`. The single-engine entry
 points (``dataflow.interpret``, ``sharding_flow.interpret_sharding``)
 are thin wrappers that pass exactly one lattice.
+
+ISSUE 9 adds a third domain: :class:`NonFiniteLattice`, the
+non-finite taint lattice under
+:mod:`apex_tpu.observability.numerics.nan_probe`. Unlike the precision
+and sharding lattices it carries CONCRETE values when it can (the
+probe replays the failing step's jaxpr with the actual tensors), so
+"did this primitive produce the first NaN?" is answered by evaluating
+the primitive, not by approximating it — with a pure taint fallback
+(any non-finite input taints every output) wherever concrete replay is
+impossible (pallas kernels, shape-changing structural re-entries).
 """
 
 from __future__ import annotations
 
-__all__ = ["Lattice", "LatticeRun", "MeshCtx", "interpret_lattices"]
+__all__ = ["Lattice", "LatticeRun", "MeshCtx", "NFVal",
+           "NonFiniteLattice", "interpret_lattices"]
 
 # Call-like primitives whose bodies run in the caller's value world.
 CALL_PRIMS = frozenset({
@@ -139,6 +150,15 @@ class Lattice:
     def join_branch(self, a, b):
         """Join the same output slot across cond branches."""
         return a if a is not None else b
+
+    def cond_branch_index(self, ins):
+        """Index of the branch this lattice KNOWS will run (from its
+        abstract view of the cond's index operand), or None to walk
+        and join every branch. The walk honors it only when every
+        participating lattice names the same branch — the abstract
+        engines (precision/sharding) return None by design: their
+        verdicts must cover all paths."""
+        return None
 
     def join_carry(self, orig, warm):
         """Join a warm-pass output carry into the input carry; the
@@ -287,6 +307,16 @@ class _Walk:
             if not branches:
                 return None
             pred_less = [col[1:] for col in ins_cols]
+            # concrete-replay lattices can name the branch that will
+            # actually run; walking (and joining) the untaken branch
+            # would blame its primitives for values that never existed
+            picks = {lat.cond_branch_index(ins_cols[k])
+                     for k, lat in enumerate(self.lattices)}
+            if len(picks) == 1:
+                pick = picks.pop()
+                if pick is not None and 0 <= pick < len(branches):
+                    return self._run_sub(branches[pick], pred_less,
+                                         eqn, ctx)
             outs_cols = None
             for br in branches:
                 br_cols = self._run_sub(br, pred_less, eqn, ctx)
@@ -377,3 +407,200 @@ def interpret_lattices(closed, runs, axis_sizes=None):
     walk = _Walk([run.lattice for run in runs],
                  [run.visit for run in runs])
     return walk.run(jaxpr, closed.consts, in_cols, ctx)
+
+
+# ------------------------------------------------- non-finite taint
+
+
+class NFVal:
+    """One point of the non-finite lattice: ``finite`` is True (proven
+    finite), False (contains NaN/Inf), or None (unknown); ``val`` is
+    the concrete array when the replay still has one."""
+
+    __slots__ = ("finite", "val")
+
+    def __init__(self, finite=None, val=None):
+        self.finite = finite
+        self.val = val
+
+    @classmethod
+    def known(cls, val):
+        return cls(finite=_finite_of(val), val=val)
+
+    def __repr__(self):
+        return (f"NFVal(finite={self.finite}, "
+                f"concrete={self.val is not None})")
+
+
+def _finite_of(val):
+    """True/False for arrays whose finiteness is checkable, None
+    otherwise (opaque objects, exotic dtypes). Integer/bool values are
+    finite by construction."""
+    import numpy as np
+    try:
+        arr = np.asarray(val)
+    except Exception:  # noqa: BLE001 — not an array-like
+        return None
+    if arr.dtype.kind in ("i", "u", "b"):
+        return True
+    if arr.dtype.kind not in ("f", "c"):
+        return None
+    try:
+        if arr.dtype.itemsize < 4:  # bf16/f16/fp8: widen for the ufunc
+            arr = arr.astype(np.float32)
+        return bool(np.isfinite(arr).all())
+    except Exception:  # noqa: BLE001 — ml_dtypes gap etc.
+        return None
+
+
+# Primitives concrete replay must not execute: kernels (a replay is a
+# host-side post-mortem — running a device kernel eagerly from it can
+# itself fail or hang) and effectful I/O.
+_NO_EVAL_PRIMS = frozenset({
+    "pallas_call", "infeed", "outfeed", "io_callback", "pure_callback",
+    "custom_partitioning",
+})
+
+
+class NonFiniteLattice(Lattice):
+    """Concrete-replay non-finite taint (see module docstring).
+
+    ``transfer`` evaluates the equation with the concrete input values
+    when every input is available (``prim.bind`` outside any trace =
+    eager evaluation) and derives each output's finite flag from the
+    result. When replay is impossible — an opaque kernel, a value
+    already degraded to taint, a bind error from a structural
+    approximation upstream — it falls back to the taint join: any
+    known-non-finite input marks every output non-finite ("the taint
+    reached this op"), all-finite inputs mark outputs finite only for
+    NaN-incapable output dtypes, else unknown.
+    """
+
+    name = "nonfinite"
+
+    def for_aval(self, aval):
+        return NFVal()
+
+    def for_const(self, var, const):
+        return NFVal.known(const)
+
+    def _literal_vals(self, eqn, ins):
+        """Concrete input list, pulling Literal values straight off the
+        equation (the walk hands None for non-Var inputs)."""
+        vals = []
+        for i, var in enumerate(eqn.invars):
+            nf = ins[i] if i < len(ins) else None
+            if nf is not None and nf.val is not None:
+                vals.append(nf.val)
+            elif nf is None and hasattr(var, "val"):
+                vals.append(var.val)
+            else:
+                return None
+        return vals
+
+    def _taint_join(self, eqn, ins, out_avals):
+        import numpy as np
+        flags = []
+        for i, var in enumerate(eqn.invars):
+            nf = ins[i] if i < len(ins) else None
+            if nf is not None:
+                flags.append(nf.finite)
+            elif hasattr(var, "val"):
+                flags.append(_finite_of(var.val))
+            else:
+                flags.append(None)
+        if any(f is False for f in flags):
+            out = False
+        elif all(f is True for f in flags):
+            out = True
+        else:
+            out = None
+        res = []
+        for aval in out_avals:
+            kind = np.dtype(getattr(aval, "dtype", np.float32)).kind \
+                if hasattr(aval, "dtype") else "f"
+            if kind in ("i", "u", "b"):
+                res.append(NFVal(finite=True))
+            else:
+                res.append(NFVal(finite=out))
+        return tuple(res)
+
+    def transfer(self, eqn, ins, out_avals, ctx):
+        prim = eqn.primitive
+        if prim.name in _NO_EVAL_PRIMS:
+            return self._taint_join(eqn, ins, out_avals)
+        vals = self._literal_vals(eqn, ins)
+        if vals is None:
+            return self._taint_join(eqn, ins, out_avals)
+        try:
+            out = prim.bind(*vals, **eqn.params)
+        except Exception:  # noqa: BLE001 — replay is best-effort; a
+            # bind error (shape drift from a structural approximation,
+            # an unsupported eager prim) degrades to taint, never kills
+            # the probe
+            return self._taint_join(eqn, ins, out_avals)
+        outs = list(out) if prim.multiple_results else [out]
+        if len(outs) != len(out_avals):
+            return self._taint_join(eqn, ins, out_avals)
+        return tuple(NFVal.known(o) for o in outs)
+
+    # structural coercions: concrete values whose shape no longer
+    # matches the target aval drop to flag-only (the finite verdict
+    # still flows; downstream binds fall back to taint)
+
+    def _coerce(self, aval, nf):
+        if nf is None:
+            return NFVal()
+        if nf.val is not None and hasattr(aval, "shape") and \
+                tuple(getattr(nf.val, "shape", ())) != tuple(aval.shape):
+            return NFVal(finite=nf.finite)
+        return nf
+
+    def bind_sub(self, aval, val):
+        return self._coerce(aval, val)
+
+    def fix_out(self, aval, val, restack=False):
+        if restack:
+            return NFVal(finite=None if val is None else val.finite)
+        return self._coerce(aval, val)
+
+    def map_scan_xs(self, val):
+        """The body sees one slice of the xs. A whole-array non-finite
+        flag must survive the slicing: element 0 can be clean while
+        the poison sits in a later row, and replaying the body with
+        the clean slice would launder the taint — drop to flag-only so
+        the body's first consuming primitive is still named."""
+        if val is None or val.val is None:
+            return val
+        if val.finite is False:
+            return NFVal(finite=False)
+        try:
+            return NFVal.known(val.val[0])
+        except Exception:  # noqa: BLE001 — 0-d or exotic container
+            return NFVal(finite=val.finite)
+
+    def cond_branch_index(self, ins):
+        """The cond's index operand (invar 0, an i32 after jax's
+        bool→index conversion) is usually concrete in a replay: name
+        the branch that actually runs so join_branch never blames the
+        untaken one."""
+        nf = ins[0] if ins else None
+        if nf is None or nf.val is None:
+            return None
+        try:
+            import numpy as np
+            idx = np.asarray(nf.val)
+            if idx.ndim != 0:
+                return None
+            return int(idx)
+        except Exception:  # noqa: BLE001 — exotic index value
+            return None
+
+    def join_branch(self, a, b):
+        if a is None or b is None:
+            return a if b is None else b
+        if a.finite is False or b.finite is False:
+            return NFVal(finite=False)
+        if a.finite is True and b.finite is True:
+            return NFVal(finite=True)
+        return NFVal()

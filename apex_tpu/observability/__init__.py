@@ -17,6 +17,11 @@ The single layer the whole stack reports through:
 - :mod:`~apex_tpu.observability.profiling` — span tracing (ring
   buffer + Perfetto export), per-step phase attribution, xplane
   device attribution, and the stall flight recorder (ISSUE 7);
+- :mod:`~apex_tpu.observability.numerics` — on-device tensor stats
+  (fused amax/l2/underflow/finite pass, decimated host pulls), amax
+  history rings (the fp8 delayed-scaling substrate), NaN/Inf
+  provenance via jaxpr replay, and training-health detectors
+  (ISSUE 9);
 - ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
   summary CLI (also ``tools/metrics_report.py``); ``... trace <run>``
   exports a span dump or xplane capture as Perfetto JSON.
@@ -60,6 +65,12 @@ from apex_tpu.observability.profiling import (  # noqa: F401
     set_tracer,
     span,
 )
+from apex_tpu.observability import numerics  # noqa: F401
+from apex_tpu.observability.numerics import (  # noqa: F401
+    AmaxHistory,
+    HealthMonitor,
+    StatsCollector,
+)
 from apex_tpu.observability.scope import annotate, scope  # noqa: F401
 from apex_tpu.observability.step_report import (  # noqa: F401
     STEP_RECORD_FIELDS,
@@ -79,4 +90,5 @@ __all__ = [
     "StepPhases", "FlightRecorder",
     "StepReporter", "STEP_RECORD_FIELDS", "peak_flops",
     "transformer_step_flops",
+    "numerics", "StatsCollector", "AmaxHistory", "HealthMonitor",
 ]

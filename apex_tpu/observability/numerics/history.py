@@ -1,0 +1,152 @@
+"""Per-tensor amax history rings (ISSUE 9 tentpole piece 2) — the fp8
+delayed-scaling primitive ROADMAP item 5 is blocked on.
+
+Transformer-Engine-style delayed scaling (PAPERS.md fp8-formats,
+Micikevicius et al.) chooses each tensor's fp8 scale from the MAX of
+its last H observed amaxes rather than the current step's — one step of
+staleness buys a scale that is already on device when the cast runs.
+:class:`AmaxHistory` keeps those rings for a whole pytree as ONE
+``f32[n, H]`` matrix (n = inexact leaves, aligned with
+``stats.leaf_paths`` order) plus a shared cursor, so the per-step
+update is a single on-device column write fed straight from the
+stacked ``TreeStats.amax`` vector — no per-tensor bookkeeping.
+
+The ring state is a plain pytree of arrays
+(:class:`AmaxHistoryState`), so it checkpoints by riding the train
+state through ``apex_tpu.checkpoint``'s atomic manifest protocol
+(commit marker + crc32) like any other leaf — auto-resume restores the
+rings **bit-identical** (proved by
+``tests/run_resilience/test_numerics_roundtrip.py`` under the PR 5
+chaos harness), which is what keeps a delayed-scaling run's scale
+choices replay-stable across preemption.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+__all__ = [
+    "F8_E4M3_MAX", "F8_E5M2_MAX", "AmaxHistoryState", "AmaxHistory",
+]
+
+#: largest representable magnitudes of the fp8 formats the delayed
+#: scales target (E4M3 for fwd activations/weights, E5M2 for grads).
+F8_E4M3_MAX = 448.0
+F8_E5M2_MAX = 57344.0
+
+
+class AmaxHistoryState(NamedTuple):
+    """Functional ring state — carry it in the train state pytree."""
+
+    ring: object     # f32[n, H]  per-tensor amax ring
+    cursor: object   # i32        next column to write
+    filled: object   # i32        columns written so far (<= H)
+
+
+class AmaxHistory:
+    """Fixed-structure amax rings for the tensors named by ``paths``.
+
+    The object itself is static configuration (paths, ring length);
+    all mutable state lives in :class:`AmaxHistoryState` so
+    ``update``/``amax``/``scales`` are jit-safe and the state
+    checkpoints/donates like any other pytree.
+    """
+
+    def __init__(self, paths: Sequence[str], length: int = 16):
+        if length < 1:
+            raise ValueError(f"history length must be >= 1, "
+                             f"got {length}")
+        self.paths = tuple(str(p) for p in paths)
+        self.length = int(length)
+
+    @classmethod
+    def for_tree(cls, tree, length: int = 16) -> "AmaxHistory":
+        """History sized/ordered for ``tree``'s inexact leaves — the
+        same order ``stats.tensor_stats`` stacks."""
+        from apex_tpu.observability.numerics import stats
+        return cls(stats.leaf_paths(tree), length=length)
+
+    def index(self, path: str) -> int:
+        return self.paths.index(path)
+
+    # ---- jit-safe state protocol -------------------------------------
+
+    def init(self) -> AmaxHistoryState:
+        import jax.numpy as jnp
+        return AmaxHistoryState(
+            ring=jnp.zeros((len(self.paths), self.length), jnp.float32),
+            cursor=jnp.zeros([], jnp.int32),
+            filled=jnp.zeros([], jnp.int32),
+        )
+
+    def update(self, state: AmaxHistoryState,
+               amax) -> AmaxHistoryState:
+        """Write one step's stacked amax vector (``f32[n]`` —
+        ``TreeStats.amax``) into the rings; one dynamic column write."""
+        import jax
+        import jax.numpy as jnp
+        amax = jnp.asarray(amax, jnp.float32)
+        ring = jax.lax.dynamic_update_slice(
+            state.ring, amax[:, None], (0, state.cursor))
+        return AmaxHistoryState(
+            ring=ring,
+            cursor=(state.cursor + 1) % self.length,
+            filled=jnp.minimum(state.filled + 1, self.length),
+        )
+
+    def update_from(self, state: AmaxHistoryState,
+                    tree_stats) -> AmaxHistoryState:
+        """Feed a :class:`~.stats.TreeStats` straight in."""
+        return self.update(state, tree_stats.amax)
+
+    def amax(self, state: AmaxHistoryState):
+        """Rolling per-tensor amax over the filled slots (``f32[n]``)
+        — the delayed-scaling statistic. Unfilled slots never vote
+        (amax is >= 0, so masking them to 0 is exact); an empty
+        history reports 0."""
+        import jax.numpy as jnp
+        mask = jnp.arange(self.length) < state.filled
+        return jnp.max(jnp.where(mask[None, :], state.ring, 0.0),
+                       axis=1)
+
+    def scales(self, state: AmaxHistoryState,
+               fp8_max: float = F8_E4M3_MAX, margin: float = 0.0):
+        """Per-tensor delayed scale ``fp8_max / (rolling_amax * 2^m)``
+        (``f32[n]``): multiply a tensor by its scale before the fp8
+        cast so the history's max lands at the format's edge. Tensors
+        with no signal yet (rolling amax 0) scale by 1."""
+        import jax.numpy as jnp
+        rolling = self.amax(state) * (2.0 ** margin)
+        return jnp.where(rolling > 0.0, fp8_max / jnp.maximum(
+            rolling, jnp.finfo(jnp.float32).tiny), 1.0)
+
+    # ---- host-side serialization (non-pytree paths) ------------------
+
+    def state_dict(self, state: AmaxHistoryState) -> dict:
+        """Plain-JSON form, for callers that persist outside the
+        checkpoint tree. The pytree-through-checkpoint.py route is the
+        canonical (bit-identical) one."""
+        import jax
+        host = jax.device_get(state)
+        return {"paths": list(self.paths), "length": self.length,
+                "ring": [[float(v) for v in row]
+                         for row in host.ring],
+                "cursor": int(host.cursor), "filled": int(host.filled)}
+
+    def load_state_dict(self, d: dict) -> AmaxHistoryState:
+        import jax.numpy as jnp
+        if tuple(d.get("paths", ())) != self.paths:
+            raise ValueError(
+                "amax-history state was recorded for a different "
+                "tensor set; refusing to misalign rings "
+                f"({len(d.get('paths', ()))} recorded vs "
+                f"{len(self.paths)} configured paths)")
+        if int(d.get("length", self.length)) != self.length:
+            raise ValueError(
+                f"amax-history length mismatch: state has "
+                f"{d.get('length')}, configured {self.length}")
+        return AmaxHistoryState(
+            ring=jnp.asarray(d["ring"], jnp.float32),
+            cursor=jnp.asarray(d["cursor"], jnp.int32),
+            filled=jnp.asarray(d["filled"], jnp.int32),
+        )

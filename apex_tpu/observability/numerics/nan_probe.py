@@ -1,0 +1,191 @@
+"""NaN/Inf provenance (ISSUE 9 tentpole piece 3): which primitive
+went non-finite first, and where in the source it lives.
+
+When the resilience ladder trips on a non-finite step (or the amp
+scaler overflows forever), a "state has NaNs" verdict is useless to an
+oncall — the question is *which tensor* drifted and *which op* first
+produced a non-finite value. This module answers it by replaying the
+step's jaxpr under the unified interpreter's non-finite taint lattice
+(:class:`apex_tpu.analysis.interp.NonFiniteLattice`): the walk
+re-evaluates each primitive with the step's CONCRETE inputs, and the
+first equation whose output is non-finite is classified
+
+- ``origin``     — its inputs were finite: this primitive *created*
+  the NaN/Inf (an exp overflow, a 0/0) — reported with its name and
+  the user source location from the equation's ``source_info``;
+- ``inherited``  — a non-finite value already entered through the
+  jaxpr's inputs (an injected ``nan_grads`` corruption, a poisoned
+  checkpoint): the primitive is the first to *touch* the taint, and
+  the offending input tensor paths are named.
+
+Replay runs eagerly on host at post-mortem time — it costs one step of
+eager compute on the failure path and nothing on the hot path.
+Everything degrades gracefully: a step function that is not traceable
+(host pulls inside it) still yields a paths-only report from the
+stats pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["Provenance", "probe_fn", "probe_tree", "step_provenance"]
+
+
+@dataclasses.dataclass
+class Provenance:
+    """The post-mortem verdict a ``TrainAborted`` report carries."""
+
+    ok: bool                          # True = nothing non-finite found
+    kind: Optional[str] = None        # "origin" | "inherited"
+    primitive: Optional[str] = None   # first offending primitive
+    source: Optional[str] = None      # user source location
+    input_paths: tuple = ()           # non-finite probe inputs
+    output_paths: tuple = ()          # non-finite tensors (state/outs)
+    message: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok, "kind": self.kind,
+            "primitive": self.primitive, "source": self.source,
+            "input_paths": list(self.input_paths),
+            "output_paths": list(self.output_paths),
+            "message": self.message,
+        }
+
+
+def _source_of(eqn) -> Optional[str]:
+    """Best-effort user source location of an equation ("file:line
+    (function)") — jax-version-tolerant, never raises."""
+    try:
+        from jax._src import source_info_util
+        return str(source_info_util.summarize(eqn.source_info))
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        return None
+
+
+def probe_tree(tree) -> Provenance:
+    """Paths-only provenance: name the non-finite tensors of ``tree``
+    (one fused reduction + one fetch; no jaxpr replay)."""
+    from apex_tpu.observability.numerics import stats
+
+    paths = stats.nonfinite_paths(tree)
+    if not paths:
+        return Provenance(ok=True, message="all tensors finite")
+    return Provenance(
+        ok=False, output_paths=paths,
+        message=f"{len(paths)} non-finite tensor(s)")
+
+
+def probe_fn(fn, *args) -> Provenance:
+    """Trace ``fn(*args)``, replay its jaxpr with the concrete ``args``
+    under the non-finite taint lattice, and report the first offending
+    equation (see module docstring). Raises whatever tracing raises —
+    callers that probe arbitrary user functions should catch."""
+    import jax
+
+    from apex_tpu.analysis import interp
+    from apex_tpu.observability.numerics import stats
+
+    closed = jax.make_jaxpr(fn)(*args)
+    flat, _treedef = jax.tree_util.tree_flatten(args)
+    all_paths = stats.tree_paths(args) if len(args) > 1 else \
+        stats.tree_paths(args[0]) if args else ()
+    if len(all_paths) != len(flat):  # container mismatch: fall back to
+        all_paths = tuple(f"arg[{i}]" for i in range(len(flat)))
+
+    in_vals = [interp.NFVal.known(x) for x in flat]
+    bad_inputs = tuple(all_paths[i] for i, v in enumerate(in_vals)
+                       if v.finite is False)
+
+    first: dict = {}
+
+    def visit(eqn, ins, outs, ctx):
+        if first:
+            return
+        if not any(o is not None and o.finite is False for o in outs):
+            return
+        inherited = any(v is not None and v.finite is False
+                        for v in ins)
+        first.update(
+            kind="inherited" if inherited else "origin",
+            primitive=eqn.primitive.name,
+            source=_source_of(eqn))
+
+    lattice = interp.NonFiniteLattice()
+    (outs,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(lattice, in_vals, visit)])
+
+    if first:
+        kind = first["kind"]
+        prim = first["primitive"]
+        src = first["source"]
+        msg = (f"first non-finite value produced by primitive "
+               f"'{prim}'" if kind == "origin" else
+               f"non-finite input first consumed by primitive "
+               f"'{prim}'")
+        if src:
+            msg += f" at {src}"
+        return Provenance(ok=False, kind=kind, primitive=prim,
+                          source=src, input_paths=bad_inputs,
+                          message=msg)
+    if bad_inputs:
+        return Provenance(
+            ok=False, kind="inherited", input_paths=bad_inputs,
+            message="non-finite inputs never consumed by a replayable "
+                    "primitive")
+    if any(o is not None and o.finite is False for o in outs):
+        return Provenance(
+            ok=False, kind="origin",
+            message="non-finite output from an unreplayable region "
+                    "(opaque kernel)")
+    return Provenance(ok=True, message="replay stayed finite")
+
+
+def step_provenance(step_fn, prev_state, bad_state,
+                    step: int) -> Provenance:
+    """The resilience ladder's hook: provenance for a step whose
+    output ``bad_state`` failed the finite check.
+
+    1. The offending tensor paths come from one stats pass over
+       ``bad_state`` (always works).
+    2. When ``step_fn`` traces, replay it on ``prev_state`` — a NaN
+       born inside the step is reported as ``origin`` with its
+       primitive + source location.
+    3. When that replay stays finite (the corruption entered OUTSIDE
+       the traced step: an injected ``nan_grads`` fault, host-side
+       mutation), replay on ``bad_state`` instead and name the first
+       primitive that would consume the poison (``inherited``).
+
+    Never raises: any probe failure degrades to the paths-only report.
+    """
+    try:
+        base = probe_tree(bad_state)
+    except Exception as e:  # noqa: BLE001 — even the stats pass can
+        # die on an exotic state tree; provenance must never mask the
+        # original training failure
+        return Provenance(ok=False,
+                          message=f"probe failed: {e!r:.200}")
+    try:
+        # replay on the pre-step state runs even when the STATE is
+        # finite: a NaN loss with finite params (a metrics-only health
+        # failure) still has an in-step origin worth naming
+        prov = probe_fn(lambda s: step_fn(s, step), prev_state)
+        if not prov.ok:
+            prov.output_paths = base.output_paths
+            return prov
+        if base.ok:
+            return base
+        prov = probe_fn(lambda s: step_fn(s, step), bad_state)
+        if not prov.ok:
+            prov.output_paths = base.output_paths
+            prov.message += (" (step replay on the pre-step state "
+                             "was clean)")
+            return prov
+        base.message += ("; step replay stayed finite — the "
+                         "non-finite values entered outside the "
+                         "traced step")
+    except Exception as e:  # noqa: BLE001 — untraceable step_fn
+        base.message += f"; jaxpr replay unavailable ({e!r:.120})"
+    return base

@@ -60,11 +60,14 @@ def transformer_step_flops(n_params: int, n_layers: int, hidden: int,
 # Fields every step record carries (None when the caller didn't supply
 # the ingredient). tests/run_observability and the analysis
 # step-record-schema target validate against this, so the schema cannot
-# drift silently from its consumers.
+# drift silently from its consumers. ``numerics`` is the ISSUE 9 block:
+# the latest decimated stats-pass summary
+# (``numerics.StatsCollector.last`` — finite flag, non-finite paths,
+# top-k amax tensors, stats-pass cost).
 STEP_RECORD_FIELDS = (
     "reporter", "step", "step_time_ms", "loss", "loss_scale",
     "overflow_count", "grad_norm", "tokens_per_sec", "tflops_per_sec",
-    "mfu",
+    "mfu", "numerics",
 )
 
 
@@ -116,12 +119,16 @@ class StepReporter:
         self.records: list = []
 
     def step(self, step_time_s: float, *, loss=None, scaler_state=None,
-             grad_norm=None, **extra) -> dict:
+             grad_norm=None, numerics=None, **extra) -> dict:
         """Record one step; returns the record's ``fields`` dict.
 
         ``scaler_state``: an ``amp.scaler.LossScaleState`` (or anything
         with ``loss_scale``/``overflows`` attrs) — the loss-scale value
         and cumulative overflow count are host-read from it.
+        ``numerics``: the latest stats-pass summary dict
+        (``numerics.StatsCollector.last``) — attach it every step; the
+        collector only refreshes it on its decimated cadence, so the
+        record says which stats window it was inside.
         """
         step_time_s = float(step_time_s)
         if step_time_s <= 0:
@@ -138,6 +145,7 @@ class StepReporter:
             "tokens_per_sec": None,
             "tflops_per_sec": None,
             "mfu": None,
+            "numerics": dict(numerics) if numerics else None,
         }
         if scaler_state is not None:
             fields["loss_scale"] = _host_float(

@@ -31,6 +31,16 @@ Guarantees (proved by the chaos suite in ``tests/run_resilience/``):
 
 Every decision lands as a ``resilience/*`` counter/event in the
 :mod:`apex_tpu.observability` registry.
+
+ISSUE 9: a health failure additionally runs the numerics NaN probe —
+the offending tensor paths (one fused stats pass over the bad state)
+plus, when the step function traces, the first non-finite primitive
+and its source location from a jaxpr replay
+(:func:`apex_tpu.observability.numerics.step_provenance`). The
+verdict rides every ``rollback`` event and the
+:class:`TrainAborted` report's ``numerics`` block, so an injected
+``nan_grads``/``corrupt_tree`` chaos fault — or the real thing — is
+fully attributable from the abort artifact alone.
 """
 
 from __future__ import annotations
@@ -128,6 +138,10 @@ class ResilientTrainLoop:
         ``check_state_every`` steps all inexact state leaves are finite
         (reduced on device, one host sync — set it to k>1 or 0 on real
         hardware if the per-step fetch matters).
+    numerics_provenance: run the NaN probe on health failures (see
+        module docstring). Post-mortem-path only — costs nothing on
+        healthy steps; disable for step functions whose replay side
+        effects are unacceptable.
     auto_resume: restore from ``directory`` on :meth:`run` entry.
     exit_on_preempt: call ``sys.exit(EXIT_PREEMPTED)`` instead of
         raising :class:`Preempted` (process-boundary behavior for real
@@ -144,7 +158,7 @@ class ResilientTrainLoop:
                  deep_validate_resume: bool = False,
                  exit_on_preempt: bool = False, on_resume=None,
                  registry=None, stall_s: float = 2.0,
-                 flight_recorder=None):
+                 flight_recorder=None, numerics_provenance: bool = True):
         self.step_fn = step_fn
         self.directory = directory
         self.save_every = save_every
@@ -161,6 +175,7 @@ class ResilientTrainLoop:
         self._registry = registry
         self.stall_s = float(stall_s)
         self.flight_recorder = flight_recorder
+        self.numerics_provenance = numerics_provenance
         self.manager = (ckpt.CheckpointManager(
             directory, max_to_keep=max_to_keep, async_save=async_save)
             if directory else None)
@@ -378,9 +393,10 @@ class ResilientTrainLoop:
                 last_error = ValueError(
                     f"non-finite state/metrics at step {step}")
                 recovery_target = max(recovery_target, step)
+                prov = self._probe_numerics(state, new_state, step)
                 state, step, rollbacks = self._rollback(
                     fallback_state, fallback_step, rollbacks, step,
-                    last_error)
+                    last_error, numerics=prov)
                 continue
 
             state = new_state
@@ -429,18 +445,48 @@ class ResilientTrainLoop:
                           error=repr(e)[:200])
         return state
 
+    # ------------------------------------------------------- provenance
+
+    def _probe_numerics(self, prev_state, bad_state, step: int):
+        """NaN provenance for a failed health check (ISSUE 9): the
+        offending tensor paths + (when the step traces) the first
+        non-finite primitive. Never raises — a broken probe degrades
+        to None and the ladder proceeds on the original error."""
+        if not self.numerics_provenance:
+            return None
+        try:
+            from apex_tpu.observability.numerics import step_provenance
+
+            prov = step_provenance(self.step_fn, prev_state, bad_state,
+                                   step).as_dict()
+        except Exception as e:  # noqa: BLE001 — the probe is
+            # diagnostics; it must never mask the health failure
+            prov = {"ok": False,
+                    "message": f"numerics probe failed: {e!r:.200}"}
+        reg = self._reg()
+        reg.counter("numerics/probes").inc()
+        reg.event("numerics_provenance", step=step, **prov)
+        return prov
+
     # --------------------------------------------------------- rollback
 
     def _rollback(self, fallback_state, fallback_step: int,
-                  rollbacks: int, step: int, error):
+                  rollbacks: int, step: int, error, numerics=None):
         """Rung 2: restore the newest valid checkpoint (or the run's
         starting state) and hand back the replay position. Rung 3:
-        past ``max_rollbacks``, abort with the structured report."""
+        past ``max_rollbacks``, abort with the structured report
+        (``numerics`` = the probe verdict, attached to the rollback
+        event and the abort report)."""
         reg = self._reg()
         rollbacks += 1
         reg.counter("resilience/rollbacks").inc()
-        reg.event("rollback", step=step, attempt=rollbacks,
-                  error=repr(error)[:200])
+        event_fields = {"step": step, "attempt": rollbacks,
+                        "error": repr(error)[:200]}
+        if numerics is not None:
+            event_fields["numerics"] = {
+                k: numerics.get(k) for k in
+                ("kind", "primitive", "source", "output_paths")}
+        reg.event("rollback", **event_fields)
         if rollbacks > self.max_rollbacks:
             report = {
                 "step": step,
@@ -455,6 +501,8 @@ class ResilientTrainLoop:
                     if m.kind == "counter"
                     and m.name.startswith("resilience/")},
             }
+            if numerics is not None:
+                report["numerics"] = numerics
             reg.event("train_aborted", **report)
             raise TrainAborted(report)
         if self.manager is not None:
@@ -496,7 +544,10 @@ def chaos_probe(spec: str, directory: str, *, steps: int = 24,
     def step_fn(state, step):
         g = jax.random.normal(jax.random.fold_in(key, step), (16, 16))
         w = state["w"] - 0.01 * (g + 0.1 * state["w"])
-        return {"w": w}, {"loss": float(jnp.mean(w * w))}
+        # loss stays a device scalar: the health check reads it either
+        # way, and keeping the step traceable lets the ISSUE 9 NaN
+        # probe replay its jaxpr when a chaos fault poisons the state
+        return {"w": w}, {"loss": jnp.mean(w * w)}
 
     restarts = 0
     completed = False

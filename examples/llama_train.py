@@ -233,6 +233,13 @@ def main():
         # span window; the data/compute/comms/host fractions land on the
         # StepReporter record, so the step log says WHERE the time went
         phases = obs.StepPhases(name="llama_train/step")
+        # numerics tier (ISSUE 9): a decimated fused stats pass over the
+        # param tree (amax/l2/underflow/finite, ONE host fetch every 8
+        # steps) rides the step record's numerics block, and the health
+        # monitor turns loss trajectories into numerics/* events before
+        # the resilience ladder has to act
+        collector = obs.StatsCollector("llama_train", every=8)
+        health = obs.HealthMonitor("llama_train")
         key = jax.random.PRNGKey(1)
         stats = {"first": None, "last": None}
 
@@ -258,7 +265,10 @@ def main():
                     targets)
                 loss = float(loss)  # host pull: syncs the step chain
                 dt = time.perf_counter() - t0
-            rec = reporter.step(dt, loss=loss, **phases.last_fields())
+            collector.observe({"stage": new_stage, "io": new_io}, it)
+            health.observe(it, loss=loss)
+            rec = reporter.step(dt, loss=loss, numerics=collector.last,
+                                **phases.last_fields())
             if stats["first"] is None:
                 stats["first"] = loss
             stats["last"] = loss

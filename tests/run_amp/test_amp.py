@@ -363,3 +363,71 @@ class TestReferenceParitySurface:
         out = apex_tpu.RankInfoFormatter("%(rank_info)s %(message)s")\
             .format(rec)
         assert out.endswith(" m")
+
+
+class TestScalerReadout:
+    """ISSUE 9 satellite: report() exposes last-overflow step and the
+    consecutive-skip streak, plus the top-k offending tensors when the
+    last update overflowed."""
+
+    def test_streak_and_last_overflow_step(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=2.0 ** 10)
+        st = s.init()
+        ovf = jnp.ones([], jnp.bool_)
+        clean = jnp.zeros([], jnp.bool_)
+        st = s.update(st, clean)            # step 0
+        st = s.update(st, ovf)              # step 1: overflow
+        st = s.update(st, ovf)              # step 2: overflow
+        assert int(st.skip_streak) == 2
+        assert int(st.last_overflow_step) == 2
+        assert int(st.overflows) == 2
+        st = s.update(st, clean)            # step 3: streak resets
+        assert int(st.skip_streak) == 0
+        assert int(st.last_overflow_step) == 2  # history survives
+
+        from apex_tpu.observability import MetricRegistry
+        reg = MetricRegistry()
+        values = s.report(st, registry=reg)
+        assert values["last_overflow_step"] == 2
+        assert values["skip_streak"] == 0
+        assert reg.gauge("amp/last_overflow_step").value == 2
+        assert reg.gauge("amp/skip_streak").value == 0
+
+    def test_static_scaler_tracks_diagnostics(self):
+        s = LossScaler(loss_scale=128.0)
+        st = s.init()
+        st = s.update(st, jnp.ones([], jnp.bool_))
+        assert float(st.loss_scale) == 128.0  # static scale untouched
+        assert int(st.skip_streak) == 1 and int(st.overflows) == 1
+
+    def test_overflow_report_names_top_offenders(self):
+        from apex_tpu.observability import MetricRegistry
+        s = LossScaler(loss_scale="dynamic", init_scale=8.0)
+        st = s.update(s.init(), jnp.ones([], jnp.bool_))
+        reg = MetricRegistry()
+        grads = {"small": jnp.ones((2,)),
+                 "blown": jnp.array([jnp.inf, 1.0]),
+                 "big": jnp.full((2,), 1e4)}
+        values = s.report(st, registry=reg, grads=grads, top_k=2)
+        assert [p for p, _ in values["top_offenders"]] == \
+            ["blown", "big"]
+        events = [e for e in reg.events()
+                  if e["name"] == "amp_overflow"]
+        assert events and \
+            events[0]["fields"]["nonfinite_paths"] == ["blown"]
+        # clean streak: no stats pass, no event
+        st2 = s.update(st, jnp.zeros([], jnp.bool_))
+        values2 = s.report(st2, registry=reg, grads=grads)
+        assert "top_offenders" not in values2
+
+    def test_state_dict_roundtrip_with_legacy_dicts(self):
+        s = LossScaler(loss_scale="dynamic", init_scale=4.0)
+        st = s.update(s.init(), jnp.ones([], jnp.bool_))
+        st2 = s.load_state_dict(s.state_dict(st))
+        assert int(st2.last_overflow_step) == int(st.last_overflow_step)
+        assert int(st2.skip_streak) == int(st.skip_streak)
+        # a pre-ISSUE-9 dict (no new keys) loads with neutral readout
+        legacy = s.load_state_dict(
+            {"loss_scale": 4.0, "unskipped": 3, "overflows": 1})
+        assert int(legacy.last_overflow_step) == -1
+        assert int(legacy.skip_streak) == 0

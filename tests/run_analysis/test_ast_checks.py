@@ -822,3 +822,112 @@ def test_unclosed_span_clean_tree():
         [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
         root=repo, checks=(_UNCLOSED,)) if f.check == _UNCLOSED]
     assert not found, "\n".join(f.render() for f in found)
+
+
+# ------------------------------------------- host-isnan-in-step-loop
+
+_ISNAN = "host-isnan-in-step-loop"
+
+
+def test_host_isnan_bool_pull_in_loop_flagged():
+    """Seeded regression 1: the classic per-step poll — bool() on a
+    jnp.isnan reduction inside the step loop."""
+    src = """
+import jax.numpy as jnp
+
+def train(step_fn, state, n):
+    for it in range(n):
+        state, loss = step_fn(state, it)
+        if bool(jnp.isnan(loss).any()):
+            break
+"""
+    found = _by_check(lint_source(src, "apex_tpu/train.py",
+                                  abspath="/r/apex_tpu/train.py"),
+                      _ISNAN)
+    assert len(found) == 1 and found[0].line == 7
+    assert "observability.numerics" in found[0].message
+
+
+def test_host_isnan_item_and_condition_pulls_flagged():
+    """Seeded regression 2: .item() pulls and bare `if jnp.isinf(...)`
+    conditions (an implicit bool()) inside loops — one finding per
+    pull site, nested wrappers never double-count."""
+    src = """
+import jax.numpy as jnp
+
+def watch(tensors):
+    while True:
+        for t in tensors:
+            if jnp.isinf(t).any().item():
+                return t
+        bad = float(jnp.isnan(tensors[0]).sum())
+"""
+    found = _by_check(lint_source(src, "examples/watch.py",
+                                  abspath="/r/examples/watch.py"),
+                      _ISNAN)
+    assert sorted(f.line for f in found) == [7, 9]
+
+
+def test_host_isnan_clean_and_exempt_cases():
+    # host floats (math/np), on-device isnan use, and out-of-loop
+    # pulls are all idiomatic — no findings
+    clean = """
+import math
+import numpy as np
+import jax.numpy as jnp
+
+def train(step_fn, state, n):
+    for it in range(n):
+        state, loss_f = step_fn(state, it)
+        if math.isnan(loss_f) or np.isnan(loss_f):
+            break
+        state = jnp.where(jnp.isnan(state), 0.0, state)
+
+def once(x):
+    return bool(jnp.isnan(x).any())
+"""
+    assert not _by_check(lint_source(clean, "apex_tpu/train.py",
+                                     abspath="/r/apex_tpu/train.py"),
+                         _ISNAN)
+    # the numerics package is the sanctioned implementation: exempt
+    flagged = """
+import jax.numpy as jnp
+
+def pull(leaves):
+    for leaf in leaves:
+        if bool(jnp.isnan(leaf).any()):
+            return leaf
+"""
+    assert not _by_check(lint_source(
+        flagged, "apex_tpu/observability/numerics/stats.py",
+        abspath="/r/apex_tpu/observability/numerics/stats.py"),
+        _ISNAN)
+    # driver code (tools/, bench.py) is out of scope, like the other
+    # step-loop checks
+    assert not _by_check(lint_source(flagged, "tools/probe.py",
+                                     abspath="/r/tools/probe.py"),
+                         _ISNAN)
+
+
+def test_host_isnan_suppressible_and_repo_clean():
+    src = """
+import jax.numpy as jnp
+
+def train(xs):
+    for x in xs:
+        if bool(jnp.isnan(x).any()):  # apex-lint: disable=host-isnan-in-step-loop
+            break
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py",
+                                     abspath="/r/apex_tpu/a.py"),
+                         _ISNAN)
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
+        root=repo, checks=(_ISNAN,)) if f.check == _ISNAN]
+    assert not found, "\n".join(f.render() for f in found)

@@ -119,3 +119,63 @@ def test_compare_tolerates_truncated_dump(tmp_path):
     with open(cur, "a") as f:
         f.write('{"type": "histogram", "name": "x/step_time_ms", "p5')
     assert _run(cur, "--compare", base).returncode == 0
+
+
+# ----------------------------------------------- numerics gates (ISSUE 9)
+
+def _finite_rec(value, source="train"):
+    return {"type": "gauge", "name": "numerics/finite",
+            "labels": {"source": source}, "value": value}
+
+
+def _grad_norm_rec(p50, source="train"):
+    return {"type": "histogram", "name": "numerics/grad_norm",
+            "labels": {"source": source}, "count": 8,
+            "total": 8 * p50, "min": p50, "max": p50, "mean": p50,
+            "p50": p50, "p90": p50, "p99": p50}
+
+
+def test_compare_finite_flip_and_grad_jump_fail(tmp_path):
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=[_finite_rec(1.0), _grad_norm_rec(1.0)])
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_finite_rec(0.0), _grad_norm_rec(15.0)])
+    # the 10x grad-norm factor is fixed: a huge --compare-threshold
+    # (a step-TIME knob) must not loosen either numerics gate
+    proc = _run(cur, "--compare", base, "--compare-threshold", "100")
+    assert proc.returncode == 1
+    assert "REGRESSION numerics/finite{source=train}" in proc.stdout
+    assert "REGRESSION numerics/grad_norm{source=train}" in proc.stdout
+    assert ">10x jump" in proc.stdout
+
+
+def test_compare_numerics_steady_state_passes(tmp_path):
+    # finite -> finite and a sub-10x grad drift pass; a base that was
+    # ALREADY non-finite doesn't re-fail (not a NEW regression)
+    base = _dump(tmp_path / "base.jsonl", extra=[
+        _finite_rec(1.0), _finite_rec(0.0, source="was_bad"),
+        _grad_norm_rec(1.0)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=[
+        _finite_rec(1.0), _finite_rec(0.0, source="was_bad"),
+        _grad_norm_rec(8.0)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_numerics_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=[
+        _finite_rec(1.0, source="bench/fused_adam"),
+        {"type": "gauge", "name": "numerics/amax_max",
+         "labels": {"source": "bench/fused_adam"}, "value": 3.5},
+        {"type": "gauge", "name": "numerics/stats_pass_ms",
+         "labels": {"source": "bench/fused_adam"}, "value": 0.42},
+        {"type": "gauge", "name": "numerics/stats_interval",
+         "labels": {"source": "bench/fused_adam"}, "value": 4},
+        {"type": "counter", "name": "numerics/grad_norm_spikes",
+         "labels": {"source": "bench/fused_adam"}, "value": 2},
+    ])
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "numerics/* family" in proc.stdout
+    assert "bench/fused_adam" in proc.stdout
+    assert "grad_norm_spikes:2" in proc.stdout

@@ -1,0 +1,326 @@
+"""apex_tpu.observability.numerics — ISSUE 9 unit suite: the fused
+stats pass, the decimated collector, amax-history rings, health
+detectors, NaN provenance, and the StepReporter numerics block."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.observability import (
+    AmaxHistory,
+    HealthMonitor,
+    MetricRegistry,
+    StatsCollector,
+    StepReporter,
+    numerics,
+)
+
+TREE = {
+    "layer": {
+        "w": jnp.array([[1.0, -3.0], [0.5, 2.0]], jnp.float32),
+        "b": jnp.array([0.0, 0.0], jnp.float32),
+    },
+    "half": jnp.array([1.0, 2.0], jnp.bfloat16),
+    "step": jnp.array(7),  # integer leaf: skipped by the stats pass
+}
+
+
+# ------------------------------------------------------------- stats
+
+class TestTensorStats:
+    def test_values_and_paths(self):
+        per = numerics.host_tensor_stats(TREE)
+        assert set(per) == {"layer/b", "layer/w", "half"}
+        w = per["layer/w"]
+        assert w["amax"] == 3.0
+        np.testing.assert_allclose(w["l2"], np.sqrt(1 + 9 + 0.25 + 4))
+        assert w["zero_frac"] == 0.0 and w["finite"]
+        assert per["layer/b"]["zero_frac"] == 1.0
+        assert numerics.leaf_paths(TREE) == ("half", "layer/b",
+                                             "layer/w")
+
+    def test_jit_safe_inside_step(self):
+        """tensor_stats composes into a jitted step — the one-fused-
+        reduction contract."""
+
+        @jax.jit
+        def step(tree):
+            return numerics.tensor_stats(tree)
+
+        stats = step(TREE)
+        assert stats.amax.shape == (3,)
+        per = numerics.host_tensor_stats(TREE, stats)
+        assert per["layer/w"]["amax"] == 3.0
+
+    def test_underflow_fraction_uses_leaf_dtype(self):
+        # 1e-39 is subnormal in f32 (tiny ~1.18e-38) but exactly 0.0
+        # in bf16 — the threshold must be the leaf's own dtype's
+        tree = {"x": jnp.array([1e-39, 1.0], jnp.float32)}
+        per = numerics.host_tensor_stats(tree)
+        assert per["x"]["underflow_frac"] == 0.5
+
+    def test_nonfinite_detection_and_summary(self):
+        tree = {"good": jnp.ones(3),
+                "bad": jnp.array([1.0, jnp.nan]),
+                "big": jnp.array([100.0])}
+        assert numerics.nonfinite_paths(tree) == ("bad",)
+        summary = numerics.summarize_stats(
+            numerics.host_tensor_stats(tree), top_k=2)
+        assert not summary["finite"]
+        assert summary["nonfinite_paths"] == ["bad"]
+        # NaN tensors rank first in worst_amax but never poison the
+        # finite aggregate
+        assert summary["worst_amax"][0][0] == "bad"
+        assert summary["amax_max"] == 100.0
+
+    def test_empty_and_integer_only_tree(self):
+        per = numerics.host_tensor_stats({"n": jnp.array(3)})
+        assert per == {}
+        summary = numerics.summarize_stats(per)
+        assert summary["finite"] and summary["amax_max"] == 0.0
+
+
+class TestStatsCollector:
+    def test_decimation_and_registry_family(self):
+        reg = MetricRegistry()
+        coll = StatsCollector("t", every=4, registry=reg)
+        assert coll.observe(TREE, 0) is not None
+        assert coll.observe(TREE, 1) is None  # off-cadence: no work
+        assert coll.observe(TREE, 3) is None
+        assert coll.observe(TREE, 4) is not None
+        assert reg.counter("numerics/stats_pulls", source="t").value == 2
+        assert reg.gauge("numerics/finite", source="t").value == 1.0
+        assert coll.last["stats_pass_ms"] >= 0
+        events = [e for e in reg.events()
+                  if e["name"] == "numerics_stats"]
+        assert len(events) == 2
+
+    def test_nonfinite_tree_flips_gauge(self):
+        reg = MetricRegistry()
+        coll = StatsCollector("t", every=1, registry=reg)
+        summary = coll.observe({"w": jnp.array([jnp.inf])}, 0)
+        assert not summary["finite"]
+        assert reg.gauge("numerics/finite", source="t").value == 0.0
+        assert reg.counter("numerics/nonfinite_pulls",
+                           source="t").value == 1
+
+
+# ------------------------------------------------------------ history
+
+class TestAmaxHistory:
+    def test_ring_update_and_rolling_amax(self):
+        hist = AmaxHistory(["a", "b"], length=3)
+        st = hist.init()
+        st = hist.update(st, jnp.array([1.0, 10.0]))
+        st = hist.update(st, jnp.array([5.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(hist.amax(st)),
+                                   [5.0, 10.0])
+        # ring wraps: after 3 more updates the first entries age out
+        for v in ([2.0, 1.0], [2.0, 1.0], [2.0, 1.0]):
+            st = hist.update(st, jnp.array(v))
+        np.testing.assert_allclose(np.asarray(hist.amax(st)),
+                                   [2.0, 1.0])
+        assert int(st.filled) == 3
+
+    def test_update_is_jit_safe_and_feeds_from_stats(self):
+        tree = {"w": jnp.array([2.0, -4.0]), "b": jnp.array([1.0])}
+        hist = AmaxHistory.for_tree(tree, length=4)
+        assert hist.paths == numerics.leaf_paths(tree)
+        st = jax.jit(hist.update_from)(hist.init(),
+                                       numerics.tensor_stats(tree))
+        np.testing.assert_allclose(
+            np.asarray(hist.amax(st)), [1.0, 4.0])
+
+    def test_delayed_scales(self):
+        hist = AmaxHistory(["a", "cold"], length=2)
+        st = hist.update(hist.init(), jnp.array([448.0 * 2, 0.0]))
+        scales = np.asarray(hist.scales(st))
+        np.testing.assert_allclose(scales[0], 0.5)
+        assert scales[1] == 1.0  # no signal yet -> identity scale
+
+    def test_state_dict_roundtrip_and_mismatch_guards(self):
+        hist = AmaxHistory(["a", "b"], length=3)
+        st = hist.update(hist.init(), jnp.array([1.5, 2.5]))
+        st2 = hist.load_state_dict(hist.state_dict(st))
+        np.testing.assert_array_equal(np.asarray(st.ring),
+                                      np.asarray(st2.ring))
+        assert int(st2.cursor) == int(st.cursor)
+        other = AmaxHistory(["a", "c"], length=3)
+        with pytest.raises(ValueError):
+            other.load_state_dict(hist.state_dict(st))
+        with pytest.raises(ValueError):
+            AmaxHistory(["a", "b"], length=5).load_state_dict(
+                hist.state_dict(st))
+
+
+# ------------------------------------------------------------- health
+
+class TestHealthMonitor:
+    def test_grad_spike_and_nonfinite(self):
+        reg = MetricRegistry()
+        hm = HealthMonitor("t", registry=reg, min_samples=3)
+        for i in range(4):
+            assert hm.observe(i, grad_norm=1.0) == []
+        events = hm.observe(4, grad_norm=25.0)
+        assert events and events[0]["event"] == "numerics_grad_spike"
+        assert reg.counter("numerics/grad_norm_spikes",
+                           source="t").value == 1
+        events = hm.observe(5, grad_norm=float("nan"))
+        assert events[0]["event"] == "numerics_nonfinite"
+        assert reg.gauge("numerics/finite",
+                         source="t:grad_norm").value == 0.0
+        # the p50 source for the --compare grad-norm gate exists
+        assert reg.histogram("numerics/grad_norm",
+                             source="t").count == 5
+
+    def test_loss_plateau_fires_once(self):
+        reg = MetricRegistry()
+        hm = HealthMonitor("t", registry=reg, plateau_window=4,
+                           min_samples=2)
+        fired = []
+        for i in range(10):
+            fired += hm.observe(i, loss=0.5)
+        assert [e["event"] for e in fired] == ["numerics_loss_plateau"]
+
+    def test_overflow_streak_consumes_scaler_report(self):
+        reg = MetricRegistry()
+        hm = HealthMonitor("t", registry=reg,
+                           overflow_streak_threshold=3)
+        assert hm.observe(0, scaler_report={"skip_streak": 2}) == []
+        events = hm.observe(1, scaler_report={
+            "skip_streak": 3, "last_overflow_step": 1,
+            "loss_scale": 64.0})
+        assert events[0]["event"] == "numerics_overflow_streak"
+        assert reg.gauge("numerics/overflow_streak",
+                         source="t").value == 3
+        assert reg.gauge("numerics/last_overflow_step",
+                         source="t").value == 1
+        # still in the same streak: edge-triggered, no second event
+        assert hm.observe(2, scaler_report={"skip_streak": 4}) == []
+
+
+# ------------------------------------------------------------- probe
+
+class TestNanProbe:
+    def test_origin_names_primitive_and_source(self):
+        def f(x):
+            return jnp.sum(jnp.log(x["w"]))
+
+        prov = numerics.probe_fn(f, {"w": jnp.array([-1.0, 2.0])})
+        assert not prov.ok and prov.kind == "origin"
+        assert prov.primitive == "log"
+        assert prov.source and "test_numerics" in prov.source
+
+    def test_inherited_names_first_touch_and_input_path(self):
+        def g(s):
+            return {"w": s["w"] * 3.0 - 1.0}
+
+        prov = numerics.probe_fn(g, {"w": jnp.array([jnp.nan])})
+        assert not prov.ok and prov.kind == "inherited"
+        assert prov.primitive == "mul"
+        assert prov.input_paths == ("w",)
+
+    def test_origin_found_through_jit_and_scan(self):
+        def h(s):
+            def body(c, _):
+                return c * 10.0, None
+            c, _ = jax.lax.scan(body, s["w"], None, length=3)
+            return jnp.exp(c * 1e5)
+
+        prov = numerics.probe_fn(jax.jit(h), {"w": jnp.array([100.0])})
+        assert not prov.ok and prov.kind == "origin"
+        assert prov.primitive == "exp"
+
+    def test_clean_fn_reports_ok(self):
+        prov = numerics.probe_fn(lambda x: x * 2.0, jnp.ones(3))
+        assert prov.ok
+
+    def test_step_provenance_external_corruption(self):
+        """The injected-corruption shape: the step itself is clean,
+        the NaN arrived from outside — provenance still names the
+        first primitive that would consume it plus the tensor path."""
+
+        def step_fn(state, step):
+            w = state["w"] * 0.99
+            return {"w": w}, {"loss": jnp.sum(w * w)}
+
+        prov = numerics.step_provenance(
+            step_fn, {"w": jnp.ones((2,))},
+            {"w": jnp.full((2,), jnp.nan)}, 3)
+        assert not prov.ok and prov.kind == "inherited"
+        assert prov.primitive is not None
+        assert prov.output_paths == ("w",)
+
+    def test_step_provenance_untraceable_step_degrades(self):
+        def step_fn(state, step):
+            loss = float(jnp.sum(state["w"]))  # host pull: untraceable
+            return state, {"loss": loss}
+
+        prov = numerics.step_provenance(
+            step_fn, {"w": jnp.ones(2)},
+            {"w": jnp.array([jnp.nan, 1.0])}, 0)
+        assert not prov.ok
+        assert prov.output_paths == ("w",)
+        assert "replay unavailable" in prov.message
+
+
+# -------------------------------------------------- reporter block
+
+def test_step_reporter_carries_numerics_block():
+    reg = MetricRegistry()
+    coll = StatsCollector("rep", every=1, registry=reg)
+    coll.observe(TREE, 0)
+    rec = StepReporter("rep", registry=reg).step(
+        0.01, loss=1.0, numerics=coll.last)
+    assert rec["numerics"]["finite"] is True
+    assert rec["numerics"]["stats_pass_ms"] >= 0
+    # the block survives the registry JSONL round-trip
+    import json
+    dumped = json.dumps(reg.to_records())
+    assert "stats_pass_ms" in dumped
+    # and stays None when nobody supplies it
+    assert StepReporter("bare", registry=reg).step(0.01)["numerics"] \
+        is None
+
+
+class TestNanProbeControlFlow:
+    """Review regressions: the replay must follow the control flow the
+    real execution took, not an over-approximation of it."""
+
+    def test_untaken_cond_branch_never_blamed(self):
+        """A lax.cond guard whose unsafe branch is NOT taken (the
+        scaled_update shape) must replay clean — joining the untaken
+        branch used to report its log as a NaN 'origin'."""
+
+        def f(x):
+            return jax.lax.cond(jnp.all(x > 0),
+                                lambda v: jnp.sum(jnp.log(v)),
+                                lambda v: jnp.sum(v), x)
+
+        prov = numerics.probe_fn(f, jnp.array([-1.0, 2.0]))
+        assert prov.ok, prov.as_dict()
+        # and the guard still catches the branch that DOES run
+        prov2 = numerics.probe_fn(f, jnp.array([1.0, 2.0]))
+        assert prov2.ok
+        def g(x):
+            return jax.lax.cond(jnp.any(x < 0),
+                                lambda v: jnp.sum(jnp.log(v)),
+                                lambda v: jnp.sum(v), x)
+        prov3 = numerics.probe_fn(g, jnp.array([-1.0, 2.0]))
+        assert not prov3.ok and prov3.primitive == "log"
+
+    def test_scan_xs_poison_past_row_zero_still_consumed(self):
+        """A NaN in a scanned xs row past index 0 (a poisoned
+        microbatch) must still name the consuming primitive — slicing
+        row 0 used to launder the taint into a clean replay."""
+
+        def f(xs):
+            def body(c, x):
+                return c + x, None
+            c, _ = jax.lax.scan(body, jnp.zeros(()), xs)
+            return c
+
+        prov = numerics.probe_fn(f, jnp.array([1.0, jnp.nan, 2.0]))
+        assert not prov.ok and prov.kind == "inherited"
+        assert prov.primitive == "add"

@@ -29,9 +29,14 @@ runs since ISSUE 4) additionally get a per-target table of estimated
 comms bytes/step and peak live HBM; the ``analysis/plan_*`` family
 (ISSUE 8) renders the auto-shard planner's ranked candidate table and
 its predicted-vs-measured calibration ratio, and ``--compare`` gates a
-chosen-plan flip between runs as a regression. Unknown
-``schema_version`` values in analysis reports fail loudly rather than
-mis-summarizing.
+chosen-plan flip between runs as a regression. The ``numerics/*``
+family (ISSUE 9) gets a per-source health table, and ``--compare``
+additionally gates two numerics regressions: a finite→non-finite flip
+of any ``numerics/finite`` gauge (binary — a run that started
+producing NaNs is broken no matter how fast it got) and a >10x jump
+of a ``numerics/grad_norm`` p50 (fixed factor, independent of
+``--compare-threshold``). Unknown ``schema_version`` values in
+analysis reports fail loudly rather than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -309,6 +314,112 @@ def summarize_resilience(path, fam):
               f"generic summary below)")
 
 
+def render_numerics_family(path):
+    """The ``numerics/*`` family from a metrics JSONL dump (None when
+    the file carries none): per-source finite flag, amax ceiling,
+    stats-pass cost/cadence, detector counters (ISSUE 9)."""
+    sources: dict = {}
+    events = 0
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        if rec.get("type") == "event" and name.startswith("numerics"):
+            events += 1
+            continue
+        if not name.startswith("numerics/"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        source = labels.get("source", "?")
+        row = sources.setdefault(source, {})
+        key = name[len("numerics/"):]
+        if rec.get("type") == "counter":
+            row[key] = row.get(key, 0) + (rec.get("value") or 0)
+        elif rec.get("type") == "gauge":
+            row[key] = rec.get("value")
+        elif rec.get("type") in ("histogram", "timer") and \
+                isinstance(rec.get("p50"), (int, float)):
+            row[key + "_p50"] = rec["p50"]
+    if not sources and not events:
+        return None
+    return {"sources": sources, "events": events}
+
+
+def summarize_numerics(path, fam):
+    print(f"{path}: numerics/* family")
+    width = max((len(s) for s in fam["sources"]), default=6)
+    print(f"  {'source':{width}s}  {'finite':>6s}  {'amax max':>12s}  "
+          f"{'stats ms':>9s}  {'interval':>8s}  detectors")
+    for source, row in sorted(fam["sources"].items()):
+        finite = row.get("finite")
+        finite_s = ("-" if finite is None
+                    else "yes" if finite else "NO")
+        amax = row.get("amax_max")
+        amax_s = f"{amax:.4g}" if isinstance(amax, (int, float)) else "-"
+        if isinstance(row.get("stats_pass_ms"), (int, float)):
+            ms_s = f"{row['stats_pass_ms']:.3f}"
+        elif isinstance(row.get("stats_pass_p50"), (int, float)):
+            ms_s = f"{row['stats_pass_p50'] * 1e3:.3f}"  # timer: s
+        else:
+            ms_s = "-"
+        interval = row.get("stats_interval")
+        int_s = str(int(interval)) if isinstance(interval,
+                                                 (int, float)) else "-"
+        fired = {k: v for k, v in row.items()
+                 if k.endswith(("_spikes", "_plateaus", "_streaks",
+                                "nonfinite_signals")) and v}
+        fired_s = ", ".join(f"{k}:{v}" for k, v in sorted(
+            fired.items())) or "-"
+        print(f"  {source:{width}s}  {finite_s:>6s}  {amax_s:>12s}  "
+              f"{ms_s:>9s}  {int_s:>8s}  {fired_s}")
+    if fam["events"]:
+        print(f"  ({fam['events']} numerics event(s) — see the "
+              f"generic summary below)")
+
+
+def _numerics_finite_gauges(records):
+    """{labels-qualified name: value} for numerics/finite gauges."""
+    out = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                rec.get("name") != "numerics/finite":
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "numerics/finite" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = rec.get("value")
+    return out
+
+
+def _grad_norm_p50s(records):
+    """{labels-qualified name: p50} for numerics/grad_norm
+    histograms."""
+    out = {}
+    for rec in records:
+        if rec.get("type") not in ("histogram", "timer") or \
+                rec.get("name") != "numerics/grad_norm" or \
+                not isinstance(rec.get("p50"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "numerics/grad_norm" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["p50"])
+    return out
+
+
+# a >10x grad-norm p50 jump is gated as a regression regardless of
+# --compare-threshold: that knob tunes step-TIME tolerance; an
+# order-of-magnitude gradient blow-up is a numerics event, not noise.
+GRAD_NORM_JUMP_FACTOR = 10.0
+
+
 def _step_time_p50s(records):
     """{metric name: p50} for every */step_time_ms histogram/timer
     record that carries a sampled p50."""
@@ -350,6 +461,11 @@ def compare_metrics(current_path, base_path, threshold=0.10):
       pallas -> xla, or a previously clean-pallas kernel (zero xla
       wins) picking up any xla win — binary, no threshold; a noisy
       share wobble that flips no verdict passes.
+    - numerics finite flip (ISSUE 9): any ``numerics/finite`` gauge
+      truthy in base and 0 in current — binary;
+    - grad-norm blow-up (ISSUE 9): any ``numerics/grad_norm`` p50 more
+      than :data:`GRAD_NORM_JUMP_FACTOR` x its base — fixed factor,
+      independent of ``threshold``.
 
     Metrics present in only one dump are reported as info, never
     failed on: a shorter run is not a regression.
@@ -388,6 +504,34 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"{base_plan[model]} -> {cur_plan[model]}")
         else:
             infos.append(f"plan {model}: {cur_plan[model]} ok")
+
+    cur_fin, base_fin = _numerics_finite_gauges(cur), \
+        _numerics_finite_gauges(base)
+    for name in sorted(base_fin):
+        if name not in cur_fin:
+            infos.append(f"{name}: only in base")
+            continue
+        if base_fin[name] and not cur_fin[name]:
+            regressions.append(
+                f"{name}: finite -> NON-FINITE (a run that started "
+                f"producing NaN/Inf is broken regardless of speed)")
+        else:
+            infos.append(f"{name}: {base_fin[name]} -> "
+                         f"{cur_fin[name]} ok")
+
+    cur_gn, base_gn = _grad_norm_p50s(cur), _grad_norm_p50s(base)
+    for name in sorted(base_gn):
+        if name not in cur_gn:
+            infos.append(f"{name}: only in base "
+                         f"(p50 {base_gn[name]:.4g})")
+            continue
+        b, c = base_gn[name], cur_gn[name]
+        if b > 0 and c > b * GRAD_NORM_JUMP_FACTOR:
+            regressions.append(
+                f"{name}: p50 {b:.4g} -> {c:.4g} "
+                f"(>{GRAD_NORM_JUMP_FACTOR:.0f}x jump)")
+        else:
+            infos.append(f"{name}: p50 {b:.4g} -> {c:.4g} ok")
 
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
@@ -531,6 +675,14 @@ if __name__ == "__main__":
                                       "tuning_family": tun}))
                 else:
                     summarize_tuning(arg, tun)
+            num = render_numerics_family(arg) if os.path.isfile(arg) \
+                else None
+            if num is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "numerics_family": num}))
+                else:
+                    summarize_numerics(arg, num)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
