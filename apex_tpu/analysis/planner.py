@@ -209,6 +209,11 @@ class TracedStep:
     act_bytes_global: int           # boundary activations, global batch
     tp_collectives: int             # act-sized tp allreduces per step
     microbatches: int
+    # dp grad-sync term (this stage's parameters): grads travel fp32,
+    # the ZeRO-1 all-gather travels in the param storage dtype (see
+    # apex_tpu.parallel.overlap.grad_sync_bytes_from_sizes)
+    grad_bytes_global: int = 0
+    param_store_bytes_global: int = 0
 
 
 class PlanModel:
@@ -245,6 +250,29 @@ class PlanModel:
 PLAN_MODELS: dict = {}
 
 
+def _check_grad_sync(mode):
+    from apex_tpu.parallel.overlap import GRAD_SYNC_MODES
+
+    if mode not in GRAD_SYNC_MODES:
+        raise ValueError(
+            f"grad_sync={mode!r} is not a known mode; valid: "
+            f"{', '.join(GRAD_SYNC_MODES)}")
+    return mode
+
+
+def _tree_grad_param_bytes(params):
+    """(fp32 grad bytes, storage-dtype param bytes) of a shaped param
+    tree — the dp grad-sync term's inputs."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(params)
+    grad_b = sum(leaf.size * 4 for leaf in leaves)
+    param_b = sum(leaf.size * np.dtype(str(leaf.dtype)).itemsize
+                  for leaf in leaves)
+    return int(grad_b), int(param_b)
+
+
 def plan_model(name):
     """Register a :class:`PlanModel` subclass under ``name``."""
     def deco(cls):
@@ -273,7 +301,8 @@ class LlamaPlanModel(PlanModel):
 
     def __init__(self, layers=8, hidden=64, heads=8, kv_heads=8,
                  intermediate=128, vocab=256, seq=32, batch=8,
-                 microbatches=4):
+                 microbatches=4, grad_sync="allreduce"):
+        self.grad_sync = _check_grad_sync(grad_sync)
         self.layers = int(layers)
         self.hidden = int(hidden)
         self.heads = int(heads)
@@ -363,6 +392,7 @@ class LlamaPlanModel(PlanModel):
         n_params_full = self._n_params_full
         n_state = len(jax.tree_util.tree_leaves(params)) + len(
             jax.tree_util.tree_leaves(opt))
+        grad_b, param_b = _tree_grad_param_bytes(params)
         traced = TracedStep(
             closed=closed,
             donated=frozenset(range(n_state)),
@@ -375,6 +405,8 @@ class LlamaPlanModel(PlanModel):
             # THIS stage depth
             tp_collectives=4 * (self.layers // pp),
             microbatches=self.microbatches,
+            grad_bytes_global=grad_b,
+            param_store_bytes_global=param_b,
         )
         self._traced[pp] = traced
         return traced
@@ -438,9 +470,17 @@ class MlpPlanModel(PlanModel):
     """Two-layer MLP + SGD — the deterministic test workhorse (also the
     smallest real customer: a Megatron column/row pair)."""
 
-    def __init__(self, hidden=64, batch=32):
+    def __init__(self, hidden=64, batch=32, grad_sync="allreduce",
+                 dtype="float32"):
+        import numpy as np
+
+        self.grad_sync = _check_grad_sync(grad_sync)
         self.hidden = int(hidden)
         self.batch = int(batch)
+        # param STORAGE dtype: with bf16 params + fp32 grads the zero1
+        # grad-sync layout prices at exactly 0.75x the allreduce
+        self.dtype = "bfloat16" if str(dtype) == "bfloat16" else \
+            str(np.dtype(str(dtype)))
         self._traced: dict = {}
 
     def pp_candidates(self, devices):
@@ -459,28 +499,45 @@ class MlpPlanModel(PlanModel):
         import jax.numpy as jnp
 
         h, b = self.hidden, self.batch
+        w_dtype = jnp.dtype(self.dtype)
         params = {
-            "w1": jax.ShapeDtypeStruct((h, 4 * h), jnp.float32),
-            "w2": jax.ShapeDtypeStruct((4 * h, h), jnp.float32),
+            "w1": jax.ShapeDtypeStruct((h, 4 * h), w_dtype),
+            "w2": jax.ShapeDtypeStruct((4 * h, h), w_dtype),
         }
         x = jax.ShapeDtypeStruct((b, h), jnp.float32)
 
         def step(params, x, y):
-            def loss_fn(p):
-                out = jax.nn.relu(x @ p["w1"]) @ p["w2"]
+            # differentiate w.r.t. — and output — an fp32 MASTER copy
+            # (the O2 pattern: master weights are the carried state,
+            # storage dtype is the input format). The traced gradients,
+            # the output resolution point, and therefore the
+            # pending-psum allreduce the GSPMD estimate prices are all
+            # fp32-wide regardless of storage dtype — the same
+            # fp32-reduce baseline the zero1 delta in _candidate_comms
+            # swaps against (the engine reduces fp32 and gathers in the
+            # storage dtype). For float32 storage the cast is a no-op
+            # and the jaxpr is unchanged.
+            master = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), params)
+
+            def loss_fn(m):
+                out = jax.nn.relu(x @ m["w1"]) @ m["w2"]
                 return jnp.mean(jnp.square(out - y))
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            loss, grads = jax.value_and_grad(loss_fn)(master)
             new = jax.tree_util.tree_map(
-                lambda p, g: p - 0.01 * g, params, grads)
+                lambda m, g: m - 0.01 * g, master, grads)
             return new, loss
 
         closed = jax.make_jaxpr(step)(params, x, x)
+        grad_b, param_b = _tree_grad_param_bytes(params)
         traced = TracedStep(
             closed=closed, donated=frozenset(range(len(params))),
             flops_total=2 * 3 * b * (h * 4 * h * 2),
             act_bytes_global=b * 4 * h * 4,
-            tp_collectives=2, microbatches=1)
+            tp_collectives=2, microbatches=1,
+            grad_bytes_global=grad_b,
+            param_store_bytes_global=param_b)
         self._traced[pp] = traced
         return traced
 
@@ -599,7 +656,12 @@ def _modeled_step_s(model, traced, cand, kind, stats):
 def _candidate_comms(model, traced, cand, stats):
     """GSPMD-estimated bytes plus the analytic terms a constraint-free
     trace cannot carry (per-layer Megatron activation allreduces, the
-    pipeline's per-microbatch boundary hops)."""
+    pipeline's per-microbatch boundary hops, and the dp gradient sync
+    — allreduce by default, or the ZeRO-1 reduce-scatter + all-gather
+    layout at <= 0.75x the allreduce bytes when the model opts in via
+    ``grad_sync="zero1"``; ISSUE 11)."""
+    from apex_tpu.parallel.overlap import grad_sync_bytes_from_sizes
+
     comms = stats["comms_bytes"]
     if cand.tp > 1 and model.layout_divides_tp(cand.layout):
         act_local = traced.act_bytes_global // max(1, cand.dp)
@@ -607,6 +669,20 @@ def _candidate_comms(model, traced, cand, stats):
             "psum", act_local, [cand.tp])
     if cand.pp > 1:
         comms += 2 * traced.act_bytes_global // max(1, cand.dp)
+    if cand.dp > 1 and getattr(model, "grad_sync",
+                               "allreduce") == "zero1":
+        # the GSPMD estimate already prices the dp grad sync as a
+        # pending-psum allreduce (the traced step folds the optimizer
+        # update in, so the grad reduction is in the jaxpr); ZeRO-1
+        # swaps that allreduce for reduce-scatter + storage-dtype
+        # all-gather, so price the DELTA, not a second sync. The
+        # stage's param slab shrinks with tp under a dividing layout.
+        tp_div = cand.tp if model.layout_divides_tp(cand.layout) else 1
+        g = traced.grad_bytes_global // tp_div
+        p = traced.param_store_bytes_global // tp_div
+        comms += (grad_sync_bytes_from_sizes(g, p, cand.dp, "zero1")
+                  - grad_sync_bytes_from_sizes(g, p, cand.dp,
+                                               "allreduce"))
     return int(comms)
 
 
@@ -755,6 +831,9 @@ def plan(model="llama", devices=None, device_kind=None,
             "step_ms": chosen.modeled_step_ms,
             "comms_bytes": chosen.comms_bytes,
             "peak_hbm_bytes": chosen.peak_hbm_bytes,
+            # which dp grad-sync layout the comms term priced
+            # (docs/parallel.md "Overlapped buckets & ZeRO-1")
+            "grad_sync": getattr(mdl, "grad_sync", "allreduce"),
             # the chosen candidate survived every check by construction
             "findings": 0 if verify else None,
         },
@@ -847,6 +926,11 @@ def main(argv=None):
     ap.add_argument("--set", action="append", default=[],
                     metavar="KEY=INT",
                     help="model_kw override, e.g. --set layers=16")
+    ap.add_argument("--grad-sync", choices=("allreduce", "zero1"),
+                    default=None,
+                    help="dp gradient-sync layout the comms model "
+                         "prices (zero1 = reduce-scatter + all-gather, "
+                         "<= 0.75x the allreduce bytes)")
     ap.add_argument("--no-verify", dest="verify", action="store_false",
                     help="skip the sharding-check vetting of the winner")
     ap.add_argument("--json", action="store_true")
@@ -867,6 +951,8 @@ def main(argv=None):
             print(f"--set {key} needs an integer, got {value!r}",
                   file=sys.stderr)
             return 2
+    if args.grad_sync is not None:
+        model_kw["grad_sync"] = args.grad_sync
 
     try:
         result = plan(model=args.model, devices=args.devices,

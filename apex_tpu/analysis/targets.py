@@ -712,6 +712,99 @@ def _ddp_bucket_allreduce_step():
         _release_mesh(owned)
 
 
+def _ddp_grad_model():
+    """Shared model for the two overlapped-DDP targets: a dp-sharded
+    batch producing full-shaped, genuinely per-rank gradients inside
+    the shard_map body (an input-specced grad tree would either shrink
+    to the local shard — breaking the bucket plan — or arrive
+    replicated and trip dead-collective on the reduce)."""
+    import jax.numpy as jnp
+
+    def grads_of(x):
+        # x: the local (batch/dp, 256) shard
+        return {"w": (x.T @ x).astype(jnp.float32),
+                "b": jnp.sum(x, axis=0)}
+
+    return grads_of
+
+
+@target("ddp_overlap_bucket_step")
+def _ddp_overlap_bucket_step():
+    """Backward-interleaved bucket allreduce (ISSUE 11 tentpole): the
+    barrier-chained per-bucket psums of sync_gradients_overlapped over
+    'dp'. The optimization_barrier issue-order chain must add no comms
+    of its own and no reshards; the estimated bytes are the allreduce
+    baseline the zero1 target's 0.75x acceptance ratio is measured
+    against."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        grads_of = _ddp_grad_model()
+
+        def step(x):
+            return sync_gradients_overlapped(
+                grads_of(x), axis_name="dp", bucket_cap_mb=0.1,
+                gradient_predivide_factor=2.0)
+
+        fn = jax.shard_map(step, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs={"w": P(), "b": P()},
+                           check_vma=False)
+        stats = SHARDING_STATS.setdefault("ddp_overlap_bucket_step", {})
+        return analyze_sharding(
+            fn, jnp.zeros((8 * sizes.get("dp", 1), 256), jnp.float32),
+            axis_sizes=sizes, stats_out=stats,
+            name="ddp_overlap_bucket_step")
+    finally:
+        _release_mesh(owned)
+
+
+@target("zero1_fused_adam_step")
+def _zero1_fused_adam_step():
+    """ZeRO-1 sharded-optimizer step (ISSUE 11 tentpole): per-bucket
+    psum_scatter of the fp32 grads + all_gather of the updated bf16
+    params, state shards donated. The sharding-flow estimate must price
+    this at <= 0.75x the allreduce target above (fp32 grads at twice
+    the bf16 param width: RS 1.0 + AG 0.5 vs allreduce 2.0), with all
+    five checks at 0 findings."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.zero import Zero1FusedAdam
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.bfloat16),
+                  "b": jnp.zeros((256,), jnp.bfloat16)}
+        opt = Zero1FusedAdam(lr=1e-3, weight_decay=0.01, axis_name="dp",
+                             num_shards=dp, bucket_cap_mb=0.1)
+        state = opt.init(params)
+        grads_of = _ddp_grad_model()
+
+        def step(x, state, params):
+            return opt.step(grads_of(x), state, params)
+
+        state_specs = opt.state_specs(params)
+        fn = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("dp"), state_specs, {"w": P(), "b": P()}),
+            out_specs=({"w": P(), "b": P()}, state_specs),
+            check_vma=False)
+        stats = SHARDING_STATS.setdefault("zero1_fused_adam_step", {})
+        return analyze_sharding(
+            fn, jnp.zeros((8 * dp, 256), jnp.float32), state, params,
+            donate_argnums=(1,), axis_sizes=sizes, stats_out=stats,
+            name="zero1_fused_adam_step")
+    finally:
+        _release_mesh(owned)
+
+
 @target("fused_adam_master_sharded_step")
 def _fused_adam_master_sharded_step():
     """Per-tensor FusedAdam over tp-sharded fp32 master params under
@@ -800,6 +893,7 @@ SHARDING_TARGETS = (
     "tp_column_parallel_fwd_bwd", "tp_row_parallel_fwd_bwd",
     "tp_fused_softmax_sharded", "pp_1f1b_microbatch_step",
     "pp_1f1b_forward", "ddp_bucket_allreduce_step",
+    "ddp_overlap_bucket_step", "zero1_fused_adam_step",
     "fused_adam_master_sharded_step", "moe_dispatch",
 )
 

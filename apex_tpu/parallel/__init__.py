@@ -8,9 +8,18 @@ from apex_tpu.parallel.distributed import (
     Reducer,
     sync_gradients,
     sync_gradients_flat,
+    sync_gradients_bucketed,
     average_reduced,
     sync_autodiff_gradients,
 )
+from apex_tpu.parallel.overlap import (
+    OverlapPlan,
+    grad_sync_comms_bytes,
+    overlapped_value_and_grad,
+    plan_overlap,
+    sync_gradients_overlapped,
+)
+from apex_tpu.parallel.zero import Zero1AdamState, Zero1FusedAdam, zero1_fused_adam
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
 from apex_tpu.parallel.larc import LARC, larc
 from apex_tpu.parallel import auto_shard, multiproc
@@ -49,8 +58,11 @@ def create_syncbn_process_group(group_size, axis_name="data",
 
 __all__ = [
     "DistributedDataParallel", "Reducer",
-    "sync_gradients", "sync_gradients_flat", "average_reduced",
-    "sync_autodiff_gradients",
+    "sync_gradients", "sync_gradients_flat", "sync_gradients_bucketed",
+    "average_reduced", "sync_autodiff_gradients",
+    "OverlapPlan", "plan_overlap", "sync_gradients_overlapped",
+    "overlapped_value_and_grad", "grad_sync_comms_bytes",
+    "Zero1AdamState", "Zero1FusedAdam", "zero1_fused_adam",
     "SyncBatchNorm", "convert_syncbn_model", "create_syncbn_process_group",
     "LARC", "larc", "auto_shard", "multiproc",
 ]

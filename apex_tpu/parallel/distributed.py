@@ -75,30 +75,39 @@ def sync_gradients(grads, axis_name: str = "data", gradient_average: bool = True
         return jax.tree_util.tree_map(reduce_leaf, grads)
 
 
-def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool = True):
+def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool = True,
+                        gradient_predivide_factor: float = 1.0):
     """Flat-bucket allreduce: pack per-dtype, reduce once per dtype, unpack.
 
     The explicit analog of the reference's flat NCCL buckets
     (ref apex/parallel/distributed.py:flat_dist_call).
+    ``gradient_predivide_factor`` splits the averaging around the
+    reduction exactly as :func:`sync_gradients` does (pre-divide before
+    the psum, multiply by ``factor/n`` after), so the flat path keeps
+    the same fp16-overflow headroom.
     """
+    pre = gradient_predivide_factor
     with span("ddp/allreduce_flat"):
         bufs, meta = flatten_tree(grads)
         reduced = {}
         for k, buf in bufs.items():
             with span(f"ddp/bucket/{k}"):
+                if pre != 1.0:
+                    buf = buf / pre
                 r = jax.lax.psum(buf, axis_name)
                 if gradient_average:
                     # static axis size, not psum(ones): the probe would
                     # be a dead collective riding every bucket
-                    r = r / jnp.asarray(jax.lax.axis_size(axis_name),
-                                        r.dtype)
+                    n = jax.lax.axis_size(axis_name)
+                    r = r * jnp.asarray(pre / n, r.dtype)
             reduced[k] = r
         return unflatten_tree(reduced, meta)
 
 
 def sync_gradients_bucketed(grads, axis_name: str = "data",
                             gradient_average: bool = True,
-                            bucket_cap_mb: float = 10.0):
+                            bucket_cap_mb: float = 10.0,
+                            gradient_predivide_factor: float = 1.0):
     """Size-capped flat-bucket allreduce (ref apex DDP ``message_size``
     bucketing, apex/parallel/distributed.py).
 
@@ -126,15 +135,18 @@ def sync_gradients_bucketed(grads, axis_name: str = "data",
 
     out = [None] * len(leaves)
     n = jax.lax.axis_size(axis_name)
+    pre = gradient_predivide_factor
     for dt, (idxs, bucket_ids) in plans.items():
         n_buckets = max(bucket_ids) + 1 if bucket_ids else 0
         for b in range(n_buckets):
             members = [i for i, bid in zip(idxs, bucket_ids) if bid == b]
             with span(f"ddp/bucket{b}/{dt}"):
                 flat = jnp.concatenate([leaves[i].ravel() for i in members])
+                if pre != 1.0:
+                    flat = flat / pre
                 red = jax.lax.psum(flat, axis_name)
                 if gradient_average:
-                    red = red / jnp.asarray(n, red.dtype)
+                    red = red * jnp.asarray(pre / n, red.dtype)
             off = 0
             for i in members:
                 sz = leaves[i].size
@@ -210,7 +222,8 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False, num_allreduce_streams: int = 1,
                  allreduce_communicators=None, gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0, gradient_average_split_factor=None,
-                 prof: bool = False, axis_name: str = "data", flat_buckets: bool = True):
+                 prof: bool = False, axis_name: str = "data", flat_buckets: bool = True,
+                 overlap_buckets: bool = False, bucket_cap_mb: float = 10.0):
         if shared_param is not None:
             raise ValueError(
                 "shared_param is deprecated (matches the reference's error; "
@@ -225,6 +238,8 @@ class DistributedDataParallel:
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.flat_buckets = flat_buckets
+        self.overlap_buckets = overlap_buckets
+        self.bucket_cap_mb = bucket_cap_mb
 
     def __call__(self, *args, **kwargs):
         if self.module is None:
@@ -232,20 +247,28 @@ class DistributedDataParallel:
         fn = getattr(self.module, "apply", self.module)
         return fn(*args, **kwargs)
 
+    def _sync_fn(self, grads):
+        if self.overlap_buckets:
+            from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+            return sync_gradients_overlapped(
+                grads, self.axis_name, self.gradient_average,
+                self.gradient_predivide_factor,
+                bucket_cap_mb=self.bucket_cap_mb)
+        if self.flat_buckets:
+            return sync_gradients_flat(
+                grads, self.axis_name, self.gradient_average,
+                self.gradient_predivide_factor)
+        return sync_gradients(grads, self.axis_name, self.gradient_average,
+                              self.gradient_predivide_factor)
+
     def _reduce(self, grads):
         if self.allreduce_always_fp32:
             orig = grads
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
-            reduced = (sync_gradients_flat(grads, self.axis_name, self.gradient_average)
-                       if self.flat_buckets else
-                       sync_gradients(grads, self.axis_name, self.gradient_average,
-                                      self.gradient_predivide_factor))
             return jax.tree_util.tree_map(
-                lambda r, g: r.astype(g.dtype), reduced, orig)
-        if self.flat_buckets:
-            return sync_gradients_flat(grads, self.axis_name, self.gradient_average)
-        return sync_gradients(grads, self.axis_name, self.gradient_average,
-                              self.gradient_predivide_factor)
+                lambda r, g: r.astype(g.dtype), self._sync_fn(grads), orig)
+        return self._sync_fn(grads)
 
     def sync(self, grads):
         """Reduce grads across the data axis (no-op when delay_allreduce)."""

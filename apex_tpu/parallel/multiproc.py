@@ -70,6 +70,40 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def simulated_mesh_env(n: int = 8, env=None) -> dict:
+    """Environment for a subprocess that must see ``n`` simulated CPU
+    devices (``--xla_force_host_platform_device_count``) — the 8-way
+    proving ground every comms path runs on when real multi-chip
+    hardware is absent (ISSUE 11). Existing force-count flags are
+    rewritten, the platform is pinned to cpu, and
+    ``APEX_TPU_SIMULATED_MESH`` marks the child so benches can record
+    ``simulated: true`` in their JSON lines."""
+    import re
+
+    base = dict(os.environ if env is None else env)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   base.get("XLA_FLAGS", ""))
+    base["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    base["JAX_PLATFORMS"] = "cpu"
+    base["APEX_TPU_FORCE_CPU"] = "1"
+    base["APEX_TPU_SIMULATED_MESH"] = str(n)
+    return base
+
+
+def run_simulated(argv, n: int = 8, timeout: float = 600.0,
+                  env=None) -> "subprocess.CompletedProcess":
+    """Run ``argv`` (absolute program + args) in a subprocess against an
+    ``n``-device simulated CPU mesh; returns the CompletedProcess with
+    captured text output. The jax.distributed-aware sibling is
+    :func:`launch` (real multi-process over a localhost coordinator);
+    this one is the in-process-mesh harness tests and benches re-exec
+    through when fewer than 2 real devices are present."""
+    return subprocess.run(
+        list(argv), capture_output=True, text=True, timeout=timeout,
+        env=simulated_mesh_env(n, env=env))
+
+
 def launch(script_args, nprocs: int, devices_per_proc: int = 1,
            cpu: bool = False, env=None) -> int:
     """Spawn ``nprocs`` workers of ``python -m apex_tpu.parallel.multiproc

@@ -661,46 +661,177 @@ def bench_gpt2(extras):
           f"{B*S/step_t:.0f} tok/s", file=sys.stderr)
 
 
-def bench_allreduce(extras):
-    """DDP allreduce bandwidth over the device mesh (SURVEY §6 row 3:
-    'DDP allreduce bandwidth over ICI'). Multi-chip only — a
-    single-device psum is a copy, not a collective; the driver's
-    one-chip tunnel records the skip reason instead of a fake number."""
+def _ddp_comms_suite(payload_mb: float):
+    """The DDP comms numbers over the CURRENT device mesh (needs >= 2
+    devices): allreduce and reduce-scatter+all-gather bandwidth, plus
+    the overlapped-bucket step's overlap_efficiency — how much of the
+    comms time the barrier-chained schedule hides under compute
+    ((t_compute + t_sync - t_overlapped) / min parts, clamped [0,1]).
+    Publishes the ddp/* gauge family and returns the result dict."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
     import numpy as np
-    from apex_tpu.parallel import sync_gradients
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from apex_tpu import observability as obs
+    from apex_tpu.parallel import (
+        grad_sync_comms_bytes,
+        sync_gradients,
+        sync_gradients_overlapped,
+    )
+    from jax import shard_map  # the 0.4.37 shim apex_tpu installed
 
     n = jax.device_count()
-    if n < 2:
-        extras["allreduce_skipped"] = f"1 device (need >=2 for ICI)"
-        return
     mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
-    nbytes = 256 * 2**20  # 256 MiB fp32 payload per device
+    nbytes = int(payload_mb * 2**20)
     # build pre-sharded: a plain jnp.ones would materialize all n shards
     # on device 0 first (16 GiB at n=64) before the jit reshards. One
     # hoisted HOST buffer -> each shard transfers host-to-device direct.
-    from jax.sharding import NamedSharding
-
     ones = np.ones((1, nbytes // 4), np.float32)
     x = jax.make_array_from_callback(
         (n, nbytes // 4), NamedSharding(mesh, P("data")),
         lambda idx: ones)
 
-    def f(x):
+    def allreduce(x):
         return sync_gradients({"g": x}, axis_name="data")["g"]
 
-    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("data"),),
+    def scatter_gather(x):
+        # the ZeRO-1 comms layout: reduce to this rank's shard, gather
+        # the (here: unchanged) shard back
+        shard = jax.lax.psum_scatter(x.reshape(-1), "data",
+                                     scatter_dimension=0, tiled=True)
+        return jax.lax.all_gather(shard, "data", tiled=True)
+
+    out = {"devices": n, "payload_mb": payload_mb}
+    fn = jax.jit(shard_map(allreduce, mesh=mesh, in_specs=(P("data"),),
                            out_specs=P("data")))
     t = time_fn(fn, x, iters=10, warmup=2)
-    # ring allreduce moves 2(n-1)/n * payload per device
-    bw = 2 * (n - 1) / n * nbytes / t
-    extras["allreduce_256mb_ms"] = round(t * 1e3, 2)
-    extras["allreduce_algo_gbps"] = round(bw / 1e9, 1)
-    print(f"allreduce 256MiB x{n}: {t*1e3:.2f} ms  "
-          f"{bw/1e9:.1f} GB/s algo-bw", file=sys.stderr)
+    bw = 2 * (n - 1) / n * nbytes / t  # ring allreduce bytes/device
+    out["allreduce_ms"] = round(t * 1e3, 3)
+    out["allreduce_algo_gbps"] = round(bw / 1e9, 2)
+
+    fn_rs = jax.jit(shard_map(scatter_gather, mesh=mesh,
+                              in_specs=(P("data"),),
+                              out_specs=P("data"), check_vma=False))
+    t_rs = time_fn(fn_rs, x, iters=10, warmup=2)
+    out["reduce_scatter_gather_ms"] = round(t_rs * 1e3, 3)
+    out["reduce_scatter_gather_algo_gbps"] = round(
+        2 * (n - 1) / n * nbytes / t_rs / 1e9, 2)
+
+    # overlapped-bucket step: a backward-ish compute chain whose grads
+    # sync through the barrier-chained bucket schedule
+    d = max(128, int(round((nbytes / 16 / 4) ** 0.5)) // 128 * 128)
+    w = jnp.ones((d, d), jnp.float32)
+    xb = jax.make_array_from_callback(
+        (n * 8, d), NamedSharding(mesh, P("data")),
+        lambda idx: np.ones((8, d), np.float32))
+    grad_tree = {"w": w, "b": jnp.ones((d,), jnp.float32)}
+
+    def compute_grads(w, xb):
+        h = jnp.tanh(xb @ w)
+        h = jnp.tanh(h @ w.T)
+        return {"w": xb.T @ h, "b": jnp.sum(h, axis=0)}
+
+    def step_compute(w, xb):
+        return compute_grads(w, xb)
+
+    def step_sync_only(w, xb):
+        return sync_gradients_overlapped(
+            {"w": w, "b": jnp.sum(xb, axis=0)}, axis_name="data",
+            bucket_cap_mb=max(payload_mb / 4, 0.25))
+
+    def step_overlapped(w, xb):
+        return sync_gradients_overlapped(
+            compute_grads(w, xb), axis_name="data",
+            bucket_cap_mb=max(payload_mb / 4, 0.25))
+
+    times = {}
+    for name, f in (("compute", step_compute),
+                    ("sync", step_sync_only),
+                    ("overlapped", step_overlapped)):
+        jf = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs={"w": P(), "b": P()}, check_vma=False))
+        times[name] = time_fn(jf, w, xb, iters=10, warmup=2)
+    hidden = times["compute"] + times["sync"] - times["overlapped"]
+    denom = max(min(times["compute"], times["sync"]), 1e-9)
+    overlap_eff = max(0.0, min(1.0, hidden / denom))
+    out["overlap_step_ms"] = round(times["overlapped"] * 1e3, 3)
+    out["overlap_efficiency"] = round(overlap_eff, 3)
+
+    comms = {mode: grad_sync_comms_bytes(grad_tree, n, mode)
+             for mode in ("allreduce", "zero1")}
+    out["comms_bytes"] = comms
+
+    reg = obs.get_registry()
+    reg.gauge("ddp/overlap_efficiency").set(out["overlap_efficiency"])
+    for mode, b in comms.items():
+        reg.gauge("ddp/comms_bytes", mode=mode).set(b)
+    reg.gauge("ddp/allreduce_algo_gbps").set(out["allreduce_algo_gbps"])
+    return out
+
+
+def bench_allreduce(extras):
+    """DDP comms over the device mesh (SURVEY §6 row 3: 'DDP allreduce
+    bandwidth over ICI') — allreduce AND the ZeRO-1 reduce-scatter +
+    all-gather layout, plus overlap_efficiency. With fewer than 2 real
+    devices this no longer skips (ISSUE 11 satellite): it re-runs
+    itself in a subprocess against an 8-way simulated CPU mesh
+    (--xla_force_host_platform_device_count) so the comms paths always
+    produce numbers, marked ``simulated: true`` in the JSON line."""
+    import jax
+    from apex_tpu import observability as obs
+
+    n = jax.device_count()
+    if n >= 2:
+        ddp = _ddp_comms_suite(
+            payload_mb=256.0 if jax.devices()[0].platform == "tpu"
+            else 4.0)
+        # simulated means host-platform virtual devices (the in-process
+        # forced mesh or the --ddp-sim child) — a real multi-GPU/TPU
+        # mesh is a measurement, not a simulation
+        ddp["simulated"] = (
+            os.environ.get("APEX_TPU_SIMULATED_MESH") is not None
+            or jax.devices()[0].platform == "cpu")
+        extras["ddp"] = ddp
+        print(f"ddp comms x{ddp['devices']}: allreduce "
+              f"{ddp['allreduce_ms']} ms  rs+ag "
+              f"{ddp['reduce_scatter_gather_ms']} ms  overlap_eff "
+              f"{ddp['overlap_efficiency']}", file=sys.stderr)
+        return
+
+    from apex_tpu.parallel import multiproc
+
+    proc = multiproc.run_simulated(
+        [sys.executable, os.path.abspath(__file__), "--ddp-sim"],
+        n=8, timeout=600)
+    line = None
+    for cand in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(cand)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "allreduce_ms" in parsed:
+            line = parsed
+            break
+    if proc.returncode != 0 or line is None:
+        extras["ddp_error"] = (
+            f"simulated-mesh rerun rc={proc.returncode}: "
+            f"{(proc.stderr or '').strip()[-200:]}")
+        print(f"ddp simulated-mesh rerun failed: "
+              f"{extras['ddp_error']}", file=sys.stderr)
+        return
+    line["simulated"] = True
+    extras["ddp"] = line
+    # mirror the child's numbers into THIS process's registry so the
+    # metrics JSONL carries the ddp/* family either way
+    reg = obs.get_registry()
+    reg.gauge("ddp/overlap_efficiency").set(line["overlap_efficiency"])
+    for mode, b in line.get("comms_bytes", {}).items():
+        reg.gauge("ddp/comms_bytes", mode=mode).set(b)
+    reg.gauge("ddp/allreduce_algo_gbps").set(line["allreduce_algo_gbps"])
+    print(f"ddp comms (simulated x{line['devices']}): allreduce "
+          f"{line['allreduce_ms']} ms  overlap_eff "
+          f"{line['overlap_efficiency']}", file=sys.stderr)
 
 
 def bench_kernels(extras):
@@ -1015,6 +1146,16 @@ def worker():
             # JSON line (same contract as the lint hooks above)
             extras["resilience_error"] = repr(e)[:200]
 
+    if cpu_mode:
+        # the DDP comms paths must land numbers even on the one-chip
+        # tunnel / CPU fallback (ISSUE 11 satellite): bench_allreduce
+        # re-execs onto an 8-way simulated mesh instead of skipping,
+        # and is cheap there — run it before the (single) emit
+        try:
+            bench_allreduce(extras)
+        except Exception as e:  # noqa: BLE001 — never cost the JSON line
+            extras["bench_allreduce_error"] = repr(e)[:200]
+
     def finalize_metrics():
         """Fold recompile counts into extras and (re)write the metrics
         JSONL — called before EVERY emit so even a timed-out worker
@@ -1316,8 +1457,30 @@ def launcher():
     return 1
 
 
+def ddp_sim_worker():
+    """``--ddp-sim``: the simulated-mesh child of bench_allreduce —
+    runs the DDP comms suite on the env-forced 8-device CPU mesh and
+    prints exactly one JSON line for the parent to merge."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = jax.device_count()
+    if n < 2:
+        print(json.dumps({
+            "error": f"only {n} device(s) after forcing the simulated "
+                     f"mesh (XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})"
+        }))
+        return 1
+    out = _ddp_comms_suite(payload_mb=4.0)
+    out["simulated"] = True
+    print(json.dumps(out))
+    return 0
+
+
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--ddp-sim" in sys.argv:
+        sys.exit(ddp_sim_worker())
+    elif "--worker" in sys.argv:
         worker()
     else:
         sys.exit(launcher())

@@ -35,6 +35,7 @@ if "APEX_TPU_TUNING_CACHE" not in os.environ:
         "tuning_cache.json")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -44,3 +45,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end example tests")
+    config.addinivalue_line(
+        "markers",
+        "multidevice(n=8): needs an n-way (simulated) device mesh; "
+        "skipped when the backend came up with fewer devices")
+
+
+def pytest_runtest_setup(item):
+    marker = item.get_closest_marker("multidevice")
+    if marker is None:
+        return
+    need = marker.kwargs.get("n", marker.args[0] if marker.args else 8)
+    have = len(jax.devices())
+    if have < need:
+        pytest.skip(f"needs {need} devices, backend has {have} "
+                    f"(the 8-way simulated mesh failed to force)")
+
+
+@pytest.fixture
+def simulated_mesh_subprocess():
+    """Shared multi-device harness (ISSUE 11): run a python snippet in
+    a FRESH subprocess against an 8-way simulated CPU mesh
+    (``apex_tpu.parallel.multiproc.simulated_mesh_env`` sets
+    ``--xla_force_host_platform_device_count`` before the interpreter
+    starts, so every comms path runs real collectives even where this
+    conftest's in-process forcing never ran). Returns a callable
+    ``run(code, n=8, timeout=300)`` -> CompletedProcess."""
+    def run(code: str, n: int = 8, timeout: float = 300.0):
+        from apex_tpu.parallel import multiproc
+
+        return multiproc.run_simulated(
+            [sys.executable, "-c", code], n=n, timeout=timeout)
+
+    return run

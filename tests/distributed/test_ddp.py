@@ -111,6 +111,44 @@ def test_predivide_factor_matches_plain_mean():
     np.testing.assert_allclose(np.asarray(run(1.0)), np.asarray(run(4.0)), rtol=1e-5)
 
 
+def test_predivide_factor_parity_across_sync_paths():
+    """ISSUE 11 satellite: the flat and bucketed paths must apply
+    gradient_predivide_factor exactly like sync_gradients (pre-divide
+    before the psum, * factor/n after) — bit-identical across all
+    three, any factor."""
+    from apex_tpu.parallel import sync_gradients_bucketed
+
+    mesh = mesh8()
+    g = {"w": jax.random.normal(jax.random.PRNGKey(5), (8, 33, 3)),
+         "b": jax.random.normal(jax.random.PRNGKey(6), (8, 17))}
+
+    def run(pre):
+        @jax.jit
+        def go(g):
+            def f(g):
+                plain = sync_gradients(g, axis_name="data",
+                                       gradient_predivide_factor=pre)
+                flat = sync_gradients_flat(
+                    g, axis_name="data", gradient_predivide_factor=pre)
+                bucketed = sync_gradients_bucketed(
+                    g, axis_name="data", bucket_cap_mb=0.0002,
+                    gradient_predivide_factor=pre)
+                return plain, flat, bucketed
+            return shard_map(f, mesh=mesh, in_specs=P("data"),
+                             out_specs=(P("data"),) * 3)(g)
+        return go(g)
+
+    for pre in (1.0, 4.0, 0.5):
+        plain, flat, bucketed = run(pre)
+        for k in g:
+            np.testing.assert_array_equal(
+                np.asarray(plain[k]), np.asarray(flat[k]),
+                err_msg=f"flat pre={pre} {k}")
+            np.testing.assert_array_equal(
+                np.asarray(plain[k]), np.asarray(bucketed[k]),
+                err_msg=f"bucketed pre={pre} {k}")
+
+
 def test_ddp_wrapper_sync_and_delay():
     mesh = mesh8()
     ddp = DistributedDataParallel(axis_name="data")

@@ -103,6 +103,51 @@ def test_tie_ranking_is_stable(monkeypatch):
     assert hbm == sorted(hbm)
 
 
+def test_grad_sync_zero1_pricing_never_worse():
+    """ISSUE 11 satellite: the comms model prices the ZeRO-1
+    reduce-scatter + all-gather layout, and for half-precision param
+    storage the dp grad-sync term is <= 0.75x the allreduce — so no
+    candidate ever gets MORE expensive by opting in."""
+    ar = planner.plan(model="mlp", devices=8, device_kind="cpu",
+                      registry=False, dtype="bfloat16")
+    z1 = planner.plan(model="mlp", devices=8, device_kind="cpu",
+                      registry=False, dtype="bfloat16",
+                      grad_sync="zero1")
+    assert z1.predicted["grad_sync"] == "zero1"
+    assert ar.predicted["grad_sync"] == "allreduce"
+    a = {c.key: c.comms_bytes for c in ar.candidates}
+    b = {c.key: c.comms_bytes for c in z1.candidates}
+    assert set(a) == set(b)
+    assert any(b[k] < a[k] for k in a if ".dp8." in k or "dp8" in k)
+    for k in a:
+        assert b[k] <= a[k], (k, a[k], b[k])
+    # fp32 storage: RS+AG moves the same bytes as the allreduce — the
+    # default pricing is unchanged (no plan churn for existing users)
+    base = planner.plan(model="mlp", devices=8, device_kind="cpu",
+                        registry=False)
+    explicit = planner.plan(model="mlp", devices=8, device_kind="cpu",
+                            registry=False, grad_sync="allreduce")
+    assert {c.key: c.comms_bytes for c in base.candidates} == \
+        {c.key: c.comms_bytes for c in explicit.candidates}
+
+
+def test_grad_sync_unknown_mode_is_loud():
+    with pytest.raises(ValueError, match="grad_sync"):
+        planner.plan(model="mlp", devices=8, device_kind="cpu",
+                     registry=False, grad_sync="broadcast")
+
+
+def test_grad_sync_recorded_in_plan_json(tmp_path):
+    p = planner.plan(model="mlp", devices=8, device_kind="cpu",
+                     registry=False, grad_sync="zero1",
+                     dtype="bfloat16")
+    path = str(tmp_path / "plan.json")
+    auto_shard.save_plan(p, path)
+    loaded = auto_shard.load_plan(path)
+    assert loaded.predicted["grad_sync"] == "zero1"
+    assert loaded.model_kw["grad_sync"] == "zero1"
+
+
 def test_plan_metrics_family_published():
     from apex_tpu.observability import MetricRegistry
 

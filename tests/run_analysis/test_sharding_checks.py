@@ -439,18 +439,40 @@ def test_run_sharding_findings_publishes_family():
 
 def test_all_sharding_targets_trace_clean():
     """The tier-1 contract: every registered sharding target runs and
-    reports 0 findings (the gate the ISSUE acceptance names)."""
+    reports 0 findings (the gate the ISSUE acceptance names) — the two
+    ISSUE 11 comms-engine targets included."""
     from apex_tpu.analysis import run_sharding_findings
 
     findings, errors, stats = run_sharding_findings(registry=None)
     assert not errors, errors
     assert not findings, [f.render() for f in findings]
-    assert len(stats) >= 6
+    assert len(stats) >= 8
     # the comms estimates are the evidence bench.py ships: the
     # collective-bearing targets must report real bytes
     assert stats["ddp_bucket_allreduce_step"]["comms_bytes"] > 0
     assert stats["moe_dispatch"]["comms_bytes"] > 0
     assert stats["tp_row_parallel_fwd_bwd"]["comms_bytes"] > 0
+    assert stats["ddp_overlap_bucket_step"]["comms_bytes"] > 0
+    assert stats["zero1_fused_adam_step"]["comms_bytes"] > 0
+
+
+def test_zero1_step_priced_at_most_three_quarters_of_allreduce():
+    """ISSUE 11 acceptance: the sharding-flow estimator prices the
+    ZeRO-1 step's dp comms at <= 0.75x the overlapped-allreduce
+    target's bytes (fp32 reduce-scatter + bf16 param all-gather vs
+    the fp32 allreduce), with both targets at 0 findings."""
+    from apex_tpu.analysis import run_sharding_findings
+
+    findings, errors, stats = run_sharding_findings(
+        registry=None, names=("ddp_overlap_bucket_step",
+                              "zero1_fused_adam_step"))
+    assert not errors, errors
+    assert not findings, [f.render() for f in findings]
+    allreduce = stats["ddp_overlap_bucket_step"]["comms_bytes"]
+    zero1 = stats["zero1_fused_adam_step"]["comms_bytes"]
+    assert allreduce > 0
+    assert zero1 * 4 <= allreduce * 3, (
+        f"zero1 {zero1} B > 0.75x allreduce {allreduce} B")
 
 
 # -------------------------------------------------------------- --diff

@@ -179,3 +179,46 @@ def test_numerics_family_table_renders(tmp_path):
     assert "numerics/* family" in proc.stdout
     assert "bench/fused_adam" in proc.stdout
     assert "grad_norm_spikes:2" in proc.stdout
+
+
+# ------------------------------------------------ ddp/* gates (ISSUE 11)
+
+def _ddp_recs(comms=1_000_000, eff=0.8):
+    return [
+        {"type": "gauge", "name": "ddp/comms_bytes",
+         "labels": {"mode": "allreduce"}, "value": comms},
+        {"type": "gauge", "name": "ddp/overlap_efficiency",
+         "value": eff},
+    ]
+
+
+def test_compare_ddp_comms_bytes_growth_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_ddp_recs(comms=10**6))
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=_ddp_recs(comms=int(1.5 * 10**6)))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION ddp/comms_bytes" in proc.stdout
+    # shrinking bytes (the zero1 switch) is never a regression
+    better = _dump(tmp_path / "b2.jsonl",
+                   extra=_ddp_recs(comms=int(0.75 * 10**6)))
+    assert _run(better, "--compare", base).returncode == 0
+
+
+def test_compare_overlap_efficiency_drop_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_ddp_recs(eff=0.8))
+    cur = _dump(tmp_path / "cur.jsonl", extra=_ddp_recs(eff=0.3))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION ddp/overlap_efficiency" in proc.stdout
+    # a small wobble within the threshold passes
+    wobble = _dump(tmp_path / "w.jsonl", extra=_ddp_recs(eff=0.76))
+    assert _run(wobble, "--compare", base).returncode == 0
+
+
+def test_ddp_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=_ddp_recs())
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DDP comms (ddp/* gauges)" in proc.stdout
+    assert "ddp/comms_bytes{mode=allreduce}" in proc.stdout

@@ -420,6 +420,47 @@ def _grad_norm_p50s(records):
 GRAD_NORM_JUMP_FACTOR = 10.0
 
 
+def _ddp_gauges(records):
+    """{labels-qualified name: value} for the ``ddp/*`` gauge family
+    (ISSUE 11: comms_bytes per sync mode, overlap_efficiency,
+    allreduce bandwidth)."""
+    out = {}
+    for rec in records:
+        name = rec.get("name", "")
+        if rec.get("type") != "gauge" or not isinstance(name, str) \
+                or not name.startswith("ddp/") \
+                or not isinstance(rec.get("value"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = name + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["value"])
+    return out
+
+
+def render_ddp_family(path):
+    """rows for the ddp/* table, or None when the dump has none."""
+    records = _read_records(path)
+    if not records:
+        return None
+    fam = _ddp_gauges(records)
+    if not fam:
+        return None
+    return [{"metric": k, "value": v} for k, v in sorted(fam.items())]
+
+
+def summarize_ddp(path, fam):
+    print(f"{path}: DDP comms (ddp/* gauges)")
+    for row in fam:
+        v = row["value"]
+        if "comms_bytes" in row["metric"]:
+            print(f"  {row['metric']:44s} {_fmt_bytes(int(v)):>10s}")
+        else:
+            print(f"  {row['metric']:44s} {v:>10.3f}")
+
+
 def _step_time_p50s(records):
     """{metric name: p50} for every */step_time_ms histogram/timer
     record that carries a sampled p50."""
@@ -465,7 +506,11 @@ def compare_metrics(current_path, base_path, threshold=0.10):
       truthy in base and 0 in current — binary;
     - grad-norm blow-up (ISSUE 9): any ``numerics/grad_norm`` p50 more
       than :data:`GRAD_NORM_JUMP_FACTOR` x its base — fixed factor,
-      independent of ``threshold``.
+      independent of ``threshold``;
+    - DDP comms (ISSUE 11): a ``ddp/comms_bytes`` gauge growing past
+      ``threshold`` (the sync layout moves more bytes), or
+      ``ddp/overlap_efficiency`` dropping past ``threshold`` (the
+      bucket schedule stopped overlapping).
 
     Metrics present in only one dump are reported as info, never
     failed on: a shorter run is not a regression.
@@ -532,6 +577,28 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"(>{GRAD_NORM_JUMP_FACTOR:.0f}x jump)")
         else:
             infos.append(f"{name}: p50 {b:.4g} -> {c:.4g} ok")
+
+    cur_ddp, base_ddp = _ddp_gauges(cur), _ddp_gauges(base)
+    for name in sorted(base_ddp):
+        if name not in cur_ddp:
+            infos.append(f"{name}: only in base ({base_ddp[name]:.4g})")
+            continue
+        b, c = base_ddp[name], cur_ddp[name]
+        if name.startswith("ddp/comms_bytes") and b > 0 \
+                and c > b * (1.0 + threshold):
+            # the gradient-sync layout started moving more bytes per
+            # step — a schedule/packing regression regardless of the
+            # wall clock on this machine
+            regressions.append(
+                f"{name}: {b:.0f} -> {c:.0f} B "
+                f"(+{(c / b - 1) * 100:.1f}% > {threshold * 100:.0f}%)")
+        elif name == "ddp/overlap_efficiency" and b > 0 \
+                and c < b * (1.0 - threshold):
+            regressions.append(
+                f"{name}: {b:.3f} -> {c:.3f} (the bucket schedule "
+                f"stopped hiding comms under backward compute)")
+        else:
+            infos.append(f"{name}: {b:.4g} -> {c:.4g} ok")
 
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
@@ -683,6 +750,13 @@ if __name__ == "__main__":
                                       "numerics_family": num}))
                 else:
                     summarize_numerics(arg, num)
+            ddp = render_ddp_family(arg) if os.path.isfile(arg) \
+                else None
+            if ddp is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg, "ddp_family": ddp}))
+                else:
+                    summarize_ddp(arg, ddp)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
