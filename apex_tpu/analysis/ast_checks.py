@@ -65,6 +65,21 @@ lived. Checks:
                       pull decimated to every N steps); the numerics
                       module itself is exempt — it IS the sanctioned
                       implementation.
+- ``rank-unsafe-artifact-path``
+                      a write-mode ``open()`` in ``apex_tpu/`` or
+                      ``examples/`` (code that runs inside
+                      multiproc-launched workers) whose path
+                      expression bakes in a fixed artifact filename
+                      (a string literal ending in .json/.jsonl/.csv/
+                      .log/...) with no rank component anywhere in the
+                      expression: two ranks handed the same path
+                      interleave or clobber each other's telemetry —
+                      the ISSUE 12 failure mode that raced every
+                      ``APEX_TPU_METRICS`` dump. Route shared paths
+                      through ``observability.fleet.rank_path`` (or
+                      build the name from the rank/pid). A path that
+                      arrives as a variable is the caller's problem at
+                      the caller's site; a literal is this file's.
 - ``hardcoded-tile-size``
                       an integer tile constant fed to ``pl.BlockSpec``
                       outside ``ops/pallas_config.py`` and the tuner's
@@ -94,7 +109,7 @@ AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "mutable-default", "raw-clock",
               "swallowed-exception-in-step-loop",
               "hardcoded-tile-size", "unclosed-span",
-              "host-isnan-in-step-loop")
+              "host-isnan-in-step-loop", "rank-unsafe-artifact-path")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -161,6 +176,34 @@ def _host_isnan_applies(path: str) -> bool:
 
 
 _ISNAN_NAMES = frozenset({"isnan", "isinf"})
+
+
+# rank-unsafe-artifact-path: library + examples code (what
+# multiproc-launched workers actually execute). The fleet identity
+# module is exempt — it IS the sanctioned suffixing implementation.
+_RANK_PATH_EXEMPT_PREFIX = "apex_tpu/observability/fleet/"
+
+# filename extensions that mean "telemetry/artifact write" — a fixed
+# one of these inside a worker is the shard-clobber pattern
+_ARTIFACT_EXTS = (".json", ".jsonl", ".csv", ".log", ".txt", ".pb",
+                  ".tsv")
+
+# an identifier anywhere in the path expression that smells like a
+# per-rank/per-process component ("...rank...", pid lookups, the
+# sanctioned helper) clears the finding
+_RANK_COMPONENT_RE = re.compile(
+    r"rank|process_index|getpid|\bpid\b|worker|shard|proc_?id",
+    re.IGNORECASE)
+
+_WRITE_MODES = {"w", "a", "wb", "ab", "w+", "a+", "wt", "at", "x",
+                "xb"}
+
+
+def _rank_unsafe_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if _RANK_PATH_EXEMPT_PREFIX in norm:
+        return False
+    return _swallowed_exc_applies(path)
 
 
 # hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
@@ -535,9 +578,60 @@ class _Visitor(ast.NodeVisitor):
                     f"ops/pallas_config, the only modules tile numbers "
                     f"may live in")
 
+    # --------------------------------------- rank-unsafe artifact paths
+
+    def _open_write_mode(self, node) -> bool:
+        """Is this ``open(...)`` call a write? (positional or ``mode=``
+        kwarg; a missing mode is the default read)."""
+        mode = node.args[1] if len(node.args) >= 2 else next(
+            (kw.value for kw in node.keywords if kw.arg == "mode"),
+            None)
+        return (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and mode.value in _WRITE_MODES)
+
+    def _check_rank_unsafe_open(self, node):
+        if not node.args:
+            return
+        if not self._open_write_mode(node):
+            return
+        path_expr = node.args[0]
+        fixed_artifact = None
+        has_rank_component = False
+        for sub in ast.walk(path_expr):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                text = sub.value
+                if text.lower().endswith(_ARTIFACT_EXTS):
+                    fixed_artifact = text
+                if _RANK_COMPONENT_RE.search(text):
+                    has_rank_component = True
+            elif isinstance(sub, ast.Name):
+                if _RANK_COMPONENT_RE.search(sub.id):
+                    has_rank_component = True
+            elif isinstance(sub, ast.Attribute):
+                if _RANK_COMPONENT_RE.search(sub.attr):
+                    has_rank_component = True
+        if fixed_artifact is None or has_rank_component:
+            return
+        self._emit(
+            "rank-unsafe-artifact-path", "error", node.lineno,
+            f"write-mode open() of a fixed artifact path "
+            f"({fixed_artifact!r}) in code multiproc workers execute: "
+            f"two ranks handed this path clobber or interleave each "
+            f"other's telemetry — route it through "
+            f"apex_tpu.observability.fleet.rank_path (automatic "
+            f".rank{{i}} suffix) or build the name from the "
+            f"rank/pid")
+
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
         tail = chain[-1] if chain else None
+
+        if "rank-unsafe-artifact-path" in self.checks and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "open":
+            self._check_rank_unsafe_open(node)
 
         if "host-isnan-in-step-loop" in self.checks and \
                 self.loop_depth[-1] > 0 and \
@@ -663,6 +757,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # sanctioned fused/decimated implementation)
     if not _host_isnan_applies(abspath or relpath):
         checks = checks - {"host-isnan-in-step-loop"}
+    # rank-unsafe-artifact-path: the same worker-executed ground, minus
+    # the fleet identity package (the sanctioned suffixer)
+    if not _rank_unsafe_applies(abspath or relpath):
+        checks = checks - {"rank-unsafe-artifact-path"}
     # hardcoded-tile-size: pallas_config + the tuner search space are
     # the sanctioned homes for tile numbers
     if not _tile_size_applies(abspath or relpath):

@@ -22,9 +22,15 @@ The single layer the whole stack reports through:
   history rings (the fp8 delayed-scaling substrate), NaN/Inf
   provenance via jaxpr replay, and training-health detectors
   (ISSUE 9);
+- :mod:`~apex_tpu.observability.fleet` — cross-rank telemetry
+  (ISSUE 12): rank identity + automatic ``.rank{i}`` artifact
+  suffixing, the grad-sync barrier-wait probe + straggler detector,
+  on-device desync fingerprints, and the fleet merge readers
+  (metrics shards and flight records);
 - ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
   summary CLI (also ``tools/metrics_report.py``); ``... trace <run>``
-  exports a span dump or xplane capture as Perfetto JSON.
+  exports a span dump or xplane capture as Perfetto JSON;
+  ``... fleet <shards>`` joins per-rank shards into one fleet view.
 
 The modules themselves import jax lazily and never force backend init —
 but importing them through the ``apex_tpu`` package still runs the
@@ -71,6 +77,15 @@ from apex_tpu.observability.numerics import (  # noqa: F401
     HealthMonitor,
     StatsCollector,
 )
+from apex_tpu.observability import fleet  # noqa: F401
+from apex_tpu.observability.fleet import (  # noqa: F401
+    DesyncDetector,
+    StragglerDetector,
+    merge_fleet,
+    merge_flight_records,
+    process_identity,
+    rank_path,
+)
 from apex_tpu.observability.scope import annotate, scope  # noqa: F401
 from apex_tpu.observability.step_report import (  # noqa: F401
     STEP_RECORD_FIELDS,
@@ -91,4 +106,6 @@ __all__ = [
     "StepReporter", "STEP_RECORD_FIELDS", "peak_flops",
     "transformer_step_flops",
     "numerics", "StatsCollector", "AmaxHistory", "HealthMonitor",
+    "fleet", "DesyncDetector", "StragglerDetector", "merge_fleet",
+    "merge_flight_records", "process_identity", "rank_path",
 ]

@@ -1,4 +1,4 @@
-"""``python -m apex_tpu.observability {report,trace} ...``
+"""``python -m apex_tpu.observability {report,trace,fleet} ...``
 
 ``report <metrics.jsonl> [...]`` summarizes one or more metrics JSONL
 dumps (bench.py's ``BENCH_METRICS.jsonl``, a training run's step log):
@@ -12,6 +12,21 @@ trace-event JSON (open at ``ui.perfetto.dev``) from any of:
 
 - a span dump (``SpanTracer.save`` / flight-recorder artifact);
 - an xplane capture (``jax.profiler`` logdir, run dir or .xplane.pb).
+
+``fleet <base-or-shards...>`` (ISSUE 12) joins ``.rank{i}``-suffixed
+per-rank metrics shards into one fleet view: per-rank step-time
+p50/p99, cross-rank skew, the merge-time straggler pass, and every
+``fleet/straggler`` / ``fleet/desync`` event. Options:
+
+- ``--json`` — the full fleet report as JSON;
+- ``--emit-metrics OUT.jsonl`` — write the fleet view as registry-
+  shaped records (``fleet/*`` family) for ``tools/metrics_report.py``
+  and its ``--compare`` rank-skew gate;
+- ``--trace OUT.json`` — merged Perfetto export of the ranks' span
+  dumps/flight records, one **pid per rank**;
+- ``--flight DIR`` — instead of metrics shards, merge the
+  ``flightrec_*`` shards in DIR into the fleet post-mortem naming the
+  stuck rank (written as ``fleetrec_*.json`` unless ``--no-write``).
 
 Exit codes: 0 ok, 1 no records found, 2 bad usage / unreadable file.
 """
@@ -124,6 +139,139 @@ def trace_main(args) -> int:
     return 0
 
 
+def _render_fleet(report: dict) -> str:
+    lines = [f"fleet: {report['rank_count']} rank shard(s)"
+             + (f" + {report['legacy_shards']} legacy un-suffixed"
+                if report.get("legacy_shards") else "")]
+    for rank, info in report["ranks"].items():
+        ident = info.get("identity") or {}
+        run = ident.get("run_id")
+        lines.append(f"  rank {rank}: {os.path.basename(info['path'])}"
+                     + (f"  run_id={run}" if run else ""))
+    for metric, row in sorted(report["step_time_skew"].items()):
+        lines.append(f"  {metric}: fleet median p50 "
+                     f"{row['fleet_median_p50']:.3f} ms  skew "
+                     f"{row['skew']:+.1%} (slowest rank "
+                     f"{row['max_rank']})")
+        for rank, p50 in sorted(row["p50_by_rank"].items()):
+            p99 = row["p99_by_rank"].get(rank)
+            p99_s = f"  p99 {p99:.3f}" if isinstance(
+                p99, (int, float)) else ""
+            lines.append(f"    rank {rank}: p50 {p50:.3f} ms{p99_s}")
+    for verdict in report["stragglers"]:
+        lines.append(f"  STRAGGLER rank {verdict['rank']} on "
+                     f"{verdict['metric']} (skew {verdict['skew']:.2f})")
+    for ev in report["fleet_events"]:
+        fields = ev.get("fields") or {}
+        body = "  ".join(f"{k}={v}" for k, v in fields.items())
+        lines.append(f"  [{ev.get('name')}] rank {ev.get('rank')} "
+                     f"{body}")
+    if not report["step_time_skew"] and not report["fleet_events"]:
+        lines.append("  (no step-time metrics or fleet events in the "
+                     "shards)")
+    return "\n".join(lines)
+
+
+def fleet_main(args) -> int:
+    from apex_tpu.observability import fleet
+
+    if args.flight:
+        try:
+            merged = fleet.merge_flight_records(args.flight,
+                                                run_id=args.run_id)
+        except (OSError, ValueError) as e:
+            print(f"cannot merge flight records: {e}", file=sys.stderr)
+            return 2 if not isinstance(e, FileNotFoundError) else 1
+        if not args.no_write:
+            merged["written"] = fleet.write_fleet_record(
+                merged, args.flight)
+        if args.json:
+            print(json.dumps(merged, indent=2))
+        else:
+            print(f"fleet flight record: {merged['rank_count']} rank(s)")
+            for rank, info in merged["ranks"].items():
+                where = info.get("last_collective")
+                print(f"  rank {rank}: step {info.get('step')} "
+                      f"trigger={info.get('trigger')}"
+                      + (f" last_collective={where}" if where else ""))
+            print(f"  verdict: {merged['verdict'] or 'no stuck rank'}")
+            if merged.get("written"):
+                print(f"  wrote {merged['written']}")
+        return 0
+    if not args.paths:
+        print("fleet needs shard path(s) or --flight DIR",
+              file=sys.stderr)
+        return 2
+    if args.trace:
+        # trace mode: the positional paths are SPAN-DUMP / flight-
+        # record shards (rank from the .rank{i} suffix, else the
+        # payload's process_index stamp)
+        rank_dumps = []
+        for path in args.paths:
+            rank = fleet.rank_of_path(path)
+            if rank is None:
+                try:
+                    with open(path) as f:
+                        rank = json.load(f).get("process_index")
+                except (OSError, ValueError) as e:
+                    print(f"cannot read {path}: {e}", file=sys.stderr)
+                    return 2
+            rank_dumps.append((rank, path))
+        # legacy shards with neither suffix nor stamp get distinct
+        # fallback pids — two of them merging into one Perfetto lane
+        # would misrepresent two processes as one
+        taken = {r for r, _ in rank_dumps if r is not None}
+        next_free = 0
+        for i, (rank, path) in enumerate(rank_dumps):
+            if rank is None:
+                while next_free in taken:
+                    next_free += 1
+                taken.add(next_free)
+                rank_dumps[i] = (next_free, path)
+        if len({r for r, _ in rank_dumps}) != len(rank_dumps):
+            dupes = sorted(r for r, _ in rank_dumps)
+            print(f"duplicate rank(s) across shards: {dupes} — pass "
+                  f"one shard per rank", file=sys.stderr)
+            return 2
+        try:
+            events = fleet.fleet_trace_events(rank_dumps)
+            with open(args.trace, "w") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f)
+        except (OSError, ValueError) as e:
+            print(f"cannot write fleet trace: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {args.trace} ({len(rank_dumps)} rank(s), one pid "
+              f"per rank; open at ui.perfetto.dev)")
+        return 0
+    base = args.paths[0] if len(args.paths) == 1 else list(args.paths)
+    try:
+        report = fleet.merge_fleet(base, run_id=args.run_id)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except (OSError, ValueError) as e:
+        print(f"cannot merge fleet shards: {e}", file=sys.stderr)
+        return 2
+    if args.emit_metrics:
+        records = fleet.fleet_metric_records(report)
+        try:
+            with open(args.emit_metrics, "w") as f:
+                for rec in records:
+                    f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            print(f"cannot write {args.emit_metrics}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"wrote {args.emit_metrics} ({len(records)} record(s))",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(_render_fleet(report))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.observability",
@@ -142,9 +290,33 @@ def main(argv=None) -> int:
                                 "or jax.profiler logdir/.xplane.pb")
     tp.add_argument("--out", default="",
                     help="output path (default: <run>.perfetto.json)")
+    fp = sub.add_parser(
+        "fleet", help="join per-rank .rank{i} telemetry shards into "
+                      "one fleet view (ISSUE 12)")
+    fp.add_argument("paths", nargs="*",
+                    help="metrics shard base/path(s); with --trace, "
+                         "span-dump/flight-record shards")
+    fp.add_argument("--json", action="store_true",
+                    help="emit the fleet report as JSON")
+    fp.add_argument("--run-id", default=None,
+                    help="only merge shards stamped with this run_id")
+    fp.add_argument("--emit-metrics", default="",
+                    help="also write the fleet view as registry-shaped "
+                         "JSONL (fleet/* family) to this path")
+    fp.add_argument("--trace", default="",
+                    help="merged Perfetto export of span-dump shards, "
+                         "one pid per rank, to this path")
+    fp.add_argument("--flight", default="",
+                    help="merge the flightrec_* shards in this "
+                         "directory instead of metrics shards")
+    fp.add_argument("--no-write", action="store_true",
+                    help="with --flight: don't persist the merged "
+                         "fleetrec_*.json")
     args = ap.parse_args(argv)
     if args.cmd == "trace":
         return trace_main(args)
+    if args.cmd == "fleet":
+        return fleet_main(args)
 
     records = []
     for path in args.paths:

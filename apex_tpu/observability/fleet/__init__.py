@@ -1,0 +1,75 @@
+"""apex_tpu.observability.fleet — cross-rank telemetry (ISSUE 12).
+
+PR 10 made the stack multi-device; this tier makes its failure modes
+attributable across ranks. Four pieces:
+
+- **identity** (:mod:`~apex_tpu.observability.fleet.identity`) —
+  env-driven ``(process_index, process_count, run_id)`` plus
+  :func:`rank_path`, the automatic ``.rank{i}`` suffix every shared
+  artifact write goes through. The registry, span tracer, flight
+  recorder and StepReporter all stamp their records with it.
+- **straggler detection** (:mod:`~.probe` + :mod:`~.straggler`) — a
+  jit-safe per-step pre-collective wait probe around the grad-sync
+  call sites (io_callback enter marker barrier-tied to the collective,
+  exit callback fed the reduced result) feeding a trailing-median
+  cross-rank skew detector that emits ``fleet/straggler`` events
+  naming the slow rank.
+- **desync detection** (:mod:`~.desync`) — cheap on-device per-step
+  fingerprints (per-leaf (sum, |sum|) checksums; ``pmax`` vs ``pmean``
+  equality is the one-scalar flag, ``all_gather`` the attributing
+  form) with a host detector naming the offending rank, step and
+  tensor path; ``ResilientTrainLoop`` trips the rollback ladder on a
+  verdict.
+- **fleet readers** (:mod:`~.merge` + :mod:`~.collector`) —
+  ``merge_fleet`` joins per-rank metrics shards into one report
+  (per-rank and cross-rank p50/p99, skew, straggler pass, rank→pid
+  Perfetto export); ``merge_flight_records`` joins ``flightrec_*``
+  shards into the fleet post-mortem naming the stuck rank and the
+  last collective each rank entered.
+
+CLI: ``python -m apex_tpu.observability fleet <shards...>`` /
+``... fleet --flight DIR``.
+"""
+
+from apex_tpu.observability.fleet import probe  # noqa: F401
+from apex_tpu.observability.fleet.collector import (  # noqa: F401
+    find_flight_records,
+    merge_flight_records,
+    write_fleet_record,
+)
+from apex_tpu.observability.fleet.desync import (  # noqa: F401
+    DesyncDetector,
+    fingerprint,
+    fingerprint_delta,
+    fingerprint_gather,
+    leaf_paths,
+)
+from apex_tpu.observability.fleet.identity import (  # noqa: F401
+    FleetIdentity,
+    identity_fields,
+    is_fleet_member,
+    process_identity,
+    rank_of_path,
+    rank_path,
+    stamp_environ,
+)
+from apex_tpu.observability.fleet.merge import (  # noqa: F401
+    fleet_metric_records,
+    fleet_shards,
+    fleet_trace_events,
+    merge_fleet,
+)
+from apex_tpu.observability.fleet.straggler import (  # noqa: F401
+    StragglerDetector,
+)
+
+__all__ = [
+    "FleetIdentity", "process_identity", "identity_fields",
+    "is_fleet_member", "rank_path", "rank_of_path", "stamp_environ",
+    "probe", "StragglerDetector",
+    "DesyncDetector", "fingerprint", "fingerprint_delta",
+    "fingerprint_gather", "leaf_paths",
+    "fleet_shards", "merge_fleet", "fleet_metric_records",
+    "fleet_trace_events",
+    "find_flight_records", "merge_flight_records", "write_fleet_record",
+]

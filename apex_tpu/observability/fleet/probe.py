@@ -1,0 +1,220 @@
+"""Jit-safe per-step barrier-wait probe around the grad-sync call sites.
+
+A straggling rank is invisible from inside its own process: every rank
+just sees "the allreduce got slow". What *is* measurable per rank is
+the pre-collective wait — the gap between this rank's gradients being
+ready (it reaches the collective) and the collective completing (every
+rank arrived). Fast ranks wait long; the straggler barely waits at
+all. Comparing those waits across ranks names the slow rank
+(:mod:`~apex_tpu.observability.fleet.straggler`).
+
+The probe is a pair of hooks the grad-sync call sites
+(``parallel/overlap.py``, ``parallel/zero.py``,
+``parallel/distributed.py``) wrap around their collectives::
+
+    flat = probe.collective_enter(flat, "ddp/overlap/bucket0", axis_name)
+    red = jax.lax.psum(flat, axis_name)
+    red = probe.collective_exit(red, "ddp/overlap/bucket0", axis_name)
+
+Disabled (the default) both are identity functions resolved at trace
+time — zero ops in the compiled program, so production steps pay
+nothing. Enabled (:func:`enable` / ``APEX_TPU_FLEET_PROBE=1``), they
+lower to host callbacks that are safe under ``jit`` + ``shard_map``:
+
+- ``collective_enter`` issues an ``io_callback`` carrying
+  ``lax.axis_index(axis_name)`` whose result token is tied to the
+  collective's operand with ``lax.optimization_barrier`` — the
+  callback fires when THIS rank's gradients are ready, before the
+  collective can issue;
+- ``collective_exit`` issues a ``jax.debug.callback`` fed a slice of
+  the reduced result — it fires once the collective completed.
+
+Per (site, rank) the host records ``wait = t_exit - t_enter`` into the
+``fleet/grad_sync_wait_s{site=,rank=}`` timer, remembers the last
+collective each rank entered (the fleet flight-record collector reads
+it to say where a stuck rank is stuck), and feeds the wait into the
+process-local :class:`~apex_tpu.observability.fleet.straggler.
+StragglerDetector` so a persistent skew emits ``fleet/straggler``
+events live. On a simulated mesh all ranks share one process and the
+probe yields genuine per-rank waits; on a real fleet each process
+records its own ranks and :func:`~apex_tpu.observability.fleet.merge.
+merge_fleet` joins them.
+
+Do NOT wrap collectives inside a ``custom_vjp`` backward (the
+``overlapped_value_and_grad`` hooks): callbacks are not differentiable
+and the bwd already runs under the forward's instrumented sites.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "enable", "disable", "enabled", "collective_enter",
+    "collective_exit", "last_collective", "last_collectives",
+    "wait_times", "reset", "set_detector",
+]
+
+_LOCK = threading.Lock()
+_ENABLED: Optional[bool] = None      # None = consult the env once
+_ENTERS: dict = {}                   # (site, rank) -> perf_counter at enter
+_LAST: dict = {}                     # rank -> site of last collective entered
+_WAITS: dict = {}                    # (site, rank) -> last wait seconds
+_DETECTOR = None                     # optional straggler.StragglerDetector
+_STEPS: dict = {}                    # site -> completed detector rounds
+_FRESH: dict = {}                    # site -> ranks with a wait since the
+#                                      last detector round fed
+
+
+def enabled() -> bool:
+    """Is the probe armed? Explicit :func:`enable`/:func:`disable` wins;
+    otherwise ``APEX_TPU_FLEET_PROBE=1`` arms it."""
+    if _ENABLED is not None:
+        return _ENABLED
+    return os.environ.get("APEX_TPU_FLEET_PROBE", "") == "1"
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset() -> None:
+    """Drop recorded waits/markers and return to env-driven arming
+    (tests; a long-lived process between runs)."""
+    global _ENABLED, _DETECTOR
+    with _LOCK:
+        _ENABLED = None
+        _DETECTOR = None
+        _ENTERS.clear()
+        _LAST.clear()
+        _WAITS.clear()
+        _STEPS.clear()
+        _FRESH.clear()
+
+
+def set_detector(detector) -> None:
+    """Feed every completed (site, per-rank wait) round into a
+    :class:`~apex_tpu.observability.fleet.straggler.StragglerDetector`
+    (mode ``"wait"``) so skew verdicts fire live in-process."""
+    global _DETECTOR
+    _DETECTOR = detector
+
+
+def last_collective(rank: Optional[int] = None) -> Optional[str]:
+    """Site of the last collective this process's rank(s) entered —
+    the flight recorder dumps this so the fleet collector can say
+    which collective a stuck rank died inside. Without ``rank``:
+    the most recent across all local ranks."""
+    with _LOCK:
+        if rank is not None:
+            return _LAST.get(int(rank))
+        # _LAST is insertion-ordered; the most recent write is last
+        return next(reversed(_LAST.values()), None) if _LAST else None
+
+
+def last_collectives() -> dict:
+    """{rank: site} of each local rank's last entered collective."""
+    with _LOCK:
+        return dict(_LAST)
+
+
+def wait_times() -> dict:
+    """{(site, rank): last wait seconds} — test/inspection hook."""
+    with _LOCK:
+        return dict(_WAITS)
+
+
+def _reg():
+    from apex_tpu.observability import get_registry
+    return get_registry()
+
+
+def _on_enter(site: str, rank) -> None:
+    rank = int(rank)
+    with _LOCK:
+        _ENTERS[(site, rank)] = time.perf_counter()
+        # pop first so insertion order tracks recency (last_collective
+        # without a rank returns the most recent write)
+        _LAST.pop(rank, None)
+        _LAST[rank] = site
+
+
+def _on_exit(site: str, rank) -> None:
+    rank = int(rank)
+    now = time.perf_counter()
+    detector_round = None
+    with _LOCK:
+        start = _ENTERS.pop((site, rank), None)
+        if start is None:
+            return  # exit without enter: a retraced/partial program
+        wait = now - start
+        _WAITS[(site, rank)] = wait
+        if _DETECTOR is not None:
+            # a "round" completes when every rank seen so far for this
+            # site has a FRESH wait since the last round — host
+            # callbacks carry no cross-device ordering guarantee, so
+            # completion is tracked per rank, never inferred from
+            # which rank's callback happened to land last
+            fresh = _FRESH.setdefault(site, set())
+            fresh.add(rank)
+            ranks = {r for s, r in _WAITS if s == site}
+            if fresh >= ranks:
+                step = _STEPS.get(site, 0)
+                _STEPS[site] = step + 1
+                # a {rank: wait} mapping, NOT a positional list: the
+                # locally-hosted ranks need not be 0..n-1
+                detector_round = (step, {
+                    r: _WAITS[(site, r)] for r in sorted(ranks)})
+                fresh.clear()
+    reg = _reg()
+    reg.timer("fleet/grad_sync_wait_s", site=site,
+              rank=str(rank)).observe(wait)
+    if detector_round is not None:
+        step, waits = detector_round
+        _DETECTOR.observe(step, waits, site=site)
+
+
+def collective_enter(x, site: str, axis_name):
+    """Mark "this rank's operand is ready, entering ``site``" — returns
+    ``x`` (tied to the host callback so the collective cannot be
+    scheduled before the mark). Identity when the probe is off."""
+    if not enabled():
+        return x
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import io_callback
+
+    def mark(r):
+        _on_enter(site, r)
+        return np.int32(0)
+
+    rank = jax.lax.axis_index(axis_name)
+    token = io_callback(mark, jax.ShapeDtypeStruct((), jnp.int32),
+                        rank, ordered=False)
+    x, _ = jax.lax.optimization_barrier((x, token))
+    return x
+
+
+def collective_exit(x, site: str, axis_name):
+    """Mark "``site`` completed on this rank" — fed a slice of the
+    reduced result so the callback cannot fire before the collective
+    finished. Returns ``x`` unchanged; identity when the probe is
+    off."""
+    if not enabled():
+        return x
+    import jax
+
+    rank = jax.lax.axis_index(axis_name)
+    probe_slice = x.ravel()[0] if getattr(x, "ndim", 0) else x
+    jax.debug.callback(lambda r, _v: _on_exit(site, r), rank, probe_slice)
+    return x

@@ -30,6 +30,7 @@ checkpoint + exit 75) after the dump lands.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import signal
@@ -46,6 +47,11 @@ from apex_tpu.observability.profiling.spans import SpanTracer, get_tracer
 __all__ = ["FlightRecorder", "DEFAULT_STALL_FACTOR"]
 
 DEFAULT_STALL_FACTOR = 3.0
+
+# process-wide dump serial: two recorders (or two dumps of one) in the
+# same second share a timestamp AND a pid — the serial is what keeps
+# their artifact names distinct (ISSUE 12 satellite)
+_DUMP_SEQ = itertools.count()
 
 
 def _default_dir() -> str:
@@ -277,15 +283,34 @@ class FlightRecorder:
         """Write the post-mortem artifact; returns its path (None when
         even the write failed — the recorder must never take down the
         run it observes)."""
+        from apex_tpu.observability.fleet import probe as fleet_probe
+        from apex_tpu.observability.fleet.identity import (
+            FleetIdentity,
+            identity_fields,
+            process_identity,
+        )
+
         reg = self._reg()
         tracer = self.tracer
         with self._lock:
             step = self._step
             started = self._step_started
             hist = list(self._history)
+        try:
+            ident = process_identity()
+        except ValueError:
+            # a malformed identity env must not take down the dump —
+            # the recorder's contract is that a post-mortem never
+            # kills (or here: never silences) the run it observes
+            ident = FleetIdentity(0, 1, None)
         payload = {
             "kind": "apex_tpu.flight_record",
             "schema_version": 1,
+            **identity_fields(ident),
+            "last_collective": fleet_probe.last_collective(),
+            "last_collectives": {
+                str(r): s
+                for r, s in fleet_probe.last_collectives().items()},
             "reason": reason,
             "trigger": kind,
             "pid": os.getpid(),
@@ -310,8 +335,12 @@ class FlightRecorder:
                           if m.labels else ""): m.value
                 for m in reg.metrics() if m.kind == "counter"},
         }
+        # rank + pid + per-process serial keep concurrent dumps (two
+        # ranks sharing a fleet dir, or two watchdogs firing in the
+        # same second of one process) from ever clobbering each other
         fname = (f"flightrec_{time.strftime('%Y%m%d-%H%M%S')}_"
-                 f"{os.getpid()}_{kind}.json")
+                 f"r{ident.process_index}_{os.getpid()}_"
+                 f"{next(_DUMP_SEQ)}_{kind}.json")
         path = os.path.join(self.directory, fname)
         try:
             os.makedirs(self.directory, exist_ok=True)

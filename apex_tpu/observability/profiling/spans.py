@@ -203,10 +203,30 @@ class SpanTracer:
         write_chrome_trace(path, spans, thread_names=self.thread_names())
         return len(spans)
 
+    @staticmethod
+    def save_path(path: str) -> str:
+        """Where :meth:`save` actually lands for ``path`` — the
+        ``.rank{i}``-suffixed variant for fleet members, ``path``
+        verbatim for solo processes (the
+        :meth:`MetricRegistry.dump_path` analog)."""
+        from apex_tpu.observability.fleet.identity import rank_path
+        return rank_path(path)
+
     def save(self, path: str, since: int = 0) -> int:
         """Persist the raw ring as a span-dump JSON (re-exportable with
         ``python -m apex_tpu.observability trace``); returns the span
-        count."""
+        count. Fleet members (ISSUE 12) write the ``.rank{i}``-suffixed
+        variant of ``path`` (:meth:`save_path` resolves it) with the
+        ``{process_index, process_count, run_id}`` stamp, so concurrent
+        rank dumps never clobber and the fleet CLI can join them
+        rank→pid."""
+        from apex_tpu.observability.fleet.identity import (
+            identity_fields,
+            is_fleet_member,
+            process_identity,
+            rank_path,
+        )
+
         spans = self.completed(since)
         payload = {
             "kind": "apex_tpu.spans",
@@ -217,7 +237,10 @@ class SpanTracer:
             "dropped": self.dropped(since),
             "spans": [s.to_dict() for s in spans],
         }
-        with open(path, "w") as f:
+        ident = process_identity()
+        if is_fleet_member(ident):
+            payload.update(identity_fields(ident))
+        with open(rank_path(path, ident), "w") as f:
             json.dump(payload, f, indent=1)
         return len(spans)
 

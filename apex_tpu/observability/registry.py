@@ -322,12 +322,32 @@ class MetricRegistry:
         return [_jsonable(r) for r in recs] + self.events()
 
     def dump(self, path: str, mode: str = "w") -> list:
-        """Write one JSONL record per metric/event; returns the records."""
+        """Write one JSONL record per metric/event; returns the records.
+
+        Fleet-aware (ISSUE 12): a fleet member (``APEX_TPU_PROCESS_*``
+        identity set, or process_count > 1) writes to the ``.rank{i}``-
+        suffixed variant of ``path`` — two ranks handed the same shared
+        path can never interleave — and every record carries the
+        ``{process_index, process_count, run_id}`` stamp
+        ``merge_fleet`` groups by. Solo processes write ``path``
+        verbatim with unstamped records, byte-identical to pre-fleet
+        dumps. :meth:`dump_path` is the resolved destination.
+        """
+        stamp = _fleet_stamp()
         records = self.to_records()
-        with open(path, mode) as f:
+        if stamp:
+            records = [dict(rec, **stamp) for rec in records]
+        with open(self.dump_path(path), mode) as f:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
         return records
+
+    @staticmethod
+    def dump_path(path: str) -> str:
+        """Where :meth:`dump` actually lands for ``path`` (the
+        per-rank suffixed variant for fleet members)."""
+        from apex_tpu.observability.fleet.identity import rank_path
+        return rank_path(path)
 
     def clear(self) -> None:
         with self._lock:
@@ -386,13 +406,29 @@ def set_registry(registry: MetricRegistry) -> MetricRegistry:
 def append_event(path: str, name: str, **fields) -> dict:
     """Append one structured event record to a metrics JSONL file without
     a registry — for processes (like the bench launcher) that own no
-    metrics but must contribute an event (e.g. ``tpu_init_error``)."""
-    rec = {"type": "event", "name": name, "seq": -1}
+    metrics but must contribute an event (e.g. ``tpu_init_error``).
+    Fleet members append to the ``.rank{i}``-suffixed path with the
+    identity stamp, like :meth:`MetricRegistry.dump`."""
+    rec = {"type": "event", "name": name, "seq": -1, **_fleet_stamp()}
     if fields:
         rec["fields"] = _jsonable(fields)
-    with open(path, "a") as f:
+    with open(MetricRegistry.dump_path(path), "a") as f:
         f.write(json.dumps(rec) + "\n")
     return rec
+
+
+def _fleet_stamp() -> dict:
+    """{process_index, process_count, run_id} for fleet members, {}
+    for solo processes (legacy dumps stay byte-identical). Env-driven
+    and jax-free — a metrics write must never force backend init."""
+    from apex_tpu.observability.fleet.identity import (
+        identity_fields,
+        is_fleet_member,
+        process_identity,
+    )
+
+    ident = process_identity()
+    return identity_fields(ident) if is_fleet_member(ident) else {}
 
 
 def read_jsonl(path: str) -> list:

@@ -63,11 +63,14 @@ def transformer_step_flops(n_params: int, n_layers: int, hidden: int,
 # drift silently from its consumers. ``numerics`` is the ISSUE 9 block:
 # the latest decimated stats-pass summary
 # (``numerics.StatsCollector.last`` — finite flag, non-finite paths,
-# top-k amax tensors, stats-pass cost).
+# top-k amax tensors, stats-pass cost). ``process_index`` /
+# ``process_count`` are the ISSUE 12 fleet stamp (0 / 1 for a solo
+# process), so a merged fleet view can attribute every step record to
+# its rank; ``run_id`` rides as an extra field only when set.
 STEP_RECORD_FIELDS = (
     "reporter", "step", "step_time_ms", "loss", "loss_scale",
     "overflow_count", "grad_norm", "tokens_per_sec", "tflops_per_sec",
-    "mfu", "numerics",
+    "mfu", "numerics", "process_index", "process_count",
 )
 
 
@@ -130,10 +133,15 @@ class StepReporter:
         collector only refreshes it on its decimated cadence, so the
         record says which stats window it was inside.
         """
+        from apex_tpu.observability.fleet.identity import (
+            process_identity,
+        )
+
         step_time_s = float(step_time_s)
         if step_time_s <= 0:
             raise ValueError(f"step_time_s must be positive, "
                              f"got {step_time_s}")
+        ident = process_identity()
         fields = {
             "reporter": self.name,
             "step": len(self.records),
@@ -146,7 +154,11 @@ class StepReporter:
             "tflops_per_sec": None,
             "mfu": None,
             "numerics": dict(numerics) if numerics else None,
+            "process_index": ident.process_index,
+            "process_count": ident.process_count,
         }
+        if ident.run_id:
+            fields["run_id"] = ident.run_id
         if scaler_state is not None:
             fields["loss_scale"] = _host_float(
                 getattr(scaler_state, "loss_scale", None))

@@ -41,12 +41,14 @@ DDP over real models.
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.observability import span
+from apex_tpu.observability.fleet import probe as fleet_probe
 from apex_tpu.ops.flat import flatten_tree, unflatten_tree
 
 
@@ -59,10 +61,20 @@ def sync_gradients(grads, axis_name: str = "data", gradient_average: bool = True
     after the reduction to avoid overflow in fp16 sums (ref distributed.py
     predivide logic).
     """
+    # fleet barrier-wait probe sites (ISSUE 12): one per leaf — the
+    # per-leaf psums are independent and can overlap, so a shared site
+    # key would clobber its own enter/exit timestamps. tree_map visits
+    # leaves in deterministic flatten order, so the numbering is
+    # stable across traces.
+    leaf_counter = itertools.count()
+
     def reduce_leaf(g):
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
+        site = f"ddp/allreduce/leaf{next(leaf_counter)}"
+        g = fleet_probe.collective_enter(g, site, axis_name)
         g = jax.lax.psum(g, axis_name)
+        g = fleet_probe.collective_exit(g, site, axis_name)
         if gradient_average:
             # axis_size is a compile-time constant; psum(ones) here
             # would emit a real collective for it (apex_tpu.analysis
@@ -94,7 +106,11 @@ def sync_gradients_flat(grads, axis_name: str = "data", gradient_average: bool =
             with span(f"ddp/bucket/{k}"):
                 if pre != 1.0:
                     buf = buf / pre
+                buf = fleet_probe.collective_enter(
+                    buf, f"ddp/bucket/{k}", axis_name)
                 r = jax.lax.psum(buf, axis_name)
+                r = fleet_probe.collective_exit(
+                    r, f"ddp/bucket/{k}", axis_name)
                 if gradient_average:
                     # static axis size, not psum(ones): the probe would
                     # be a dead collective riding every bucket

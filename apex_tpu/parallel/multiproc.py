@@ -61,6 +61,17 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
     jax.distributed.initialize(**kwargs)
+    # back-fill the fleet-identity env (ISSUE 12) so every telemetry
+    # writer — which reads the env, never jax, to stay backend-free —
+    # rank-suffixes its artifacts from here on. setdefault: an identity
+    # the launcher already exported (with run_id) wins. ONLY for a real
+    # fleet: a set index marks the process a fleet member, and a solo
+    # run must keep writing un-suffixed legacy artifact names.
+    if jax.process_count() > 1:
+        os.environ.setdefault("APEX_TPU_PROCESS_INDEX",
+                              str(jax.process_index()))
+        os.environ.setdefault("APEX_TPU_PROCESS_COUNT",
+                              str(jax.process_count()))
     return jax.process_index(), jax.process_count()
 
 
@@ -114,6 +125,11 @@ def launch(script_args, nprocs: int, devices_per_proc: int = 1,
     addr = f"127.0.0.1:{_free_port()}"
     base = dict(os.environ if env is None else env)
     base.update(COORDINATOR_ADDRESS=addr, NUM_PROCESSES=str(nprocs))
+    # shared run id for the fleet's telemetry shards (ISSUE 12):
+    # merge_fleet / the flight-record collector group by it. The
+    # port-qualified launcher pid is unique per launch on this host.
+    base.setdefault("APEX_TPU_RUN_ID",
+                    f"fleet-{os.getpid()}-{addr.rsplit(':', 1)[-1]}")
     if cpu:
         base["APEX_TPU_FORCE_CPU"] = "1"
         flags = base.get("XLA_FLAGS", "")
@@ -127,7 +143,12 @@ def launch(script_args, nprocs: int, devices_per_proc: int = 1,
         ).strip()
     procs = []
     for pid in range(nprocs):
-        env_p = dict(base, PROCESS_ID=str(pid))
+        # fleet identity per worker (ISSUE 12): index/count exported up
+        # front so telemetry written BEFORE jax.distributed comes up is
+        # already rank-suffixed and stamped
+        env_p = dict(base, PROCESS_ID=str(pid),
+                     APEX_TPU_PROCESS_INDEX=str(pid),
+                     APEX_TPU_PROCESS_COUNT=str(nprocs))
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "apex_tpu.parallel.multiproc",
              *script_args], env=env_p))

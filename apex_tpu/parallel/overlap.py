@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.observability import span
+from apex_tpu.observability.fleet import probe as fleet_probe
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,12 +204,18 @@ def sync_gradients_overlapped(grads, axis_name: str = "data",
     out = [None] * len(leaves)
     token = None
     for k, bucket in enumerate(plan.buckets):
-        with span(f"ddp/overlap/bucket{k}/{bucket.dtype}"):
+        site = f"ddp/overlap/bucket{k}/{bucket.dtype}"
+        with span(site):
             flat = _pack(leaves, bucket)
             if pre != 1.0:
                 flat = flat / pre
             flat, token = _chain(flat, token)
+            # fleet barrier-wait probe (ISSUE 12): identity when off;
+            # armed, it stamps per-rank enter/exit around the psum so
+            # the straggler detector sees each rank's wait
+            flat = fleet_probe.collective_enter(flat, site, axis_name)
             red = jax.lax.psum(flat, axis_name)
+            red = fleet_probe.collective_exit(red, site, axis_name)
             if gradient_average:
                 # static axis size (never psum(ones) — dead-collective)
                 red = red * jnp.asarray(pre / n, red.dtype)

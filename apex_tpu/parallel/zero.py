@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.observability import span
+from apex_tpu.observability.fleet import probe as fleet_probe
 from apex_tpu.optimizers import _math
 from apex_tpu.parallel.overlap import (
     OverlapPlan,
@@ -181,13 +182,19 @@ class Zero1FusedAdam:
         token = None
         for k, bucket in enumerate(plan.buckets):
             shard_len = bucket.padded // n
-            with span(f"ddp/zero1/bucket{k}/{bucket.dtype}"):
+            site = f"ddp/zero1/bucket{k}/{bucket.dtype}"
+            with span(site):
                 # grads travel fp32 (the fused_adam flat packing),
                 # params in their own storage dtype
                 gflat = _pack(g_leaves, bucket, cast=jnp.float32)
                 if pre != 1.0:
                     gflat = gflat / pre
                 gflat, token = _chain(gflat, token)
+                # fleet barrier-wait probe (ISSUE 12): identity when
+                # off; armed, per-rank enter/exit brackets the
+                # scatter+gather pair (the ZeRO-1 sync region)
+                gflat = fleet_probe.collective_enter(
+                    gflat, site, self.axis_name)
                 g_shard = jax.lax.psum_scatter(
                     gflat, self.axis_name, scatter_dimension=0,
                     tiled=True)
@@ -202,6 +209,8 @@ class Zero1FusedAdam:
                 new_p_shard = p_shard + d.astype(pflat.dtype)
                 new_pflat = jax.lax.all_gather(
                     new_p_shard, self.axis_name, tiled=True)
+                new_pflat = fleet_probe.collective_exit(
+                    new_pflat, site, self.axis_name)
             token = _token_of(new_pflat)
             mu_out.append(m)
             nu_out.append(v)

@@ -41,6 +41,16 @@ verdict rides every ``rollback`` event and the
 :class:`TrainAborted` report's ``numerics`` block, so an injected
 ``nan_grads``/``corrupt_tree`` chaos fault — or the real thing — is
 fully attributable from the abort artifact alone.
+
+ISSUE 12: pass ``desync_detector=`` (an
+:class:`apex_tpu.observability.fleet.DesyncDetector`) and return the
+step's gathered fingerprint matrix
+(:func:`~apex_tpu.observability.fleet.fingerprint_gather`) in
+``metrics["fleet_fingerprint"]`` — the loop checks it after every
+healthy step; a cross-rank divergence is treated as a rung-2 failure
+(rollback → replay → abort), with the fleet verdict — offending rank,
+first divergent step, tensor path — attached to every ``rollback``
+event and the :class:`TrainAborted` report's ``fleet`` block.
 """
 
 from __future__ import annotations
@@ -158,7 +168,8 @@ class ResilientTrainLoop:
                  deep_validate_resume: bool = False,
                  exit_on_preempt: bool = False, on_resume=None,
                  registry=None, stall_s: float = 2.0,
-                 flight_recorder=None, numerics_provenance: bool = True):
+                 flight_recorder=None, numerics_provenance: bool = True,
+                 desync_detector=None):
         self.step_fn = step_fn
         self.directory = directory
         self.save_every = save_every
@@ -176,6 +187,7 @@ class ResilientTrainLoop:
         self.stall_s = float(stall_s)
         self.flight_recorder = flight_recorder
         self.numerics_provenance = numerics_provenance
+        self.desync_detector = desync_detector
         self.manager = (ckpt.CheckpointManager(
             directory, max_to_keep=max_to_keep, async_save=async_save)
             if directory else None)
@@ -349,10 +361,15 @@ class ResilientTrainLoop:
                         # a hung step, not a failed one: the step
                         # completes after stall_s, so only a watchdog
                         # (the flight recorder's) observes it — exactly
-                        # the production wedge this simulates
+                        # the production wedge this simulates. The span
+                        # keeps the hang attributable: a flight dump
+                        # taken mid-stall shows this open region
+                        from apex_tpu.observability import span
+
                         reg.counter("resilience/faults_injected",
                                     kind="stall").inc()
-                        time.sleep(self.stall_s)
+                        with span("resilience/stall_fault"):
+                            time.sleep(self.stall_s)
                     result = self.step_fn(_state, _step)
                 except BaseException:
                     # a raised attempt's near-zero duration is NOT a
@@ -397,6 +414,21 @@ class ResilientTrainLoop:
                 state, step, rollbacks = self._rollback(
                     fallback_state, fallback_step, rollbacks, step,
                     last_error, numerics=prov)
+                continue
+
+            # ---- fleet desync check (ISSUE 12): a step can be
+            # numerically healthy on every rank yet silently divergent
+            # ACROSS ranks — treated exactly like a health failure
+            verdict = self._check_desync(metrics, step)
+            if verdict is not None:
+                last_error = ValueError(
+                    f"cross-rank desync at step {step}: rank "
+                    f"{verdict.get('rank')} diverged at "
+                    f"{verdict.get('tensor_path')}")
+                recovery_target = max(recovery_target, step)
+                state, step, rollbacks = self._rollback(
+                    fallback_state, fallback_step, rollbacks, step,
+                    last_error, fleet=verdict)
                 continue
 
             state = new_state
@@ -468,15 +500,39 @@ class ResilientTrainLoop:
         reg.event("numerics_provenance", step=step, **prov)
         return prov
 
+    # ---------------------------------------------------- fleet desync
+
+    def _check_desync(self, metrics, step: int):
+        """ISSUE 12: run the fleet desync detector over the step's
+        gathered fingerprint (``metrics["fleet_fingerprint"]``).
+        Returns the verdict dict or None; a broken detector degrades
+        to a counter + event, never a masked step."""
+        if self.desync_detector is None or not metrics:
+            return None
+        gathered = metrics.get("fleet_fingerprint")
+        if gathered is None:
+            return None
+        try:
+            return self.desync_detector.check(step, gathered)
+        except Exception as e:  # noqa: BLE001 — diagnostics must not
+            # fail a healthy step
+            reg = self._reg()
+            reg.counter("fleet/desync_check_failures").inc()
+            reg.event("fleet_desync_check_failed", step=step,
+                      error=repr(e)[:200])
+            return None
+
     # --------------------------------------------------------- rollback
 
     def _rollback(self, fallback_state, fallback_step: int,
-                  rollbacks: int, step: int, error, numerics=None):
+                  rollbacks: int, step: int, error, numerics=None,
+                  fleet=None):
         """Rung 2: restore the newest valid checkpoint (or the run's
         starting state) and hand back the replay position. Rung 3:
         past ``max_rollbacks``, abort with the structured report
-        (``numerics`` = the probe verdict, attached to the rollback
-        event and the abort report)."""
+        (``numerics`` = the probe verdict, ``fleet`` = the desync
+        verdict — both attached to the rollback event and the abort
+        report)."""
         reg = self._reg()
         rollbacks += 1
         reg.counter("resilience/rollbacks").inc()
@@ -486,6 +542,11 @@ class ResilientTrainLoop:
             event_fields["numerics"] = {
                 k: numerics.get(k) for k in
                 ("kind", "primitive", "source", "output_paths")}
+        if fleet is not None:
+            event_fields["fleet"] = {
+                k: fleet.get(k) for k in
+                ("rank", "tensor_path", "first_divergent_step",
+                 "max_delta")}
         reg.event("rollback", **event_fields)
         if rollbacks > self.max_rollbacks:
             report = {
@@ -503,6 +564,8 @@ class ResilientTrainLoop:
             }
             if numerics is not None:
                 report["numerics"] = numerics
+            if fleet is not None:
+                report["fleet"] = fleet
             reg.event("train_aborted", **report)
             raise TrainAborted(report)
         if self.manager is not None:

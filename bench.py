@@ -801,9 +801,18 @@ def bench_allreduce(extras):
 
     from apex_tpu.parallel import multiproc
 
+    # fleet identity for the re-exec child (ISSUE 12 satellite): the
+    # child dumps its own registry to the metrics path — marked a rank,
+    # its dump lands at the .rank0-suffixed sibling instead of
+    # interleaving with the parent's writes to the shared JSONL
+    child_env = dict(os.environ,
+                     APEX_TPU_PROCESS_INDEX="0",
+                     APEX_TPU_PROCESS_COUNT="1",
+                     APEX_TPU_RUN_ID=os.environ.get(
+                         "APEX_TPU_RUN_ID", f"ddp-sim-{os.getpid()}"))
     proc = multiproc.run_simulated(
         [sys.executable, os.path.abspath(__file__), "--ddp-sim"],
-        n=8, timeout=600)
+        n=8, timeout=600, env=child_env)
     line = None
     for cand in reversed((proc.stdout or "").strip().splitlines()):
         try:
@@ -1198,7 +1207,10 @@ def worker():
                           by_fn=snap["retraces_by_fn"])
         try:
             reg.dump(_metrics_path())
-            extras["metrics_jsonl"] = os.path.basename(_metrics_path())
+            # dump() rank-suffixes the shared path for fleet members
+            # (ISSUE 12) — report the name that actually landed
+            extras["metrics_jsonl"] = os.path.basename(
+                obs.MetricRegistry.dump_path(_metrics_path()))
         except OSError as e:
             extras["metrics_jsonl_error"] = repr(e)[:120]
         # span-ring Perfetto export (ISSUE 7): the host-side span
@@ -1460,7 +1472,10 @@ def launcher():
 def ddp_sim_worker():
     """``--ddp-sim``: the simulated-mesh child of bench_allreduce —
     runs the DDP comms suite on the env-forced 8-device CPU mesh and
-    prints exactly one JSON line for the parent to merge."""
+    prints exactly one JSON line for the parent to merge. Its registry
+    lands at the rank-suffixed metrics path (the launcher marks it a
+    fleet member), so child and parent can never interleave writes to
+    one shared JSONL (ISSUE 12 satellite)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -1473,6 +1488,14 @@ def ddp_sim_worker():
         return 1
     out = _ddp_comms_suite(payload_mb=4.0)
     out["simulated"] = True
+    try:
+        from apex_tpu import observability as obs
+
+        obs.get_registry().dump(_metrics_path())
+        out["metrics_jsonl"] = os.path.basename(
+            obs.MetricRegistry.dump_path(_metrics_path()))
+    except OSError as e:  # telemetry must not cost the JSON line
+        out["metrics_jsonl_error"] = repr(e)[:120]
     print(json.dumps(out))
     return 0
 

@@ -931,3 +931,102 @@ def train(xs):
         [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
         root=repo, checks=(_ISNAN,)) if f.check == _ISNAN]
     assert not found, "\n".join(f.render() for f in found)
+
+
+# --------------------------------------- rank-unsafe-artifact-path
+
+_RANK = "rank-unsafe-artifact-path"
+
+
+def test_rank_unsafe_fixed_artifact_open_flagged():
+    src = """
+import os, json
+
+def dump(records, directory):
+    with open(os.path.join(directory, "metrics.jsonl"), "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\\n")
+"""
+    found = _by_check(lint_source(src, "apex_tpu/telemetry.py",
+                                  abspath="/r/apex_tpu/telemetry.py"),
+                      _RANK)
+    assert len(found) == 1
+    assert "metrics.jsonl" in found[0].message
+    assert "rank_path" in found[0].message
+    # append mode is the interleave variant of the same race
+    src_a = src.replace('"w"', '"a"')
+    assert _by_check(lint_source(src_a, "apex_tpu/telemetry.py",
+                                 abspath="/r/apex_tpu/telemetry.py"),
+                     _RANK)
+
+
+def test_rank_unsafe_clean_forms_pass():
+    src = """
+import os
+from apex_tpu.observability.fleet import rank_path
+
+def dump(directory, rank, path):
+    # a rank component in an f-string literal
+    with open(os.path.join(directory, f"m.rank{rank}.jsonl"), "w") as f:
+        f.write("x")
+    # routed through the sanctioned helper
+    with open(rank_path(os.path.join(directory, "m.jsonl")), "w") as f:
+        f.write("x")
+    # read-mode is not a write race
+    with open(os.path.join(directory, "m.jsonl")) as f:
+        f.read()
+    # a variable path is the caller's responsibility at its own site
+    with open(path, "w") as f:
+        f.write("x")
+    # pid-qualified names are per-process already
+    with open(os.path.join(directory, f"log_{os.getpid()}.json"),
+              "w") as f:
+        f.write("x")
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/telemetry.py",
+                                     abspath="/r/apex_tpu/telemetry.py"),
+                         _RANK)
+
+
+def test_rank_unsafe_scoped_and_exempt():
+    src = """
+def dump(directory):
+    import os
+    with open(os.path.join(directory, "stats.json"), "w") as f:
+        f.write("x")
+"""
+    # driver code (tools/, bench.py) is out of scope
+    assert not _by_check(lint_source(src, "tools/report.py",
+                                     abspath="/r/tools/report.py"),
+                         _RANK)
+    # the fleet identity package IS the sanctioned implementation
+    assert not _by_check(lint_source(
+        src, "apex_tpu/observability/fleet/identity.py",
+        abspath="/r/apex_tpu/observability/fleet/identity.py"), _RANK)
+    # examples run inside multiproc workers: in scope
+    assert _by_check(lint_source(src, "examples/train.py",
+                                 abspath="/r/examples/train.py"),
+                     _RANK)
+
+
+def test_rank_unsafe_suppressible_and_repo_clean():
+    src = """
+import os
+
+def dump(directory):
+    with open(os.path.join(directory, "one_writer_only.json"), "w") as f:  # apex-lint: disable=rank-unsafe-artifact-path
+        f.write("x")
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py",
+                                     abspath="/r/apex_tpu/a.py"),
+                         _RANK)
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
+        root=repo, checks=(_RANK,)) if f.check == _RANK]
+    assert not found, "\n".join(f.render() for f in found)

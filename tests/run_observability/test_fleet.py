@@ -1,0 +1,607 @@
+"""Fleet observability tier (ISSUE 12): rank identity + per-rank
+artifact paths, the grad-sync barrier-wait probe + straggler detector,
+on-device desync fingerprints through the resilience ladder, the fleet
+merge readers, and the fleet CLI — proven on the 8-way simulated mesh,
+including the acceptance paths: an injected one-rank stall produces a
+merged fleet flight record naming the stalled rank, and an injected
+one-rank parameter perturbation produces a ``fleet/desync`` verdict
+with the first divergent step."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from apex_tpu.observability import MetricRegistry, fleet, read_jsonl
+from apex_tpu.observability.fleet import identity as fleet_identity
+from apex_tpu.observability.fleet import probe as fleet_probe
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _set_identity(monkeypatch, index, count, run_id=None):
+    monkeypatch.setenv(fleet_identity.ENV_INDEX, str(index))
+    monkeypatch.setenv(fleet_identity.ENV_COUNT, str(count))
+    if run_id is None:
+        monkeypatch.delenv(fleet_identity.ENV_RUN_ID, raising=False)
+    else:
+        monkeypatch.setenv(fleet_identity.ENV_RUN_ID, run_id)
+
+
+# ------------------------------------------------------------- identity
+
+def test_identity_defaults_and_env(monkeypatch):
+    monkeypatch.delenv(fleet_identity.ENV_INDEX, raising=False)
+    monkeypatch.delenv(fleet_identity.ENV_COUNT, raising=False)
+    monkeypatch.delenv(fleet_identity.ENV_RUN_ID, raising=False)
+    ident = fleet.process_identity()
+    assert ident == (0, 1, None)
+    assert not fleet.is_fleet_member()
+    _set_identity(monkeypatch, 3, 8, "runA")
+    ident = fleet.process_identity()
+    assert ident == (3, 8, "runA")
+    assert fleet.is_fleet_member()
+    assert fleet.identity_fields(ident) == {
+        "process_index": 3, "process_count": 8, "run_id": "runA"}
+
+
+def test_identity_rejects_inconsistent_env(monkeypatch):
+    _set_identity(monkeypatch, 9, 4)
+    with pytest.raises(ValueError):
+        fleet.process_identity()
+    monkeypatch.setenv(fleet_identity.ENV_INDEX, "not-a-number")
+    with pytest.raises(ValueError):
+        fleet.process_identity()
+
+
+def test_rank_path_suffix_and_idempotence(monkeypatch):
+    monkeypatch.delenv(fleet_identity.ENV_INDEX, raising=False)
+    monkeypatch.delenv(fleet_identity.ENV_COUNT, raising=False)
+    # solo process: shared paths pass through byte-identical
+    assert fleet.rank_path("/tmp/m.jsonl") == "/tmp/m.jsonl"
+    _set_identity(monkeypatch, 5, 8)
+    assert fleet.rank_path("/tmp/m.jsonl") == "/tmp/m.rank5.jsonl"
+    assert fleet.rank_path("/tmp/m.rank5.jsonl") == "/tmp/m.rank5.jsonl"
+    assert fleet.rank_path("noext") == "noext.rank5"
+    assert fleet.rank_of_path("/tmp/m.rank5.jsonl") == 5
+    assert fleet.rank_of_path("/tmp/m.jsonl") is None
+
+
+# ------------------------------------------- rank-aware registry dumps
+
+def test_registry_dump_solo_is_unchanged(tmp_path, monkeypatch):
+    monkeypatch.delenv(fleet_identity.ENV_INDEX, raising=False)
+    monkeypatch.delenv(fleet_identity.ENV_COUNT, raising=False)
+    reg = MetricRegistry()
+    reg.counter("x").inc()
+    path = str(tmp_path / "m.jsonl")
+    records = reg.dump(path)
+    assert os.path.isfile(path)
+    assert "process_index" not in records[0]
+
+
+def test_registry_dump_rank_suffixed_and_stamped(tmp_path, monkeypatch):
+    _set_identity(monkeypatch, 2, 4, "runB")
+    reg = MetricRegistry()
+    reg.counter("x").inc()
+    reg.event("hello", a=1)
+    shared = str(tmp_path / "m.jsonl")
+    reg.dump(shared)
+    shard = str(tmp_path / "m.rank2.jsonl")
+    assert not os.path.exists(shared)
+    assert os.path.isfile(shard)
+    back = read_jsonl(shard)
+    assert all(r["process_index"] == 2 and r["process_count"] == 4
+               and r["run_id"] == "runB" for r in back)
+    # legacy un-suffixed, unstamped files still read fine
+    with open(shared, "w") as f:
+        f.write(json.dumps({"type": "counter", "name": "y",
+                            "value": 1}) + "\n")
+    assert read_jsonl(shared)[0]["name"] == "y"
+
+
+def test_span_dump_rank_suffixed_and_stamped(tmp_path, monkeypatch):
+    from apex_tpu.observability.profiling import SpanTracer, load_spans
+
+    _set_identity(monkeypatch, 1, 2, "runC")
+    tracer = SpanTracer(capacity=16)
+    tracer.begin("ddp/allreduce")
+    tracer.end()
+    shared = str(tmp_path / "spans.json")
+    tracer.save(shared)
+    shard = str(tmp_path / "spans.rank1.json")
+    assert os.path.isfile(shard) and not os.path.exists(shared)
+    with open(shard) as f:
+        payload = json.load(f)
+    assert payload["process_index"] == 1 and payload["run_id"] == "runC"
+    spans, _ = load_spans(shard)  # schema gate tolerates the stamp
+    assert spans[0].name == "ddp/allreduce"
+
+
+def test_flight_dump_filenames_never_collide(tmp_path, monkeypatch):
+    """Satellite: two recorders (or two dumps of one) in the same
+    second, same pid, same trigger — four distinct artifacts."""
+    from apex_tpu.observability import FlightRecorder
+
+    _set_identity(monkeypatch, 0, 2)
+    reg = MetricRegistry()
+    paths = []
+    for _ in range(2):
+        rec = FlightRecorder(directory=str(tmp_path), registry=reg,
+                             deadline_s=60.0)
+        paths.append(rec.dump(reason="collide", kind="manual"))
+        paths.append(rec.dump(reason="collide", kind="manual"))
+    assert all(p is not None for p in paths)
+    assert len(set(paths)) == 4
+    with open(paths[0]) as f:
+        payload = json.load(f)
+    assert payload["process_index"] == 0
+    assert payload["process_count"] == 2
+    assert "_r0_" in os.path.basename(paths[0])
+
+
+def test_step_record_carries_fleet_stamp(monkeypatch):
+    from apex_tpu.observability import StepReporter
+
+    _set_identity(monkeypatch, 6, 8, "runD")
+    rec = StepReporter("fleet_t", registry=MetricRegistry()).step(0.01)
+    assert rec["process_index"] == 6 and rec["process_count"] == 8
+    assert rec["run_id"] == "runD"
+
+
+# ------------------------------------------------- straggler detection
+
+def test_straggler_detector_wait_mode_names_min_wait_rank():
+    reg = MetricRegistry()
+    det = fleet.StragglerDetector(mode="wait", min_history=3,
+                                  registry=reg)
+    verdict = None
+    for s in range(6):
+        verdict = det.observe(s, [1.0, 1.0, 0.05, 1.0]) or verdict
+    assert verdict is not None and verdict["rank"] == 2
+    assert [e for e in reg.events() if e["name"] == "fleet/straggler"]
+    # edge-triggered: the same straggler does not re-emit every step
+    straggler_events = [e for e in reg.events()
+                        if e["name"] == "fleet/straggler"]
+    assert len(straggler_events) == 1
+    # but the counter keeps counting detections
+    counters = [m for m in reg.metrics()
+                if m.name == "fleet/stragglers"]
+    assert counters and counters[0].labels == {"rank": "2"}
+
+
+def test_straggler_detector_step_time_mode_and_recovery():
+    reg = MetricRegistry()
+    det = fleet.StragglerDetector(mode="step_time", min_history=2,
+                                  history=4, registry=reg)
+    verdict = None
+    for s in range(4):
+        verdict = det.observe(s, [0.1, 0.5, 0.1, 0.1]) or verdict
+    assert verdict["rank"] == 1 and verdict["mode"] == "step_time"
+    # recovery: rank 1 speeds back up -> detector re-arms, then a NEW
+    # straggler fires a fresh event
+    for s in range(4, 12):
+        det.observe(s, [0.1, 0.1, 0.1, 0.1])
+    for s in range(12, 18):
+        det.observe(s, [0.1, 0.1, 0.1, 0.6])
+    names = [v["rank"] for v in det.verdicts]
+    assert names[0] == 1 and names[-1] == 3
+
+
+def test_straggler_detector_accepts_rank_keyed_mapping():
+    """The probe's feed form: a {rank: wait} dict over the locally
+    hosted ranks — which need not be 0..n-1. The verdict must name the
+    TRUE rank, not a positional index."""
+    reg = MetricRegistry()
+    det = fleet.StragglerDetector(mode="wait", min_history=3,
+                                  registry=reg)
+    verdict = None
+    for s in range(5):
+        verdict = det.observe(
+            s, {4: 1.0, 5: 0.04, 7: 1.0}) or verdict
+    assert verdict is not None and verdict["rank"] == 5
+    assert sorted(det.medians()) == [4, 5, 7]
+
+
+def test_straggler_detector_rejects_bad_config():
+    with pytest.raises(ValueError):
+        fleet.StragglerDetector(mode="nope")
+    with pytest.raises(ValueError):
+        fleet.StragglerDetector(threshold=0.0)
+
+
+# ------------------------------------------------------- fleet merging
+
+def _write_shard(tmp_path, rank, p50, run_id="runM", events=()):
+    rec = {"type": "histogram", "name": "train/step_time_ms",
+           "count": 8, "total": 8 * p50, "min": p50, "max": p50,
+           "p50": p50, "p90": p50, "p99": p50 * 1.1,
+           "process_index": rank, "process_count": 3, "run_id": run_id}
+    path = tmp_path / f"m.rank{rank}.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def test_merge_fleet_report_and_straggler(tmp_path):
+    for rank, p50 in ((0, 100.0), (1, 103.0), (2, 390.0)):
+        _write_shard(tmp_path, rank, p50)
+    # a legacy un-suffixed file joins without breaking the merge
+    with open(tmp_path / "m.jsonl", "w") as f:
+        f.write(json.dumps({"type": "counter", "name": "old/x",
+                            "value": 2}) + "\n")
+    report = fleet.merge_fleet(str(tmp_path / "m.jsonl"))
+    assert report["rank_count"] == 3 and report["legacy_shards"] == 1
+    row = report["step_time_skew"]["train/step_time_ms"]
+    assert row["max_rank"] == 2 and row["skew"] > 1.0
+    assert row["p50_by_rank"] == {0: 100.0, 1: 103.0, 2: 390.0}
+    assert report["stragglers"] and \
+        report["stragglers"][0]["rank"] == 2
+    # the merged view re-encodes as fleet/* records for metrics_report
+    recs = fleet.fleet_metric_records(report)
+    names = {r["name"] for r in recs}
+    assert {"fleet/ranks", "fleet/step_time_skew",
+            "fleet/step_time_p50_ms", "fleet/stragglers"} <= names
+
+
+def test_merge_fleet_collects_fleet_events_and_run_id_filter(tmp_path):
+    desync_ev = {"type": "event", "name": "fleet/desync", "seq": 0,
+                 "fields": {"rank": 1, "step": 7}}
+    _write_shard(tmp_path, 0, 100.0)
+    _write_shard(tmp_path, 1, 101.0, events=(desync_ev,))
+    _write_shard(tmp_path, 2, 99.0, run_id="otherRun")
+    report = fleet.merge_fleet(str(tmp_path / "m.jsonl"),
+                               run_id="runM")
+    assert report["rank_count"] == 2  # otherRun filtered out
+    assert report["fleet_events"] and \
+        report["fleet_events"][0]["name"] == "fleet/desync"
+    assert report["fleet_events"][0]["rank"] == 1
+
+
+def test_merge_fleet_empty_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fleet.merge_fleet(str(tmp_path / "absent.jsonl"))
+
+
+def test_fleet_cli_report_json_and_emit_metrics(tmp_path):
+    for rank, p50 in ((0, 100.0), (1, 400.0)):
+        _write_shard(tmp_path, rank, p50)
+    out = tmp_path / "fleet_view.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.observability", "fleet",
+         str(tmp_path / "m.jsonl"), "--json",
+         "--emit-metrics", str(out)],
+        capture_output=True, text=True, timeout=240,
+        cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["rank_count"] == 2
+    assert out.is_file()
+    # the emitted fleet/* records render as the metrics_report table
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "metrics_report.py"), str(out)],
+        capture_output=True, text=True, timeout=240)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "fleet/* family" in proc2.stdout
+    assert "train/step_time_ms" in proc2.stdout
+
+
+# ------------------------------------------------ desync fingerprints
+
+@pytest.mark.multidevice
+def test_fingerprint_delta_and_gather_on_mesh():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((3,))}
+
+    def step(w, b, poison):
+        rank = jax.lax.axis_index("dp")
+        t = {"w": w + jnp.where(jnp.logical_and(poison, rank == 5),
+                                1e-3, 0.0), "b": b}
+        return (fleet.fingerprint_delta(t, "dp"),
+                fleet.fingerprint_gather(t, "dp"))
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False))
+    delta, gathered = f(tree["w"], tree["b"], jnp.asarray(False))
+    assert float(jnp.max(delta)) == 0.0
+    det = fleet.DesyncDetector.for_tree(tree, registry=MetricRegistry())
+    assert det.check(0, np.asarray(gathered)[:8]) is None
+
+    delta, gathered = f(tree["w"], tree["b"], jnp.asarray(True))
+    assert float(jnp.max(delta)) > 0.0
+    mat = np.asarray(gathered)
+    mat = mat[:8] if mat.shape[0] != 8 else mat
+    verdict = det.check(3, mat)
+    assert verdict["rank"] == 5
+    assert verdict["tensor_path"] == "['w']"
+    assert verdict["first_divergent_step"] == 3
+    assert verdict["divergent_ranks"] == [5]
+
+
+def test_desync_detector_shape_mismatch_loud():
+    import numpy as np
+
+    det = fleet.DesyncDetector(["['w']"], registry=MetricRegistry())
+    with pytest.raises(ValueError):
+        det.check(0, np.zeros((4, 6)))
+
+
+# ------------------------------------------- grad-sync wait probe
+
+@pytest.mark.multidevice
+def test_grad_sync_probe_records_per_rank_waits():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.observability import set_registry
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    reg = MetricRegistry()
+    prev = set_registry(reg)
+    fleet_probe.reset()
+    fleet_probe.enable()
+    det = fleet.StragglerDetector(mode="wait", min_history=2,
+                                  registry=reg)
+    fleet_probe.set_detector(det)
+    try:
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+        grads = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+
+        f = jax.jit(jax.shard_map(
+            lambda g: sync_gradients_overlapped(g, axis_name="data"),
+            mesh=mesh, in_specs=({"w": P(), "b": P()},),
+            out_specs={"w": P(), "b": P()}, check_vma=False))
+        for _ in range(3):
+            jax.block_until_ready(f(grads))
+        timers = [m for m in reg.metrics()
+                  if m.name == "fleet/grad_sync_wait_s"]
+        assert len(timers) == 8  # one per rank
+        assert all(m.count == 3 for m in timers)
+        assert sorted(m.labels["rank"] for m in timers) == \
+            [str(r) for r in range(8)]
+        assert fleet_probe.last_collective() is not None
+        assert "ddp/overlap" in fleet_probe.last_collective()
+    finally:
+        fleet_probe.reset()
+        set_registry(prev)
+
+
+@pytest.mark.multidevice
+def test_probe_disabled_is_bit_identical():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    grads = {"w": jnp.arange(64.0).reshape(8, 8)}
+
+    def run():
+        f = jax.jit(jax.shard_map(
+            lambda g: sync_gradients_overlapped(g, axis_name="data"),
+            mesh=mesh, in_specs=({"w": P()},), out_specs={"w": P()},
+            check_vma=False))
+        return np.asarray(f(grads)["w"])
+
+    fleet_probe.reset()
+    baseline = run()
+    fleet_probe.enable()
+    try:
+        armed = run()
+    finally:
+        fleet_probe.reset()
+    assert (baseline == armed).all()
+
+
+# ------------------------- acceptance: desync through the loop (8-way)
+
+DESYNC_LOOP_CODE = r"""
+import json
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+import apex_tpu  # shims
+from apex_tpu.observability import fleet, get_registry
+from apex_tpu.resilience.loop import ResilientTrainLoop, TrainAborted
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((4,))}
+detector = fleet.DesyncDetector.for_tree(params)
+
+def inner(w, b, step):
+    rank = jax.lax.axis_index("dp")
+    # rank 5 silently diverges from step 3 on — the "silent" failure
+    # the fingerprint exists to catch (every rank stays finite)
+    poison = jnp.logical_and(step >= 3, rank == 5)
+    w = w + jnp.where(poison, 1e-3, 0.0)
+    t = {"w": w, "b": b}
+    return w, b, fleet.fingerprint_gather(t, "dp")
+
+fn = jax.jit(jax.shard_map(
+    inner, mesh=mesh, in_specs=(P(), P(), P()),
+    out_specs=(P(), P(), P()), check_vma=False))
+
+def step_fn(state, step):
+    w, b, gathered = fn(state["w"], state["b"], jnp.asarray(step))
+    g = np.asarray(gathered)
+    g = g[:8] if g.shape[0] != 8 else g
+    return ({"w": w, "b": b},
+            {"loss": 0.0, "fleet_fingerprint": g})
+
+loop = ResilientTrainLoop(step_fn, max_rollbacks=0,
+                          desync_detector=detector,
+                          check_state_every=0)
+out = {"aborted": False}
+try:
+    loop.run(params, 8)
+except TrainAborted as e:
+    out = {"aborted": True, "fleet": e.report.get("fleet"),
+           "reason": e.report.get("reason")}
+reg = get_registry()
+out["desync_events"] = sum(1 for ev in reg.events()
+                           if ev["name"] == "fleet/desync")
+out["rollback_fleet"] = [ev["fields"].get("fleet")
+                         for ev in reg.events()
+                         if ev["name"] == "rollback"]
+print("FLEET_RESULT " + json.dumps(out))
+"""
+
+
+@pytest.mark.multidevice
+def test_one_rank_desync_trips_rollback_ladder(
+        simulated_mesh_subprocess):
+    """Acceptance: an injected one-rank parameter perturbation on the
+    8-way simulated mesh produces a fleet/desync verdict with the
+    first divergent step, and the loop's ladder aborts with the fleet
+    verdict attached to TrainAborted."""
+    proc = simulated_mesh_subprocess(DESYNC_LOOP_CODE, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("FLEET_RESULT "))
+    out = json.loads(line[len("FLEET_RESULT "):])
+    assert out["aborted"] is True
+    verdict = out["fleet"]
+    assert verdict["rank"] == 5
+    assert verdict["first_divergent_step"] == 3
+    assert verdict["step"] == 3
+    assert verdict["tensor_path"] == "['w']"
+    assert out["desync_events"] >= 1
+    assert out["rollback_fleet"][0]["rank"] == 5
+
+
+# --------------------- acceptance: one-rank stall -> fleet flight dump
+
+RANK_LOOP_CODE = r"""
+import os, sys, time
+import jax, jax.numpy as jnp
+import apex_tpu
+from apex_tpu.observability import FlightRecorder, span
+from apex_tpu.resilience.faults import FaultPlan
+from apex_tpu.resilience.loop import ResilientTrainLoop
+
+rank = int(os.environ["APEX_TPU_PROCESS_INDEX"])
+plan = FaultPlan.parse(os.environ["RANK_FAULT_SPEC"]) \
+    if os.environ.get("RANK_FAULT_SPEC") else None
+
+def step_fn(state, step):
+    with span("ddp/allreduce"):
+        x = jnp.asarray(state["x"]) + 1.0
+    time.sleep(0.01)
+    return {"x": x}, {"loss": float(step)}
+
+recorder = FlightRecorder(
+    directory=os.environ["FLEET_FLIGHT_DIR"], deadline_s=0.3,
+    poll_s=0.05, signals=())
+recorder.install()
+loop = ResilientTrainLoop(step_fn, fault_plan=plan, stall_s=1.5,
+                          flight_recorder=recorder,
+                          check_state_every=0)
+try:
+    loop.run({"x": jnp.zeros(())}, 5)
+finally:
+    # every rank leaves a shard on exit; the stalled rank's watchdog
+    # already dumped mid-stall with trigger="stall"
+    recorder.dump(reason="run complete", kind="exit")
+    recorder.uninstall()
+print("RANK_DONE", rank)
+"""
+
+
+def test_one_rank_stall_names_stalled_rank_in_fleet_record(tmp_path):
+    """Acceptance: a fleet of 3 rank processes, rank 1 carrying a
+    seeded one-rank stall fault — every rank dumps, the collector
+    merges the collision-free shards and names the stalled rank and
+    the last collective it entered."""
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir)
+    script = str(tmp_path / "rank_loop.py")
+    with open(script, "w") as f:
+        f.write(RANK_LOOP_CODE)
+    procs = []
+    for rank in range(3):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   FLEET_FLIGHT_DIR=flight_dir,
+                   RANK_FAULT_SPEC=("seed=0,stall@2" if rank == 1
+                                    else ""))
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+        fleet_identity.stamp_environ(env, rank, 3, run_id="stallrun")
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = [p.communicate(timeout=600) for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    shards = fleet.find_flight_records(flight_dir)
+    # 3 exit dumps + at least the stalled rank's watchdog dump, all
+    # collision-free
+    assert len(shards) >= 4
+    assert len(set(shards)) == len(shards)
+    merged = fleet.merge_flight_records(flight_dir, run_id="stallrun")
+    assert merged["rank_count"] == 3
+    assert merged["stuck_ranks"] == ["1"]
+    assert merged["ranks"]["1"]["trigger"] == "stall"
+    assert merged["ranks"]["1"]["last_collective"] == "ddp/allreduce"
+    assert "rank 1" in merged["verdict"]
+    # the written fleetrec artifact round-trips
+    path = fleet.write_fleet_record(merged, flight_dir)
+    with open(path) as f:
+        assert json.load(f)["stuck_ranks"] == ["1"]
+    # the CLI names the same rank
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.observability", "fleet",
+         "--flight", flight_dir, "--no-write", "--run-id", "stallrun"],
+        capture_output=True, text=True, timeout=240, cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rank 1 stuck" in proc.stdout
+
+
+def test_merge_flight_records_empty_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fleet.merge_flight_records(str(tmp_path))
+
+
+# ------------------------------------------------- fleet trace export
+
+def test_fleet_trace_one_pid_per_rank(tmp_path, monkeypatch):
+    from apex_tpu.observability.profiling import SpanTracer
+
+    for rank in range(2):
+        _set_identity(monkeypatch, rank, 2, "tracerun")
+        tracer = SpanTracer(capacity=8)
+        tracer.begin(f"ddp/bucket{rank}")
+        tracer.end()
+        tracer.save(str(tmp_path / "spans.json"))
+    dumps = [(r, str(tmp_path / f"spans.rank{r}.json"))
+             for r in range(2)]
+    events = fleet.fleet_trace_events(dumps)
+    pids = {ev["pid"] for ev in events}
+    assert pids == {0, 1}
+    names = {ev["args"]["name"] for ev in events
+             if ev.get("name") == "process_name"}
+    assert names == {"rank0", "rank1"}
+    # the CLI wraps the same export
+    out = tmp_path / "fleet.perfetto.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.observability", "fleet",
+         dumps[0][1], dumps[1][1], "--trace", str(out)],
+        capture_output=True, text=True, timeout=240, cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        payload = json.load(f)
+    assert {ev["pid"] for ev in payload["traceEvents"]} == {0, 1}

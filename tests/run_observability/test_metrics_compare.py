@@ -222,3 +222,55 @@ def test_ddp_family_table_renders(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "DDP comms (ddp/* gauges)" in proc.stdout
     assert "ddp/comms_bytes{mode=allreduce}" in proc.stdout
+
+
+# ------------------------------------------ fleet/* gates (ISSUE 12)
+
+def _skew_rec(skew, metric="train/step_time_ms"):
+    return {"type": "gauge", "name": "fleet/step_time_skew",
+            "labels": {"metric": metric}, "value": skew}
+
+
+def test_compare_fleet_skew_growth_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=[_skew_rec(0.05)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=[_skew_rec(0.40)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION fleet/step_time_skew" in proc.stdout
+    # a wobble inside the threshold passes, and skew SHRINKING (the
+    # straggler recovered) is never a regression
+    ok = _dump(tmp_path / "ok.jsonl", extra=[_skew_rec(0.10)])
+    assert _run(ok, "--compare", base).returncode == 0
+    better = _dump(tmp_path / "b2.jsonl", extra=[_skew_rec(0.0)])
+    assert _run(better, "--compare", base).returncode == 0
+
+
+def test_compare_fleet_skew_threshold_knob(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=[_skew_rec(0.05)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=[_skew_rec(0.40)])
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.5").returncode == 0
+
+
+def test_fleet_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=[
+        {"type": "gauge", "name": "fleet/ranks", "value": 3},
+        _skew_rec(0.25),
+        {"type": "gauge", "name": "fleet/step_time_p50_ms",
+         "labels": {"metric": "train/step_time_ms", "rank": "2"},
+         "value": 130.0},
+        {"type": "counter", "name": "fleet/stragglers",
+         "labels": {"rank": "2"}, "value": 4},
+        {"type": "counter", "name": "fleet/desync_events", "value": 1},
+        {"type": "timer", "name": "fleet/grad_sync_wait_s",
+         "labels": {"site": "ddp/allreduce", "rank": "0"},
+         "count": 8, "total": 0.8, "min": 0.1, "max": 0.1,
+         "p50": 0.1, "unit": "s"},
+    ])
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleet/* family (3 rank(s))" in proc.stdout
+    assert "skew +25.0%" in proc.stdout
+    assert "stragglers: rank 2: 4" in proc.stdout
+    assert "desync events: 1" in proc.stdout
+    assert "wait ddp/allreduce rank 0" in proc.stdout

@@ -35,8 +35,16 @@ additionally gates two numerics regressions: a finite→non-finite flip
 of any ``numerics/finite`` gauge (binary — a run that started
 producing NaNs is broken no matter how fast it got) and a >10x jump
 of a ``numerics/grad_norm`` p50 (fixed factor, independent of
-``--compare-threshold``). Unknown ``schema_version`` values in
-analysis reports fail loudly rather than mis-summarizing.
+``--compare-threshold``). The ``fleet/*`` family (ISSUE 12 — either a
+live rank's dump or the merged view ``python -m
+apex_tpu.observability fleet --emit-metrics`` writes) gets the
+cross-rank table: per-metric step-time skew with per-rank p50s,
+straggler/desync counts, and the grad-sync barrier-wait timers;
+``--compare`` additionally gates a ``fleet/step_time_skew`` gauge
+growing by more than ``--compare-threshold`` skew points — one rank
+falling behind the fleet is a regression regardless of absolute step
+time. Unknown ``schema_version`` values in analysis reports fail
+loudly rather than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -461,6 +469,111 @@ def summarize_ddp(path, fam):
             print(f"  {row['metric']:44s} {v:>10.3f}")
 
 
+def render_fleet_family(path):
+    """The ``fleet/*`` family from a metrics JSONL dump (None when the
+    file carries none): cross-rank step-time skew per metric with the
+    per-rank p50 row, straggler/desync counters, and the grad-sync
+    wait timers the barrier probe records (ISSUE 12). Feed it either a
+    live rank's dump or the merged view ``python -m
+    apex_tpu.observability fleet --emit-metrics`` writes."""
+    skew: dict = {}
+    p50s: dict = {}
+    stragglers: dict = {}
+    waits: dict = {}
+    desyncs = None
+    ranks = None
+    events = 0
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        if rec.get("type") == "event" and name.startswith("fleet/"):
+            events += 1
+            continue
+        if not name.startswith("fleet/"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        if name == "fleet/ranks":
+            ranks = rec.get("value")
+        elif name == "fleet/step_time_skew":
+            skew[labels.get("metric", "?")] = rec.get("value")
+        elif name == "fleet/step_time_p50_ms":
+            p50s.setdefault(labels.get("metric", "?"), {})[
+                labels.get("rank", "?")] = rec.get("value")
+        elif name == "fleet/stragglers":
+            stragglers[labels.get("rank", "?")] = \
+                stragglers.get(labels.get("rank", "?"), 0) + \
+                (rec.get("value") or 0)
+        elif name == "fleet/desync_events" or name == "fleet/desyncs":
+            desyncs = (desyncs or 0) + (rec.get("value") or 0)
+        elif name == "fleet/grad_sync_wait_s" and \
+                rec.get("type") in ("histogram", "timer"):
+            # string key (not a tuple): the family dict round-trips
+            # through --json
+            key = f"{labels.get('site', '?')}|{labels.get('rank', '?')}"
+            waits[key] = {"count": rec.get("count"),
+                          "p50": rec.get("p50"),
+                          "max": rec.get("max")}
+    if not (skew or stragglers or waits or events
+            or desyncs is not None or ranks is not None):
+        return None
+    return {"ranks": ranks, "skew": skew, "p50s": p50s,
+            "stragglers": stragglers, "waits": waits,
+            "desyncs": desyncs, "events": events}
+
+
+def summarize_fleet(path, fam):
+    print(f"{path}: fleet/* family"
+          + (f" ({fam['ranks']} rank(s))"
+             if fam["ranks"] is not None else ""))
+    for metric, skew in sorted(fam["skew"].items()):
+        skew_s = f"{skew:+.1%}" if isinstance(skew,
+                                              (int, float)) else "-"
+        row = fam["p50s"].get(metric, {})
+        per_rank = "  ".join(
+            f"r{rank}:{v:.3f}" for rank, v in sorted(row.items())
+            if isinstance(v, (int, float)))
+        print(f"  {metric}: skew {skew_s}"
+              + (f"  p50(ms) {per_rank}" if per_rank else ""))
+    if fam["stragglers"]:
+        counts = "  ".join(f"rank {r}: {n}" for r, n in
+                           sorted(fam["stragglers"].items()))
+        print(f"  stragglers: {counts}")
+    if fam["desyncs"]:
+        print(f"  desync events: {fam['desyncs']}")
+    for key, row in sorted(fam["waits"].items()):
+        site, _, rank = key.rpartition("|")
+        p50 = row.get("p50")
+        p50_s = f"{p50 * 1e3:.3f} ms" if isinstance(
+            p50, (int, float)) else "-"
+        print(f"  wait {site} rank {rank}: n={row.get('count')} "
+              f"p50 {p50_s}")
+    if fam["events"]:
+        print(f"  ({fam['events']} fleet event(s) — see the generic "
+              f"summary below)")
+
+
+def _fleet_skew_gauges(records):
+    """{labels-qualified name: value} for fleet/step_time_skew
+    gauges."""
+    out = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                rec.get("name") != "fleet/step_time_skew" or \
+                not isinstance(rec.get("value"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "fleet/step_time_skew" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["value"])
+    return out
+
+
 def _step_time_p50s(records):
     """{metric name: p50} for every */step_time_ms histogram/timer
     record that carries a sampled p50."""
@@ -599,6 +712,27 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"stopped hiding comms under backward compute)")
         else:
             infos.append(f"{name}: {b:.4g} -> {c:.4g} ok")
+
+    cur_skew, base_skew = _fleet_skew_gauges(cur), \
+        _fleet_skew_gauges(base)
+    for name in sorted(base_skew):
+        if name not in cur_skew:
+            infos.append(f"{name}: only in base "
+                         f"({base_skew[name]:+.1%})")
+            continue
+        b, c = base_skew[name], cur_skew[name]
+        # the skew gauge is already a relative spread (slowest rank's
+        # p50 over the fleet median − 1), so the gate is an absolute
+        # delta in skew points: one rank drifting from +5% to +40%
+        # behind the fleet is a straggler regression no matter what
+        # the wall clock did
+        if c > b + threshold:
+            regressions.append(
+                f"{name}: rank skew {b:+.1%} -> {c:+.1%} "
+                f"(grew past +{threshold * 100:.0f} points — one rank "
+                f"is falling behind the fleet)")
+        else:
+            infos.append(f"{name}: skew {b:+.1%} -> {c:+.1%} ok")
 
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
@@ -757,6 +891,14 @@ if __name__ == "__main__":
                     print(json.dumps({"path": arg, "ddp_family": ddp}))
                 else:
                     summarize_ddp(arg, ddp)
+            flt = render_fleet_family(arg) if os.path.isfile(arg) \
+                else None
+            if flt is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "fleet_family": flt}))
+                else:
+                    summarize_fleet(arg, flt)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
