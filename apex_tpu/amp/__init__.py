@@ -8,6 +8,7 @@ from apex_tpu.amp.frontend import (
     O1,
     O2,
     O3,
+    O4,
     Policy,
     Properties,
     initialize,
@@ -17,7 +18,15 @@ from apex_tpu.amp.frontend import (
 )
 from apex_tpu.amp.handle import AmpHandle, NoOpHandle
 from apex_tpu.amp._amp_state import master_params
-from apex_tpu.amp.scaler import LossScaler, LossScaleState, scaled_update
+from apex_tpu.amp.scaler import (
+    Fp8DelayedScaler,
+    Fp8ScalingState,
+    Fp8SiteRecorder,
+    LossScaler,
+    LossScaleState,
+    current_fp8,
+    scaled_update,
+)
 from apex_tpu.amp import lists
 from apex_tpu.amp.amp import (
     amp_call,
@@ -33,9 +42,11 @@ from apex_tpu.amp.amp import (
 
 __all__ = [
     "Policy", "Properties", "initialize", "state_dict", "load_state_dict",
-    "O0", "O1", "O2", "O3", "opt_levels",
+    "O0", "O1", "O2", "O3", "O4", "opt_levels",
     "AmpHandle", "NoOpHandle", "master_params",
     "LossScaler", "LossScaleState",
+    "Fp8DelayedScaler", "Fp8ScalingState", "Fp8SiteRecorder",
+    "current_fp8",
     "scaled_update", "lists",
     "amp_call", "casting", "current_policy", "half_function",
     "float_function", "promote_function", "register_half_function",

@@ -12,7 +12,15 @@ O0     fp32                none                   no              1.0
 O1     fp32                bf16 at op boundaries  no              dynamic
 O2     bf16 (norms fp32)   bf16 params            fp32 (in opt)   dynamic
 O3     bf16                pure bf16              no              1.0
+O4     bf16 (norms fp32)   fp8 matmuls (E4M3/     fp32 (in opt)   dynamic
+                           E5M2, delayed scaling)
 =====  ==================  =====================  ==============  ===========
+
+O4 (ISSUE 13) keeps O2's storage/master discipline and additionally
+runs registered matmul sites in fp8 via
+``apex_tpu.amp.scaler.Fp8DelayedScaler`` + ``ops.precision.matmul_fp8``
+(see the fp8 table in lists.py and docs/amp.md — the delayed-scaling
+state is separate, explicitly threaded through the train step).
 
 bf16 replaces fp16 as the default "half" type (same MXU throughput, fp32
 exponent range — the reason loss scaling is rarely *needed* on TPU, though
@@ -46,14 +54,15 @@ class Properties:
     keep_batchnorm_fp32: Optional[bool] = None
     master_weights: Optional[bool] = None
     loss_scale: Union[float, str] = 1.0
+    fp8: bool = False                          # O4: fp8 matmul epilogues
 
 
 def _opt_level_props(opt_level: str, half) -> Properties:
     if opt_level not in opt_levels:
         raise ValueError(
             f"Unexpected optimization level {opt_level}. Options are 'O0', "
-            "'O1', 'O2', 'O3'. Note that in `O0`, `O1`, etc., the prefix O "
-            "is the letter O, not the number zero.")
+            "'O1', 'O2', 'O3', 'O4'. Note that in `O0`, `O1`, etc., the "
+            "prefix O is the letter O, not the number zero.")
     return opt_levels[opt_level](Properties(), half)
 
 
@@ -132,7 +141,32 @@ class O3:
         return properties
 
 
-opt_levels = {"O0": O0(), "O1": O1(), "O2": O2(), "O3": O3()}
+class O4:
+    """fp8 (E4M3/E5M2) compute with delayed scaling (ISSUE 13)."""
+
+    brief = "O4: fp8 matmuls (E4M3 fwd / E5M2 grad) with delayed scaling.\n"
+    more = ("O2's storage discipline (bf16 model, fp32 norms + master "
+            "weights, dynamic loss scale) plus fp8 matmul epilogues: "
+            "registered sites quantize operands to E4M3 and backward "
+            "cotangents to E5M2 under per-tensor delayed scales from "
+            "AmaxHistory rings (apex_tpu.amp.scaler.Fp8DelayedScaler). "
+            "The precision sanitizer rejects unsafe fp8 graphs "
+            "statically (fp8-unscaled / fp8-stale-amax).\n")
+
+    def __call__(self, properties, half=jnp.bfloat16):
+        properties.enabled = True
+        properties.opt_level = "O4"
+        properties.cast_model_type = half
+        properties.patch_jax_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        properties.fp8 = True
+        return properties
+
+
+opt_levels = {"O0": O0(), "O1": O1(), "O2": O2(), "O3": O3(),
+              "O4": O4()}
 
 
 @dataclasses.dataclass(frozen=True)

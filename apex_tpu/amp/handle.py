@@ -30,7 +30,8 @@ class AmpHandle:
     def __init__(self, props: Properties, min_loss_scale=None,
                  max_loss_scale=2.0 ** 24, half_dtype=jnp.bfloat16):
         self.props = props
-        compute = half_dtype if props.opt_level in ("O1", "O2", "O3") else jnp.float32
+        compute = half_dtype if props.opt_level in ("O1", "O2", "O3",
+                                                    "O4") else jnp.float32
         param = props.cast_model_type or jnp.float32
         self.policy = Policy(
             param_dtype=param,
@@ -47,6 +48,33 @@ class AmpHandle:
         )
         self.scaler_state = self.scaler.init()
         self._optimizers = []
+        # O4 (ISSUE 13): the delayed-scaling automaton is bound lazily —
+        # its site set depends on the step function, which the handle
+        # cannot know at initialize() time. init_fp8() binds it; until
+        # then state_dict() simply carries no "fp8" block.
+        self.fp8_enabled = bool(getattr(props, "fp8", False))
+        self.fp8_scaler = None
+        self.fp8_state = None
+
+    # ---- fp8 tier (O4) -----------------------------------------------------
+
+    def init_fp8(self, sites, history: int = 16, margin: float = 0.0):
+        """Bind the O4 delayed-scaling automaton to ``sites`` (matmul
+        site names — see ``ops.precision.matmul_amp``) and initialize
+        its state. Returns the :class:`~apex_tpu.amp.scaler.Fp8DelayedScaler`;
+        the state lives on ``handle.fp8_state`` and rides
+        ``state_dict()``/``load_state_dict()`` next to the loss-scale
+        automaton."""
+        from apex_tpu.amp.scaler import Fp8DelayedScaler
+
+        if not self.fp8_enabled:
+            raise RuntimeError(
+                f"init_fp8 needs the O4 opt level (got "
+                f"{self.props.opt_level}): only O4 enables the fp8 tier")
+        self.fp8_scaler = Fp8DelayedScaler(sites, history=history,
+                                           margin=margin)
+        self.fp8_state = self.fp8_scaler.init()
+        return self.fp8_scaler
 
     # ---- functional protocol ----------------------------------------------
 
@@ -206,10 +234,22 @@ class AmpHandle:
     # ---- checkpointing -----------------------------------------------------
 
     def state_dict(self) -> dict:
-        return self.scaler.state_dict(self.scaler_state)
+        """Loss-scale automaton (+ the O4 ``"fp8"`` block when bound).
+
+        Round-trip contract (ISSUE 13 satellite): a legacy (pre-fp8)
+        dict loads into an fp8-bearing handle with the fp8 state left
+        at its fresh init, and an fp8-bearing dict loads into a legacy
+        handle with the extra key ignored — state format drift never
+        bricks a checkpoint in either direction."""
+        d = self.scaler.state_dict(self.scaler_state)
+        if self.fp8_scaler is not None and self.fp8_state is not None:
+            d["fp8"] = self.fp8_scaler.state_dict(self.fp8_state)
+        return d
 
     def load_state_dict(self, d: dict) -> None:
         self.scaler_state = self.scaler.load_state_dict(d)
+        if self.fp8_scaler is not None and "fp8" in d:
+            self.fp8_state = self.fp8_scaler.load_state_dict(d["fp8"])
 
 
 class NoOpHandle:

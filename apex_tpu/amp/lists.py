@@ -40,3 +40,52 @@ def classify(op_name: str) -> str:
     if op_name in FP32_OPS:
         return "fp32"
     return "promote"
+
+
+# --------------------------------------------------------------- fp8 (O4)
+# The O4 policy table ("FP8 Formats for Deep Learning", Micikevicius et
+# al. 2022): contractions run on the MXU in fp8 — E4M3 for the forward
+# operands (activations + weights: more mantissa, 448 max), E5M2 for the
+# backward cotangents (more range, 57344 max) — every tensor scaled by
+# its delayed per-tensor factor before the cast
+# (apex_tpu.amp.scaler.Fp8DelayedScaler over AmaxHistory rings).
+# Everything else keeps the O2 discipline: bf16 storage/elementwise,
+# fp32 for range-sensitive math and optimizer state.
+
+#: ops whose *forward* operands quantize to E4M3 under O4. These are the
+#: only op shapes the fp8 tier converts — all are matmul-family MXU work
+#: routed through ops.precision.matmul_fp8 / einsum_fp8.
+FP8_E4M3_FWD_OPS = frozenset({
+    "dot", "dot_general", "matmul", "einsum", "dense", "linear",
+})
+
+#: ops whose *backward* cotangents quantize to E5M2 under O4 (the vjp
+#: side of the table above — matmul_fp8's custom_vjp implements it).
+FP8_E5M2_GRAD_OPS = FP8_E4M3_FWD_OPS
+
+#: MXU-friendly but fp8-unsafe: stays in the bf16 compute dtype under O4
+#: (attention logits/probs keep bf16 until an fp8 flash path exists;
+#: convs are out of the llama workload's scope).
+FP8_BF16_FALLBACK_OPS = frozenset({
+    "attention_qk", "attention_av", "conv", "conv_general_dilated",
+})
+
+#: range-sensitive or state math: fp32 under O4, exactly the O1/O2
+#: FP32_OPS discipline plus the scaling machinery itself (amax
+#: reductions and scale arithmetic must never quantize).
+FP8_FP32_OPS = FP32_OPS | frozenset({"amax", "scale", "optimizer_update"})
+
+
+def classify_fp8(op_name: str) -> str:
+    """O4 classification for an op name: ``'fp8'`` (E4M3 fwd / E5M2
+    grad via the delayed-scaling epilogues), ``'fp32'``, ``'bf16'``
+    (explicitly listed MXU-but-fp8-unsafe work), or ``'promote'`` for
+    ops in none of the tables — widest-input promotion, the same
+    default :func:`classify` gives O1."""
+    if op_name in FP8_E4M3_FWD_OPS:
+        return "fp8"
+    if op_name in FP8_FP32_OPS:
+        return "fp32"
+    if op_name in FP8_BF16_FALLBACK_OPS:
+        return "bf16"
+    return "promote"

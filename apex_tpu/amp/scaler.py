@@ -235,16 +235,367 @@ class LossScaler:
         }
 
     def load_state_dict(self, d: dict) -> LossScaleState:
-        # .get defaults: dicts written before the ISSUE 9 fields load
-        # with the "never overflowed yet" readout
+        # Compat contract (ISSUE 13 satellite, explicit tests in
+        # tests/run_amp/test_fp8.py): every field except loss_scale
+        # defaults, so legacy (pre-ISSUE-9 / pre-fp8) dicts load with
+        # the "never overflowed yet" readout — and unknown EXTRA keys
+        # (e.g. the O4 handle's "fp8" block read by an older build) are
+        # simply ignored, never fatal.
         return LossScaleState(
             loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
-            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            unskipped=jnp.asarray(d.get("unskipped", 0), jnp.int32),
             overflows=jnp.asarray(d.get("overflows", 0), jnp.int32),
             steps=jnp.asarray(d.get("steps", 0), jnp.int32),
             last_overflow_step=jnp.asarray(
                 d.get("last_overflow_step", -1), jnp.int32),
             skip_streak=jnp.asarray(d.get("skip_streak", 0), jnp.int32),
+        )
+
+
+# --------------------------------------------------------------- fp8 (O4)
+# Delayed-scaling automaton on top of the ISSUE 9 AmaxHistory rings
+# (observability/numerics/history.py): each registered matmul site owns
+# three ring rows — its two forward operands (E4M3) and its grad
+# cotangent (E5M2). Scales are computed from the ring max (previous
+# steps' amaxes — one step of staleness buys an on-device scale), the
+# per-step update is a single column write per ring, and the whole
+# state is a plain pytree that rides checkpoint.py's atomic manifest
+# bit-identically (proved under the PR 5 chaos harness in
+# tests/run_resilience/test_fp8_roundtrip.py).
+#
+# The *mechanism* is trace-time: a step enters `scaler.step(state)` and
+# every `ops.precision.matmul_amp` call site inside the context turns
+# into a scaled fp8 matmul, recording its amax observations into the
+# context (plain Python at trace time, so the whole protocol jits).
+# Sites are identified by (name, trace-order ordinal) — deterministic
+# for a fixed step function; sites the scaler was not built with fall
+# back to the fp32-accum path (which is what keeps decoder matmuls
+# inside lax.scan/vmap safe: a collected tracer may never escape a
+# transform, so only top-level sites are ever registered).
+
+
+class Fp8ScalingState(NamedTuple):
+    """Functional delayed-scaling state — carry it through the jitted
+    train step and checkpoint it with the rest of the train state."""
+
+    fwd: Any     # AmaxHistoryState over <site>/a, <site>/b rows (E4M3)
+    grad: Any    # AmaxHistoryState over <site>/g rows (E5M2)
+    steps: Any   # i32: update() calls applied
+
+
+_FP8_STACK: list = []
+
+
+def current_fp8():
+    """The innermost active fp8 context (``Fp8DelayedScaler.step`` /
+    ``record_fp8_sites``), or None when the fp8 tier is off — the hook
+    ``ops.precision.matmul_amp`` consults at every routed call site."""
+    return _FP8_STACK[-1] if _FP8_STACK else None
+
+
+class _Fp8ContextBase:
+    def __enter__(self):
+        _FP8_STACK.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if _FP8_STACK and _FP8_STACK[-1] is self:
+            _FP8_STACK.pop()
+        return False
+
+    def _site(self, name: str) -> str:
+        k = self._counts.get(name, 0)
+        self._counts[name] = k + 1
+        return f"{name}#{k}"
+
+
+def _fp32acc_fallback(a, b, out_dtype):
+    """Non-fp8 path for context matmuls: the accumulator stays fp32 all
+    the way to ``out_dtype`` — a ``keep_acc`` caller asking for the
+    fp32 result must NOT see the product round-trip through the
+    storage dtype first (that would push the epilogue's backward
+    reductions into bf16, exactly what matmul_fp32acc's keep_acc
+    exists to avoid)."""
+    from apex_tpu.ops.precision import matmul_fp32acc
+
+    y = matmul_fp32acc(a, b, keep_acc=True)
+    return y.astype(jnp.result_type(a, b) if out_dtype is None
+                    else out_dtype)
+
+
+class Fp8SiteRecorder(_Fp8ContextBase):
+    """Discovery context: records every fp8-eligible call site's name in
+    trace order (``with Fp8SiteRecorder() as rec: jax.eval_shape(fn,
+    ...)``) while computing through the fp32-accum path. Feed
+    ``rec.sites`` to :class:`Fp8DelayedScaler`."""
+
+    def __init__(self):
+        self.sites = []
+        self._counts = {}
+
+    def matmul(self, a, b, name="matmul", out_dtype=None):
+        self._site(name)
+        self.sites.append(name)
+        return _fp32acc_fallback(a, b, out_dtype)
+
+
+class _Fp8Apply(_Fp8ContextBase):
+    """The live O4 context one traced step enters: resolves each site's
+    delayed scales from the carried state, rewrites the matmul through
+    ``ops.precision.matmul_fp8``, and collects this step's amax
+    observations for :meth:`Fp8DelayedScaler.update`.
+
+    Gradients MUST be computed through :meth:`value_and_grad` (not bare
+    ``jax.value_and_grad``): the forward amaxes ride out of the grad
+    transform as an aux output and the E5M2 cotangent amaxes come back
+    as the gradients of per-site probe scalars — both plain functional
+    outputs, so nothing collected inside the transform ever leaks a
+    tracer."""
+
+    def __init__(self, scaler: "Fp8DelayedScaler", state: Fp8ScalingState):
+        self.scaler = scaler
+        self.state = state
+        self._counts = {}
+        self._fwd_scales, self._grad_scales = scaler.scales(state)
+        self._fwd_amax = {}     # row index -> traced scalar (stash)
+        self._probes = None     # f32[ng] inside value_and_grad's aug
+        self._harvest = None    # (fwd f32[nf], grad f32[ng]) once done
+        self.skipped_sites = []  # names that fell back (unregistered)
+
+    def matmul(self, a, b, name="matmul", out_dtype=None):
+        from apex_tpu.ops import precision as _prec
+
+        site = self._site(name)
+        paths = self.scaler.fwd_history.paths
+        if f"{site}/a" not in paths:
+            # not registered with this scaler: fp32-accum fallback. This
+            # is load-bearing, not best-effort — sites under scan/vmap
+            # (llama decoder layers) must not leak collected tracers out
+            # of their transform, so only registered top-level sites
+            # convert.
+            self.skipped_sites.append(site)
+            return _fp32acc_fallback(a, b, out_dtype)
+        ia = self.scaler.fwd_history.index(f"{site}/a")
+        ib = self.scaler.fwd_history.index(f"{site}/b")
+        ig = self.scaler.grad_history.index(f"{site}/g")
+        # the amax observations come out of the SAME fused
+        # cast-and-scale pass that quantizes — one HBM read per
+        # operand, not a second standalone reduction
+        y, amax_a, amax_b = _prec.matmul_fp8_stats(
+            a, b, self._fwd_scales[ia], self._fwd_scales[ib],
+            grad_scale=self._grad_scales[ig], out_dtype=out_dtype,
+            grad_probe=(None if self._probes is None
+                        else self._probes[ig]))
+        self._fwd_amax[ia] = amax_a
+        self._fwd_amax[ib] = amax_b
+        return y
+
+    def _stack_fwd(self):
+        zero = jnp.zeros([], jnp.float32)
+        return jnp.stack([
+            self._fwd_amax.get(i, zero)
+            for i in range(len(self.scaler.fwd_history.paths))])
+
+    def value_and_grad(self, fn, argnums=0, has_aux=False):
+        """fp8-aware ``jax.value_and_grad``: same signature/return
+        shape, plus the amax bookkeeping described on the class. Call
+        it INSIDE the context, on the loss whose matmuls route through
+        this context's sites."""
+        import jax as _jax
+
+        scalar_argnums = isinstance(argnums, int)
+        nums = (argnums,) if scalar_argnums else tuple(argnums)
+        ng = len(self.scaler.grad_history.paths)
+
+        def call(*args, **kwargs):
+            def aug(probes, *a, **k):
+                # fresh ordinals per differentiated trace: an eval
+                # forward before this call (or a previous
+                # value_and_grad in a grad-accumulation loop) must not
+                # shift a registered site to `name#1` — that would
+                # silently fall back to fp32acc and write a zero ring
+                # column
+                self._probes = probes
+                self._counts = {}
+                self._fwd_amax = {}
+                try:
+                    out = fn(*a, **k)
+                finally:
+                    self._probes = None
+                loss, aux = out if has_aux else (out, None)
+                fwd = self._stack_fwd()
+                self._fwd_amax = {}  # drop inner-trace stash
+                return loss, (aux, fwd)
+
+            probes0 = jnp.zeros((ng,), jnp.float32)
+            (loss, (aux, fwd)), grads = _jax.value_and_grad(
+                aug, argnums=(0,) + tuple(n + 1 for n in nums),
+                has_aux=True)(probes0, *args, **kwargs)
+            # merge with any previous harvest (microbatch accumulation
+            # calls value_and_grad repeatedly): the step's observation
+            # is the max over every traversal, never the last one
+            if self._harvest is None:
+                self._harvest = (fwd, grads[0])
+            else:
+                self._harvest = (jnp.maximum(self._harvest[0], fwd),
+                                 jnp.maximum(self._harvest[1],
+                                             grads[0]))
+            # restart site ordinals for whatever follows (another grad
+            # call, an eval forward) — transpose-time recompute traces
+            # have already run inside the value_and_grad call above
+            self._counts = {}
+            user = grads[1:]
+            user = user[0] if scalar_argnums else user
+            return ((loss, aux) if has_aux else loss), user
+
+        return call
+
+    def fwd_amax(self):
+        """This step's stacked E4M3 amax observations (``f32[nf]``);
+        unobserved rows write 0 (a 0 never votes in the ring max)."""
+        if self._harvest is not None:
+            return self._harvest[0]
+        return self._stack_fwd()
+
+    def grad_amax(self):
+        """Stacked E5M2 cotangent amaxes (``f32[ng]``) — the probe
+        gradients :meth:`value_and_grad` harvested; all 0 when no
+        backward ran (forward-only steps observe nothing)."""
+        if self._harvest is not None:
+            return self._harvest[1]
+        return jnp.zeros((len(self.scaler.grad_history.paths),),
+                         jnp.float32)
+
+
+class Fp8DelayedScaler:
+    """Per-tensor delayed scaling for the O4 fp8 tier.
+
+    ``sites``: ordered matmul-site names (duplicates allowed — they
+    become ``name#0``, ``name#1``, ... in trace order), each owning two
+    E4M3 forward rows and one E5M2 grad row in the amax rings. The
+    object is static configuration; all mutable state is the
+    :class:`Fp8ScalingState` pytree, so ``scales``/``update`` are
+    jit-safe and the state checkpoints like any other leaf.
+
+    Protocol (inside the traced step)::
+
+        with fp8.step(fp8_state) as ctx:
+            loss, grads = jax.value_and_grad(loss_fn)(params, ...)
+        new_fp8_state = fp8.update(fp8_state, ctx,
+                                   reduce_axes=("dp",))  # in shard_map
+    """
+
+    def __init__(self, sites, history: int = 16, margin: float = 0.0):
+        from apex_tpu.observability.numerics.history import AmaxHistory
+
+        counts: dict = {}
+        canon = []
+        for s in sites:
+            k = counts.get(s, 0)
+            counts[s] = k + 1
+            canon.append(f"{s}#{k}")
+        if not canon:
+            raise ValueError("Fp8DelayedScaler needs at least one site")
+        self.sites = tuple(canon)
+        self.history = int(history)
+        self.margin = float(margin)
+        self.fwd_history = AmaxHistory(
+            [f"{c}/{op}" for c in canon for op in ("a", "b")],
+            length=history)
+        self.grad_history = AmaxHistory(
+            [f"{c}/g" for c in canon], length=history)
+
+    @classmethod
+    def for_step(cls, fn, *example_args, history: int = 16,
+                 margin: float = 0.0) -> "Fp8DelayedScaler":
+        """Build a scaler sized for ``fn``'s fp8 sites by abstractly
+        tracing it under a discovery context (``jax.eval_shape`` — no
+        FLOPs, no device buffers). ``fn`` should be the step whose
+        matmuls route through ``ops.precision.matmul_amp`` — including
+        its backward (pass the ``value_and_grad`` form) so recompute
+        sites register too. Sites under ``lax.scan``/``vmap``/``remat``
+        are recorded like any other but will be skipped at apply time;
+        prefer explicit ``Fp8DelayedScaler([names...])`` when the step
+        mixes transformed and top-level sites."""
+        import jax
+
+        with Fp8SiteRecorder() as rec:
+            jax.eval_shape(fn, *example_args)
+        return cls(rec.sites, history=history, margin=margin)
+
+    # ---- jit-safe state protocol -------------------------------------
+
+    def init(self) -> Fp8ScalingState:
+        return Fp8ScalingState(
+            fwd=self.fwd_history.init(),
+            grad=self.grad_history.init(),
+            steps=jnp.zeros([], jnp.int32),
+        )
+
+    def scales(self, state: Fp8ScalingState):
+        """(fwd_scales f32[2*n_sites], grad_scales f32[n_sites]) —
+        delayed per-tensor factors from the ring max: multiply a tensor
+        by its scale before the fp8 cast so the history's max lands at
+        the format edge / 2^margin. Fresh rows (no signal yet) scale
+        by 1."""
+        from apex_tpu.observability.numerics.history import (
+            F8_E4M3_MAX,
+            F8_E5M2_MAX,
+        )
+
+        return (self.fwd_history.scales(state.fwd, fp8_max=F8_E4M3_MAX,
+                                        margin=self.margin),
+                self.grad_history.scales(state.grad, fp8_max=F8_E5M2_MAX,
+                                         margin=self.margin))
+
+    def step(self, state: Fp8ScalingState) -> _Fp8Apply:
+        """The per-step context manager (see class docstring)."""
+        return _Fp8Apply(self, state)
+
+    def update(self, state: Fp8ScalingState, ctx: _Fp8Apply,
+               reduce_axes=()) -> Fp8ScalingState:
+        """Write this step's collected amaxes into the rings (one
+        column write per ring). Inside ``shard_map`` pass every mesh
+        axis in ``reduce_axes``: observations are pmax-voted so ALL
+        ranks write identical columns and the delayed scales stay
+        replicated (the fp8 analog of ``scaled_update``'s psum'd
+        overflow flag)."""
+        fwd = ctx.fwd_amax()
+        grad = ctx.grad_amax()
+        if reduce_axes:
+            axes = tuple(reduce_axes)
+            fwd = jax.lax.pmax(_promote_varying(fwd, axes), axes)
+            grad = jax.lax.pmax(_promote_varying(grad, axes), axes)
+        return Fp8ScalingState(
+            fwd=self.fwd_history.update(state.fwd, fwd),
+            grad=self.grad_history.update(state.grad, grad),
+            steps=state.steps + 1,
+        )
+
+    # ---- host-side serialization -------------------------------------
+
+    def state_dict(self, state: Fp8ScalingState) -> dict:
+        return {
+            "sites": list(self.sites),
+            "history": self.history,
+            "margin": self.margin,
+            "fwd": self.fwd_history.state_dict(state.fwd),
+            "grad": self.grad_history.state_dict(state.grad),
+            "steps": int(jax.device_get(state.steps)),
+        }
+
+    def load_state_dict(self, d: dict) -> Fp8ScalingState:
+        if tuple(d.get("sites", ())) != self.sites:
+            raise ValueError(
+                "fp8 scaling state was recorded for a different site "
+                f"set ({list(d.get('sites', ()))} vs {list(self.sites)});"
+                " refusing to misalign the amax rings")
+        return Fp8ScalingState(
+            fwd=self.fwd_history.load_state_dict(d["fwd"]),
+            grad=self.grad_history.load_state_dict(d["grad"]),
+            # .get default: dicts written before the steps counter load
+            # as "no updates seen yet"
+            steps=jnp.asarray(d.get("steps", 0), jnp.int32),
         )
 
 

@@ -109,7 +109,8 @@ AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "mutable-default", "raw-clock",
               "swallowed-exception-in-step-loop",
               "hardcoded-tile-size", "unclosed-span",
-              "host-isnan-in-step-loop", "rank-unsafe-artifact-path")
+              "host-isnan-in-step-loop", "rank-unsafe-artifact-path",
+              "raw-fp8-cast")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -204,6 +205,34 @@ def _rank_unsafe_applies(path: str) -> bool:
     if _RANK_PATH_EXEMPT_PREFIX in norm:
         return False
     return _swallowed_exc_applies(path)
+
+
+# raw-fp8-cast (ISSUE 13): a bare astype to an fp8 dtype anywhere but
+# the sanctioned quantization owners. fp8 casts are only safe behind a
+# delayed per-tensor scale + saturation (ops/precision.quantize_fp8 /
+# matmul_fp8, fed by the amp Fp8DelayedScaler); a raw cast overflows to
+# NaN (E4M3 has no inf encoding) the first time an activation leaves
+# ±448. The owners: ops/precision.py (+ its Pallas kernel) and amp/.
+_FP8_CAST_ALLOW_FILES = {"apex_tpu/ops/precision.py",
+                         "apex_tpu/ops/fp8_cast_kernel.py"}
+_FP8_CAST_ALLOW_PREFIXES = ("apex_tpu/amp/",)
+
+# an astype argument that IS an fp8 dtype: jnp/jax.numpy float8_*
+# members, the precision module's F8_* aliases (an alias is still a raw
+# cast), or a dtype string literal
+_FP8_DTYPE_NAME_RE = re.compile(r"^(float8_e4m3fn|float8_e5m2|"
+                                r"F8_E4M3|F8_E5M2)$")
+
+
+def _raw_fp8_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if "apex_tpu" in norm.split("/")[:-1]:
+        tail = norm[norm.rindex("apex_tpu/"):]
+        if tail in _FP8_CAST_ALLOW_FILES:
+            return False
+        if any(tail.startswith(p) for p in _FP8_CAST_ALLOW_PREFIXES):
+            return False
+    return True
 
 
 # hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
@@ -624,6 +653,33 @@ class _Visitor(ast.NodeVisitor):
             f".rank{{i}} suffix) or build the name from the "
             f"rank/pid")
 
+    def _check_raw_fp8_cast(self, node):
+        """``x.astype(<fp8 dtype>)`` outside the sanctioned owners —
+        positional or ``dtype=`` keyword form: a raw cast has neither
+        the delayed scale nor the saturation clamp — quantization must
+        go through ops.precision."""
+        arg = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "dtype"),
+            None)
+        if arg is None:
+            return
+        name = None
+        chain = _attr_chain(arg)
+        if chain:
+            name = self._resolve(chain)[-1]
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        if name is None or not _FP8_DTYPE_NAME_RE.match(name):
+            return
+        self._emit(
+            "raw-fp8-cast", "error", node.lineno,
+            f"raw fp8 cast '.astype({name})': an unscaled, unsaturated "
+            f"cast overflows to NaN past the format edge (E4M3 has no "
+            f"inf) and flushes small tails to zero — quantize through "
+            f"apex_tpu.ops.precision (quantize_fp8 / matmul_fp8) under "
+            f"the amp Fp8DelayedScaler's delayed scales; only "
+            f"ops/precision.py and amp/ may cast to fp8")
+
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
         tail = chain[-1] if chain else None
@@ -649,6 +705,10 @@ class _Visitor(ast.NodeVisitor):
 
         if tail == "BlockSpec" and "hardcoded-tile-size" in self.checks:
             self._check_blockspec_shape(node)
+
+        if tail == "astype" and "raw-fp8-cast" in self.checks and \
+                isinstance(node.func, ast.Attribute):
+            self._check_raw_fp8_cast(node)
 
         if tail == "enter_context":
             # stack.enter_context(span(...)) closes at stack exit —
@@ -765,6 +825,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # the sanctioned homes for tile numbers
     if not _tile_size_applies(abspath or relpath):
         checks = checks - {"hardcoded-tile-size"}
+    # raw-fp8-cast: ops/precision.py (+ its Pallas kernel) and amp/ are
+    # the sanctioned quantization owners
+    if not _raw_fp8_applies(abspath or relpath):
+        checks = checks - {"raw-fp8-cast"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:

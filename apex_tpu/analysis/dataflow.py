@@ -24,7 +24,12 @@ abstract interpreter whose value lattice tracks, per jaxpr ``Var``,
   unscale);
 - ``from_max`` / ``max_subtracted``  whether the value is (derived
   from) a running max, and whether a max was subtracted from it — the
-  softmax-stability signal.
+  softmax-stability signal;
+- ``fp8_scaled`` / ``fp8_scale_hist``  whether a delayed fp8 scale has
+  been multiplied in (a value carrying the client taint
+  ``"fp8_scale"``), and whether that scale derived from the
+  amax-history state (taint ``"amax_hist"``) — the O4 signals the
+  ``fp8-unscaled`` / ``fp8-stale-amax`` checks read.
 
 Sub-jaxprs are entered, not skipped: ``pjit``/``closed_call``/
 ``remat``/``custom_jvp_call``/``custom_vjp_call`` bodies are
@@ -55,12 +60,17 @@ import numpy as np
 from apex_tpu.analysis import interp
 
 __all__ = [
-    "AbsVal", "HALF_DTYPES", "ADDITIVE_REDUCTIONS", "ARITH_PRIMS",
-    "PrecisionLattice", "PRECISION_LATTICE",
+    "AbsVal", "HALF_DTYPES", "FP8_DTYPES", "ADDITIVE_REDUCTIONS",
+    "ARITH_PRIMS", "PrecisionLattice", "PRECISION_LATTICE",
     "interpret", "abs_val_for_aval", "itemsize",
 ]
 
 HALF_DTYPES = frozenset({"bfloat16", "float16"})
+
+#: the MXU fp8 formats (O4 tier) — tracked separately from the halves:
+#: an fp8 value's safety is about its SCALE provenance, not its
+#: accumulator (the epilogues always pin fp32 accumulation).
+FP8_DTYPES = frozenset({"float8_e4m3fn", "float8_e5m2"})
 
 FLOAT_DTYPES = frozenset({
     "bfloat16", "float16", "float32", "float64",
@@ -112,9 +122,18 @@ class AbsVal:
     unscaled: bool = False
     from_max: bool = False
     max_subtracted: bool = False
+    fp8_scaled: bool = False      # a delayed fp8 scale was applied
+    fp8_scale_hist: bool = False  # ... and it derived from amax history
 
     def with_(self, **kw) -> "AbsVal":
         return dataclasses.replace(self, **kw)
+
+    def touches_fp8(self) -> bool:
+        """Is this value in (or a pure cast away from) an fp8 dtype?
+        The cast chain resets on compute, so an f8 value upcast right
+        before a dot still reads as fp8 here."""
+        return self.dtype in FP8_DTYPES or \
+            any(d in FP8_DTYPES for d in self.cast_chain)
 
 
 def abs_val_for_aval(aval, taints=frozenset()) -> AbsVal:
@@ -133,7 +152,9 @@ def _join(vals, out_aval):
     depth = max((v.reduction_depth for v in ins), default=0)
     unscaled = any(v.unscaled for v in ins)
     return AbsVal(dtype=dtype, origin=origin, reduction_depth=depth,
-                  taints=taints, unscaled=unscaled)
+                  taints=taints, unscaled=unscaled,
+                  fp8_scaled=any(v.fp8_scaled for v in ins),
+                  fp8_scale_hist=any(v.fp8_scale_hist for v in ins))
 
 
 def _transfer(eqn, in_vals, out_avals):
@@ -186,6 +207,18 @@ def _transfer(eqn, in_vals, out_avals):
                         for v in present)
         if has_grad and has_scale:
             base = base.with_(unscaled=True)
+        # fp8 delayed-scale application (O4): multiplying/dividing by a
+        # value descended from the fp8 scale state marks the product as
+        # scaled; the scale counts as history-fresh only when it also
+        # descends from the amax-history rings ("amax_hist" — assigned
+        # to the threaded Fp8ScalingState by the target's roles)
+        fp8_scales = [v for v in present if "fp8_scale" in v.taints
+                      and v.dtype not in FP8_DTYPES]
+        if fp8_scales:
+            base = base.with_(
+                fp8_scaled=True,
+                fp8_scale_hist=base.fp8_scale_hist or any(
+                    "amax_hist" in v.taints for v in fp8_scales))
         return (base,)
 
     if prim in ADDITIVE_REDUCTIONS:
@@ -200,8 +233,12 @@ def _transfer(eqn, in_vals, out_avals):
             *(v.taints for v in in_vals if v is not None)) \
             if any(v is not None for v in in_vals) else frozenset()
         unscaled = any(v is not None and v.unscaled for v in in_vals)
+        present = [v for v in in_vals if v is not None]
         return tuple(
-            abs_val_for_aval(a, taints).with_(unscaled=unscaled)
+            abs_val_for_aval(a, taints).with_(
+                unscaled=unscaled,
+                fp8_scaled=any(v.fp8_scaled for v in present),
+                fp8_scale_hist=any(v.fp8_scale_hist for v in present))
             for a in out_avals)
 
     return tuple(_join(in_vals, a) for a in out_avals)
@@ -248,6 +285,8 @@ class PrecisionLattice(interp.Lattice):
             taints=a.taints | b.taints,
             unscaled=a.unscaled or b.unscaled,
             reduction_depth=max(a.reduction_depth, b.reduction_depth),
+            fp8_scaled=a.fp8_scaled or b.fp8_scaled,
+            fp8_scale_hist=a.fp8_scale_hist or b.fp8_scale_hist,
         )
 
 

@@ -25,6 +25,16 @@ invariants into a machine-checked fact over the traced programs:
   with "master"/"param"-tainted state without ever being multiplied or
   divided by a "scale"-tainted value (the scaler's unscale) — the skip
   that applies *scaled* gradients.
+- ``fp8-unscaled``       an E4M3/E5M2 value (by dtype, or upcast from
+  one with no compute in between) reaching a ``dot_general`` without a
+  live delayed scale having been multiplied in before the cast — the
+  raw-cast recipe that silently saturates/zeros tensor tails (ISSUE 13;
+  the O4 differentiator: caught statically, not at loss-curve time).
+- ``fp8-stale-amax``     a cast to fp8 whose applied scale does NOT
+  descend from the amax-history state threaded into this step
+  (a constant, a hand-rolled factor, a stashed scale from another
+  run): the static proxy for "the scale tracks the amax rings" —
+  delayed scaling is only safe when the factor follows the data.
 
 Entry point: :func:`analyze_precision` (mirrors
 ``jaxpr_checks.analyze_fn``); the registered customers live in
@@ -38,6 +48,7 @@ from __future__ import annotations
 
 from apex_tpu.analysis.dataflow import (
     ARITH_PRIMS,
+    FP8_DTYPES,
     HALF_DTYPES,
     AbsVal,
     interpret,
@@ -47,7 +58,7 @@ from apex_tpu.analysis.findings import Finding
 
 PRECISION_CHECKS = (
     "lowprec-accum", "master-weights", "unsafe-exp", "cast-churn",
-    "loss-scale-bypass",
+    "loss-scale-bypass", "fp8-unscaled", "fp8-stale-amax",
 )
 
 _REDUCE_PRIMS = ("reduce_sum", "cumsum", "reduce_window_sum")
@@ -191,12 +202,51 @@ def _visit_loss_scale_bypass(ctx, eqn, ins, outs):
             f"gradients (effective lr multiplied by the loss scale)")
 
 
+def _visit_fp8_unscaled(ctx, eqn, ins, outs):
+    if eqn.primitive.name not in _CONTRACT_PRIMS:
+        return
+    for side, v in zip(("lhs", "rhs"), ins):
+        if v is None or not v.touches_fp8():
+            continue
+        if not v.fp8_scaled:
+            ctx.add(
+                "fp8-unscaled", "error",
+                f"fp8 ({v.dtype if v.dtype in FP8_DTYPES else 'fp8-cast'})"
+                f" {side} operand reaches '{eqn.primitive.name}' without "
+                f"a live delayed scale: values outside ±448 (E4M3) / "
+                f"±57344 (E5M2) saturate and small tails flush to zero "
+                f"— multiply in the per-tensor scale from the "
+                f"AmaxHistory rings before the cast "
+                f"(ops.precision.matmul_fp8 does the whole epilogue)",
+                dedup_key=(side, v.dtype))
+
+
+def _visit_fp8_stale_amax(ctx, eqn, ins, outs):
+    if eqn.primitive.name != "convert_element_type" or not outs:
+        return
+    out = outs[0]
+    if out.dtype not in FP8_DTYPES:
+        return
+    if out.fp8_scaled and not out.fp8_scale_hist:
+        ctx.add(
+            "fp8-stale-amax", "error",
+            f"cast to {out.dtype} under a scale that does not derive "
+            f"from the amax-history state threaded into this step: a "
+            f"constant or stashed factor stops tracking the tensor's "
+            f"range the moment the loss landscape moves — compute the "
+            f"scale from the carried Fp8ScalingState "
+            f"(Fp8DelayedScaler.scales) every step",
+            dedup_key=(out.dtype,))
+
+
 _VISITORS = {
     "lowprec-accum": _visit_lowprec_accum,
     "master-weights": _visit_master_weights,
     "unsafe-exp": _visit_unsafe_exp,
     "cast-churn": _visit_cast_churn,
     "loss-scale-bypass": _visit_loss_scale_bypass,
+    "fp8-unscaled": _visit_fp8_unscaled,
+    "fp8-stale-amax": _visit_fp8_stale_amax,
 }
 
 
@@ -217,8 +267,12 @@ def analyze_precision(fn, *example_args, name=None, roles=None,
     (loss-scaled gradients), ``"scale"`` (the scaler state /
     loss-scale value), ``"master"`` (params/m/v that must stay fp32 on
     this path), ``"param"`` (model params; only read by the bypass
-    check). ``master_outs``: flat output indices that must not be half
-    precision. Returns a list of :class:`Finding`.
+    check), ``"fp8_scale"`` (values that act as fp8 delayed scales) and
+    ``"amax_hist"`` (the carried Fp8ScalingState/AmaxHistory state —
+    tag the fp8 state argument with BOTH so scales derived from it
+    count as history-fresh for ``fp8-stale-amax``). ``master_outs``:
+    flat output indices that must not be half precision. Returns a
+    list of :class:`Finding`.
     """
     import jax
     import numpy as np

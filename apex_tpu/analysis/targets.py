@@ -458,6 +458,77 @@ def _fused_rms_norm_fwd_bwd():
         x, w, name="fused_rms_norm_fwd_bwd")
 
 
+@target("fp8_matmul_delayed_scaling")
+def _fp8_matmul_delayed_scaling():
+    """The O4 epilogue end-to-end (ISSUE 13): one matmul site through
+    the Fp8DelayedScaler context — scale-in, E4M3 cast, fp32-acc dot,
+    scale-out, E5M2 grad cast, ring update. Both fp8 checks stay armed
+    at 0 findings here because every cast sits behind a live,
+    history-derived scale; drop the scale (or feed a constant) and
+    tier-1 fails at the seeded regressions in
+    tests/run_analysis/test_precision_checks.py."""
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.scaler import Fp8DelayedScaler
+
+    fp8 = Fp8DelayedScaler(["proj"], history=4)
+    state = fp8.init()
+    a = jnp.zeros((16, 32), jnp.bfloat16)
+    b = jnp.zeros((32, 64), jnp.bfloat16)
+
+    def step(a, b, state):
+        with fp8.step(state) as ctx:
+            def loss(a, b):
+                y = ctx.matmul(a, b, name="proj")
+                return jnp.sum(y.astype(jnp.float32))
+
+            l, grads = ctx.value_and_grad(loss, argnums=(0, 1))(a, b)
+        return l, grads, fp8.update(state, ctx)
+
+    return analyze_precision(
+        step, a, b, state,
+        roles={2: ("fp8_scale", "amax_hist")},
+        name="fp8_matmul_delayed_scaling")
+
+
+@target("fp8_mlp_train_step")
+def _fp8_mlp_train_step():
+    """O4 over the mlp entry point: bf16 params, fp8 forward matmuls
+    via the routed ``matmul_amp`` sites, fp32 loss — the whole fwd+bwd
+    traced under the live context, so the fp8 casts inside the real
+    library path (not a synthetic matmul) carry their scale provenance
+    through the lattice. Also keeps lowprec-accum armed on the fp8
+    path's de-scale/bias epilogue."""
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.scaler import Fp8DelayedScaler
+    from apex_tpu.mlp import mlp_function
+
+    params = (jnp.zeros((64, 128), jnp.bfloat16),
+              jnp.zeros((128,), jnp.bfloat16),
+              jnp.zeros((128, 32), jnp.bfloat16),
+              jnp.zeros((32,), jnp.bfloat16))
+    x = jnp.zeros((16, 64), jnp.bfloat16)
+    y = jnp.zeros((16, 32), jnp.float32)
+
+    def loss(params, x, y):
+        out = mlp_function(True, "relu", x, *params)
+        return jnp.mean(jnp.square(out.astype(jnp.float32) - y))
+
+    fp8 = Fp8DelayedScaler.for_step(loss, params, x, y, history=4)
+    state = fp8.init()
+
+    def step(params, x, y, state):
+        with fp8.step(state) as ctx:
+            l, grads = ctx.value_and_grad(loss)(params, x, y)
+        return l, grads, fp8.update(state, ctx)
+
+    return analyze_precision(
+        step, params, x, y, state,
+        roles={3: ("fp8_scale", "amax_hist")},
+        name="fp8_mlp_train_step")
+
+
 @target("tp_fused_softmax")
 def _tp_fused_softmax():
     """Tensor-parallel fused softmax, jnp fallback path on bf16 logits:
@@ -952,7 +1023,8 @@ PRECISION_TARGETS = (
     "mlp_train_step", "amp_o1_train_step", "amp_o2_master_update",
     "fused_adam_tree_master_step", "fused_lamb_master_step",
     "fused_layer_norm_fwd_bwd", "fused_rms_norm_fwd_bwd",
-    "tp_fused_softmax",
+    "tp_fused_softmax", "fp8_matmul_delayed_scaling",
+    "fp8_mlp_train_step",
 )
 
 

@@ -16,9 +16,19 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.precision import einsum_fp32acc, matmul_fp32acc as _mm
+from apex_tpu.ops.precision import (
+    einsum_fp32acc,
+    matmul_amp,
+    matmul_fp32acc as _mm_acc,
+)
 
 _wgrad = functools.partial(einsum_fp32acc, "...i,...o->io")
+
+# forward gemms route through the amp-aware hook (O4 fp8 upgrades the
+# "fused_dense" sites); the hand-written custom_vjp backward below keeps
+# the fp32-accum epilogue — cotangent math stays at full precision,
+# matching the E5M2-only-where-AD-flows contract in docs/amp.md
+_mm = functools.partial(matmul_amp, name="fused_dense")
 
 
 def fused_dense_function(input, weight, bias):
@@ -31,11 +41,26 @@ def dense_no_bias_function(input, weight):
 
 
 @jax.custom_vjp
-def fused_dense_gelu_dense_function(input, weight1, bias1, weight2, bias2):
-    """dense → gelu → dense (ref FusedDenseGeluDenseFunc)."""
+def _fdgd_vjp(input, weight1, bias1, weight2, bias2):
     gelu_in = _mm(input, weight1) + bias1
     output1 = jax.nn.gelu(gelu_in, approximate=False)
     return _mm(output1, weight2) + bias2
+
+
+def fused_dense_gelu_dense_function(input, weight1, bias1, weight2, bias2):
+    """dense → gelu → dense (ref FusedDenseGeluDenseFunc).
+
+    Under the O4 fp8 context the saved-activation ``custom_vjp`` steps
+    aside (its hand-written backward cannot see the context's amax
+    probes) and AD flows through ``matmul_fp8``'s vjp — the quantized
+    residuals replace ``gelu_in``/``output1`` as the saved state."""
+    from apex_tpu.amp.scaler import current_fp8
+
+    if current_fp8() is not None:
+        gelu_in = _mm(input, weight1) + bias1
+        output1 = jax.nn.gelu(gelu_in, approximate=False)
+        return _mm(output1, weight2) + bias2
+    return _fdgd_vjp(input, weight1, bias1, weight2, bias2)
 
 
 def _fdgd_fwd(input, weight1, bias1, weight2, bias2):
@@ -48,20 +73,20 @@ def _fdgd_fwd(input, weight1, bias1, weight2, bias2):
 def _fdgd_bwd(res, g):
     input, weight1, weight2, gelu_in, output1 = res
     # second gemm
-    d_output1 = _mm(g, weight2.T)
+    d_output1 = _mm_acc(g, weight2.T)
     d_weight2 = _wgrad(output1, g)
     d_bias2 = jnp.sum(g, axis=tuple(range(g.ndim - 1)))
     # gelu (exact erf form) backward
     _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t, approximate=False), gelu_in)
     d_gelu_in = gelu_vjp(d_output1)[0]
     # first gemm
-    d_input = _mm(d_gelu_in, weight1.T)
+    d_input = _mm_acc(d_gelu_in, weight1.T)
     d_weight1 = _wgrad(input, d_gelu_in)
     d_bias1 = jnp.sum(d_gelu_in, axis=tuple(range(d_gelu_in.ndim - 1)))
     return d_input, d_weight1, d_bias1, d_weight2, d_bias2
 
 
-fused_dense_gelu_dense_function.defvjp(_fdgd_fwd, _fdgd_bwd)
+_fdgd_vjp.defvjp(_fdgd_fwd, _fdgd_bwd)
 
 # O1 boundary casts: gemm(+gelu) chains are MXU work → compute dtype
 from apex_tpu.amp.amp import half_function as _half_function  # noqa: E402

@@ -18,7 +18,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.precision import matmul_fp32acc
+from apex_tpu.ops.precision import matmul_amp
 
 _ACTIVATIONS = ("none", "relu", "sigmoid")
 
@@ -32,14 +32,7 @@ def _act(y, activation: str):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def mlp_function(bias: bool, activation: str, x, *weights_and_biases):
-    """Functional fused MLP (ref mlp.py:24 ``mlp_function``).
-
-    ``weights_and_biases``: ``w0, b0, w1, b1, ...`` when ``bias`` else
-    ``w0, w1, ...``; weights are ``(in, out)``. Activation applies to every
-    layer except the last (ref mlp.py MlpFunction/C++ semantics: hidden
-    layers activated, output layer linear).
-    """
+def _mlp_function_vjp(bias: bool, activation: str, x, *weights_and_biases):
     return _forward(bias, activation, x, weights_and_biases)
 
 
@@ -53,9 +46,11 @@ def _forward(bias, activation, x, wb):
         # accumulator dtype, storage dtype restored per layer (enforced
         # by the mlp_train_step precision target — apex_tpu.analysis
         # lowprec-accum; downcasting before the bias add would push the
-        # bias-grad reduction into bf16)
+        # bias-grad reduction into bf16). Under the O4 fp8 context the
+        # registered "mlp" sites take the E4M3 delayed-scaling epilogue
+        # instead (the fp8_mlp_train_step target pins that path).
         out_dtype = jnp.promote_types(y.dtype, w.dtype)
-        y = matmul_fp32acc(y, w, keep_acc=True)
+        y = matmul_amp(y, w, name="mlp", keep_acc=True)
         if bias:
             y = y + wb[i * step + 1]
         if i < n - 1:
@@ -80,7 +75,28 @@ def _mlp_bwd(bias, activation, res, g):
     return vjp(g)
 
 
-mlp_function.defvjp(_mlp_fwd, _mlp_bwd)
+_mlp_function_vjp.defvjp(_mlp_fwd, _mlp_bwd)
+
+
+def mlp_function(bias: bool, activation: str, x, *weights_and_biases):
+    """Functional fused MLP (ref mlp.py:24 ``mlp_function``).
+
+    ``weights_and_biases``: ``w0, b0, w1, b1, ...`` when ``bias`` else
+    ``w0, w1, ...``; weights are ``(in, out)``. Activation applies to every
+    layer except the last (ref mlp.py MlpFunction/C++ semantics: hidden
+    layers activated, output layer linear).
+
+    Under the O4 fp8 context the recompute ``custom_vjp`` steps aside
+    and AD flows straight through ``matmul_fp8``'s own vjp: a custom
+    backward's sub-trace cannot see the context's amax probes, and the
+    fp8 residency (quantized operands saved for the backward) IS the
+    activation-memory win remat was buying here.
+    """
+    from apex_tpu.amp.scaler import current_fp8
+
+    if current_fp8() is not None:
+        return _forward(bias, activation, x, weights_and_biases)
+    return _mlp_function_vjp(bias, activation, x, *weights_and_biases)
 
 # O1 boundary cast: the matmul chain is MXU work → compute dtype
 # (consumes amp/lists.py via amp_call's classification; ref apex registers

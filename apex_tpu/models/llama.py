@@ -349,8 +349,15 @@ def lm_head(params, x, cfg: LlamaConfig, tp_axis="tp",
         x = gather_from_sequence_parallel_region(x, tp_axis, seq_dim=1)
     x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
     w = lm_head_weight(params, cfg)
-    # vocab-sharded output: plain local gemm, no gather (CE is vocab-parallel)
-    return jnp.matmul(x, w.astype(x.dtype)).astype(jnp.float32)
+    # vocab-sharded output: plain local gemm, no gather (CE is
+    # vocab-parallel). Routed through the amp-aware hook: under the O4
+    # fp8 context the registered "lm_head" site runs the E4M3/E5M2
+    # delayed-scaling epilogue (the biggest single matmul in the step);
+    # everywhere else this is the same fp32-accum gemm as before.
+    from apex_tpu.ops.precision import matmul_amp
+
+    return matmul_amp(x, w.astype(x.dtype),
+                      name="lm_head").astype(jnp.float32)
 
 
 def hidden_states(params, tokens, cfg: LlamaConfig,

@@ -52,7 +52,7 @@ _KERNEL_AUTO_EVIDENCE = {
 # else is a typo that would silently never be consulted
 KNOWN_KERNELS = frozenset(
     {"flash_attention", "layer_norm", "rms_norm", "fused_softmax",
-     "flat_adam"})
+     "flat_adam", "fp8_cast"})
 
 
 def _env_json(name: str, shape_hint: str):

@@ -1,4 +1,4 @@
-"""fp32-accumulator contraction helpers.
+"""fp32-accumulator and fp8 contraction helpers.
 
 One home for the "storage dtype unchanged, MXU accumulator pinned at
 >= fp32" contract every half-precision contraction in the tree follows
@@ -8,13 +8,46 @@ dtype contracts are untouched), while ``preferred_element_type`` keeps
 the partial sums in at least fp32 on the MXU. For fp32/fp64 operands
 both helpers are exact no-ops relative to a plain call.
 
+The O4 tier (ISSUE 13) adds the fp8 epilogues next to them:
+:func:`matmul_fp8` / :func:`einsum_fp8` run scale-in → saturating
+E4M3 cast → dot with an fp32 ``preferred_element_type`` → scale-out,
+with a ``custom_vjp`` that quantizes the backward cotangent to E5M2
+under its own delayed scale ("FP8 Formats for Deep Learning",
+Micikevicius et al. 2022). :func:`matmul_amp` is the routing hook the
+library's contraction call sites use: identical to
+:func:`matmul_fp32acc` until a step enters the amp fp8 context
+(``apex_tpu.amp.scaler.Fp8DelayedScaler.step`` — the O4 opt level), at
+which point registered sites upgrade to the fp8 path. Raw
+``astype(float8_*)`` casts anywhere else in the tree are rejected by
+the ``raw-fp8-cast`` AST lint — quantization happens HERE, behind the
+scales, or not at all.
+
 Used by ``mlp``, ``fused_dense``, ``transformer.tensor_parallel.layers``
 and ``transformer.moe`` — fix accumulation policy here, not per-site.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+
+#: the two MXU fp8 formats (jax's float8 dtypes — bit-exact CPU
+#: emulation off-TPU, which is what bench.py's fp8 race and every CI
+#: test run on). E4M3: forward operands; E5M2: backward cotangents.
+F8_E4M3 = jnp.float8_e4m3fn
+F8_E5M2 = jnp.float8_e5m2
+
+#: largest representable magnitudes (saturation bounds — E4M3 has no
+#: inf encoding, so an unsaturated overflow would round to NaN). Kept
+#: numerically identical to observability.numerics.history.F8_*_MAX,
+#: which the delayed-scale computation uses.
+F8_E4M3_MAX = 448.0
+F8_E5M2_MAX = 57344.0
+
+_F8_MAX = {jnp.dtype(F8_E4M3): F8_E4M3_MAX,
+           jnp.dtype(F8_E5M2): F8_E5M2_MAX}
 
 
 def _acc_dtype(out_dtype):
@@ -46,3 +79,225 @@ def einsum_fp32acc(subscripts, a, b):
     return jnp.einsum(
         subscripts, a, b,
         preferred_element_type=_acc_dtype(out)).astype(out)
+
+
+# ------------------------------------------------------------- fp8 (O4)
+
+
+def fp8_amax(x):
+    """``max(|x|)`` as an fp32 scalar — the delayed-scaling observation
+    fed into the AmaxHistory rings."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)))
+
+
+def quantize_fp8(x, scale, dtype=F8_E4M3):
+    """Scale-in + saturating cast: ``sat(x * scale) -> dtype``. The one
+    sanctioned fp8 quantization in the tree (the ``raw-fp8-cast`` lint
+    rejects bare ``astype(float8_*)`` elsewhere); routed through the
+    fused Pallas cast-and-scale kernel when ``use_pallas('fp8_cast')``.
+    """
+    from apex_tpu.ops import fp8_cast_kernel
+
+    fmax = _F8_MAX[jnp.dtype(dtype)]
+    y, _ = fp8_cast_kernel.cast_and_scale_stats(x, scale, dtype, fmax)
+    return y
+
+
+def quantize_fp8_stats(x, scale, dtype=F8_E4M3):
+    """``(quantize_fp8(x, scale, dtype), fp8_amax(x))`` in one fused
+    pass (one read of ``x`` under the Pallas kernel)."""
+    from apex_tpu.ops import fp8_cast_kernel
+
+    fmax = _F8_MAX[jnp.dtype(dtype)]
+    return fp8_cast_kernel.cast_and_scale_stats(x, scale, dtype, fmax)
+
+
+# The grad-ring observation problem: the cotangent's amax is only
+# available while the BACKWARD is being traced, and a value collected
+# there may not escape the grad transform (UnexpectedTracerError).
+# Solution: every fp8 matmul takes a zero-valued ``grad_probe`` scalar
+# whose custom_vjp cotangent is DEFINED as ``fp8_amax(g)`` — the
+# observation flows out of ``value_and_grad`` as the probe's gradient,
+# a plain functional output. ``Fp8DelayedScaler``'s context threads the
+# probes and harvests the gradients; standalone callers may pass
+# ``grad_probe=None`` (observation discarded).
+
+
+# _matmul_fp8 always returns (y, amax_a, amax_b): the fused
+# cast-and-scale pass computes the operand amaxes anyway (one read),
+# and the amp context needs them as its E4M3 ring observations —
+# recomputing them outside would stream every operand from HBM twice.
+# Callers that drop the amaxes (plain matmul_fp8) leave them dead at
+# the trace level, so the jnp fallback path pays nothing.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _matmul_fp8(out_dtype, a_dtype, b_dtype, a, b, sa, sb, gs, probe):
+    ys, _ = _matmul_fp8_fwd(out_dtype, a_dtype, b_dtype, a, b, sa, sb,
+                            gs, probe)
+    return ys
+
+
+def _matmul_fp8_fwd(out_dtype, a_dtype, b_dtype, a, b, sa, sb, gs,
+                    probe):
+    del a_dtype, b_dtype, probe
+    a8, amax_a = quantize_fp8_stats(a, sa, F8_E4M3)
+    b8, amax_b = quantize_fp8_stats(b, sb, F8_E4M3)
+    acc = jnp.matmul(a8, b8, preferred_element_type=jnp.float32)
+    y = (acc * (1.0 / (sa * sb))).astype(out_dtype)
+    # the fp8 residency IS the memory win: the backward reuses the
+    # quantized operands instead of re-saving bf16 activations
+    return (y, amax_a, amax_b), (a8, b8, sa, sb, gs)
+
+
+def _matmul_fp8_bwd(out_dtype, a_dtype, b_dtype, res, ct):
+    del out_dtype
+    a8, b8, sa, sb, gs = res
+    g = ct[0]  # the amax outputs' cotangents are meaningless — drop
+    g8 = quantize_fp8(g, gs, F8_E5M2)
+    da = jnp.matmul(g8, b8.T, preferred_element_type=jnp.float32) \
+        * (1.0 / (gs * sb))
+    a2 = a8.reshape((-1, a8.shape[-1]))
+    g2 = g8.reshape((-1, g8.shape[-1]))
+    db = jnp.matmul(a2.T, g2, preferred_element_type=jnp.float32) \
+        * (1.0 / (gs * sa))
+    return (da.astype(a_dtype), db.astype(b_dtype),
+            jnp.zeros_like(sa), jnp.zeros_like(sb), jnp.zeros_like(gs),
+            fp8_amax(g))  # the probe cotangent IS the E5M2 observation
+
+
+_matmul_fp8.defvjp(_matmul_fp8_fwd, _matmul_fp8_bwd)
+
+
+def matmul_fp8(a, b, scale_a, scale_b, *, grad_scale=None,
+               out_dtype=None, grad_probe=None):
+    """fp8 matmul epilogue: scale-in → saturating E4M3 cast → dot with
+    fp32 ``preferred_element_type`` → scale-out to ``out_dtype``
+    (default: the operands' promotion, so callers' storage-dtype
+    contracts are untouched).
+
+    ``b`` must be a 2-D ``(k, n)`` weight (``a`` may carry leading
+    batch dims). Scales are this tensor's *delayed* factors — computed
+    from an amax-history ring BEFORE this step, which is what keeps the
+    whole cast on device (``apex_tpu.amp.scaler.Fp8DelayedScaler``
+    owns them; the ``fp8-stale-amax`` analysis check rejects scales
+    with any other provenance). The backward quantizes the incoming
+    cotangent to E5M2 under ``grad_scale`` and contracts it against
+    the saved fp8 operands; scale cotangents are zero (scales are
+    state, not parameters). ``grad_probe``: a zero fp32 scalar whose
+    gradient is defined as the cotangent's pre-scale amax — the grad
+    ring observation, harvested by ``Fp8DelayedScaler``'s
+    ``ctx.value_and_grad`` (None: observation discarded).
+    """
+    y, _, _ = matmul_fp8_stats(a, b, scale_a, scale_b,
+                               grad_scale=grad_scale,
+                               out_dtype=out_dtype,
+                               grad_probe=grad_probe)
+    return y
+
+
+def matmul_fp8_stats(a, b, scale_a, scale_b, *, grad_scale=None,
+                     out_dtype=None, grad_probe=None):
+    """:func:`matmul_fp8` that also returns the operands' pre-scale
+    amaxes: ``(y, amax_a, amax_b)``. The amaxes come out of the SAME
+    fused cast-and-scale pass that quantizes (one read per operand) —
+    this is the form the amp fp8 context consumes for its E4M3 ring
+    observations."""
+    if b.ndim != 2:
+        raise ValueError(
+            f"matmul_fp8 expects a 2-D (k, n) weight for b, got shape "
+            f"{b.shape} — reshape leading dims into a, or use einsum_fp8")
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None \
+        else jnp.promote_types(a.dtype, b.dtype)
+    gs = jnp.ones([], jnp.float32) if grad_scale is None \
+        else jnp.asarray(grad_scale, jnp.float32)
+    probe = jnp.zeros([], jnp.float32) if grad_probe is None \
+        else grad_probe
+    return _matmul_fp8(str(out_dtype), str(a.dtype), str(b.dtype), a, b,
+                       jnp.asarray(scale_a, jnp.float32),
+                       jnp.asarray(scale_b, jnp.float32), gs, probe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _einsum_fp8(subscripts, out_dtype, a_dtype, b_dtype, a, b, sa, sb,
+                gs, probe):
+    y, _ = _einsum_fp8_fwd(subscripts, out_dtype, a_dtype, b_dtype,
+                           a, b, sa, sb, gs, probe)
+    return y
+
+
+def _einsum_fp8_fwd(subscripts, out_dtype, a_dtype, b_dtype, a, b, sa,
+                    sb, gs, probe):
+    del a_dtype, b_dtype, probe
+    a8 = quantize_fp8(a, sa, F8_E4M3)
+    b8 = quantize_fp8(b, sb, F8_E4M3)
+    acc = jnp.einsum(subscripts, a8, b8,
+                     preferred_element_type=jnp.float32)
+    y = (acc * (1.0 / (sa * sb))).astype(out_dtype)
+    return y, (a8, b8, sa, sb, gs)
+
+
+def _einsum_fp8_bwd(subscripts, out_dtype, a_dtype, b_dtype, res, g):
+    del out_dtype
+    a8, b8, sa, sb, gs = res
+    g8 = quantize_fp8(g, gs, F8_E5M2)
+    # transpose the einsum via vjp at the saved quantized operands; all
+    # three ride upcast to fp32 (bit-identical values — f8 is a strict
+    # fp32 subset) because jax refuses implicit f8/f32 promotion in the
+    # transposed contraction
+    _, vjp = jax.vjp(
+        lambda x, y: jnp.einsum(subscripts, x, y,
+                                preferred_element_type=jnp.float32),
+        a8.astype(jnp.float32), b8.astype(jnp.float32))
+    da, db = vjp(g8.astype(jnp.float32))
+    inv = 1.0 / gs
+    return ((da * (inv / sb)).astype(a_dtype),
+            (db * (inv / sa)).astype(b_dtype),
+            jnp.zeros_like(sa), jnp.zeros_like(sb), jnp.zeros_like(gs),
+            fp8_amax(g))
+
+
+_einsum_fp8.defvjp(_einsum_fp8_fwd, _einsum_fp8_bwd)
+
+
+def einsum_fp8(subscripts, a, b, scale_a, scale_b, *, grad_scale=None,
+               out_dtype=None, grad_probe=None):
+    """Two-operand einsum variant of :func:`matmul_fp8` (same scale-in /
+    E4M3 / fp32-accumulate / scale-out recipe; backward cotangent
+    E5M2-quantized, transposed through the einsum's own vjp)."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None \
+        else jnp.promote_types(a.dtype, b.dtype)
+    gs = jnp.ones([], jnp.float32) if grad_scale is None \
+        else jnp.asarray(grad_scale, jnp.float32)
+    probe = jnp.zeros([], jnp.float32) if grad_probe is None \
+        else grad_probe
+    return _einsum_fp8(subscripts, str(out_dtype), str(a.dtype),
+                       str(b.dtype), a, b,
+                       jnp.asarray(scale_a, jnp.float32),
+                       jnp.asarray(scale_b, jnp.float32), gs, probe)
+
+
+def matmul_amp(a, b, *, name="matmul", keep_acc=False):
+    """The amp-aware contraction the library call sites route through
+    (``mlp``, ``fused_dense``, TP layers, the llama lm_head).
+
+    Identical to :func:`matmul_fp32acc` — same output dtype, fp32 MXU
+    accumulator — until a step enters the O4 fp8 context
+    (``Fp8DelayedScaler.step``): then sites the scaler was built with
+    run :func:`matmul_fp8` under their delayed scales (and register
+    this step's amax observations), while unregistered sites keep the
+    fp32-accum path. ``name`` identifies the site (trace-order ordinals
+    disambiguate reuse); ``keep_acc`` returns the fp32-accumulator
+    dtype for callers fusing more epilogue work, exactly like
+    :func:`matmul_fp32acc`.
+    """
+    from apex_tpu.amp.scaler import current_fp8
+
+    ctx = current_fp8()
+    if ctx is not None and b.ndim == 2 \
+            and jnp.issubdtype(a.dtype, jnp.floating) \
+            and jnp.issubdtype(b.dtype, jnp.floating):
+        out = jnp.result_type(a, b)
+        return ctx.matmul(a, b, name=name,
+                          out_dtype=_acc_dtype(out) if keep_acc else out)
+    return matmul_fp32acc(a, b, keep_acc=keep_acc)

@@ -30,7 +30,18 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.precision import matmul_fp32acc as _mm_fp32acc
+import functools
+
+from apex_tpu.ops.precision import matmul_amp, matmul_fp32acc as _mm_fp32acc
+
+# forward gemms route through the amp-aware hook: identical fp32-accum
+# behavior everywhere except under the O4 fp8 context, where registered
+# "column_parallel"/"row_parallel"/"tp_linear" sites take the
+# E4M3/E5M2 delayed-scaling epilogue (AD flows straight through these
+# call sites, so the E5M2 grad recipe applies in full)
+_mm_col = functools.partial(matmul_amp, name="column_parallel")
+_mm_row = functools.partial(matmul_amp, name="row_parallel")
+_mm_tp = functools.partial(matmul_amp, name="tp_linear")
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.tensor_parallel import mappings
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_bound
@@ -140,7 +151,7 @@ class ColumnParallelLinear(nn.Module):
             # Input arrives sequence-sharded over tp; the gemm needs the
             # full sequence — constrain to replicated and let XLA gather.
             x = _constrain(x, *([None] * x.ndim))
-        y = _mm_fp32acc(x.astype(dtype), kernel.astype(dtype))
+        y = _mm_col(x.astype(dtype), kernel.astype(dtype))
         if bias is not None and not self.skip_bias_add:
             y = y + bias.astype(dtype)
         if self.gather_output:
@@ -189,7 +200,7 @@ class RowParallelLinear(nn.Module):
         dtype = self.compute_dtype or x.dtype
         if not self.input_is_parallel:
             x = _constrain(x, *([None] * (x.ndim - 1)), TP)
-        y = _mm_fp32acc(x.astype(dtype), kernel.astype(dtype))
+        y = _mm_row(x.astype(dtype), kernel.astype(dtype))
         if self.sequence_parallel_enabled:
             # reduce_scatter over the sequence dim instead of full allreduce.
             y = _constrain(y, TP, *([None] * (y.ndim - 1)))
@@ -301,7 +312,7 @@ def linear_with_grad_accumulation_and_async_allreduce(
     if gradient_accumulation_fusion:
         y = _matmul_fp32_wgrad(x, weight)
     else:
-        y = _mm_fp32acc(x, weight)
+        y = _mm_tp(x, weight)
     if bias is not None:
         y = y + bias
     return y
@@ -341,7 +352,7 @@ def row_parallel_linear(
     axis = axis_name if axis_name is not None else TP
     if not input_is_parallel:
         x = mappings.scatter_to_tensor_model_parallel_region(x, axis)
-    y = _mm_fp32acc(x, kernel)
+    y = _mm_tp(x, kernel)
     if sequence_parallel_enabled:
         y = mappings.reduce_scatter_to_sequence_parallel_region(y, axis,
                                                                 seq_dim=seq_dim)

@@ -27,6 +27,7 @@ from apex_tpu.tuning.cache import save as save_cache  # noqa: F401
 from apex_tpu.tuning.geometry import (  # noqa: F401
     flash_tiles,
     flat_adam_geometry,
+    fp8_cast_geometry,
     norm_row_block,
     override,
     softmax_block_k,

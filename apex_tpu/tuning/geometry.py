@@ -109,3 +109,18 @@ def softmax_block_k(sk: int) -> int:
     if params is None:
         return search_space.default_softmax_block_k()
     return int(params["block_k"])
+
+
+def fp8_cast_geometry(n: int) -> tuple:
+    """(block_rows, cols) for the fused fp8 cast-and-scale slab over an
+    ``n``-element buffer — same clamp rule as flat_adam: a tile tuned
+    on a big activation must not over-pad a small one."""
+    params, _ = _resolve("fp8_cast", n=n)
+    if params is None:
+        return search_space.default_fp8_cast_geometry(n)
+    block_rows = int(params["block_rows"])
+    cols = int(params["cols"])
+    d_rows, d_cols = search_space.default_fp8_cast_geometry(n)
+    if block_rows * cols > max(2 * n, d_rows * d_cols):
+        return d_rows, d_cols
+    return block_rows, cols

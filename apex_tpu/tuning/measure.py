@@ -131,6 +131,23 @@ def _roofline_softmax_xla(dims):
     return rows * dims["sk"] * _ISZ * 4 / HBM_BW
 
 
+def _roofline_fp8_cast(params, dims):
+    n = dims["n"]
+    br, cols = params["block_rows"], params["cols"]
+    rows = _ceil_div(n, cols)
+    padded = _ceil_div(rows, br) * br * cols
+    bytes_ = padded * (4 + 1)  # fp32 in, fp8 out; scale/amax are noise
+    return bytes_ / HBM_BW + (padded // (br * cols)) * GRID_OVERHEAD_S
+
+
+def _roofline_fp8_cast_xla(dims):
+    # XLA runs the quantize (scale+clip+cast) and the amax reduction as
+    # two fusions over the unpadded buffer: the input streams twice
+    # (cost-study reduction-fusion stance) — the one-read fusion is the
+    # kernel's whole advantage
+    return dims["n"] * (2 * 4 + 1) / HBM_BW
+
+
 def roofline(kernel, params, dims) -> float:
     """Modeled seconds for the Pallas kernel at ``params``."""
     if kernel == "flat_adam":
@@ -143,6 +160,8 @@ def roofline(kernel, params, dims) -> float:
         return _roofline_norm(params, dims)
     if kernel == "fused_softmax":
         return _roofline_softmax(params, dims)
+    if kernel == "fp8_cast":
+        return _roofline_fp8_cast(params, dims)
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -158,6 +177,8 @@ def roofline_xla(kernel, dims) -> float:
         return _roofline_norm_xla(dims)
     if kernel == "fused_softmax":
         return _roofline_softmax_xla(dims)
+    if kernel == "fp8_cast":
+        return _roofline_fp8_cast_xla(dims)
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -259,6 +280,27 @@ def _live_runner(kernel, dims):
 
             return lambda x: scaled_upper_triang_masked_softmax(
                 x, None, 1.0)
+
+        return make_fn, x, (lambda c, step: step(c)), 16
+
+    if kernel == "fp8_cast":
+        n = dims["n"]
+        x = jax.random.normal(key, (n,), jnp.float32)
+
+        def make_fn():
+            from apex_tpu.ops import precision
+
+            def step(x):
+                # dequantize back to the fp32 carry so the scan threads
+                # the kernel's output (idempotent after iteration 1 —
+                # fine for timing, the bytes still stream); the
+                # sign(amax+1) factor is 1 but keeps the fused amax
+                # output live against DCE
+                y, amax = precision.quantize_fp8_stats(
+                    x, jnp.float32(1.0))
+                return y.astype(jnp.float32) * jnp.sign(amax + 1.0)
+
+            return step
 
         return make_fn, x, (lambda c, step: step(c)), 16
 

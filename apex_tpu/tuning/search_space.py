@@ -25,9 +25,10 @@ from apex_tpu.ops import pallas_config
 # Every kernel the tuner knows. flash fwd/bwd are separate search
 # problems (different VMEM residency, different best tiles — the shipped
 # defaults were 512 vs 256); both map onto the single 'flash_attention'
-# dispatch verdict in pallas_config.KNOWN_KERNELS.
+# dispatch verdict in pallas_config.KNOWN_KERNELS. fp8_cast is the O4
+# fused cast-and-scale pass (ops/fp8_cast_kernel.py).
 KERNELS = ("flat_adam", "flash_attention_fwd", "flash_attention_bwd",
-           "layer_norm", "rms_norm", "fused_softmax")
+           "layer_norm", "rms_norm", "fused_softmax", "fp8_cast")
 
 # TPU min-tile geometry (pallas_guide.md tiling table): lane dim is
 # always 128; fp32 sublane multiple is 8. Candidates below never go
@@ -59,7 +60,7 @@ def shape_bucket(kernel: str, **dims) -> str:
     with exact h / sk. A tuned tile is reused for every shape landing in
     the same bucket.
     """
-    if kernel == "flat_adam":
+    if kernel in ("flat_adam", "fp8_cast"):
         return f"n~{_ceil_pow2(dims['n'])}"
     if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
         return (f"sq~{_ceil_pow2(dims['sq'])},"
@@ -136,6 +137,34 @@ def flash_candidates(kind: str, sq: int, sk: int, d: int,
     return out or [{"block_q": _LANE, "block_kv": _LANE}]
 
 
+def _fp8_cast_vmem(block_rows: int, cols: int) -> int:
+    # x block fp32 in + fp8 out + the fp32 compute copy live at once;
+    # the (1, 1) scale/amax blocks are noise. 2x headroom rides the
+    # caller's _VMEM_FRACTION like every other kernel here.
+    return block_rows * cols * (4 + 1 + 4)
+
+
+def fp8_cast_candidates(n: int, device_kind=None) -> list:
+    """(block_rows, cols) sweep for the fused fp8 cast-and-scale slab
+    over an ``n``-element buffer (ops/fp8_cast_kernel.py). Same slab
+    rules as flat_adam — padding capped at ~2x the buffer — except the
+    row floor is 32: the fp8 OUTPUT's min tile is (32, 128)
+    (pallas_guide.md dtype table), so an 8-row block that fp32 would
+    accept is a Mosaic reject for an f8 store."""
+    budget = _vmem_budget(device_kind)
+    out = []
+    for cols in (128, 256, 512, 1024, 2048):
+        rows = -(-n // cols)
+        for block_rows in (32, 64, 128, 256, 512, 1024):
+            if _fp8_cast_vmem(block_rows, cols) > budget:
+                continue
+            padded = -(-rows // block_rows) * block_rows * cols
+            if padded > max(2 * n, 32 * _LANE * 8):
+                continue
+            out.append({"block_rows": block_rows, "cols": cols})
+    return out or [{"block_rows": 32, "cols": _LANE}]
+
+
 def norm_candidates(kernel: str, rows: int, h: int,
                     device_kind=None) -> list:
     """Row-block sweep for layer_norm / rms_norm. The backward holds ~5
@@ -182,6 +211,8 @@ def candidates(kernel: str, device_kind=None, **dims) -> list:
                                device_kind)
     if kernel == "fused_softmax":
         return softmax_candidates(dims["sk"], device_kind)
+    if kernel == "fp8_cast":
+        return fp8_cast_candidates(dims["n"], device_kind)
     raise ValueError(f"unknown kernel {kernel!r}; valid: {list(KERNELS)}")
 
 
@@ -236,3 +267,25 @@ def default_softmax_block_k() -> int:
     """k-block for the long-row two-pass fused softmax (the old
     fused_softmax._BLOCKED_BK module constant, routed here)."""
     return 2048
+
+
+def default_fp8_cast_geometry(n: int) -> tuple:
+    """(block_rows, cols) for the fp8 cast-and-scale slab when no tuned
+    entry exists: the flat_adam sizing ladder with the row floor raised
+    to the fp8 (32, 128) min tile, padding waste bounded the same way."""
+    n = max(int(n), 1)
+    cols = _LANE
+    while cols < 1024 and n >= cols * 32 * 2:
+        cols *= 2
+    rows = -(-n // cols)
+    block_rows = 32
+    for cand in (1024, 512, 256, 128, 64, 32):
+        if cand > rows and cand > 32:
+            continue
+        if _fp8_cast_vmem(cand, cols) > _vmem_budget():
+            continue
+        padded = -(-rows // cand) * cand
+        if padded - rows <= max(32, rows // 4):
+            block_rows = cand
+            break
+    return block_rows, cols

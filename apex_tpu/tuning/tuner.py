@@ -31,6 +31,9 @@ DEFAULT_SHAPES = {
     "layer_norm": {"rows": 8192, "h": 4096},
     "rms_norm": {"rows": 8192, "h": 4096},
     "fused_softmax": {"rows": 256, "sk": 32768},
+    # the llama lm_head activation at the bench shapes: (B*S, hidden) =
+    # 8 * 2048 * 4096 — the biggest tensor the O4 tier quantizes per step
+    "fp8_cast": {"n": 8 * 2048 * 4096},
 }
 
 

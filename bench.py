@@ -843,6 +843,96 @@ def bench_allreduce(extras):
           f"{line['overlap_efficiency']}", file=sys.stderr)
 
 
+def bench_fp8(cpu_mode, extras):
+    """fp8-vs-bf16 llama matmul race (ISSUE 13): the lm_head-shaped
+    gemm through ops.precision.matmul_fp8 (scale-in, E4M3 cast, fp32
+    accumulate, scale-out) against the bf16 fp32-acc baseline, timed
+    with the on-device scan slope. On CPU this is EMULATION via jax's
+    float8 dtypes (numerics exact, perf meaningless-but-recorded:
+    the JSON line + amp/fp8_* gauges are the schema relay_hunter's
+    next live window fills with real MXU numbers); the --compare gate
+    in tools/metrics_report.py watches the speedup ratio once a TPU
+    base exists."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import observability as obs
+    from apex_tpu.ops import precision
+
+    if cpu_mode:
+        BS, H, V, k = 256, 256, 1024, 8
+    else:
+        BS, H, V, k = 8192, 4096, 32768, 8
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (BS, H), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (H, V),
+                          jnp.bfloat16) * 0.05
+    wt = w.T
+    # delayed-style scales, computed once outside the timed region the
+    # way the amp context serves them from the rings
+    sa = jnp.float32(448.0) / jnp.maximum(precision.fp8_amax(a), 1e-6)
+    sw = jnp.float32(448.0) / jnp.maximum(precision.fp8_amax(w), 1e-6)
+
+    damp = jnp.bfloat16(1e-2)  # keeps the chained carry bounded
+
+    def make_bf16():
+        def step(x):
+            z = precision.matmul_fp32acc(x, w)
+            return precision.matmul_fp32acc(z, wt) * damp
+
+        return step
+
+    def make_fp8():
+        def step(x):
+            z = precision.matmul_fp8(x, w, sa, sw)
+            return precision.matmul_fp8(z, wt, sa, sw) * damp
+
+        return step
+
+    chain = lambda c, step: step(c)  # noqa: E731
+    bf16_t = time_scanned(make_bf16, a, chain, k=k)
+    fp8_t = time_scanned(make_fp8, a, chain, k=k)
+    # quantize-path cost on its own (the fused cast-and-scale pass the
+    # fp8_cast tuner kernel owns the tiling of); dequantized carry +
+    # sign(amax+1)==1 keep both outputs live against DCE
+    def make_quant():
+        def step(x):
+            y, amax = precision.quantize_fp8_stats(x, sa)
+            return y.astype(jnp.float32) * jnp.sign(amax + 1.0)
+
+        return step
+
+    quant_t = time_scanned(make_quant, a.astype(jnp.float32), chain, k=k)
+    # numerics sanity rides the record: fp8 output vs the bf16 baseline
+    y8 = precision.matmul_fp8(a, w, sa, sw).astype(jnp.float32)
+    y16 = precision.matmul_fp32acc(a, w).astype(jnp.float32)
+    rel = float(jnp.max(jnp.abs(y8 - y16))
+                / jnp.maximum(jnp.max(jnp.abs(y16)), 1e-6))
+
+    speedup = bf16_t / fp8_t if fp8_t > 0 else 0.0
+    line = {
+        "matmul_fp8_ms": round(fp8_t * 1e3, 3),
+        "matmul_bf16_ms": round(bf16_t * 1e3, 3),
+        "speedup": round(speedup, 3),
+        "quantize_ms": round(quant_t * 1e3, 3),
+        "max_rel_err": round(rel, 4),
+        "shape": [BS, H, V],
+        "emulated": jax.default_backend() != "tpu",
+    }
+    extras["fp8"] = line
+    reg = obs.get_registry()
+    reg.gauge("amp/fp8_matmul_ms").set(line["matmul_fp8_ms"])
+    reg.gauge("amp/fp8_bf16_matmul_ms").set(line["matmul_bf16_ms"])
+    reg.gauge("amp/fp8_speedup").set(line["speedup"])
+    reg.gauge("amp/fp8_quantize_ms").set(line["quantize_ms"])
+    reg.gauge("amp/fp8_max_rel_err").set(line["max_rel_err"])
+    reg.event("fp8_race", **line)
+    print(f"fp8 matmul ({BS}x{H}x{V}): fp8 {line['matmul_fp8_ms']} ms "
+          f"vs bf16 {line['matmul_bf16_ms']} ms -> {line['speedup']}x"
+          f"{' [cpu emulation]' if line['emulated'] else ''}",
+          file=sys.stderr)
+
+
 def bench_kernels(extras):
     """Pallas vs XLA-fallback per-kernel timings at Llama-ish shapes
     (VERDICT r2 item 2: the kernels had never been Mosaic-compiled on
@@ -1133,6 +1223,13 @@ def worker():
                 serrors.items()))
     except Exception as e:  # same contract as the precision hook
         extras["sharding_findings_error"] = repr(e)[:120]
+
+    # fp8-vs-bf16 matmul race (ISSUE 13): the O4 tier's perf evidence —
+    # CPU emulation here, real MXU numbers on the next relay window
+    try:
+        bench_fp8(cpu_mode, extras)
+    except Exception as e:  # never let the race cost the JSON line
+        extras["fp8_error"] = repr(e)[:200]
 
     # chaos mode (ISSUE 5): APEX_TPU_FAULT_PLAN=<spec> (e.g.
     # "seed=1,preempt@7,ckpt_torn@4,step_exc~0.05") runs the bench step

@@ -56,6 +56,15 @@ def main():
                         "PartitionSpec layout for the device budget "
                         "(--pp*--dp*--tp devices) instead of the "
                         "hand-written tables (docs/planner.md)")
+    p.add_argument("--opt-level", default="O0", choices=["O0", "O4"],
+                   help="O4 (ISSUE 13): run the lm_head matmul in fp8 "
+                        "(E4M3 fwd / E5M2 grad) under delayed per-tensor "
+                        "scaling; the Fp8ScalingState rides the train "
+                        "state through checkpoints, so scales resume "
+                        "bit-identical (docs/amp.md). The O1-O3 amp "
+                        "levels apply to the apex-shaped examples "
+                        "(imagenet/main_amp style); this 3D-parallel "
+                        "demo exposes the fp8 tier.")
     args = p.parse_args()
 
     n_dev = args.pp * args.dp * args.tp
@@ -127,13 +136,28 @@ def main():
     M, mb, s = args.microbatches, args.microbatch_size, args.seq
     tx = fused_adam(lr=args.lr)
 
+    # O4 fp8 tier (ISSUE 13): one registered site — the lm_head
+    # projection, the biggest single matmul in the step (hidden x
+    # vocab). Decoder-layer matmuls live inside the lax.scan over
+    # layers, where the delayed-scaling context deliberately falls back
+    # to the fp32-accum path (a collected amax may not escape a
+    # transform); registering only "lm_head" makes that explicit.
+    fp8 = None
+    if args.opt_level == "O4":
+        from apex_tpu.amp import Fp8DelayedScaler
+
+        fp8 = Fp8DelayedScaler(["lm_head"], history=16)
+        print("opt-level O4: lm_head in fp8 (E4M3/E5M2, delayed "
+              "scaling, history=16)")
+
     def psum(t, ax):
         return jax.lax.psum(_to_varying(t, ax), ax)
 
     def pmean(t, ax):
         return jax.lax.pmean(_to_varying(t, ax), ax)
 
-    def train_step(stage_params, io_params, opt_state, tokens, targets):
+    def train_step(stage_params, io_params, opt_state, tokens, targets,
+                   fp8_state=None):
         pp_rank = jax.lax.axis_index("pp")
         pp_size = jax.lax.axis_size("pp")
 
@@ -161,18 +185,44 @@ def main():
             outs = pipelined_forward(stage_fn, stage, x_mb, axis_name="pp",
                                      remat=True)
 
-            def mb_loss(o, t):
-                logits = llama.lm_head(io, o, cfg, tp_axis="tp",
+            if fp8 is not None:
+                # O4: fold the microbatch dim into the batch and run ONE
+                # lm_head call outside any vmap — the fp8 context's amax
+                # collection cannot cross a transform boundary, and the
+                # folded gemm is the same math (equal-sized microbatches
+                # mean mean-of-means == global mean)
+                o2 = outs.reshape((M * mb,) + outs.shape[2:])
+                t2 = targets.reshape((M * mb,) + targets.shape[2:])
+                logits = llama.lm_head(io, o2, cfg, tp_axis="tp",
                                        sequence_parallel=sp)
-                return jnp.mean(vocab_parallel_cross_entropy(
-                    logits, t, axis_name="tp"))
+                losses = jnp.mean(vocab_parallel_cross_entropy(
+                    logits, t2, axis_name="tp"))
+            else:
+                def mb_loss(o, t):
+                    logits = llama.lm_head(io, o, cfg, tp_axis="tp",
+                                           sequence_parallel=sp)
+                    return jnp.mean(vocab_parallel_cross_entropy(
+                        logits, t, axis_name="tp"))
 
-            losses = jax.vmap(mb_loss)(outs, targets)
-            local = jnp.where(pp_rank == pp_size - 1, jnp.mean(losses), 0.0)
+                losses = jnp.mean(jax.vmap(mb_loss)(outs, targets))
+            local = jnp.where(pp_rank == pp_size - 1, losses, 0.0)
             return jax.lax.psum(local, "pp")
 
-        loss, (g_stage, g_io) = jax.value_and_grad(total_loss)(
-            (stage_params, io_params))
+        if fp8 is not None:
+            with fp8.step(fp8_state) as fp8_ctx:
+                loss, (g_stage, g_io) = fp8_ctx.value_and_grad(
+                    total_loss)((stage_params, io_params))
+            # pmax the observations over EVERY mesh axis so all ranks
+            # write identical ring columns and the delayed scales stay
+            # replicated (non-last pp stages observe their bubble
+            # activations too — a conservative over-estimate that only
+            # lowers the scale)
+            new_fp8 = fp8.update(fp8_state, fp8_ctx,
+                                 reduce_axes=("pp", "dp", "tp"))
+        else:
+            loss, (g_stage, g_io) = jax.value_and_grad(total_loss)(
+                (stage_params, io_params))
+            new_fp8 = fp8_state
 
         g_stage = jax.tree_util.tree_map(lambda g: pmean(g, "dp"), g_stage)
         g_io = jax.tree_util.tree_map(
@@ -190,6 +240,8 @@ def main():
             jnp.add, stage_params, updates["stage"])
         new_io = jax.tree_util.tree_map(jnp.add, io_params, updates["io"])
         loss = jax.lax.pmean(jax.lax.pmean(loss, "dp"), "tp")
+        if fp8 is not None:
+            return new_stage, new_io, opt_state, new_fp8, loss
         return new_stage, new_io, opt_state, loss
 
     if plan is not None:
@@ -213,12 +265,28 @@ def main():
             tx, {"stage": stage_params, "io": io_params},
             {"stage": stage_specs, "io": io_specs})
 
-        step = jax.jit(shard_map(
-            train_step, mesh=mesh,
-            in_specs=(stage_specs, io_specs, opt_specs,
-                      P(None, "dp", None), P(None, "dp", None)),
-            out_specs=(stage_specs, io_specs, opt_specs, P()),
-        ))
+        if fp8 is not None:
+            # the Fp8ScalingState is replicated (every leaf P()): the
+            # pmax'd updates keep all ranks' rings bit-identical, and a
+            # replicated spec is what lets the restored state resume
+            # bit-identical after preempt/crash-restart
+            fp8_state0 = fp8.init()
+            fp8_specs = jax.tree_util.tree_map(lambda _: P(), fp8_state0)
+            step = jax.jit(shard_map(
+                train_step, mesh=mesh,
+                in_specs=(stage_specs, io_specs, opt_specs,
+                          P(None, "dp", None), P(None, "dp", None),
+                          fp8_specs),
+                out_specs=(stage_specs, io_specs, opt_specs, fp8_specs,
+                           P()),
+            ))
+        else:
+            step = jax.jit(shard_map(
+                train_step, mesh=mesh,
+                in_specs=(stage_specs, io_specs, opt_specs,
+                          P(None, "dp", None), P(None, "dp", None)),
+                out_specs=(stage_specs, io_specs, opt_specs, P()),
+            ))
 
         # per-step telemetry through the shared layer: structured step
         # records (step time, tokens/s, loss) land in the process
@@ -260,9 +328,14 @@ def main():
                 t0 = time.perf_counter()
                 with obs.span("data/batch"):
                     tokens, targets = make_batch(it)
-                new_stage, new_io, new_opt, loss = step(
-                    state["stage"], state["io"], state["opt"], tokens,
-                    targets)
+                if fp8 is not None:
+                    new_stage, new_io, new_opt, new_fp8, loss = step(
+                        state["stage"], state["io"], state["opt"],
+                        tokens, targets, state["fp8"])
+                else:
+                    new_stage, new_io, new_opt, loss = step(
+                        state["stage"], state["io"], state["opt"],
+                        tokens, targets)
                 loss = float(loss)  # host pull: syncs the step chain
                 dt = time.perf_counter() - t0
             collector.observe({"stage": new_stage, "io": new_io}, it)
@@ -275,8 +348,11 @@ def main():
             print(f"step {it:3d}  loss {loss:.4f}  "
                   f"({rec['step_time_ms']:.0f} ms  "
                   f"{rec['tokens_per_sec']:.0f} tok/s)")
-            return ({"stage": new_stage, "io": new_io, "opt": new_opt},
-                    {"loss": loss})
+            new_state = {"stage": new_stage, "io": new_io,
+                         "opt": new_opt}
+            if fp8 is not None:
+                new_state["fp8"] = new_fp8
+            return new_state, {"loss": loss}
 
         # resilient driver (ISSUE 5): the ref-style epoch checkpointing
         # of main_amp.py upgraded to the production contract — sharded
@@ -320,9 +396,14 @@ def main():
             exit_on_preempt=True,  # the scheduler-facing contract:
             # emergency checkpoint, then exit 75 (EX_TEMPFAIL) = rerun me
             on_resume=lambda it: print(f"=> resumed from step {it}"))
+        init_state = {"stage": stage_params, "io": io_params,
+                      "opt": opt_state}
+        if fp8 is not None:
+            # the fp8 scaling state checkpoints/restores with the rest
+            # of the train state — delayed scales are replay-stable
+            init_state["fp8"] = fp8_state0
         try:
-            loop.run({"stage": stage_params, "io": io_params,
-                      "opt": opt_state}, args.steps)
+            loop.run(init_state, args.steps)
         finally:
             watcher.uninstall()
             recorder.uninstall()

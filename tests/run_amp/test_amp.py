@@ -46,8 +46,9 @@ class TestOptLevels:
         assert not handle.scaler.dynamic
 
     def test_bad_opt_level(self):
+        # O4 became the fp8 tier (ISSUE 13); O5 is the first invalid one
         with pytest.raises(ValueError):
-            amp.initialize(opt_level="O4")
+            amp.initialize(opt_level="O5")
 
     def test_fp16_override(self):
         p, handle = amp.initialize(params_tree(), opt_level="O3",
@@ -276,7 +277,7 @@ class TestReferenceParitySurface:
     def test_opt_level_descriptors(self):
         from apex_tpu.amp import O0, O2, opt_levels, Properties
 
-        assert set(opt_levels) == {"O0", "O1", "O2", "O3"}
+        assert set(opt_levels) == {"O0", "O1", "O2", "O3", "O4"}
         for name, desc in opt_levels.items():
             assert desc.brief.startswith(name)
             p = desc(Properties())

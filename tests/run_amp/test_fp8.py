@@ -1,0 +1,388 @@
+"""fp8 (O4) tier unit tests (ISSUE 13): the matmul/einsum epilogues,
+the delayed-scaling automaton + trace-time context, the O4 opt level,
+and the scaler state-dict forward/backward compatibility satellite."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.amp as amp
+from apex_tpu.amp import lists
+from apex_tpu.amp.scaler import (
+    Fp8DelayedScaler,
+    Fp8SiteRecorder,
+    LossScaler,
+    current_fp8,
+)
+from apex_tpu.ops import precision as P
+
+_KEY = jax.random.PRNGKey(0)
+
+
+def _rand(shape, dtype=jnp.bfloat16, k=0, scale=1.0):
+    return jax.random.normal(jax.random.fold_in(_KEY, k), shape,
+                             dtype) * scale
+
+
+# ------------------------------------------------------------ epilogues
+
+
+class TestMatmulFp8:
+    def test_matches_bf16_within_fp8_tolerance(self):
+        a = _rand((32, 64), k=1)
+        b = _rand((64, 16), k=2)
+        y8 = P.matmul_fp8(a, b, 1.0, 1.0).astype(jnp.float32)
+        y16 = P.matmul_fp32acc(a, b).astype(jnp.float32)
+        rel = float(jnp.max(jnp.abs(y8 - y16))
+                    / jnp.max(jnp.abs(y16)))
+        assert np.isfinite(rel) and rel < 0.15  # E4M3: ~2 mantissa bits
+
+    def test_output_dtype_contract(self):
+        a, b = _rand((8, 16), k=3), _rand((16, 4), k=4)
+        assert P.matmul_fp8(a, b, 1.0, 1.0).dtype == jnp.bfloat16
+        assert P.matmul_fp8(a, b, 1.0, 1.0,
+                            out_dtype=jnp.float32).dtype == jnp.float32
+
+    def test_batched_lhs(self):
+        a = _rand((2, 8, 16), k=5)
+        b = _rand((16, 4), k=6)
+        y = P.matmul_fp8(a, b, 1.0, 1.0)
+        assert y.shape == (2, 8, 4)
+
+    def test_non_2d_weight_rejected(self):
+        a = _rand((8, 16), k=7)
+        with pytest.raises(ValueError, match="2-D"):
+            P.matmul_fp8(a, _rand((2, 16, 4), k=8), 1.0, 1.0)
+
+    def test_saturating_quantize_never_nan(self):
+        x = jnp.array([1e6, -1e6, 3.0], jnp.float32)
+        y = P.quantize_fp8(x, 1.0)  # raw E4M3 overflow would be NaN
+        y32 = y.astype(jnp.float32)
+        assert bool(jnp.all(jnp.isfinite(y32)))
+        assert float(y32[0]) == 448.0 and float(y32[1]) == -448.0
+
+    def test_grads_flow_and_scale_cotangents_zero(self):
+        a, b = _rand((8, 16), k=9), _rand((16, 4), k=10)
+        sa = jnp.float32(2.0)
+
+        def loss(a, b, sa):
+            return jnp.sum(P.matmul_fp8(a, b, sa, 1.0)
+                           .astype(jnp.float32))
+
+        da, db, dsa = jax.grad(loss, argnums=(0, 1, 2))(a, b, sa)
+        assert da.dtype == a.dtype and db.dtype == b.dtype
+        assert float(dsa) == 0.0
+        assert bool(jnp.any(da.astype(jnp.float32) != 0))
+
+    def test_grad_probe_cotangent_is_cotangent_amax(self):
+        a, b = _rand((8, 16), k=11), _rand((16, 4), k=12)
+
+        def loss(probe):
+            y = P.matmul_fp8(a, b, 1.0, 1.0, grad_probe=probe)
+            return jnp.sum(y.astype(jnp.float32) * 3.0)
+
+        g = jax.grad(loss)(jnp.zeros([], jnp.float32))
+        assert float(g) == 3.0  # amax of a constant-3 cotangent
+
+    def test_einsum_fp8_matches_matmul(self):
+        a, b = _rand((8, 16), k=13), _rand((16, 4), k=14)
+        y_e = P.einsum_fp8("ij,jk->ik", a, b, 1.0, 1.0)
+        y_m = P.matmul_fp8(a, b, 1.0, 1.0)
+        np.testing.assert_array_equal(
+            np.asarray(y_e.astype(jnp.float32)),
+            np.asarray(y_m.astype(jnp.float32)))
+
+    def test_einsum_fp8_grads(self):
+        a, b = _rand((8, 16), k=15), _rand((16, 4), k=16)
+
+        def loss(a, b):
+            return jnp.sum(P.einsum_fp8("ij,jk->ik", a, b, 1.0, 1.0)
+                           .astype(jnp.float32))
+
+        da, db = jax.grad(loss, argnums=(0, 1))(a, b)
+        assert da.shape == a.shape and db.shape == b.shape
+        assert bool(jnp.any(db.astype(jnp.float32) != 0))
+
+
+class TestMatmulAmpRouting:
+    def test_no_context_identical_to_fp32acc(self):
+        a, b = _rand((8, 16), k=17), _rand((16, 4), k=18)
+        assert current_fp8() is None
+        y = P.matmul_amp(a, b, name="anything")
+        np.testing.assert_array_equal(
+            np.asarray(y.astype(jnp.float32)),
+            np.asarray(P.matmul_fp32acc(a, b).astype(jnp.float32)))
+
+    def test_unregistered_site_falls_back_inside_context(self):
+        fp8 = Fp8DelayedScaler(["known"], history=2)
+        a, b = _rand((8, 16), k=19), _rand((16, 4), k=20)
+        with fp8.step(fp8.init()) as ctx:
+            y = P.matmul_amp(a, b, name="unknown")
+        assert ctx.skipped_sites == ["unknown#0"]
+        np.testing.assert_array_equal(
+            np.asarray(y.astype(jnp.float32)),
+            np.asarray(P.matmul_fp32acc(a, b).astype(jnp.float32)))
+
+    def test_fallback_preserves_keep_acc_precision(self):
+        """Review finding: a keep_acc caller (mlp's fused epilogue)
+        hitting the unregistered-site fallback must get the fp32
+        accumulator directly, never a bf16 round trip."""
+        fp8 = Fp8DelayedScaler(["known"], history=2)
+        a, b = _rand((8, 16), k=19, scale=3.0), _rand((16, 4), k=20)
+        with fp8.step(fp8.init()):
+            y = P.matmul_amp(a, b, name="unknown", keep_acc=True)
+        assert y.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(y),
+            np.asarray(P.matmul_fp32acc(a, b, keep_acc=True)))
+
+
+# --------------------------------------------------- delayed scaling
+
+
+class TestFp8DelayedScaler:
+    def test_duplicate_site_names_get_ordinals(self):
+        fp8 = Fp8DelayedScaler(["mlp", "mlp", "head"], history=4)
+        assert fp8.sites == ("mlp#0", "mlp#1", "head#0")
+        assert len(fp8.fwd_history.paths) == 6
+        assert len(fp8.grad_history.paths) == 3
+
+    def test_empty_sites_rejected(self):
+        with pytest.raises(ValueError, match="at least one site"):
+            Fp8DelayedScaler([])
+
+    def test_fresh_state_scales_are_one(self):
+        fp8 = Fp8DelayedScaler(["s"], history=4)
+        fwd, grad = fp8.scales(fp8.init())
+        assert np.asarray(fwd).tolist() == [1.0, 1.0]
+        assert np.asarray(grad).tolist() == [1.0]
+
+    def test_step_updates_rings_and_scales_are_delayed(self):
+        fp8 = Fp8DelayedScaler(["s"], history=4)
+        state = fp8.init()
+        a = _rand((8, 16), k=21, scale=4.0)
+        b = _rand((16, 4), k=22)
+
+        @jax.jit
+        def step(a, b, state):
+            with fp8.step(state) as ctx:
+                def loss(a, b):
+                    return jnp.sum(ctx.matmul(a, b, name="s")
+                                   .astype(jnp.float32))
+
+                l, grads = ctx.value_and_grad(loss, argnums=(0, 1))(a, b)
+            return l, grads, fp8.update(state, ctx)
+
+        l1, g1, s1 = step(a, b, state)
+        assert np.isfinite(float(l1))
+        # first step ran on the fresh (scale=1) state; the ring now
+        # holds the real amaxes, so the NEXT step's scales move
+        fwd, grad = fp8.scales(s1)
+        amax_a = float(P.fp8_amax(a))
+        assert abs(float(fwd[0]) - 448.0 / amax_a) / (448.0 / amax_a) \
+            < 1e-5
+        assert float(grad[0]) > 0 and int(s1.steps) == 1
+        l2, g2, s2 = step(a, b, s1)
+        assert np.isfinite(float(l2)) and int(s2.fwd.cursor) == 2
+
+    def test_value_and_grad_has_aux_and_scalar_argnums(self):
+        fp8 = Fp8DelayedScaler(["s"], history=2)
+        a, b = _rand((8, 16), k=23), _rand((16, 4), k=24)
+        with fp8.step(fp8.init()) as ctx:
+            def loss(a):
+                y = ctx.matmul(a, b, name="s")
+                return jnp.sum(y.astype(jnp.float32)), {"aux": 7}
+
+            (l, aux), da = ctx.value_and_grad(loss, has_aux=True)(a)
+        assert aux == {"aux": 7} and da.shape == a.shape
+        assert float(ctx.grad_amax()[0]) > 0
+
+    def test_eval_forward_then_grad_keeps_site_registered(self):
+        """Review finding: a forward traversal before value_and_grad
+        (or repeated value_and_grad calls — microbatch accumulation)
+        must NOT shift the registered site's ordinal into silent
+        fp32acc fallback / zero ring writes."""
+        fp8 = Fp8DelayedScaler(["s"], history=2)
+        state = fp8.init()
+        a = _rand((8, 16), k=27, scale=3.0)
+        b = _rand((16, 4), k=28)
+        with fp8.step(state) as ctx:
+            ctx.matmul(a, b, name="s")  # eval-style forward first
+
+            def loss(a, b):
+                return jnp.sum(ctx.matmul(a, b, name="s")
+                               .astype(jnp.float32))
+
+            ctx.value_and_grad(loss, argnums=(0, 1))(a, b)
+            # second grad call (grad accumulation): merged, not lost
+            ctx.value_and_grad(loss, argnums=(0, 1))(a, b)
+        assert "s#1" not in ctx.skipped_sites
+        new = fp8.update(state, ctx)
+        assert float(new.fwd.ring[0, 0]) == float(P.fp8_amax(a))
+        assert float(jnp.max(new.grad.ring)) > 0
+
+    def test_forward_only_update_writes_fwd_zero_grad(self):
+        fp8 = Fp8DelayedScaler(["s"], history=2)
+        state = fp8.init()
+        a, b = _rand((8, 16), k=25), _rand((16, 4), k=26)
+        with fp8.step(state) as ctx:
+            ctx.matmul(a, b, name="s")
+        new = fp8.update(state, ctx)
+        assert float(jnp.max(new.fwd.ring)) > 0
+        assert float(jnp.max(new.grad.ring)) == 0.0
+
+    def test_for_step_discovery_on_mlp(self):
+        from apex_tpu.mlp import mlp_function
+
+        params = tuple(_rand(s, k=30 + i) for i, s in enumerate(
+            [(16, 32), (32,), (32, 8), (8,)]))
+        x = _rand((4, 16), k=40)
+
+        def loss(params, x):
+            out = mlp_function(True, "relu", x, *params)
+            return jnp.sum(out.astype(jnp.float32))
+
+        fp8 = Fp8DelayedScaler.for_step(loss, params, x, history=2)
+        assert fp8.sites == ("mlp#0", "mlp#1")
+        state = fp8.init()
+        with fp8.step(state) as ctx:
+            l, g = ctx.value_and_grad(loss)(params, x)
+        new = fp8.update(state, ctx)
+        assert not ctx.skipped_sites
+        assert np.isfinite(float(l))
+        assert float(jnp.max(new.grad.ring)) > 0
+
+    def test_recorder_is_a_context(self):
+        with Fp8SiteRecorder() as rec:
+            assert current_fp8() is rec
+        assert current_fp8() is None
+
+    def test_reduce_axes_keeps_ranks_identical(self):
+        from jax.sharding import Mesh, PartitionSpec as Sp
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        fp8 = Fp8DelayedScaler(["s"], history=2)
+        state = fp8.init()
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+        x = _rand((4, 8), jnp.float32, k=41)
+        w = _rand((8, 4), jnp.float32, k=42)
+
+        def body(x, state):
+            with fp8.step(state) as ctx:
+                def loss(x):
+                    return jnp.sum(ctx.matmul(x, w, name="s")
+                                   .astype(jnp.float32))
+
+                l, _ = ctx.value_and_grad(loss)(x)
+            return fp8.update(state, ctx, reduce_axes=("dp",)).fwd.ring
+
+        specs = jax.tree_util.tree_map(lambda _: Sp(), state)
+        ring = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(Sp("dp"), specs),
+            out_specs=Sp(), check_vma=False))(x, state)
+        # the replicated out_spec would error if ranks disagreed; the
+        # pmax'd column must also equal the GLOBAL amax over both shards
+        assert float(ring[0, 0]) == float(P.fp8_amax(x))
+
+    def test_state_dict_roundtrip_and_mismatch_loud(self):
+        fp8 = Fp8DelayedScaler(["a", "b"], history=3)
+        state = fp8.init()
+        d = fp8.state_dict(state)
+        s2 = fp8.load_state_dict(d)
+        np.testing.assert_array_equal(np.asarray(s2.fwd.ring),
+                                      np.asarray(state.fwd.ring))
+        other = Fp8DelayedScaler(["a"], history=3)
+        with pytest.raises(ValueError, match="different site"):
+            other.load_state_dict(d)
+        # steps missing (older writer): defaults to 0
+        d.pop("steps")
+        assert int(fp8.load_state_dict(d).steps) == 0
+
+
+# ------------------------------------------- opt level + compat satellite
+
+
+class TestO4Level:
+    def test_properties(self):
+        props = amp.opt_levels["O4"](amp.Properties())
+        assert props.fp8 and props.master_weights
+        assert props.loss_scale == "dynamic"
+        assert props.keep_batchnorm_fp32 is True
+
+    def test_handle_policy_and_init_fp8(self):
+        h = amp.initialize(opt_level="O4", enabled=True)
+        assert h.policy.compute_dtype == jnp.bfloat16
+        h.init_fp8(["site"], history=4)
+        assert h.fp8_scaler.sites == ("site#0",)
+        h2 = amp.initialize(opt_level="O2", enabled=True)
+        with pytest.raises(RuntimeError, match="O4"):
+            h2.init_fp8(["site"])
+
+    def test_classify_fp8(self):
+        assert lists.classify_fp8("matmul") == "fp8"
+        assert lists.classify_fp8("dot_general") == "fp8"
+        assert lists.classify_fp8("softmax") == "fp32"
+        assert lists.classify_fp8("attention_qk") == "bf16"
+        assert lists.classify_fp8("layer_norm") == "fp32"
+        # unlisted ops take widest-input promotion, NOT the bf16 list —
+        # editing FP8_BF16_FALLBACK_OPS must change behavior
+        assert lists.classify_fp8("add") == "promote"
+
+
+class TestStateDictCompat:
+    """ISSUE 13 satellite: explicit forward/backward round-trip."""
+
+    def test_legacy_pre_fp8_dict_loads_with_defaults(self):
+        scaler = LossScaler("dynamic")
+        # a pre-ISSUE-9 writer only knew these three fields
+        state = scaler.load_state_dict(
+            {"loss_scale": 1024.0, "unskipped": 7, "overflows": 2})
+        assert float(state.loss_scale) == 1024.0
+        assert int(state.steps) == 0
+        assert int(state.last_overflow_step) == -1
+        # minimal dict: everything but loss_scale defaults
+        state = scaler.load_state_dict({"loss_scale": 8.0})
+        assert int(state.unskipped) == 0
+
+    def test_new_dict_roundtrips_bit_identical(self):
+        scaler = LossScaler("dynamic")
+        state = scaler.update(scaler.init(), jnp.asarray(True))
+        d = scaler.state_dict(state)
+        state2 = scaler.load_state_dict(d)
+        for a, b in zip(state, state2):
+            assert float(a) == float(b)
+
+    def test_fp8_dict_into_legacy_handle_ignored(self):
+        h4 = amp.initialize(opt_level="O4", enabled=True)
+        h4.init_fp8(["s"])
+        d = h4.state_dict()
+        assert "fp8" in d
+        h2 = amp.initialize(opt_level="O2", enabled=True)
+        h2.load_state_dict(d)  # extra key must not raise
+        assert float(h2.scaler_state.loss_scale) == \
+            float(d["loss_scale"])
+
+    def test_legacy_dict_into_fp8_handle_defaults_fresh(self):
+        h4 = amp.initialize(opt_level="O4", enabled=True)
+        h4.init_fp8(["s"], history=4)
+        h4.load_state_dict({"loss_scale": 2048.0, "unskipped": 3})
+        assert float(h4.scaler_state.loss_scale) == 2048.0
+        assert int(h4.fp8_state.steps) == 0  # fresh init kept
+
+    def test_fp8_handle_roundtrip(self):
+        h = amp.initialize(opt_level="O4", enabled=True)
+        fp8 = h.init_fp8(["s"], history=4)
+        # advance the rings so the round-trip carries signal
+        with fp8.step(h.fp8_state) as ctx:
+            ctx.matmul(_rand((4, 8), k=50), _rand((8, 4), k=51),
+                       name="s")
+        h.fp8_state = fp8.update(h.fp8_state, ctx)
+        d = h.state_dict()
+        h2 = amp.initialize(opt_level="O4", enabled=True)
+        h2.init_fp8(["s"], history=4)
+        h2.load_state_dict(d)
+        np.testing.assert_array_equal(
+            np.asarray(h2.fp8_state.fwd.ring),
+            np.asarray(h.fp8_state.fwd.ring))

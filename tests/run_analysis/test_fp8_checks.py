@@ -1,0 +1,194 @@
+"""fp8 precision-flow checks + raw-fp8-cast AST lint (ISSUE 13).
+
+The CI contract the satellites name: the two seeded fp8 regressions
+(an unscaled dot, a stale non-history scale) are CAUGHT here in tier-1,
+the registered O4 targets stay at 0 findings, and the raw-cast lint
+holds the live tree at 0.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis.ast_checks import lint_paths, lint_source
+from apex_tpu.analysis.precision_checks import (
+    PRECISION_CHECKS,
+    analyze_precision,
+)
+from apex_tpu.analysis.targets import run_targets
+
+_A = jnp.zeros((8, 16), jnp.bfloat16)
+_B = jnp.zeros((16, 4), jnp.bfloat16)
+
+
+def _checks(findings):
+    return sorted({f.check for f in findings})
+
+
+class TestFp8Unscaled:
+    def test_seeded_unscaled_dot_caught(self):
+        """The ISSUE's first seeded regression: raw casts straight into
+        a dot — no scale anywhere."""
+
+        def bad(a, b):
+            return jnp.matmul(a.astype(jnp.float8_e4m3fn),
+                              b.astype(jnp.float8_e4m3fn),
+                              preferred_element_type=jnp.float32)
+
+        found = analyze_precision(bad, _A, _B, name="bad_unscaled",
+                                  checks=("fp8-unscaled",))
+        assert _checks(found) == ["fp8-unscaled"]
+        # both operands flagged (lhs + rhs dedup keys differ)
+        assert len(found) == 2
+
+    def test_upcast_before_dot_still_caught(self):
+        """An f8 value upcast to f32 right before the dot is the same
+        bug (the cast chain carries the f8 hop)."""
+
+        def bad(a, b):
+            a8 = a.astype(jnp.float8_e4m3fn).astype(jnp.float32)
+            return jnp.matmul(a8, b.astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+
+        found = analyze_precision(bad, _A, _B, name="bad_upcast",
+                                  checks=("fp8-unscaled",))
+        assert _checks(found) == ["fp8-unscaled"]
+
+    def test_scaled_dot_clean(self):
+        def good(a, b, state):
+            sa = 448.0 / jnp.maximum(jnp.max(state), 1e-6)
+            a8 = (a.astype(jnp.float32) * sa).astype(jnp.float8_e4m3fn)
+            b8 = (b.astype(jnp.float32) * sa).astype(jnp.float8_e4m3fn)
+            return jnp.matmul(a8, b8,
+                              preferred_element_type=jnp.float32)
+
+        found = analyze_precision(
+            good, _A, _B, jnp.ones((4,), jnp.float32),
+            roles={2: ("fp8_scale", "amax_hist")}, name="good",
+            checks=("fp8-unscaled", "fp8-stale-amax"))
+        assert found == []
+
+
+class TestFp8StaleAmax:
+    def test_seeded_stale_scale_caught(self):
+        """The ISSUE's second seeded regression: a scale that is NOT
+        derived from the carried amax-history state (here: a plain
+        argument with no history provenance)."""
+
+        def bad(a, b, scale):
+            a8 = (a.astype(jnp.float32) * scale).astype(
+                jnp.float8_e4m3fn)
+            b8 = (b.astype(jnp.float32) * scale).astype(
+                jnp.float8_e4m3fn)
+            return jnp.matmul(a8, b8,
+                              preferred_element_type=jnp.float32)
+
+        found = analyze_precision(
+            bad, _A, _B, jnp.float32(16.0), roles={2: "fp8_scale"},
+            name="bad_stale")
+        assert "fp8-stale-amax" in _checks(found)
+        # the scale WAS applied, so unscaled must stay quiet
+        assert "fp8-unscaled" not in _checks(found)
+
+    def test_real_delayed_scaling_path_clean(self):
+        """The actual Fp8DelayedScaler step traces clean through both
+        checks — the same construction as the registered target, kept
+        here as the direct regression anchor."""
+        from apex_tpu.amp.scaler import Fp8DelayedScaler
+
+        fp8 = Fp8DelayedScaler(["s"], history=4)
+        state = fp8.init()
+
+        def step(a, b, state):
+            with fp8.step(state) as ctx:
+                def loss(a, b):
+                    return jnp.sum(ctx.matmul(a, b, name="s")
+                                   .astype(jnp.float32))
+
+                l, grads = ctx.value_and_grad(loss, argnums=(0, 1))(a, b)
+            return l, grads, fp8.update(state, ctx)
+
+        found = analyze_precision(
+            step, _A, _B, state, roles={2: ("fp8_scale", "amax_hist")},
+            name="delayed", checks=("fp8-unscaled", "fp8-stale-amax"))
+        assert found == []
+
+
+class TestRegisteredTargets:
+    def test_fp8_targets_zero_findings(self):
+        findings, errors = run_targets(
+            {"fp8_matmul_delayed_scaling", "fp8_mlp_train_step"})
+        assert errors == {}
+        assert findings == []
+
+    def test_check_ids_registered(self):
+        assert "fp8-unscaled" in PRECISION_CHECKS
+        assert "fp8-stale-amax" in PRECISION_CHECKS
+
+
+# ------------------------------------------------------- raw-fp8-cast
+
+
+_RAW_SRC = """
+import jax.numpy as jnp
+from apex_tpu.ops.precision import F8_E4M3
+
+def f(x):
+    a = x.astype(jnp.float8_e4m3fn)
+    b = x.astype(F8_E4M3)
+    c = x.astype("float8_e5m2")
+    ok = x.astype(jnp.float32)
+    ok2 = x.astype(jnp.bfloat16)
+    return a, b, c, ok, ok2
+"""
+
+
+class TestRawFp8CastLint:
+    def test_seeded_raw_casts_caught(self):
+        found = lint_source(_RAW_SRC, "apex_tpu/models/foo.py",
+                            abspath="/repo/apex_tpu/models/foo.py")
+        raw = [f for f in found if f.check == "raw-fp8-cast"]
+        assert [f.line for f in raw] == [6, 7, 8]
+
+    def test_examples_and_tools_ground_covered(self):
+        for rel in ("examples/foo.py", "tools/foo.py", "bench.py"):
+            found = lint_source(_RAW_SRC, rel, abspath=f"/repo/{rel}")
+            assert any(f.check == "raw-fp8-cast" for f in found), rel
+
+    def test_sanctioned_owners_exempt(self):
+        for rel in ("apex_tpu/ops/precision.py",
+                    "apex_tpu/ops/fp8_cast_kernel.py",
+                    "apex_tpu/amp/scaler.py"):
+            found = lint_source(_RAW_SRC, rel,
+                                abspath=f"/repo/{rel}")
+            assert not any(f.check == "raw-fp8-cast" for f in found), rel
+
+    def test_keyword_form_caught(self):
+        # x.astype(dtype=...) must not evade the lint (review finding)
+        src = ("import jax.numpy as jnp\n"
+               "y = x.astype(dtype=jnp.float8_e4m3fn)\n")
+        found = lint_source(src, "apex_tpu/models/foo.py",
+                            abspath="/repo/apex_tpu/models/foo.py")
+        assert any(f.check == "raw-fp8-cast" for f in found)
+
+    def test_suppression_comment_respected(self):
+        src = ("import jax.numpy as jnp\n"
+               "y = x.astype(jnp.float8_e5m2)"
+               "  # apex-lint: disable=raw-fp8-cast\n")
+        found = lint_source(src, "apex_tpu/models/foo.py",
+                            abspath="/repo/apex_tpu/models/foo.py")
+        assert not any(f.check == "raw-fp8-cast" for f in found)
+
+    @pytest.mark.slow
+    def test_live_tree_at_zero(self):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        found = lint_paths(
+            [os.path.join(repo, "apex_tpu"),
+             os.path.join(repo, "examples"),
+             os.path.join(repo, "tools"),
+             os.path.join(repo, "bench.py")],
+            root=repo, checks=("raw-fp8-cast",))
+        assert found == []

@@ -58,6 +58,24 @@ def test_llama_train():
 
 
 @pytest.mark.slow
+def test_llama_train_o4_fp8(tmp_path):
+    """ISSUE 13 acceptance: --opt-level O4 runs end-to-end on CPU with
+    finite loss, and the fp8 scaling state resumes from checkpoints
+    (bit-identity is proved in-process by
+    tests/run_resilience/test_fp8_roundtrip.py)."""
+    ckpt = str(tmp_path / "ck")
+    out = _run("llama_train.py", "--steps", "5", "--fixed-data",
+               "--opt-level", "O4", "--checkpoint-dir", ckpt)
+    assert "opt-level O4" in out
+    assert "(decreased)" in out
+    out = _run("llama_train.py", "--steps", "8", "--fixed-data",
+               "--opt-level", "O4", "--checkpoint-dir", ckpt,
+               "--resume")
+    assert "=> resumed from step" in out
+    assert "(decreased)" in out
+
+
+@pytest.mark.slow
 def test_dcgan():
     out = _run("dcgan.py", "--steps", "4")
     assert "ran to completion: OK" in out

@@ -274,3 +274,49 @@ def test_fleet_family_table_renders(tmp_path):
     assert "stragglers: rank 2: 4" in proc.stdout
     assert "desync events: 1" in proc.stdout
     assert "wait ddp/allreduce rank 0" in proc.stdout
+
+
+# ------------------------------------------------ fp8 speedup gate (ISSUE 13)
+
+
+def _fp8_rec(speedup):
+    return {"type": "gauge", "name": "amp/fp8_speedup", "value": speedup}
+
+
+def test_compare_fp8_speedup_drop_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=[_fp8_rec(1.8)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=[_fp8_rec(1.2)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION amp/fp8_speedup" in proc.stdout
+    # a looser threshold lets the same ratio drop pass
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.5").returncode == 0
+
+
+def test_compare_fp8_speedup_wobble_passes(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=[_fp8_rec(1.8)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=[_fp8_rec(1.75)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_compare_fp8_only_in_base_is_info(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=[_fp8_rec(1.8)])
+    cur = _dump(tmp_path / "cur.jsonl")
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "only in base" in proc.stdout
+
+
+def test_fp8_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=[
+        _fp8_rec(1.6),
+        {"type": "gauge", "name": "amp/fp8_matmul_ms", "value": 2.5},
+        {"type": "gauge", "name": "amp/fp8_bf16_matmul_ms",
+         "value": 4.0},
+    ])
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "amp/fp8_* family" in proc.stdout
+    assert "fp8_speedup" in proc.stdout

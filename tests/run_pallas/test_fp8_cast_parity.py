@@ -1,0 +1,64 @@
+"""Interpret-mode parity for the fused fp8 cast-and-scale kernel: every
+candidate the sweep can emit produces BIT-identical fp8 values and the
+exact pre-scale amax vs the jnp fallback (same contract as
+test_tuning_parity.py for the other kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import fp8_cast_kernel, pallas_config
+from apex_tpu.tuning import geometry, search_space
+
+_N = 5000  # not a slab multiple: exercises the padding path
+_X = jax.random.normal(jax.random.PRNGKey(0), (_N,), jnp.float32) * 300.0
+_SCALE = jnp.float32(1.3)
+
+
+def _jnp_ref(dtype, fmax):
+    return fp8_cast_kernel._cast_and_scale_jnp(_X, _SCALE, dtype, fmax)
+
+
+@pytest.mark.parametrize("dtype,fmax", [
+    (jnp.float8_e4m3fn, 448.0), (jnp.float8_e5m2, 57344.0)])
+def test_every_candidate_bit_identical(dtype, fmax):
+    y_ref, amax_ref = _jnp_ref(dtype, fmax)
+    cands = search_space.candidates("fp8_cast", n=_N)
+    assert cands
+    with pallas_config.force("interpret"):
+        for c in cands:
+            with geometry.override("fp8_cast", c):
+                y, amax = fp8_cast_kernel.cast_and_scale_stats(
+                    _X, _SCALE, dtype, fmax)
+            np.testing.assert_array_equal(
+                np.asarray(y).view(np.uint8),
+                np.asarray(y_ref).view(np.uint8), err_msg=str(c))
+            assert float(amax) == float(amax_ref), c
+
+
+def test_2d_input_and_shape_preserved():
+    x2 = _X[:4096].reshape(32, 128)
+    with pallas_config.force("interpret"):
+        y, amax = fp8_cast_kernel.cast_and_scale_stats(
+            x2, _SCALE, jnp.float8_e4m3fn, 448.0)
+    assert y.shape == x2.shape and y.dtype == jnp.float8_e4m3fn
+    assert float(amax) == float(jnp.max(jnp.abs(x2)))
+
+
+def test_saturation_in_kernel():
+    x = jnp.array([1e9, -1e9], jnp.float32)
+    with pallas_config.force("interpret"):
+        y, _ = fp8_cast_kernel.cast_and_scale_stats(
+            x, jnp.float32(1.0), jnp.float8_e4m3fn, 448.0)
+    y32 = np.asarray(y.astype(jnp.float32))
+    assert y32.tolist() == [448.0, -448.0]
+
+
+def test_scalar_and_empty_fall_back():
+    # degenerate shapes take the jnp path regardless of mode
+    with pallas_config.force("interpret"):
+        y, amax = fp8_cast_kernel.cast_and_scale_stats(
+            jnp.float32(3.0), jnp.float32(1.0), jnp.float8_e4m3fn,
+            448.0)
+    assert float(amax) == 3.0

@@ -1,0 +1,98 @@
+"""fp8_cast kernel registration in the autotuner (ISSUE 13 satellite):
+VMEM-bounded candidates, stable buckets, deterministic roofline-fallback
+ranking, and the dispatch geometry clamp."""
+
+from apex_tpu.ops import pallas_config
+from apex_tpu.tuning import geometry, measure, search_space, tuner
+
+_N = 1 << 20
+
+
+class TestSearchSpace:
+    def test_registered(self):
+        assert "fp8_cast" in search_space.KERNELS
+        assert "fp8_cast" in pallas_config.KNOWN_KERNELS
+        assert "fp8_cast" in tuner.DEFAULT_SHAPES
+
+    def test_candidates_within_vmem_budget(self):
+        budget = search_space._vmem_budget()
+        cands = search_space.candidates("fp8_cast", n=_N)
+        assert cands
+        for c in cands:
+            assert search_space._fp8_cast_vmem(
+                c["block_rows"], c["cols"]) <= budget
+
+    def test_candidates_respect_fp8_min_tile(self):
+        # fp8 min tile is (32, 128): no candidate may go under either
+        for c in search_space.candidates("fp8_cast", n=_N):
+            assert c["block_rows"] >= 32
+            assert c["cols"] >= 128
+
+    def test_padding_waste_bounded(self):
+        for n in (4097, _N, 50_000_000):
+            for c in search_space.candidates("fp8_cast", n=n):
+                rows = -(-n // c["cols"])
+                padded = (-(-rows // c["block_rows"])
+                          * c["block_rows"] * c["cols"])
+                assert padded <= max(2 * n, 32 * 128 * 8)
+
+    def test_bucket_stable_within_pow2(self):
+        b = search_space.shape_bucket("fp8_cast", n=300_000_000)
+        assert b == search_space.shape_bucket("fp8_cast", n=350_000_000)
+        assert b != search_space.shape_bucket("fp8_cast", n=600_000_000)
+
+
+class TestRooflineRanking:
+    def test_deterministic(self):
+        dims = {"n": _N}
+        cands = search_space.candidates("fp8_cast", n=_N)
+
+        def rank():
+            return sorted(
+                (measure.roofline("fp8_cast", c, dims),
+                 tuple(sorted(c.items()))) for c in cands)
+
+        assert rank() == rank()
+
+    def test_kernel_beats_two_pass_xla_model(self):
+        # the fused one-read pass must model faster than the two-fusion
+        # XLA fallback at any sane tile — that's the kernel's thesis
+        dims = {"n": _N}
+        best = min(measure.roofline("fp8_cast", c, dims)
+                   for c in search_space.candidates("fp8_cast", n=_N))
+        assert best < measure.roofline_xla("fp8_cast", dims)
+
+    def test_tune_kernel_roofline_end_to_end(self):
+        from apex_tpu.observability import MetricRegistry
+
+        reg = MetricRegistry()
+        res = tuner.tune_kernel("fp8_cast", {"n": _N}, live=False,
+                                write=False, registry=reg,
+                                log=lambda m: None)
+        entry = res["entry"]
+        assert entry["source"] == "roofline"
+        assert entry["use_pallas"] is True
+        assert set(entry["params"]) == {"block_rows", "cols"}
+        # deterministic winner: rerunning picks the same tile
+        res2 = tuner.tune_kernel("fp8_cast", {"n": _N}, live=False,
+                                 write=False, registry=reg,
+                                 log=lambda m: None)
+        assert res2["entry"]["params"] == entry["params"]
+
+
+class TestDispatchGeometry:
+    def test_default_without_cache(self):
+        br, cols = geometry.fp8_cast_geometry(_N)
+        assert br >= 32 and cols >= 128
+
+    def test_override_wins(self):
+        with geometry.override("fp8_cast",
+                               {"block_rows": 64, "cols": 256}):
+            assert geometry.fp8_cast_geometry(_N) == (64, 256)
+
+    def test_oversized_tuned_tile_clamps_to_default(self):
+        # a tile tuned for a huge buffer must not over-pad a tiny one
+        with geometry.override("fp8_cast",
+                               {"block_rows": 1024, "cols": 2048}):
+            assert geometry.fp8_cast_geometry(500) == \
+                search_space.default_fp8_cast_geometry(500)

@@ -21,6 +21,7 @@ DIMS = {
     "layer_norm": {"rows": 8192, "h": 4096},
     "rms_norm": {"rows": 8192, "h": 4096},
     "fused_softmax": {"sk": 32768},
+    "fp8_cast": {"n": 1 << 20},
 }
 
 

@@ -469,6 +469,61 @@ def summarize_ddp(path, fam):
             print(f"  {row['metric']:44s} {v:>10.3f}")
 
 
+def render_fp8_family(path):
+    """The ``amp/fp8_*`` gauge family from a metrics JSONL dump (None
+    when the file carries none): the fp8-vs-bf16 matmul race numbers
+    bench.py records (ISSUE 13) plus any fp8_race events."""
+    gauges: dict = {}
+    events = 0
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        if rec.get("type") == "event" and name == "fp8_race":
+            events += 1
+            continue
+        if rec.get("type") == "gauge" and name.startswith("amp/fp8_"):
+            gauges[name[len("amp/"):]] = rec.get("value")
+    if not gauges and not events:
+        return None
+    return {"gauges": gauges, "events": events}
+
+
+def summarize_fp8(path, fam):
+    print(f"{path}: amp/fp8_* family (fp8-vs-bf16 race)")
+    for key in ("fp8_matmul_ms", "fp8_bf16_matmul_ms", "fp8_speedup",
+                "fp8_quantize_ms", "fp8_max_rel_err"):
+        if key in fam["gauges"]:
+            v = fam["gauges"][key]
+            v_s = f"{v:.4g}" if isinstance(v, (int, float)) else str(v)
+            print(f"  {key:22s} {v_s}")
+    for key, v in sorted(fam["gauges"].items()):
+        if key not in ("fp8_matmul_ms", "fp8_bf16_matmul_ms",
+                       "fp8_speedup", "fp8_quantize_ms",
+                       "fp8_max_rel_err"):
+            print(f"  {key:22s} {v}")
+
+
+def _fp8_speedup_gauges(records):
+    """{labels-qualified name: value} for amp/fp8_speedup gauges."""
+    out = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                rec.get("name") != "amp/fp8_speedup" or \
+                not isinstance(rec.get("value"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "amp/fp8_speedup" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["value"])
+    return out
+
+
 def render_fleet_family(path):
     """The ``fleet/*`` family from a metrics JSONL dump (None when the
     file carries none): cross-rank step-time skew per metric with the
@@ -734,6 +789,25 @@ def compare_metrics(current_path, base_path, threshold=0.10):
         else:
             infos.append(f"{name}: skew {b:+.1%} -> {c:+.1%} ok")
 
+    cur_fp8, base_fp8 = _fp8_speedup_gauges(cur), \
+        _fp8_speedup_gauges(base)
+    for name in sorted(base_fp8):
+        if name not in cur_fp8:
+            infos.append(f"{name}: only in base "
+                         f"({base_fp8[name]:.3f}x)")
+            continue
+        b, c = base_fp8[name], cur_fp8[name]
+        # the fp8-vs-bf16 speedup RATIO is the gated quantity (ISSUE
+        # 13): wall clocks move with the machine, but fp8 getting
+        # relatively slower than bf16 means the epilogue/quantize path
+        # regressed regardless of absolute speed
+        if b > 0 and c < b * (1.0 - threshold):
+            regressions.append(
+                f"{name}: fp8-vs-bf16 speedup {b:.3f}x -> {c:.3f}x "
+                f"(-{(1 - c / b) * 100:.1f}% > {threshold * 100:.0f}%)")
+        else:
+            infos.append(f"{name}: speedup {b:.3f}x -> {c:.3f}x ok")
+
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
         if kernel not in cur_race:
@@ -891,6 +965,13 @@ if __name__ == "__main__":
                     print(json.dumps({"path": arg, "ddp_family": ddp}))
                 else:
                     summarize_ddp(arg, ddp)
+            f8 = render_fp8_family(arg) if os.path.isfile(arg) \
+                else None
+            if f8 is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg, "fp8_family": f8}))
+                else:
+                    summarize_fp8(arg, f8)
             flt = render_fleet_family(arg) if os.path.isfile(arg) \
                 else None
             if flt is not None:
