@@ -17,7 +17,11 @@ Two engines, one CLI, one pytest gate:
   checks** (:mod:`.sharding_checks`): implicit reshards, replicated
   large inputs, psum→slice reduce-scatter opportunities, dead
   collectives, and the per-device peak-HBM budget — plus the
-  per-target comms-bytes/peak-HBM estimates bench.py reports.
+  per-target comms-bytes/peak-HBM estimates bench.py reports. The
+  **rank-consistency engine** (:mod:`.spmd_checks`) proves the SPMD
+  contracts over the same walk: no collective under rank-divergent
+  control, no rank-distinct value stored where out_specs claim
+  replication, coordinated RNG, anchored host effects.
 - **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
   examples/, tools/, bench.py) for host-sync anti-patterns — the
   ``block_until_ready``-as-timing bug that produced r5's impossible
@@ -55,19 +59,26 @@ from apex_tpu.analysis.planner import (
     PlanError,
     plan,
 )
+from apex_tpu.analysis.spmd_checks import (
+    SPMD_CHECKS,
+    analyze_spmd,
+)
 from apex_tpu.analysis.targets import (
     TARGETS,
     run_precision_findings,
     run_sharding_findings,
+    run_spmd_findings,
     run_targets,
 )
 
 __all__ = [
     "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PLAN_MODELS",
     "PRECISION_CHECKS", "Plan", "PlanError",
-    "SHARDING_CHECKS", "TARGETS", "analyze_fn", "analyze_precision",
-    "analyze_sharding", "analyze_sharding_jaxpr", "lint_paths",
-    "lint_source", "load_baseline",
+    "SHARDING_CHECKS", "SPMD_CHECKS", "TARGETS", "analyze_fn",
+    "analyze_precision",
+    "analyze_sharding", "analyze_sharding_jaxpr", "analyze_spmd",
+    "lint_paths", "lint_source", "load_baseline",
     "new_findings", "plan", "run_precision_findings",
-    "run_sharding_findings", "run_targets", "save_baseline",
+    "run_sharding_findings", "run_spmd_findings", "run_targets",
+    "save_baseline",
 ]

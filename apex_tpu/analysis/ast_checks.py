@@ -94,6 +94,19 @@ lived. Checks:
                       it shipped with) — route geometry through
                       ``apex_tpu.tuning``.
 
+- ``nondeterministic-collective-order``
+                      a ``for`` loop over an unordered iterable (set
+                      literal/comprehension, ``set()``/``frozenset()``
+                      or a set-method call, ``os.listdir``) whose body
+                      builds buckets or issues collectives, in comms
+                      scheduling code (``apex_tpu/parallel/``,
+                      ``runtime/``, ``distributed/``): set iteration
+                      order differs across processes (string hash
+                      randomization) and listdir follows filesystem
+                      order, so ranks disagree on bucket layout /
+                      collective issue order — the plan_buckets-shaped
+                      deadlock seed. Iterate ``sorted(...)``.
+
 Suppress with ``# apex-lint: disable=<id>`` on (or above) the line.
 """
 
@@ -110,7 +123,7 @@ AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "swallowed-exception-in-step-loop",
               "hardcoded-tile-size", "unclosed-span",
               "host-isnan-in-step-loop", "rank-unsafe-artifact-path",
-              "raw-fp8-cast")
+              "raw-fp8-cast", "nondeterministic-collective-order")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -125,18 +138,23 @@ _RAW_CLOCK_ALLOW_PREFIXES = ("apex_tpu/observability/",
                              "apex_tpu/resilience/")
 
 
-def _raw_clock_applies(path: str) -> bool:
-    """Is ``path`` (absolute when available — relpaths depend on the
-    caller's cwd/root) library code the raw-clock check governs? True
-    when an ``apex_tpu`` DIRECTORY segment appears in it, minus the
-    allowlisted clock owners (matched from the last such segment, so
-    checkouts living under a directory that happens to be named
-    apex_tpu still resolve correctly)."""
+def _apex_tail(path: str):
+    """``path`` from its last ``apex_tpu`` DIRECTORY segment on, or
+    None when no such segment exists — the shared scoping idiom for
+    library-code checks (absolute paths preferred: relpaths depend on
+    the caller's cwd/root; matching from the LAST segment keeps
+    checkouts that live under a directory named apex_tpu correct)."""
     norm = path.replace("\\", "/")
     if "apex_tpu" not in norm.split("/")[:-1]:
-        return False
-    tail = norm[norm.rindex("apex_tpu/"):]
-    if tail in _RAW_CLOCK_ALLOW_FILES:
+        return None
+    return norm[norm.rindex("apex_tpu/"):]
+
+
+def _raw_clock_applies(path: str) -> bool:
+    """Is ``path`` library code the raw-clock check governs? Library
+    code under apex_tpu/, minus the allowlisted clock owners."""
+    tail = _apex_tail(path)
+    if tail is None or tail in _RAW_CLOCK_ALLOW_FILES:
         return False
     return not any(tail.startswith(p) for p in _RAW_CLOCK_ALLOW_PREFIXES)
 
@@ -225,14 +243,43 @@ _FP8_DTYPE_NAME_RE = re.compile(r"^(float8_e4m3fn|float8_e5m2|"
 
 
 def _raw_fp8_applies(path: str) -> bool:
-    norm = path.replace("\\", "/")
-    if "apex_tpu" in norm.split("/")[:-1]:
-        tail = norm[norm.rindex("apex_tpu/"):]
+    tail = _apex_tail(path)
+    if tail is not None:
         if tail in _FP8_CAST_ALLOW_FILES:
             return False
         if any(tail.startswith(p) for p in _FP8_CAST_ALLOW_PREFIXES):
             return False
     return True
+
+
+# nondeterministic-collective-order (ISSUE 14): comms scheduling code —
+# parallel/ (bucket plans, collective issue chains), runtime/
+# (plan_buckets) and the distributed shims. Every rank must build the
+# SAME bucket list and issue collectives in the SAME order; a loop over
+# a set (hash-randomized for strings across processes) or os.listdir
+# (filesystem order) deciding either is a cross-rank deadlock/desync
+# seed: rank A packs {f32, bf16} buckets in one order, rank B in the
+# other, and the psums pair the wrong buffers.
+_NONDET_ORDER_PREFIXES = ("apex_tpu/parallel/", "apex_tpu/runtime/",
+                          "apex_tpu/distributed/")
+
+#: loop bodies that "issue comms / build buckets": a collective call, a
+#: plan_buckets call, or any bucket-named identifier
+_ORDER_COLLECTIVE_NAMES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "reduce_scatter", "all_to_all", "ppermute", "plan_buckets",
+})
+
+#: set-producing call tails a for-loop must not iterate unsorted
+_SET_CALL_NAMES = frozenset({"set", "frozenset"})
+_SET_METHOD_NAMES = frozenset({"difference", "union", "intersection",
+                               "symmetric_difference"})
+
+
+def _nondet_order_applies(path: str) -> bool:
+    tail = _apex_tail(path)
+    return tail is not None and any(
+        tail.startswith(p) for p in _NONDET_ORDER_PREFIXES)
 
 
 # hardcoded-tile-size: the two modules tile numbers are ALLOWED to live
@@ -484,11 +531,69 @@ class _Visitor(ast.NodeVisitor):
     # ------------------------------------------------- loops / handlers
 
     def visit_For(self, node):
+        if "nondeterministic-collective-order" in self.checks:
+            self._check_nondet_order(node)
         self.loop_depth[-1] += 1
         self.generic_visit(node)
         self.loop_depth[-1] -= 1
 
     visit_AsyncFor = visit_For
+
+    # --------------------------- nondeterministic collective order
+
+    def _nondet_iterable(self, node):
+        """A human-readable description when ``node`` (a for-loop's
+        iter expression) has no deterministic order: a set
+        literal/comprehension, a set()/frozenset()/set-method call, or
+        os.listdir. ``sorted(...)`` around any of these never matches
+        — that IS the fix."""
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return "a set literal"
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _SET_CALL_NAMES:
+                return f"{node.func.id}(...)"
+            chain = _attr_chain(node.func)
+            if chain:
+                if chain[-1] == "listdir":
+                    return "os.listdir(...)"
+                if chain[-1] in _SET_METHOD_NAMES and len(chain) >= 2:
+                    return f".{chain[-1]}(...) (a set)"
+        return None
+
+    def _body_issues_comms(self, node) -> bool:
+        """Does the loop body contain a collective/plan_buckets call or
+        a bucket-named identifier? (the 'this loop decides comms or
+        bucket order' signal)."""
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func)
+                    if chain and chain[-1] in _ORDER_COLLECTIVE_NAMES:
+                        return True
+                if isinstance(sub, ast.Name) and \
+                        "bucket" in sub.id.lower():
+                    return True
+                if isinstance(sub, ast.Attribute) and \
+                        "bucket" in sub.attr.lower():
+                    return True
+        return False
+
+    def _check_nondet_order(self, node):
+        how = self._nondet_iterable(node.iter)
+        if how is None or not self._body_issues_comms(node):
+            return
+        self._emit(
+            "nondeterministic-collective-order", "error",
+            node.iter.lineno,
+            f"loop over {how} — an unordered iterable — decides bucket "
+            f"construction or collective issue order: set iteration "
+            f"order differs across processes (string hash "
+            f"randomization) and os.listdir follows filesystem order, "
+            f"so two ranks build different bucket lists / issue "
+            f"collectives in different orders and the fleet deadlocks "
+            f"or pairs the wrong buffers — iterate sorted(...) so "
+            f"every rank sees the same order")
 
     def visit_While(self, node):
         # the While TEST re-evaluates every iteration: an isnan there
@@ -829,6 +934,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # the sanctioned quantization owners
     if not _raw_fp8_applies(abspath or relpath):
         checks = checks - {"raw-fp8-cast"}
+    # nondeterministic-collective-order: comms scheduling code only
+    # (parallel/, runtime/, distributed/)
+    if not _nondet_order_applies(abspath or relpath):
+        checks = checks - {"nondeterministic-collective-order"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:

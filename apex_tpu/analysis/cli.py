@@ -27,16 +27,28 @@ from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
 from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
+from apex_tpu.analysis.spmd_checks import SPMD_CHECKS
 
 DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 
 # Engines the per-target wall time rolls up into (the lint summary's
 # gate-latency line — the unified-interpreter speedup and any future
 # regression show up here, per ISSUE 8 satellite).
-ENGINE_NAMES = ("ast", "jaxpr", "dataflow", "sharding")
+ENGINE_NAMES = ("ast", "jaxpr", "dataflow", "sharding", "spmd")
+
+# Total-wall-time budget for one gate run (ISSUE 14 satellite): the
+# engine stack keeps growing, and tier-1 runs the gate every round — a
+# silently-slowing gate rots the whole suite's latency. The default is
+# deliberately generous (the full run is ~10s today); override with
+# LINT_TIME_BUDGET_S, or set it <= 0 to disable.
+DEFAULT_TIME_BUDGET_S = 180.0
 
 # Version of the --json payload; bump when its shape changes so
 # downstream readers (tools/metrics_report.py) can dispatch on it.
+# Version 1 payloads MAY additionally carry a per-finding "fingerprint"
+# (check+symbol+snippet hash, see findings.finding_fingerprint) — an
+# additive field old readers ignore; --diff uses it to survive file
+# renames/moves.
 JSON_SCHEMA_VERSION = 1
 
 
@@ -48,14 +60,16 @@ def _default_paths(root):
 def known_checks():
     return (set(ast_checks.AST_CHECKS) | set(JAXPR_CHECKS)
             | set(PRECISION_CHECKS) | set(SHARDING_CHECKS)
-            | set(targets.TARGET_CHECKS))
+            | set(SPMD_CHECKS) | set(targets.TARGET_CHECKS))
 
 
 def load_diff_report(path):
-    """A stored ``--json`` dump -> Counter of finding keys (the --diff
-    base). Loud on anything that is not an apex_tpu.analysis report of
-    a schema this reader knows — a silently-ignored base would report
-    every finding as old forever."""
+    """A stored ``--json`` dump -> (Counter of finding keys, Counter of
+    snippet fingerprints) — the --diff base. Loud on anything that is
+    not an apex_tpu.analysis report of a schema this reader knows — a
+    silently-ignored base would report every finding as old forever.
+    Fingerprints are absent from pre-rename-fix dumps; the fallback
+    then simply never matches (the old, path-keyed behavior)."""
     import collections
 
     with open(path) as f:
@@ -74,9 +88,12 @@ def load_diff_report(path):
             f"--diff base {path} has schema_version {version}; this "
             f"reader knows [{JSON_SCHEMA_VERSION}]")
     keys = collections.Counter()
+    fps = collections.Counter()
     for f in data.get("findings", ()):
         keys[f"{f.get('check')}:{f.get('path')}:{f.get('symbol')}"] += 1
-    return keys
+        if f.get("fingerprint"):
+            fps[f["fingerprint"]] += 1
+    return keys, fps
 
 
 def parse_allow(entries):
@@ -161,7 +178,9 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
                     engine = ("dataflow" if target_name in
                               targets.PRECISION_TARGETS else
                               "sharding" if target_name in
-                              targets.SHARDING_TARGETS else "jaxpr")
+                              targets.SHARDING_TARGETS else
+                              "spmd" if target_name in
+                              targets.SPMD_TARGETS else "jaxpr")
                     engine_seconds[engine] = engine_seconds.get(
                         engine, 0.0) + seconds
             if checks:
@@ -215,15 +234,17 @@ def main(argv=None):
 
     if args.list_checks:
         for cid in ast_checks.AST_CHECKS:
-            print(f"{cid:24s} [ast]")
+            print(f"{cid:32s} [ast]")
         for cid in JAXPR_CHECKS:
-            print(f"{cid:24s} [jaxpr]")
+            print(f"{cid:32s} [jaxpr]")
         for cid in PRECISION_CHECKS:
-            print(f"{cid:24s} [jaxpr/dataflow]")
+            print(f"{cid:32s} [jaxpr/dataflow]")
         for cid in SHARDING_CHECKS:
-            print(f"{cid:24s} [jaxpr/sharding]")
+            print(f"{cid:32s} [jaxpr/sharding]")
+        for cid in SPMD_CHECKS:
+            print(f"{cid:32s} [jaxpr/spmd]")
         for cid in targets.TARGET_CHECKS:
-            print(f"{cid:24s} [jaxpr]")
+            print(f"{cid:32s} [jaxpr]")
         return 0
 
     checks = None
@@ -235,7 +256,9 @@ def main(argv=None):
         allow = parse_allow(args.allow)
         # validate the diff base BEFORE the (expensive) run: a bad base
         # should fail in milliseconds, not after tracing every target
-        diff_keys = load_diff_report(args.diff) if args.diff else None
+        diff_keys = diff_fps = None
+        if args.diff:
+            diff_keys, diff_fps = load_diff_report(args.diff)
         found, errors = run(paths=args.paths or None, root=args.root,
                             ast=args.ast, jaxpr=args.jaxpr, checks=checks,
                             allow=allow, engine_seconds=engine_seconds)
@@ -265,18 +288,28 @@ def main(argv=None):
         base_keys = diff_keys if base_keys is None \
             else base_keys | diff_keys
     if base_keys is not None:
-        fresh = findings_mod.new_findings(found, base_keys)
+        # the diff base's snippet fingerprints give renamed/moved files
+        # a second chance: same check+symbol+source line under a new
+        # path is churn, not a NEW finding
+        fresh = findings_mod.new_findings_with_fingerprints(
+            found, base_keys, diff_fps, root=args.root)
         grandfathered = len(found) - len(fresh)
 
     timing = "  ".join(
         f"{name} {engine_seconds.get(name, 0.0):.1f}s"
         for name in ENGINE_NAMES)
     total = sum(engine_seconds.values())
+    over_budget = _check_time_budget(total)
     if args.json:
+        lines_cache: dict = {}
         print(json.dumps({
             "schema_version": JSON_SCHEMA_VERSION,
             "kind": "apex_tpu.analysis",
-            "findings": [vars(f) for f in fresh],
+            "findings": [
+                dict(vars(f),
+                     fingerprint=findings_mod.finding_fingerprint(
+                         f, root=args.root, lines_cache=lines_cache))
+                for f in fresh],
             "grandfathered": grandfathered,
             "target_errors": errors,
             "engine_seconds": {k: round(v, 3) for k, v in
@@ -293,6 +326,33 @@ def main(argv=None):
         print(f"engine wall time: {timing}  (total {total:.1f}s)",
               file=sys.stderr)
 
-    if errors:
+    if errors or over_budget:
         return 2
     return 1 if fresh else 0
+
+
+def _check_time_budget(total_seconds) -> bool:
+    """ISSUE 14 satellite: the gate's wall time is itself gated. True
+    (and a LOUD stderr report) when the summed engine_seconds exceed
+    LINT_TIME_BUDGET_S (default :data:`DEFAULT_TIME_BUDGET_S`; <= 0
+    disables). A malformed override is an error, not a silent
+    default — a typo'd budget would never fire again."""
+    raw = os.environ.get("LINT_TIME_BUDGET_S", "")
+    if raw.strip():
+        try:
+            budget = float(raw)
+        except ValueError:
+            print(f"LINT_TIME_BUDGET_S={raw!r} is not a number",
+                  file=sys.stderr)
+            return True
+    else:
+        budget = DEFAULT_TIME_BUDGET_S
+    if budget <= 0 or total_seconds <= budget:
+        return False
+    print(f"LINT TIME BUDGET EXCEEDED: engines took "
+          f"{total_seconds:.1f}s > {budget:.1f}s "
+          f"(LINT_TIME_BUDGET_S) — the static gate runs inside tier-1 "
+          f"every round; profile the per-engine wall-time line above "
+          f"and trim the offending targets (or raise the budget "
+          f"deliberately)", file=sys.stderr)
+    return True

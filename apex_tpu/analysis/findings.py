@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
 import json
+import os
 import re
 
 SEVERITIES = ("error", "warning")
@@ -107,4 +109,89 @@ def new_findings(findings, baseline: collections.Counter):
             budget[f.key] -= 1
         else:
             fresh.append(f)
+    return fresh
+
+
+# --------------------------------------------------- snippet fingerprint
+#
+# A Finding's key embeds its PATH, so renaming/moving a file makes every
+# grandfathered finding in it look NEW to `--diff` (the base dump's keys
+# all name the old path). The fingerprint is the path-free identity:
+# check + symbol + the flagged source LINE's text (whitespace-stripped).
+# `--diff` falls back to it when the path:symbol key misses, so a pure
+# rename/move never fails the gate while a genuinely new occurrence
+# (different code, or one MORE of the same snippet than the base had —
+# multiplicity-aware both ways) still does. Only source-mapped findings
+# (line > 0) get one: jaxpr findings live at synthetic paths that never
+# rename.
+
+
+def finding_fingerprint(finding: Finding, root=None, lines_cache=None):
+    """Stable ``check:symbol:snippet`` hash for a source-mapped finding,
+    or None when the source line cannot be read (jaxpr findings,
+    deleted files). ``lines_cache``: optional per-RUN dict (path ->
+    line list or None) so N findings in one file cost one read; scope
+    it to a single invocation — never across runs, files get rewritten
+    between them."""
+    if finding.line <= 0:
+        return None
+    path = finding.path
+    if root is not None and not os.path.isabs(path):
+        path = os.path.join(root, path)
+    lines = lines_cache.get(path) if lines_cache is not None else None
+    if lines is None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except (OSError, UnicodeDecodeError):
+            lines = []
+        if lines_cache is not None:
+            lines_cache[path] = lines
+    try:
+        snippet = lines[finding.line - 1].strip()
+    except IndexError:
+        return None
+    digest = hashlib.sha1(
+        f"{finding.check}:{finding.symbol}:{snippet}".encode()
+    ).hexdigest()
+    return digest[:16]
+
+
+def new_findings_with_fingerprints(findings, baseline, base_fps,
+                                   root=None):
+    """:func:`new_findings`, with a second chance for findings whose
+    path-keyed identity missed but whose snippet fingerprint is in the
+    base run (``base_fps``: Counter of fingerprints) — the
+    renamed/moved-file case."""
+    budget = collections.Counter(baseline)
+    fp_budget = collections.Counter(base_fps or {})
+    lines_cache: dict = {}
+
+    def fp_of(f):
+        return finding_fingerprint(f, root=root,
+                                   lines_cache=lines_cache) \
+            if fp_budget else None
+
+    # Two passes, NOT one: every path-keyed match must land (and
+    # consume its fingerprint slot — a copy-paste duplicate may not
+    # ride the renamed-file budget) BEFORE any fallback matching, or
+    # the verdict depends on finding order (a duplicate whose path
+    # sorts before the original would steal the fingerprint slot and
+    # be silently grandfathered).
+    unmatched = []
+    for f in findings:
+        if budget[f.key] > 0:
+            budget[f.key] -= 1
+            fp = fp_of(f)
+            if fp is not None and fp_budget[fp] > 0:
+                fp_budget[fp] -= 1
+        else:
+            unmatched.append(f)
+    fresh = []
+    for f in unmatched:
+        fp = fp_of(f)
+        if fp is not None and fp_budget[fp] > 0:
+            fp_budget[fp] -= 1
+            continue
+        fresh.append(f)
     return fresh

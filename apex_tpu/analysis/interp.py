@@ -48,7 +48,8 @@ impossible (pallas kernels, shape-changing structural re-entries).
 from __future__ import annotations
 
 __all__ = ["Lattice", "LatticeRun", "MeshCtx", "NFVal",
-           "NonFiniteLattice", "interpret_lattices"]
+           "NonFiniteLattice", "interpret_lattices",
+           "run_lattice_silent"]
 
 # Call-like primitives whose bodies run in the caller's value world.
 CALL_PRIMS = frozenset({
@@ -87,11 +88,22 @@ def consts_of(obj):
 
 class MeshCtx:
     """Axis universe the interpretation runs under: name -> size, plus
-    the manual (shard_map-consumed) axes at the current depth."""
+    the manual (shard_map-consumed) axes at the current depth.
 
-    def __init__(self, axis_sizes=None, manual_axes=frozenset()):
+    ``control`` is the divergent-control stack (ISSUE 14): one
+    ``(prim, axes)`` entry per enclosing ``cond``/``while`` whose
+    predicate some participating lattice declared rank-divergent
+    (:meth:`Lattice.divergent_axes`). A visitor that sees a collective
+    while the stack carries a non-empty entry knows the collective's
+    issue is conditional on a value that differs across those mesh
+    axes — the deadlock/desync shape the rank-consistency checks
+    exist for."""
+
+    def __init__(self, axis_sizes=None, manual_axes=frozenset(),
+                 control=()):
         self.axis_sizes = dict(axis_sizes or {})
         self.manual_axes = frozenset(manual_axes)
+        self.control = tuple(control)
 
     def size(self, axis, default=1) -> int:
         return int(self.axis_sizes.get(axis, default))
@@ -100,7 +112,21 @@ class MeshCtx:
         sizes = dict(self.axis_sizes)
         if extra_sizes:
             sizes.update({str(k): int(v) for k, v in extra_sizes.items()})
-        return MeshCtx(sizes, self.manual_axes | frozenset(extra_manual))
+        return MeshCtx(sizes, self.manual_axes | frozenset(extra_manual),
+                       self.control)
+
+    def control_child(self, prim, axes):
+        """The context for a ``cond``/``while`` body whose predicate
+        can differ across ``axes``."""
+        return MeshCtx(self.axis_sizes, self.manual_axes,
+                       self.control + ((str(prim), frozenset(axes)),))
+
+    def divergent_axes(self) -> frozenset:
+        """Union of the control stack's divergent axes."""
+        out = frozenset()
+        for _prim, axes in self.control:
+            out |= axes
+        return out
 
 
 def shard_map_axis_sizes(eqn) -> dict:
@@ -164,6 +190,14 @@ class Lattice:
         """Join a warm-pass output carry into the input carry; the
         default keeps the original (no fixpoint)."""
         return orig
+
+    def divergent_axes(self, eqn, ins, ctx) -> frozenset:
+        """Mesh axes across which this ``cond``/``while`` equation's
+        predicate can DIFFER between ranks, in this lattice's view —
+        the walk pushes the union onto :attr:`MeshCtx.control` for the
+        body traversal. The default (every abstract engine that does
+        not model rank distinctness) declares none."""
+        return frozenset()
 
     # ---- scan / shard_map structure ----------------------------------
 
@@ -300,12 +334,23 @@ class _Walk:
             n_body = params.get("body_nconsts", 0)
             self._warm_carries(subs[0], body_cols, eqn, ctx,
                                carry_at=n_body, n_carry=None)
-            return self._run_sub(subs[0], body_cols, eqn, ctx)
+            # divergence must be judged on the WARMED carries: a
+            # predicate that only becomes rank-divergent through the
+            # loop carry (per-rank early exit) is invisible on the
+            # initial values. The warm pass itself is silent, so no
+            # visitor misses the control context.
+            warmed_ins = [
+                list(ins_cols[k][:n_cond + n_body])
+                + list(body_cols[k][n_body:])
+                for k in range(len(self.lattices))]
+            sub_ctx = self._control_ctx(eqn, warmed_ins, ctx)
+            return self._run_sub(subs[0], body_cols, eqn, sub_ctx)
 
         if prim == "cond":
             branches = closed_jaxprs_in(params.get("branches", ()))
             if not branches:
                 return None
+            ctx = self._control_ctx(eqn, ins_cols, ctx)
             pred_less = [col[1:] for col in ins_cols]
             # concrete-replay lattices can name the branch that will
             # actually run; walking (and joining) the untaken branch
@@ -344,6 +389,17 @@ class _Walk:
                     for k, lat in enumerate(self.lattices)]
 
         return None
+
+    def _control_ctx(self, eqn, ins_cols, ctx):
+        """Push a divergent-control entry for a cond/while body when any
+        participating lattice declares the predicate rank-divergent
+        (no-op context otherwise — the common case costs one call)."""
+        axes = frozenset()
+        for k, lat in enumerate(self.lattices):
+            axes |= lat.divergent_axes(eqn, ins_cols[k], ctx)
+        if not axes:
+            return ctx
+        return ctx.control_child(eqn.primitive.name, axes)
 
     def _warm_carries(self, sub, cols, eqn, ctx, carry_at, n_carry,
                       restack_from=None):
@@ -387,6 +443,20 @@ class _Walk:
                 fixed.append(lat.fix_out(aval, o, restack=restack))
             fixed_cols.append(tuple(fixed))
         return fixed_cols
+
+
+def run_lattice_silent(lattice, closed_or_jaxpr, in_vals, ctx):
+    """Run ONE lattice over a (closed) jaxpr with no visitors and
+    return its abstract outputs — the hook a lattice's own
+    :meth:`Lattice.divergent_axes` uses to evaluate a while-loop's
+    ``cond_jaxpr`` (which the main walk never enters: only the body
+    carries values forward)."""
+    jaxpr = jaxpr_of(closed_or_jaxpr)
+    cols = [list(in_vals[:len(jaxpr.invars)])]
+    cols[0] += [None] * (len(jaxpr.invars) - len(cols[0]))
+    (outs,) = _Walk([lattice], [None]).run(
+        jaxpr, consts_of(closed_or_jaxpr), cols, ctx)
+    return outs
 
 
 def interpret_lattices(closed, runs, axis_sizes=None):

@@ -20,6 +20,7 @@ from apex_tpu.analysis.sharding_checks import (
     SHARDING_CHECKS,
     analyze_sharding,
 )
+from apex_tpu.analysis.spmd_checks import SPMD_CHECKS, analyze_spmd
 
 TARGETS = {}
 
@@ -44,7 +45,11 @@ TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 # Check ids that require running the tracing targets (the CLI runs the
 # full target suite when any of these is requested).
 TRACING_CHECKS = (tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
-                  + tuple(SHARDING_CHECKS))
+                  + tuple(SHARDING_CHECKS) + tuple(SPMD_CHECKS))
+
+# Per-target collective/host-effect counts from the last analyze_spmd
+# run of each spmd target (the analysis/spmd_* gauge family).
+SPMD_STATS = {}
 
 
 def target(name, allow=()):
@@ -969,6 +974,412 @@ SHARDING_TARGETS = (
 )
 
 
+# --------------------------------------------- rank-consistency targets
+# (ISSUE 14): the real grad-sync/pipeline/optimizer schedules run
+# through the spmd rank-consistency checks — collectives under
+# rank-divergent control, out_specs claiming replication the program
+# does not establish, uncoordinated RNG, unordered host effects between
+# collectives. Trace-only, CPU backend, like everything above.
+
+
+def _analyze_spmd_target(name, fn, *args, **kw):
+    stats = SPMD_STATS.setdefault(name, {})
+    return analyze_spmd(fn, *args, name=name, stats_out=stats, **kw)
+
+
+@target("spmd_ddp_sync_gradients")
+def _spmd_ddp_sync_gradients():
+    """The per-leaf + flat-bucket DDP grad sync (sync_gradients /
+    sync_gradients_flat): grads born per-rank from the dp-sharded
+    batch, psum-reduced, stored through P() out_specs — the exact
+    replication contract rank-divergent-update audits. Drop a psum and
+    tier-1 fails here."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.distributed import (
+        sync_gradients,
+        sync_gradients_flat,
+    )
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        grads_of = _ddp_grad_model()
+
+        def step(x):
+            g = grads_of(x)
+            flat = sync_gradients_flat(g, axis_name="dp")
+            plain = sync_gradients(g, axis_name="dp",
+                                   gradient_predivide_factor=2.0)
+            return jax.tree_util.tree_map(jnp.add, flat, plain)
+
+        fn = jax.shard_map(step, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs={"w": P(), "b": P()},
+                           check_vma=False)
+        return _analyze_spmd_target(
+            "spmd_ddp_sync_gradients", fn,
+            jnp.zeros((8 * sizes.get("dp", 1), 256), jnp.float32),
+            axis_sizes=sizes)
+    finally:
+        _release_mesh(owned)
+
+
+@target("spmd_ddp_overlap_bucket_step")
+def _spmd_ddp_overlap_bucket_step():
+    """The barrier-chained overlapped bucket allreduce (ISSUE 11's
+    engine): the optimization_barrier issue chain must not launder
+    distinctness or anchor-free host effects into the schedule."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        grads_of = _ddp_grad_model()
+
+        def step(x):
+            return sync_gradients_overlapped(
+                grads_of(x), axis_name="dp", bucket_cap_mb=0.1,
+                gradient_predivide_factor=2.0)
+
+        fn = jax.shard_map(step, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs={"w": P(), "b": P()},
+                           check_vma=False)
+        return _analyze_spmd_target(
+            "spmd_ddp_overlap_bucket_step", fn,
+            jnp.zeros((8 * sizes.get("dp", 1), 256), jnp.float32),
+            axis_sizes=sizes)
+    finally:
+        _release_mesh(owned)
+
+
+@target("spmd_fleet_probe_grad_sync")
+def _spmd_fleet_probe_grad_sync():
+    """The overlapped grad sync with the PR 11 fleet barrier-wait probe
+    ARMED: its io_callback enter marker is barrier-tied into the
+    collective operand and its exit callback is fed the reduced result,
+    so unordered-host-effect must hold the probe's own call sites at 0
+    — the acceptance clause ISSUE 14 names."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.observability.fleet import probe
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh, sizes, owned = _owned_mesh()
+    was = probe._ENABLED
+    probe.enable()
+    try:
+        grads_of = _ddp_grad_model()
+
+        def step(x):
+            return sync_gradients_overlapped(
+                grads_of(x), axis_name="dp", bucket_cap_mb=0.1)
+
+        fn = jax.shard_map(step, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs={"w": P(), "b": P()},
+                           check_vma=False)
+        findings = _analyze_spmd_target(
+            "spmd_fleet_probe_grad_sync", fn,
+            jnp.zeros((8 * sizes.get("dp", 1), 256), jnp.float32),
+            axis_sizes=sizes)
+        stats = SPMD_STATS["spmd_fleet_probe_grad_sync"]
+        if not stats.get("host_effects"):
+            # the probe silently tracing to nothing would hollow the
+            # acceptance contract out — same loud-failure rule as a
+            # typo'd target name
+            raise RuntimeError(
+                "fleet probe did not emit host callbacks into the "
+                "traced grad sync — is probe.enable() broken?")
+        return findings
+    finally:
+        probe._ENABLED = was
+        _release_mesh(owned)
+
+
+@target("spmd_zero1_fused_adam_step")
+def _spmd_zero1_fused_adam_step():
+    """ZeRO-1 scatter/gather: params must exit replicated (the
+    all_gather), per-rank mu/nu shards must exit through P('dp')
+    out_specs — a rank-indexed dynamic_slice feeding state is only
+    legal because the out_names declare the dim-0 sharding."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.zero import Zero1FusedAdam
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.bfloat16),
+                  "b": jnp.zeros((256,), jnp.bfloat16)}
+        opt = Zero1FusedAdam(lr=1e-3, weight_decay=0.01, axis_name="dp",
+                             num_shards=dp, bucket_cap_mb=0.1)
+        state = opt.init(params)
+        grads_of = _ddp_grad_model()
+
+        def step(x, state, params):
+            return opt.step(grads_of(x), state, params)
+
+        state_specs = opt.state_specs(params)
+        fn = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("dp"), state_specs, {"w": P(), "b": P()}),
+            out_specs=({"w": P(), "b": P()}, state_specs),
+            check_vma=False)
+        return _analyze_spmd_target(
+            "spmd_zero1_fused_adam_step", fn,
+            jnp.zeros((8 * dp, 256), jnp.float32), state, params,
+            axis_sizes=sizes)
+    finally:
+        _release_mesh(owned)
+
+
+@target("spmd_pp_1f1b_microbatch_step")
+def _spmd_pp_1f1b_microbatch_step():
+    """The 1F1B pipeline train step: scan-carried ppermutes keep the
+    activations pp-distinct, the last-stage loss select is rank-origin
+    data — and the loss psum + P('pp') grad out_specs must account for
+    every one of those axes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+
+    world = _world()
+    pp = 4 if world % 4 == 0 and world >= 4 else (
+        2 if world % 2 == 0 else 1)
+    mesh, sizes, owned = _owned_mesh(pipeline_model_parallel_size_=pp)
+    try:
+        dim, m_count, mb = 8, 4, 2
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        params = {"w": jnp.zeros((pp, dim, dim)),
+                  "b": jnp.zeros((pp, dim))}
+        x = jnp.zeros((m_count, mb, dim))
+        tgt = jnp.zeros((m_count, mb, dim))
+
+        def step(params, x, tgt):
+            local = jax.tree_util.tree_map(lambda p: p[0], params)
+            loss, grads = \
+                forward_backward_pipelining_without_interleaving(
+                    stage_fn, loss_fn, local, x, tgt,
+                    forward_only=False, axis_name="pp")
+            return loss, jax.tree_util.tree_map(
+                lambda g: g[None], grads)
+
+        fn = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P("pp"), P(), P()),
+                           out_specs=(P(), P("pp")))
+        return _analyze_spmd_target(
+            "spmd_pp_1f1b_microbatch_step", fn, params, x, tgt,
+            axis_sizes=sizes)
+    finally:
+        _release_mesh(owned)
+
+
+@target("spmd_llama_o4_step")
+def _spmd_llama_o4_step():
+    """The llama O4 train step (ISSUE 13's fp8 tier over the 3D mesh),
+    mirroring examples/llama_train.py --opt-level O4: pipelined
+    forward, vocab-parallel CE, fp8 delayed scaling pmax'd over every
+    axis, dp-pmean'd grads — the largest real schedule in the gate.
+    The fp8 state and loss exit through P() out_specs, so a missing
+    reduce anywhere in that chain is a rank-divergent-update here."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.amp import Fp8DelayedScaler
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pipelined_forward,
+    )
+    from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+    from apex_tpu.transformer.tensor_parallel.mappings import _to_varying
+
+    import numpy as np
+
+    world = _world()
+    if world >= 8:
+        pp, dp, tp = 2, 2, 2
+    elif world >= 4:
+        pp, dp, tp = 1, 2, 2
+    else:
+        pp, dp, tp = 1, 1, max(world, 1)
+    n_dev = pp * dp * tp
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]).reshape(pp, dp, tp),
+                ("pp", "dp", "tp"))
+    sizes = {"pp": pp, "dp": dp, "tp": tp}
+    sp = tp > 1
+    M, mb, s = 2, 2, 16
+    cfg = llama.tiny(num_layers=max(pp, 1), num_heads=2 * tp,
+                     num_kv_heads=tp, hidden_size=32 * tp,
+                     intermediate_size=64 * tp, vocab_size=128 * tp,
+                     max_seq_len=s)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    stage_params = llama.split_stages(params, pp)
+    io_params = {k: v for k, v in params.items() if k != "layers"}
+    tx = fused_adam(lr=1e-3)
+    fp8 = Fp8DelayedScaler(["lm_head"], history=4)
+
+    def psum(t, ax):
+        return jax.lax.psum(_to_varying(t, ax), ax)
+
+    def pmean(t, ax):
+        return jax.lax.pmean(_to_varying(t, ax), ax)
+
+    def train_step(stage_params, io_params, opt_state, tokens, targets,
+                   fp8_state):
+        pp_rank = jax.lax.axis_index("pp")
+        pp_size = jax.lax.axis_size("pp")
+
+        def vary_all(t):
+            for ax in ("pp", "dp", "tp"):
+                t = jax.tree_util.tree_map(
+                    lambda a, ax=ax: _to_varying(a, ax), t)
+            return t
+
+        def total_loss(trees):
+            stage, io = trees
+            stage = jax.tree_util.tree_map(lambda a: a[0], stage)
+            stage, io = vary_all(stage), vary_all(io)
+            x_mb = vary_all(jax.vmap(
+                lambda tok: llama.embed(io, tok, cfg, tp_axis="tp",
+                                        sequence_parallel=sp))(tokens))
+            positions = llama._positions(mb, s, None)
+
+            def stage_fn(sp_params, x):
+                return llama.stage_fn(sp_params, x, cfg, positions,
+                                      tp_axis="tp", cp_axis=None,
+                                      sequence_parallel=sp)
+
+            outs = pipelined_forward(stage_fn, stage, x_mb,
+                                     axis_name="pp", remat=True)
+            o2 = outs.reshape((M * mb,) + outs.shape[2:])
+            t2 = targets.reshape((M * mb,) + targets.shape[2:])
+            logits = llama.lm_head(io, o2, cfg, tp_axis="tp",
+                                   sequence_parallel=sp)
+            losses = jnp.mean(vocab_parallel_cross_entropy(
+                logits, t2, axis_name="tp"))
+            local = jnp.where(pp_rank == pp_size - 1, losses, 0.0)
+            return jax.lax.psum(local, "pp")
+
+        with fp8.step(fp8_state) as fp8_ctx:
+            loss, (g_stage, g_io) = fp8_ctx.value_and_grad(
+                total_loss)((stage_params, io_params))
+        new_fp8 = fp8.update(fp8_state, fp8_ctx,
+                             reduce_axes=("pp", "dp", "tp"))
+        g_stage = jax.tree_util.tree_map(
+            lambda g: pmean(g, "dp"), g_stage)
+        g_io = jax.tree_util.tree_map(
+            lambda g: pmean(psum(g, "pp"), "dp"), g_io)
+        if sp:
+            g_stage = {k: (psum(v, "tp") if k.endswith("norm") else v)
+                       for k, v in g_stage.items()}
+            g_io = {k: (psum(v, "tp") if k == "final_norm" else v)
+                    for k, v in g_io.items()}
+        grads = {"stage": g_stage, "io": g_io}
+        updates, opt_state = tx.update(
+            grads, opt_state, {"stage": stage_params, "io": io_params})
+        new_stage = jax.tree_util.tree_map(
+            jnp.add, stage_params, updates["stage"])
+        new_io = jax.tree_util.tree_map(
+            jnp.add, io_params, updates["io"])
+        loss = jax.lax.pmean(jax.lax.pmean(loss, "dp"), "tp")
+        return new_stage, new_io, opt_state, new_fp8, loss
+
+    from apex_tpu.optimizers import opt_partition_specs
+
+    lp = llama.param_specs(cfg)["layers"]
+    io_specs = {"embed": P("tp", None), "final_norm": P(),
+                "lm_head": P(None, "tp")}
+    stage_specs = {k: P("pp", *lp[k]) for k in lp}
+    with mesh:
+        opt_state = tx.init({"stage": stage_params, "io": io_params})
+        opt_specs = opt_partition_specs(
+            tx, {"stage": stage_params, "io": io_params},
+            {"stage": stage_specs, "io": io_specs})
+        fp8_state0 = fp8.init()
+        fp8_specs = jax.tree_util.tree_map(lambda _: P(), fp8_state0)
+        fn = jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(stage_specs, io_specs, opt_specs,
+                      P(None, "dp", None), P(None, "dp", None),
+                      fp8_specs),
+            out_specs=(stage_specs, io_specs, opt_specs, fp8_specs,
+                       P()),
+            check_vma=False)
+        tokens = jnp.zeros((M, mb * dp, s), jnp.int32)
+        return _analyze_spmd_target(
+            "spmd_llama_o4_step", fn, stage_params, io_params,
+            opt_state, tokens, tokens, fp8_state0, axis_sizes=sizes)
+
+
+@target("spmd_simple_distributed")
+def _spmd_simple_distributed():
+    """examples/simple_distributed.py's own train step (the satellite:
+    the example now does its DDP reduction explicitly under
+    check_rep=False, and THIS target is what keeps that pmean in
+    place — remove it and tier-1 fails as a rank-divergent-update)."""
+    import os
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from examples.simple_distributed import make_train_step
+
+    from apex_tpu.optimizers import fused_adam
+
+    world = _world()
+    mesh = Mesh(np.asarray(jax.devices()[:world]), ("data",))
+    sizes = {"data": world}
+    tx = fused_adam(lr=1e-2)
+    w = jnp.zeros((16, 1))
+    opt_state = tx.init(w)
+    x = jnp.zeros((8 * world, 16))
+    y = jnp.zeros((8 * world, 1))
+    fn = jax.shard_map(
+        make_train_step(tx), mesh=mesh,
+        in_specs=(P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+    return _analyze_spmd_target(
+        "spmd_simple_distributed", fn, w, opt_state, x, y,
+        axis_sizes=sizes)
+
+
+SPMD_TARGETS = (
+    "spmd_ddp_sync_gradients", "spmd_ddp_overlap_bucket_step",
+    "spmd_fleet_probe_grad_sync", "spmd_zero1_fused_adam_step",
+    "spmd_pp_1f1b_microbatch_step", "spmd_llama_o4_step",
+    "spmd_simple_distributed",
+)
+
+
 def run_targets(names=None, extra_allow=None, timings=None):
     """Run the registered targets; returns (findings, errors) where
     errors maps target name -> repr of an exception that kept the target
@@ -1059,5 +1470,36 @@ def run_sharding_findings(registry=None, names=None):
             dict(SHARDING_STATS.get(name, {})),
         )
     report_to_registry(results, registry=registry)
+    stats = {name: s for name, (_, s) in results.items()}
+    return findings, errors, stats
+
+
+def run_spmd_findings(registry=None, names=None):
+    """Run only the rank-consistency targets and publish finding counts
+    + per-target collective/host-effect counts to the observability
+    registry (``analysis/spmd_*`` family) — the hook bench.py reports
+    through. Returns (findings, errors, stats)."""
+    from apex_tpu.analysis.spmd_checks import (
+        SPMD_CHECKS as _SP,
+        report_to_registry as _report,
+    )
+
+    wanted = tuple(names) if names is not None else SPMD_TARGETS
+    unknown = set(wanted) - set(TARGETS)
+    if unknown:
+        raise ValueError(
+            f"unknown spmd target(s) {sorted(unknown)}; valid: "
+            f"{sorted(SPMD_TARGETS)}")
+    findings, errors = run_targets(set(wanted))
+    findings = [f for f in findings if f.check in _SP]
+    results = {}
+    for name in wanted:
+        if name in errors:
+            continue
+        results[name] = (
+            [f for f in findings if f.symbol == name],
+            dict(SPMD_STATS.get(name, {})),
+        )
+    _report(results, registry=registry)
     stats = {name: s for name, (_, s) in results.items()}
     return findings, errors, stats

@@ -1224,6 +1224,25 @@ def worker():
     except Exception as e:  # same contract as the precision hook
         extras["sharding_findings_error"] = repr(e)[:120]
 
+    # rank-consistency verdict (ISSUE 14): the SPMD checks over the
+    # real grad-sync/pipeline/O4 schedules — counts land in the
+    # analysis/spmd_* metric family and the JSON line, so a perf number
+    # always ships with its fleet-safety lint status
+    try:
+        from apex_tpu.analysis import run_spmd_findings
+
+        spfindings, sperrors, spstats = run_spmd_findings(registry=reg)
+        extras["spmd_findings"] = len(spfindings)
+        extras["spmd_targets"] = {
+            name: {"collectives": int(s.get("collectives", 0)),
+                   "host_effects": int(s.get("host_effects", 0))}
+            for name, s in sorted(spstats.items())}
+        if sperrors:
+            extras["spmd_target_errors"] = dict(sorted(
+                sperrors.items()))
+    except Exception as e:  # same contract as the precision hook
+        extras["spmd_findings_error"] = repr(e)[:120]
+
     # fp8-vs-bf16 matmul race (ISSUE 13): the O4 tier's perf evidence —
     # CPU emulation here, real MXU numbers on the next relay window
     try:

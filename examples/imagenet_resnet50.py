@@ -217,7 +217,6 @@ def main():
     from apex_tpu.checkpoint import CheckpointManager
     from apex_tpu.models import resnet
     from apex_tpu.optimizers import fused_sgd
-    from apex_tpu.parallel import sync_autodiff_gradients
 
     n_dev = args.devices
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("data",))
@@ -287,8 +286,20 @@ def main():
             return scaler.scale_loss(loss, sstate), (loss, mut["batch_stats"])
 
         grads, (loss, new_stats) = jax.grad(loss_fn, has_aux=True)(master)
-        # DDP allreduce; vma-aware so custom_vjp leaves sync too
-        grads = sync_autodiff_gradients(grads, axis_name="data")
+        # DDP allreduce: with check_rep=False (jax 0.4.37's replication
+        # checker rejects these out_specs, and disabling it also
+        # disables the auto-psum/vma repair the old
+        # sync_autodiff_gradients path relied on) EVERY grad leaf
+        # arrives per-rank local — reduce them all explicitly
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "data"), grads)
+        if args.no_sync_bn:
+            # non-sync BN computes per-shard running stats, but the P()
+            # out_specs store ONE tree — average them at the storage
+            # boundary (sync_bn already psums inside the layer, so its
+            # stats are identical across ranks and skip this)
+            new_stats = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, "data"), new_stats)
         updates, opt_state, sstate, overflow = amp.scaled_update(
             tx, scaler, grads, opt_state, master, sstate)
         master = optax.apply_updates(master, updates)
@@ -303,15 +314,20 @@ def main():
         return (jax.lax.psum(c1, "data"), jax.lax.psum(c5, "data"))
 
     stats_specs = jax.tree_util.tree_map(lambda _: P(), batch_stats)
+    # check_rep=False: 0.4.37's replication checker cannot statically
+    # infer these P() out_specs (the numerics are kept honest by the
+    # explicit pmean above — sync_bn already psums its statistics)
     step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(P(), P(), P(), stats_specs, P("data"), P("data")),
         out_specs=(P(), P(), P(), stats_specs, P(), P()),
+        check_rep=False,
     ))
     evalf = jax.jit(shard_map(
         eval_step, mesh=mesh,
         in_specs=(P(), stats_specs, P("data"), P("data")),
         out_specs=(P(), P()),
+        check_rep=False,
     ))
 
     # ------------------------------------------------------ resume / ckpt

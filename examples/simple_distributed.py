@@ -4,10 +4,19 @@ ref examples/simple/distributed/distributed_data_parallel.py.
 The reference launches one process per GPU (`torch.distributed.launch`),
 wraps a 10-step linear model in apex DDP, and checks grads are synced. On
 TPU the devices live in one process: the same model runs under ``shard_map``
-over a 'data' mesh axis, and DDP is a ``pmean`` of the gradients inside the
-jitted step. The script verifies the synced gradient equals the gradient of
-the global batch computed on one device — the invariant the reference's
-multi-process test asserts.
+over a 'data' mesh axis, and DDP is an explicit ``pmean`` of the per-rank
+gradients inside the jitted step. The script verifies the synced gradient
+equals the gradient of the global batch computed on one device — the
+invariant the reference's multi-process test asserts.
+
+Numerics note (jax 0.4.37 at HEAD): the container's shard_map replication
+checker rejects ``out_specs=P()`` it cannot statically infer, and with
+``check_rep=False`` the transpose no longer auto-psums grads of
+replicated params — they arrive per-rank LOCAL. The step therefore does
+the DDP reduction explicitly (``lax.pmean`` over 'data'), which is also
+what makes it checkable: the step is a registered
+``apex_tpu.analysis`` spmd-checks target (``spmd_simple_distributed``),
+so dropping the pmean fails tier-1 as a ``rank-divergent-update``.
 """
 
 from __future__ import annotations
@@ -15,6 +24,31 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def local_loss(w, x, y):
+    return jnp.mean((x @ w - y) ** 2)
+
+
+def make_train_step(tx):
+    """The shard_map body (module-level so the analysis target can
+    trace exactly what the script runs): explicit psum-mean DDP over
+    'data', fused-adam update, replicated outputs."""
+
+    def train_step(w, opt_state, x, y):
+        # w is replicated (in_specs P()); with check_rep=False the
+        # shard_map transpose does NOT auto-psum its grads, so each
+        # rank holds the grad of its local shard — reduce explicitly.
+        # pmean of per-shard mean-grads == the global-batch mean grad
+        # (equal shard sizes), apex DDP's gradient_average=True.
+        grads = jax.grad(local_loss)(w, x, y)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "data"), grads)
+        updates, opt_state = tx.update(grads, opt_state, w)
+        return w + updates, opt_state, jax.lax.pmean(
+            local_loss(w, x, y), "data"), grads
+
+    return train_step
 
 
 def main():
@@ -30,7 +64,6 @@ def main():
         from jax.experimental.shard_map import shard_map
 
     from apex_tpu.optimizers import fused_adam
-    from apex_tpu.parallel import average_reduced
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
@@ -38,27 +71,15 @@ def main():
     x = jax.random.normal(jax.random.PRNGKey(0), (64, 16))
     y = x @ jnp.full((16, 1), 0.5) + 0.1
 
-    def local_loss(w, x, y):
-        return jnp.mean((x @ w - y) ** 2)
-
     tx = fused_adam(lr=1e-2)
     opt_state = tx.init(w)
-
-    def train_step(w, opt_state, x, y):
-        # w is replicated (in_specs P()), so jax's shard_map transpose
-        # already psums the local grads over 'data' — the DDP allreduce
-        # itself. average_reduced turns the sum into the global-batch mean
-        # (apex DDP's gradient_average=True).
-        grads = jax.grad(local_loss)(w, x, y)
-        grads = average_reduced(grads, axis_name="data")
-        updates, opt_state = tx.update(grads, opt_state, w)
-        return w + updates, opt_state, jax.lax.pmean(
-            local_loss(w, x, y), "data"), grads
+    train_step = make_train_step(tx)
 
     step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(P(), P(), P("data"), P("data")),
         out_specs=(P(), P(), P(), P()),
+        check_rep=False,
     ))
 
     # invariant: synced grad == single-device grad of the global batch

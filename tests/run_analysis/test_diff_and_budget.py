@@ -1,0 +1,226 @@
+"""ISSUE 14 satellites: --diff rename robustness (snippet
+fingerprints) and the lint wall-time budget (LINT_TIME_BUDGET_S).
+
+In-process cli.main where possible (a `python -m` subprocess costs ~8s
+of jax import against the tier-1 budget); ONE real subprocess pins the
+rename contract end-to-end including env handling.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_main(args, capsys):
+    from apex_tpu.analysis import cli
+
+    rc = cli.main(list(args))
+    captured = capsys.readouterr()
+    return rc, captured.out, captured.err
+
+
+_BAD_SRC = ("def f(x=[]):\n"
+            "    return x\n")
+
+
+# ------------------------------------------------- --diff vs renames
+
+
+def test_diff_survives_file_rename(tmp_path, capsys):
+    """The satellite's core contract: a stored --json base, the file
+    renamed, nothing else changed -> zero NEW findings."""
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    assert rc == 1
+    dump = json.loads(out)
+    # the dump carries the snippet fingerprint next to each finding
+    assert all(f.get("fingerprint") for f in dump["findings"])
+    base = tmp_path / "base.json"
+    base.write_text(out)
+
+    b = tmp_path / "b.py"
+    a.rename(b)
+    rc, _out, err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(b),
+         "--diff", str(base)], capsys)
+    assert rc == 0, err
+    assert "1 grandfathered" in err
+
+
+def test_diff_rename_plus_new_finding_still_fails(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    assert rc == 1
+    base = tmp_path / "base.json"
+    base.write_text(out)
+    b = tmp_path / "b.py"
+    a.rename(b)
+    # a genuinely NEW finding (different snippet) rides along the move
+    b.write_text(_BAD_SRC + "def g(y={}):\n    return y\n")
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(b),
+         "--diff", str(base)], capsys)
+    assert rc == 1
+    assert "def g" not in out  # rendered finding names the line, not src
+    assert "y={}" in out or "g" in out
+
+
+def test_diff_copy_cannot_ride_the_rename_budget(tmp_path, capsys):
+    """key-matched findings consume their fingerprint slot too: the
+    original file PLUS a copy-pasted duplicate is one new finding, not
+    zero."""
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    base = tmp_path / "base.json"
+    base.write_text(out)
+    copy = tmp_path / "copy.py"
+    copy.write_text(_BAD_SRC)  # identical snippet, new path
+    rc, _out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), str(copy),
+         "--diff", str(base)], capsys)
+    assert rc == 1
+
+
+def test_diff_copy_sorting_before_original_still_fails(tmp_path,
+                                                       capsys):
+    """Review regression: path-keyed matches must resolve BEFORE the
+    fingerprint fallback — a duplicate whose path sorts before the
+    original ('_copy' < 'a') must not steal the rename slot and get
+    silently grandfathered."""
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    base = tmp_path / "base.json"
+    base.write_text(out)
+    copy = tmp_path / "_copy.py"
+    copy.write_text(_BAD_SRC)
+    rc, _out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(copy), str(a),
+         "--diff", str(base)], capsys)
+    assert rc == 1
+
+
+def test_diff_fingerprint_free_base_keeps_old_behavior(tmp_path,
+                                                       capsys):
+    """A pre-fix base dump (no fingerprint fields) must behave exactly
+    as before: a rename reads as NEW findings."""
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    rc, out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    dump = json.loads(out)
+    for f in dump["findings"]:
+        f.pop("fingerprint", None)
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(dump))
+    b = tmp_path / "b.py"
+    a.rename(b)
+    rc, _out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(b),
+         "--diff", str(base)], capsys)
+    assert rc == 1
+
+
+@pytest.mark.slow
+def test_diff_rename_subprocess_end_to_end(tmp_path):
+    """One real `python -m apex_tpu.analysis` round trip (the ISSUE
+    names a subprocess test): dump on the base, rename, --diff clean."""
+    a = tmp_path / "a.py"
+    a.write_text(_BAD_SRC)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--no-jaxpr",
+         "--root", str(tmp_path), str(a), "--json"],
+        capture_output=True, text=True, env=env, cwd=repo)
+    assert out.returncode == 1, out.stderr
+    base = tmp_path / "base.json"
+    base.write_text(out.stdout)
+    a.rename(tmp_path / "b.py")
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--no-jaxpr",
+         "--root", str(tmp_path), str(tmp_path / "b.py"),
+         "--diff", str(base)],
+        capture_output=True, text=True, env=env, cwd=repo)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+
+
+# -------------------------------------------------- wall-time budget
+
+
+def _budget_env(monkeypatch, value):
+    if value is None:
+        monkeypatch.delenv("LINT_TIME_BUDGET_S", raising=False)
+    else:
+        monkeypatch.setenv("LINT_TIME_BUDGET_S", value)
+
+
+def test_budget_exceeded_fails_loud(tmp_path, capsys, monkeypatch):
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\n")
+    _budget_env(monkeypatch, "0.000001")
+    rc, _out, err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a)], capsys)
+    assert rc == 2
+    assert "LINT TIME BUDGET EXCEEDED" in err
+    assert "LINT_TIME_BUDGET_S" in err
+
+
+def test_budget_generous_default_passes(tmp_path, capsys, monkeypatch):
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\n")
+    _budget_env(monkeypatch, None)
+    rc, _out, err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a)], capsys)
+    assert rc == 0, err
+
+
+def test_budget_disabled_by_nonpositive(tmp_path, capsys, monkeypatch):
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\n")
+    _budget_env(monkeypatch, "-1")
+    rc, _out, _err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a)], capsys)
+    assert rc == 0
+
+
+def test_budget_malformed_value_is_loud(tmp_path, capsys, monkeypatch):
+    """A typo'd budget must fail, not silently fall back — it would
+    never fire again."""
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\n")
+    _budget_env(monkeypatch, "fast")
+    rc, _out, err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a)], capsys)
+    assert rc == 2
+    assert "not a number" in err
+
+
+def test_budget_exceeded_even_when_findings_clean(tmp_path, capsys,
+                                                  monkeypatch):
+    """The budget is an independent gate: exit 2 (infrastructure), not
+    1 (findings), and it fires on a finding-free run."""
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\n")
+    _budget_env(monkeypatch, "0.000001")
+    rc, out, err = _run_main(
+        ["--no-jaxpr", "--root", str(tmp_path), str(a), "--json"],
+        capsys)
+    assert rc == 2
+    assert json.loads(out)["findings"] == []

@@ -13,6 +13,11 @@
 #
 # Extra args are forwarded to `python -m apex_tpu.analysis` (which
 # ignores --baseline when --write-baseline is given).
+#
+# Wall-time budget (ISSUE 14 satellite): the CLI fails (exit 2, LOUD)
+# when the summed engine wall time exceeds LINT_TIME_BUDGET_S (default
+# 180s; <= 0 disables) — the growing engine stack must not silently rot
+# tier-1 runtime. The per-engine breakdown is printed on every run.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
