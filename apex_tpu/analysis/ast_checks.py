@@ -94,6 +94,23 @@ lived. Checks:
                       it shipped with) — route geometry through
                       ``apex_tpu.tuning``.
 
+- ``raw-memory-introspection``
+                      a direct ``jax.live_arrays()`` /
+                      ``jax.profiler.device_memory_profile()`` /
+                      ``.memory_stats()`` call in ``apex_tpu/`` or
+                      ``examples/`` outside the memory observability
+                      package and ``ops/pallas_config.py``: the live
+                      walk is a host-side sweep of every buffer (and
+                      ``get_backend()`` forces backend init from a
+                      telemetry read) — ad-hoc calls in a step loop
+                      serialize the pipeline exactly like the
+                      per-tensor isnan pulls the numerics tier retired,
+                      and their numbers bypass the watermark/top-k
+                      accounting the OOM forensics depend on. Route
+                      through ``apex_tpu.observability.memory``
+                      (``MemoryMonitor`` decimated snapshots,
+                      ``device_memory_stats``); ``pallas_config`` owns
+                      the ``bytes_limit`` budget read.
 - ``nondeterministic-collective-order``
                       a ``for`` loop over an unordered iterable (set
                       literal/comprehension, ``set()``/``frozenset()``
@@ -123,7 +140,8 @@ AST_CHECKS = ("sync-timing", "host-in-jit", "rng-in-jit",
               "swallowed-exception-in-step-loop",
               "hardcoded-tile-size", "unclosed-span",
               "host-isnan-in-step-loop", "rank-unsafe-artifact-path",
-              "raw-fp8-cast", "nondeterministic-collective-order")
+              "raw-fp8-cast", "nondeterministic-collective-order",
+              "raw-memory-introspection")
 
 # Modules whose job is the corrected sync itself.
 _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
@@ -221,6 +239,37 @@ _WRITE_MODES = {"w", "a", "wb", "ab", "w+", "a+", "wt", "at", "x",
 def _rank_unsafe_applies(path: str) -> bool:
     norm = path.replace("\\", "/")
     if _RANK_PATH_EXEMPT_PREFIX in norm:
+        return False
+    return _swallowed_exc_applies(path)
+
+
+# raw-memory-introspection (ISSUE 15): direct memory-introspection
+# calls anywhere but the sanctioned owners — the memory observability
+# package (MemoryMonitor's decimated snapshots, the compiled-stats
+# capture) and ops/pallas_config.py (the bytes_limit budget read).
+_MEMORY_INTROSPECT_EXEMPT_PREFIX = "apex_tpu/observability/memory/"
+_MEMORY_INTROSPECT_ALLOW_FILES = {"apex_tpu/ops/pallas_config.py"}
+
+#: function names that ARE memory introspection when they resolve into
+#: jax (live_arrays / profiler.device_memory_profile).
+_MEMORY_INTROSPECT_JAX_NAMES = frozenset({
+    "live_arrays", "device_memory_profile",
+})
+
+#: PJRT-object methods matched by ATTRIBUTE name (their receivers —
+#: `jax.devices()[0]`, a stashed `client` — break the dotted chain, so
+#: jax-root resolution can never see them).
+_MEMORY_INTROSPECT_ATTRS = frozenset({
+    "memory_stats", "live_executables",
+})
+
+
+def _memory_introspect_applies(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if _MEMORY_INTROSPECT_EXEMPT_PREFIX in norm:
+        return False
+    tail = _apex_tail(path)
+    if tail is not None and tail in _MEMORY_INTROSPECT_ALLOW_FILES:
         return False
     return _swallowed_exc_applies(path)
 
@@ -785,9 +834,45 @@ class _Visitor(ast.NodeVisitor):
             f"the amp Fp8DelayedScaler's delayed scales; only "
             f"ops/precision.py and amp/ may cast to fp8")
 
+    def _check_memory_introspection(self, node, chain, tail):
+        # matched on the attribute, not the chain: the common shapes —
+        # `jax.devices()[0].memory_stats()`, `client.live_executables()`
+        # — have subscripted/opaque receivers that break the
+        # dotted-name chain
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MEMORY_INTROSPECT_ATTRS:
+            self._emit(
+                "raw-memory-introspection", "error", node.lineno,
+                f"direct '.{node.func.attr}()' read: the PJRT "
+                f"allocator/executable surface belongs to the memory "
+                f"observability tier — use apex_tpu.observability."
+                f"memory (device_memory_stats, the compiled-stats "
+                f"capture; snapshots, watermarks and gauges ride "
+                f"along) or pallas_config.device_hbm_bytes for the "
+                f"budget; only those modules may read it directly")
+            return
+        if tail in _MEMORY_INTROSPECT_JAX_NAMES and chain:
+            res = self._resolve(chain)
+            if res and res[0] == "jax":
+                self._emit(
+                    "raw-memory-introspection", "error", node.lineno,
+                    f"direct '{'.'.join(chain)}(...)' call: the live-"
+                    f"buffer walk sweeps every array on host (and "
+                    f"forces backend init through get_backend) — in a "
+                    f"step loop it serializes the pipeline like the "
+                    f"per-tensor isnan pulls the numerics tier "
+                    f"retired. Route through apex_tpu.observability."
+                    f"memory (MemoryMonitor's decimated snapshots / "
+                    f"memory_snapshot), which also keeps the "
+                    f"watermark + top-k accounting OOM forensics "
+                    f"depend on")
+
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
         tail = chain[-1] if chain else None
+
+        if "raw-memory-introspection" in self.checks:
+            self._check_memory_introspection(node, chain, tail)
 
         if "rank-unsafe-artifact-path" in self.checks and \
                 isinstance(node.func, ast.Name) and \
@@ -938,6 +1023,10 @@ def lint_source(source: str, relpath: str, checks=None, abspath=None):
     # (parallel/, runtime/, distributed/)
     if not _nondet_order_applies(abspath or relpath):
         checks = checks - {"nondeterministic-collective-order"}
+    # raw-memory-introspection: the memory observability package and
+    # pallas_config are the sanctioned introspection owners
+    if not _memory_introspect_applies(abspath or relpath):
+        checks = checks - {"raw-memory-introspection"}
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:

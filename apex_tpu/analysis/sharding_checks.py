@@ -43,6 +43,8 @@ JSONL (``analysis/sharding_*`` family).
 
 from __future__ import annotations
 
+import contextlib
+
 from apex_tpu.analysis.findings import Finding
 from apex_tpu.analysis.sharding_flow import (
     COLLECTIVE_PRIMS,
@@ -62,6 +64,25 @@ SHARDING_CHECKS = (
 
 # Inputs below this size are never worth sharding (replicated-large).
 DEFAULT_REPLICATED_THRESHOLD = 1 << 20  # 1 MiB
+
+# When armed (a dict), analyze_sharding records each traced target's
+# (fn, example_args, donate_argnums, closed jaxpr) under its name — the
+# hook the memory-calibration tier (ISSUE 15) uses to AOT-compile the
+# exact program the HBM estimator priced. Arm via capture_traces().
+_TRACE_CAPTURE = None
+
+
+@contextlib.contextmanager
+def capture_traces(sink: dict):
+    """Arm the per-target trace capture for the duration of the block;
+    ``sink`` receives one entry per analyze_sharding call (keyed by
+    target name). Re-entrant: the previous sink is restored on exit."""
+    global _TRACE_CAPTURE
+    prev, _TRACE_CAPTURE = _TRACE_CAPTURE, sink
+    try:
+        yield sink
+    finally:
+        _TRACE_CAPTURE = prev
 
 
 def _fmt_spec(spec):
@@ -365,6 +386,16 @@ def analyze_sharding(fn, *example_args, name=None, in_specs=None,
     _validate_checks(checks)
 
     closed = jax.make_jaxpr(fn)(*example_args)
+
+    if _TRACE_CAPTURE is not None:
+        # ISSUE 15: the memory-calibration tier re-compiles the SAME
+        # (fn, args) triple the estimator modeled, so measured-vs-
+        # modeled compares like for like. Captured before the specs are
+        # flattened so the sink owns everything a jit needs.
+        _TRACE_CAPTURE[name] = {
+            "fn": fn, "example_args": example_args,
+            "donate_argnums": donate_argnums, "closed": closed,
+        }
 
     flat_specs = _flatten_specs(example_args, in_specs)
     in_vals = []

@@ -27,6 +27,11 @@ The single layer the whole stack reports through:
   suffixing, the grad-sync barrier-wait probe + straggler detector,
   on-device desync fingerprints, and the fleet merge readers
   (metrics shards and flight records);
+- :mod:`~apex_tpu.observability.memory` — the memory tier (ISSUE 15):
+  live HBM telemetry (decimated live-bytes snapshots, watermarks,
+  top-k buffers), per-executable compiled memory stats off the
+  recompile listener, measured-vs-modeled HBM calibration of the
+  sharding cost model, and OOM forensics (``memrec_*.json``);
 - ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
   summary CLI (also ``tools/metrics_report.py``); ``... trace <run>``
   exports a span dump or xplane capture as Perfetto JSON;
@@ -77,6 +82,13 @@ from apex_tpu.observability.numerics import (  # noqa: F401
     HealthMonitor,
     StatsCollector,
 )
+from apex_tpu.observability import memory  # noqa: F401
+from apex_tpu.observability.memory import (  # noqa: F401
+    CompiledMemoryCapture,
+    MemoryMonitor,
+    calibrate_targets,
+    install_compiled_capture,
+)
 from apex_tpu.observability import fleet  # noqa: F401
 from apex_tpu.observability.fleet import (  # noqa: F401
     DesyncDetector,
@@ -106,6 +118,8 @@ __all__ = [
     "StepReporter", "STEP_RECORD_FIELDS", "peak_flops",
     "transformer_step_flops",
     "numerics", "StatsCollector", "AmaxHistory", "HealthMonitor",
+    "memory", "MemoryMonitor", "CompiledMemoryCapture",
+    "install_compiled_capture", "calibrate_targets",
     "fleet", "DesyncDetector", "StragglerDetector", "merge_fleet",
     "merge_flight_records", "process_identity", "rank_path",
 ]

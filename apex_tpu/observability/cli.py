@@ -1,4 +1,4 @@
-"""``python -m apex_tpu.observability {report,trace,fleet} ...``
+"""``python -m apex_tpu.observability {report,trace,fleet,memory} ...``
 
 ``report <metrics.jsonl> [...]`` summarizes one or more metrics JSONL
 dumps (bench.py's ``BENCH_METRICS.jsonl``, a training run's step log):
@@ -28,7 +28,17 @@ p50/p99, cross-rank skew, the merge-time straggler pass, and every
   ``flightrec_*`` shards in DIR into the fleet post-mortem naming the
   stuck rank (written as ``fleetrec_*.json`` unless ``--no-write``).
 
-Exit codes: 0 ok, 1 no records found, 2 bad usage / unreadable file.
+``memory [--out SNAP.json] [--targets a,b,...]`` (ISSUE 15) takes one
+live memory snapshot on the current backend and runs the
+measured-vs-modeled HBM calibration over the sharding-flow targets:
+device kind + the live ``bytes_limit``, live-buffer totals and top
+buffers, and the per-target ``ratio`` table. ``--out`` persists the
+snapshot as JSON — on a real TPU relay window this is the cost
+model's on-silicon ground truth (``tools/relay_hunter.py`` runs it
+per clean window as ``TPU_MEMORY_r0X.json``).
+
+Exit codes: 0 ok, 1 no records found (memory: no calibration ratio
+landed), 2 bad usage / unreadable file.
 """
 
 from __future__ import annotations
@@ -272,6 +282,58 @@ def fleet_main(args) -> int:
     return 0
 
 
+def memory_main(args) -> int:
+    from apex_tpu.observability import memory as memory_mod
+
+    names = None
+    if args.targets:
+        names = tuple(t for t in args.targets.split(",") if t)
+    try:
+        calibration = memory_mod.calibrate_targets(names=names)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    snapshot = memory_mod.memory_snapshot(top_k=args.top_k)
+    import jax
+
+    dev = jax.devices()[0]
+    payload = {
+        "kind": "apex_tpu.memory_snapshot",
+        "schema_version": memory_mod.MEMORY_SCHEMA_VERSION,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "snapshot": snapshot,
+        "calibration": calibration,
+    }
+    try:
+        from apex_tpu.ops.pallas_config import device_hbm_bytes
+
+        payload["device_hbm_bytes"] = device_hbm_bytes()
+    except Exception as e:  # noqa: BLE001 — a malformed live limit is
+        # loud in the payload, not fatal to the snapshot
+        payload["device_hbm_bytes_error"] = repr(e)[:200]
+    if args.out:
+        try:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=1, default=repr)
+        except OSError as e:
+            print(f"cannot write {args.out}: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {args.out}")
+    else:
+        print(json.dumps(payload, indent=2, default=repr))
+    ratios = [row for row in calibration.values() if "ratio" in row]
+    for name, row in sorted(calibration.items()):
+        if "ratio" in row:
+            print(f"  {name}: ratio {row['ratio']:.3f}x "
+                  f"(modeled {row['modeled_bytes']} B, measured "
+                  f"{row['measured_bytes']} B)", file=sys.stderr)
+        else:
+            print(f"  {name}: SKIPPED {row['error']}", file=sys.stderr)
+    return 0 if ratios else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.observability",
@@ -312,11 +374,24 @@ def main(argv=None) -> int:
     fp.add_argument("--no-write", action="store_true",
                     help="with --flight: don't persist the merged "
                          "fleetrec_*.json")
+    mp = sub.add_parser(
+        "memory", help="live memory snapshot + measured-vs-modeled "
+                       "HBM calibration (ISSUE 15)")
+    mp.add_argument("--out", default="",
+                    help="persist the snapshot JSON here (default: "
+                         "print to stdout)")
+    mp.add_argument("--targets", default="",
+                    help="comma-separated sharding-flow target names "
+                         "(default: the calibration set)")
+    mp.add_argument("--top-k", type=int, default=5,
+                    help="how many largest buffers the snapshot keeps")
     args = ap.parse_args(argv)
     if args.cmd == "trace":
         return trace_main(args)
     if args.cmd == "fleet":
         return fleet_main(args)
+    if args.cmd == "memory":
+        return memory_main(args)
 
     records = []
     for path in args.paths:

@@ -1,0 +1,343 @@
+"""Live HBM telemetry (ISSUE 15 tentpole piece 1).
+
+Every telemetry tier so far sees time, numerics and the fleet — none
+sees memory, even though the planner prunes layouts on a *modeled*
+peak-HBM number and an OOM kills a run with nothing but an opaque
+RESOURCE_EXHAUSTED string. :class:`MemoryMonitor` is the live side of
+the story:
+
+- **decimated live-bytes snapshots** — one walk over
+  ``jax.live_arrays()`` (per-device local-byte attribution: a sharded
+  array charges each holding device its shard) plus
+  ``device.memory_stats()`` where the backend reports it (TPU/GPU:
+  ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``; the CPU
+  backend reports nothing and the allocator fields stay None). Like
+  the numerics :class:`~apex_tpu.observability.numerics.StatsCollector`
+  the walk runs only every ``every`` steps — off-cadence steps cost
+  nothing — and bench.py derives the cadence that keeps the amortized
+  cost under 2% of step time;
+- **per-step high-watermark** — the largest live-byte total any
+  snapshot saw (plus the allocator's own ``peak_bytes_in_use`` where
+  available), the number the modeled ``hbm-budget`` check is
+  calibrated against;
+- **top-k largest buffers** — shape/dtype/bytes of the arrays that
+  dominate the live set, the first thing an OOM post-mortem needs;
+- the ``memory/*`` gauge family + ``memory_snapshot`` events in the
+  registry, and :meth:`MemoryMonitor.dump` — an identity-stamped,
+  ``rank_path``-suffixed JSON artifact (two fleet ranks handed the
+  same path can never clobber each other).
+
+This module (with the rest of the memory package and
+``ops/pallas_config.py``) is the sanctioned home of raw memory
+introspection: direct ``jax.live_arrays()`` / ``.memory_stats()`` /
+``device_memory_profile()`` calls anywhere else in the library are
+linted (``raw-memory-introspection``) — ad-hoc host pulls of the live
+set in a step loop serialize the pipeline exactly like the per-tensor
+isnan anti-pattern the numerics tier retired.
+
+jax imports are lazy and every read is guarded: a telemetry pull must
+never take down (or force backend init in) the run it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+__all__ = [
+    "MEMORY_SCHEMA_VERSION", "live_buffer_records", "device_live_bytes",
+    "device_memory_stats", "memory_snapshot", "MemoryMonitor",
+    "active_monitor", "set_active_monitor", "flight_section",
+]
+
+MEMORY_SCHEMA_VERSION = 1
+
+#: the allocator fields a PJRT backend may report (TPU reports all
+#: three; CPU reports none) — pulled verbatim into snapshots.
+MEMORY_STATS_FIELDS = ("bytes_in_use", "peak_bytes_in_use",
+                       "bytes_limit", "largest_alloc_size")
+
+
+def live_buffer_records(top_k: Optional[int] = None) -> list:
+    """One record per live (addressable, non-deleted) jax array,
+    largest first: ``{shape, dtype, nbytes, devices, per_device}``.
+    ``nbytes`` is the array's PHYSICAL footprint on this process —
+    summed over addressable shards, so a replicated array counts one
+    copy per holding device — and ``per_device`` attributes it.
+    ``top_k`` truncates after sorting. The walk is host-only — no
+    device sync, no dispatch."""
+    import jax
+
+    records = []
+    skipped = 0
+    for arr in jax.live_arrays():
+        try:
+            per_device = _per_device_bytes(arr)
+            shape = tuple(int(d) for d in arr.shape)
+            dtype = str(arr.dtype)
+        except Exception:  # noqa: BLE001 — a deleted/donated buffer
+            # can race the walk; telemetry counts + skips it rather
+            # than raise
+            skipped += 1
+            continue
+        records.append({"shape": list(shape), "dtype": dtype,
+                        "nbytes": sum(per_device.values()),
+                        "devices": sorted(per_device),
+                        "per_device": per_device})
+    if skipped:
+        from apex_tpu.observability.registry import get_registry
+        get_registry().counter("memory/buffers_skipped").inc(skipped)
+    records.sort(key=lambda r: (-r["nbytes"], r["dtype"],
+                                tuple(r["shape"])))
+    return records[:top_k] if top_k is not None else records
+
+
+def _per_device_bytes(arr) -> dict:
+    """{device_str: physical bytes} for one array, from its
+    addressable shards — a REPLICATED array charges every holding
+    device the full buffer (each physically holds a copy; the logical
+    ``nbytes`` alone would undercount by the replication factor
+    exactly the params/optimizer state that dominate the live set).
+    Falls back to an even split of the logical size when the shard
+    surface is unavailable."""
+    try:
+        out: dict = {}
+        for shard in arr.addressable_shards:
+            dev = str(shard.device)
+            out[dev] = out.get(dev, 0) + int(shard.data.nbytes)
+        if out:
+            return out
+    except Exception:  # noqa: BLE001 — optional surface; fall through
+        pass
+    devs = sorted(str(d) for d in arr.devices()) or ["<unknown>"]
+    share = int(arr.nbytes) // len(devs)
+    return {d: share for d in devs}
+
+
+def device_live_bytes(records: Optional[list] = None) -> dict:
+    """Per-device PHYSICAL live bytes: ``{device_str: bytes}``. Pass
+    the ``live_buffer_records()`` list already in hand to avoid a
+    second walk (``memory_snapshot`` does — the snapshot cost the <2%
+    decimation budget is derived from must be ONE walk)."""
+    if records is None:
+        records = live_buffer_records()
+    per_device: dict = {}
+    for rec in records:
+        for dev, nbytes in rec["per_device"].items():
+            per_device[dev] = per_device.get(dev, 0) + nbytes
+    return {d: int(b) for d, b in sorted(per_device.items())}
+
+
+def device_memory_stats(device=None) -> dict:
+    """The PJRT allocator's own view of ``device`` (default: the first
+    device), restricted to :data:`MEMORY_STATS_FIELDS`. Empty on
+    backends that report nothing (CPU) — absence, never fabricated
+    zeros."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — optional PJRT surface
+        stats = None
+    if not stats:
+        return {}
+    return {k: int(stats[k]) for k in MEMORY_STATS_FIELDS
+            if isinstance(stats.get(k), (int, float))}
+
+
+def memory_snapshot(top_k: int = 5) -> dict:
+    """One full live-memory snapshot (the :class:`MemoryMonitor` unit
+    of work): physical live-byte totals, per-device attribution, the
+    top-k largest buffers, and the allocator stats where reported.
+    ONE live-array walk end to end — the snapshot cost is what the
+    <2% decimation budget is derived from."""
+    buffers = live_buffer_records()
+    total = sum(r["nbytes"] for r in buffers)
+    return {
+        "live_bytes": int(total),
+        "live_buffers": len(buffers),
+        "per_device": device_live_bytes(buffers),
+        "top": [{k: r[k] for k in ("shape", "dtype", "nbytes")}
+                for r in buffers[:top_k]],
+        "memory_stats": device_memory_stats() or None,
+    }
+
+
+class MemoryMonitor:
+    """Decimated live-HBM driver: ``observe(step)`` takes a snapshot
+    every ``every`` steps, tracks the high-watermark, and publishes the
+    ``memory/*`` family; off-cadence steps cost nothing.
+
+    Publishes per snapshot (all labeled ``source=<name>``):
+
+    - gauges ``memory/live_bytes``, ``memory/live_buffers``,
+      ``memory/watermark_bytes`` (+ ``memory/bytes_in_use`` /
+      ``memory/peak_bytes_in_use`` / ``memory/bytes_limit`` when the
+      backend reports them);
+    - timer ``memory/snapshot_pass`` — the walk's own cost, so the
+      <2% overhead budget is measured, not assumed;
+    - counter ``memory/snapshots``; event ``memory_snapshot`` with the
+      top-k buffers.
+
+    ``last`` keeps the most recent summary — the ``memory`` block
+    ``StepReporter.step(..., memory=monitor.last)`` attaches. The
+    constructed monitor becomes the process's *active* monitor
+    (:func:`active_monitor`), which is how flight-recorder and OOM
+    dumps find the watermark without a handle.
+    """
+
+    def __init__(self, name: str = "memory", every: int = 16,
+                 registry=None, top_k: int = 5):
+        self.name = name
+        self.every = max(int(every), 1)
+        self.top_k = int(top_k)
+        self._registry = registry
+        self.last: Optional[dict] = None
+        self.watermark_bytes: int = 0
+        self.watermark_step: Optional[int] = None
+        self.snapshots: int = 0
+        set_active_monitor(self)
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from apex_tpu.observability.registry import get_registry
+        return get_registry()
+
+    def observe(self, step: int) -> Optional[dict]:
+        """Take a snapshot when ``step`` is on cadence; returns the
+        summary dict (also kept as ``last``), or None off-cadence."""
+        if step % self.every:
+            return None
+        reg = self._reg()
+        timer = reg.timer("memory/snapshot_pass", source=self.name)
+        timer.start()
+        try:
+            snap = memory_snapshot(top_k=self.top_k)
+        except BaseException:
+            timer.cancel()
+            raise
+        elapsed = timer.stop()
+        snap["step"] = int(step)
+        snap["snapshot_ms"] = round(elapsed * 1e3, 3)
+        if snap["live_bytes"] > self.watermark_bytes:
+            self.watermark_bytes = snap["live_bytes"]
+            self.watermark_step = int(step)
+        snap["watermark_bytes"] = self.watermark_bytes
+        snap["watermark_step"] = self.watermark_step
+        self.snapshots += 1
+        reg.counter("memory/snapshots", source=self.name).inc()
+        reg.gauge("memory/live_bytes", source=self.name).set(
+            snap["live_bytes"])
+        reg.gauge("memory/live_buffers", source=self.name).set(
+            snap["live_buffers"])
+        reg.gauge("memory/watermark_bytes", source=self.name).set(
+            self.watermark_bytes)
+        for key, value in (snap.get("memory_stats") or {}).items():
+            reg.gauge(f"memory/{key}", source=self.name).set(value)
+        reg.event("memory_snapshot", source=self.name, step=int(step),
+                  live_bytes=snap["live_bytes"],
+                  live_buffers=snap["live_buffers"],
+                  watermark_bytes=self.watermark_bytes,
+                  top=snap["top"])
+        self.last = snap
+        return snap
+
+    def summary(self) -> dict:
+        """The compact block flight-recorder / OOM dumps embed:
+        watermark + the latest snapshot (None when no snapshot ran)."""
+        return {
+            "watermark_bytes": self.watermark_bytes,
+            "watermark_step": self.watermark_step,
+            "snapshots": self.snapshots,
+            "last": self.last,
+        }
+
+    def dump(self, path: str) -> str:
+        """Write the monitor's state (a fresh snapshot + watermark +
+        per-executable compiled stats when captured) as one
+        identity-stamped JSON artifact at the ``rank_path``-suffixed
+        variant of ``path``; returns the resolved path."""
+        from apex_tpu.observability.fleet.identity import (
+            identity_fields,
+            rank_path,
+        )
+        from apex_tpu.observability.memory import compiled as compiled_mod
+
+        cap = compiled_mod.current_capture()
+        payload = {
+            "kind": "apex_tpu.memory_record",
+            "schema_version": MEMORY_SCHEMA_VERSION,
+            **identity_fields(),
+            **self.summary(),
+            "snapshot": memory_snapshot(top_k=self.top_k),
+            "compiled": cap.snapshot() if cap is not None else None,
+        }
+        resolved = rank_path(path)
+        with open(resolved, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+        self._reg().event("memory_dump", source=self.name,
+                          path=resolved)
+        return resolved
+
+
+# ---------------------------------------------------- active monitor
+
+_ACTIVE: "MemoryMonitor | None" = None
+
+
+def active_monitor() -> "MemoryMonitor | None":
+    """The most recently constructed :class:`MemoryMonitor` (None when
+    no tier is running one) — the handle-free lookup the flight
+    recorder and OOM forensics use."""
+    return _ACTIVE
+
+
+def set_active_monitor(monitor: "MemoryMonitor | None"):
+    """Swap the process's active monitor; returns the previous one."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, monitor
+    return prev
+
+
+def _backend_ready() -> bool:
+    """True when a jax backend is ALREADY initialized — the guard that
+    keeps a telemetry write from being the thing that forces backend
+    init (``jax.live_arrays()`` goes through ``get_backend()``)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+        return bool(getattr(xb, "_backends", None))
+    except Exception:  # noqa: BLE001 — private surface moved; a
+        # process that imported jax almost certainly initialized it
+        return True
+
+
+def flight_section() -> "dict | None":
+    """The ``memory`` block a flight-recorder / stall dump embeds:
+    current live bytes + the active monitor's watermark and top
+    buffers. Never raises and never forces backend init — returns None
+    when no backend is up or any read fails (a post-mortem must not
+    take down the run it observes)."""
+    if not _backend_ready():
+        return None
+    try:
+        monitor = active_monitor()
+        section = {"live_bytes": None, "live_buffers": None,
+                   "watermark_bytes": None, "top": None}
+        snap = memory_snapshot(
+            top_k=monitor.top_k if monitor is not None else 5)
+        section["live_bytes"] = snap["live_bytes"]
+        section["live_buffers"] = snap["live_buffers"]
+        section["top"] = snap["top"]
+        if snap.get("memory_stats"):
+            section["memory_stats"] = snap["memory_stats"]
+        if monitor is not None:
+            section["watermark_bytes"] = monitor.watermark_bytes
+            section["watermark_step"] = monitor.watermark_step
+        return section
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return None

@@ -1,0 +1,282 @@
+"""OOM forensics (ISSUE 15 tentpole piece 4).
+
+An OOM today kills a run with nothing but an opaque
+``RESOURCE_EXHAUSTED`` string. This module turns that string into a
+structured post-mortem:
+
+- :func:`is_oom_error` — classify an exception as resource
+  exhaustion (the same markers ``bench.py``'s fallback ladder keys on);
+- :func:`parse_resource_exhausted` — pull the numbers out of the
+  message: requested bytes (``... allocate N bytes``, ``Attempting to
+  allocate 1.17G``, the TPU compiler's ``Used X of Y hbm``), the
+  allocator breakdown table (reserved/program/arguments/HLO temp) and
+  the ``Largest program allocations`` entries, all best-effort — a
+  message shape the parser has never seen degrades to
+  ``matched=False``, never a raise;
+- :func:`dump_memrec` — write the ``memrec_*.json`` artifact: the
+  parse, the active :class:`~.hbm.MemoryMonitor`'s watermark + last
+  snapshot, a fresh live-buffer snapshot, the per-executable compiled
+  stats table, every thread's stack (the flight recorder's shared
+  ingredient) and the trailing registry events. Rank + pid + serial in
+  the filename keep concurrent dumps collision-free, exactly like
+  ``flightrec_*``;
+- :func:`oom_forensics` — the one-call driver
+  :class:`~apex_tpu.resilience.ResilientTrainLoop` runs when a step
+  dies OOM-shaped: dump + return the compact verdict (requested bytes,
+  largest live buffer, watermark) that rides every ``rollback`` event
+  and ``TrainAborted.report["memory"]``.
+
+The ``oom`` fault kind in :mod:`apex_tpu.resilience.faults` raises a
+message shaped like the real thing, so this whole path is
+chaos-testable on CPU.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import time
+from typing import Optional
+
+__all__ = [
+    "OOM_MARKERS", "is_oom_error", "parse_resource_exhausted",
+    "dump_memrec", "oom_forensics",
+]
+
+#: substrings that mark an exception as resource exhaustion (matched
+#: against repr(), mirroring bench.py's fallback-ladder classifier).
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+               "Ran out of memory", "OOM")
+
+# "... allocate 1073741824 bytes" (BFC / host allocators)
+_ALLOC_BYTES_RE = re.compile(
+    r"allocat(?:e|ing)\s+([\d,]+)\s*bytes", re.IGNORECASE)
+# "Attempting to allocate 1.17G" / "Used 19.46G of 15.48G hbm"
+_SIZE = r"([\d.]+)\s*([KMGTP]i?)?B?"
+_ALLOC_SIZE_RE = re.compile(
+    r"(?:attempting to allocate|trying to allocate)\s+" + _SIZE,
+    re.IGNORECASE)
+_USED_OF_RE = re.compile(
+    r"Used\s+" + _SIZE + r"\s+of\s+" + _SIZE, re.IGNORECASE)
+_FREE_RE = re.compile(r"([\d.]+)\s*([KMGTP]i?)?B?\s+free",
+                      re.IGNORECASE)
+# the TPU compiler's usage table: "    program          18.93G"
+_BREAKDOWN_RE = re.compile(
+    r"^\s{2,}(reserved|program|arguments|global|scoped|HLO temp|"
+    r"stack)\s+" + _SIZE + r"\s*(?:\(|$)", re.MULTILINE)
+# "  1. Size: 2.50G" entries under "Largest program allocations"
+_LARGEST_RE = re.compile(r"^\s*\d+\.\s+Size:\s+" + _SIZE,
+                         re.MULTILINE)
+_OPERATOR_RE = re.compile(r'Operator:\s*op_name="([^"]*)"')
+
+_SUFFIX = {None: 1, "": 1,
+           "K": 1 << 10, "Ki": 1 << 10, "M": 1 << 20, "Mi": 1 << 20,
+           "G": 1 << 30, "Gi": 1 << 30, "T": 1 << 40, "Ti": 1 << 40,
+           "P": 1 << 50, "Pi": 1 << 50}
+
+# process-wide memrec serial (same collision contract as flightrec_*)
+_DUMP_SEQ = itertools.count()
+
+
+def _to_bytes(num: str, suffix: Optional[str]) -> Optional[int]:
+    try:
+        return int(float(num.replace(",", ""))
+                   * _SUFFIX.get(suffix or "", 1))
+    except (TypeError, ValueError):
+        return None
+
+
+def is_oom_error(exc) -> bool:
+    """True when ``exc`` (an exception or message string) is resource
+    exhaustion — a cheaper rung (smaller batch, rollback) may dodge it;
+    anything else must fail fast."""
+    text = exc if isinstance(exc, str) else repr(exc)
+    return any(marker in text for marker in OOM_MARKERS)
+
+
+def parse_resource_exhausted(text: str) -> dict:
+    """Best-effort structured parse of a RESOURCE_EXHAUSTED message.
+
+    Returns ``{matched, requested_bytes, limit_bytes, free_bytes,
+    breakdown, largest_allocations}`` — unknown fields None/empty, and
+    ``matched`` False when no byte figure parsed at all (the caller
+    still gets the raw message elsewhere)."""
+    text = text or ""
+    requested = None
+    limit = None
+    m = _ALLOC_BYTES_RE.search(text)
+    if m:
+        requested = _to_bytes(m.group(1), None)
+    if requested is None:
+        m = _ALLOC_SIZE_RE.search(text)
+        if m:
+            requested = _to_bytes(m.group(1), m.group(2))
+    m = _USED_OF_RE.search(text)
+    if m:
+        if requested is None:
+            requested = _to_bytes(m.group(1), m.group(2))
+        limit = _to_bytes(m.group(3), m.group(4))
+    free = None
+    m = _FREE_RE.search(text)
+    if m:
+        free = _to_bytes(m.group(1), m.group(2))
+
+    breakdown = {}
+    for m in _BREAKDOWN_RE.finditer(text):
+        nbytes = _to_bytes(m.group(2), m.group(3))
+        if nbytes is not None:
+            breakdown[m.group(1)] = nbytes
+
+    # each size entry's Operator line is searched only in ITS span
+    # (up to the next numbered entry): an entry without one (padding /
+    # unknown allocations) must not shift every later attribution
+    largest = []
+    size_matches = list(_LARGEST_RE.finditer(text))
+    for i, m in enumerate(size_matches):
+        nbytes = _to_bytes(m.group(1), m.group(2))
+        if nbytes is None:
+            continue
+        entry = {"nbytes": nbytes}
+        span_end = (size_matches[i + 1].start()
+                    if i + 1 < len(size_matches) else len(text))
+        op = _OPERATOR_RE.search(text, m.end(), span_end)
+        if op:
+            entry["op_name"] = op.group(1)
+        largest.append(entry)
+
+    return {
+        "matched": requested is not None or bool(breakdown)
+        or bool(largest),
+        "requested_bytes": requested,
+        "limit_bytes": limit,
+        "free_bytes": free,
+        "breakdown": breakdown,
+        "largest_allocations": largest,
+    }
+
+
+def _default_dir() -> str:
+    # the flight recorder owns the artifact-directory policy — a memrec
+    # must land next to the flightrec so one story tells both dumps
+    from apex_tpu.observability.profiling import flight_recorder
+    return flight_recorder._default_dir()
+
+
+def dump_memrec(error=None, *, monitor=None, registry=None,
+                directory: Optional[str] = None,
+                step: Optional[int] = None, kind: str = "oom",
+                max_events: int = 100) -> Optional[str]:
+    """Write the ``memrec_*.json`` OOM post-mortem; returns its path
+    (None when even the write failed — forensics must never take down
+    the run). ``monitor`` defaults to the active
+    :class:`~.hbm.MemoryMonitor`."""
+    from apex_tpu.observability.fleet.identity import (
+        FleetIdentity,
+        identity_fields,
+        process_identity,
+    )
+    from apex_tpu.observability.memory import compiled as compiled_mod
+    from apex_tpu.observability.memory import hbm
+    from apex_tpu.observability.profiling.flight_recorder import (
+        thread_stacks,
+    )
+
+    reg = registry
+    if reg is None:
+        from apex_tpu.observability.registry import get_registry
+        reg = get_registry()
+    if monitor is None:
+        monitor = hbm.active_monitor()
+    try:
+        ident = process_identity()
+    except ValueError:
+        ident = FleetIdentity(0, 1, None)
+    error_text = None if error is None else (
+        error if isinstance(error, str) else repr(error))
+    try:
+        snapshot = hbm.memory_snapshot(
+            top_k=monitor.top_k if monitor is not None else 5)
+    except Exception as e:  # noqa: BLE001 — the backend may be the
+        # thing that just died; the parse + watermark still dump
+        snapshot = {"error": repr(e)[:200]}
+    cap = compiled_mod.current_capture()
+    payload = {
+        "kind": "apex_tpu.memory_record",
+        "schema_version": hbm.MEMORY_SCHEMA_VERSION,
+        **identity_fields(ident),
+        "trigger": kind,
+        "pid": os.getpid(),
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "step": step,
+        "error": None if error_text is None else error_text[:4000],
+        "oom": None if error_text is None
+        else parse_resource_exhausted(error_text),
+        "monitor": monitor.summary() if monitor is not None else None,
+        "snapshot": snapshot,
+        "compiled": cap.snapshot() if cap is not None else None,
+        "thread_stacks": thread_stacks(),
+        "events": (reg.events()[-max_events:] if max_events > 0
+                   else []),
+    }
+    fname = (f"memrec_{time.strftime('%Y%m%d-%H%M%S')}_"
+             f"r{ident.process_index}_{os.getpid()}_"
+             f"{next(_DUMP_SEQ)}_{kind}.json")
+    path = os.path.join(directory or _default_dir(), fname)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+    except OSError as e:
+        reg.counter("memory/memrec_dump_failures").inc()
+        reg.event("memrec_dump_failed", error=repr(e)[:200])
+        return None
+    reg.counter("memory/memrec_dumps").inc()
+    reg.event("memory_record", path=path, trigger=kind, step=step)
+    return path
+
+
+def oom_forensics(error, *, monitor=None, registry=None,
+                  directory: Optional[str] = None,
+                  step: Optional[int] = None) -> dict:
+    """The one-call OOM post-mortem the resilience loop runs: dump a
+    memrec artifact and return the compact verdict dict
+    (``requested_bytes``, ``largest_buffer``, ``live_bytes``,
+    ``watermark_bytes``, ``memrec`` path, the truncated error). Never
+    raises — any failure degrades to fields of the verdict."""
+    from apex_tpu.observability.memory import hbm
+
+    if monitor is None:
+        monitor = hbm.active_monitor()
+    error_text = error if isinstance(error, str) else repr(error)
+    parsed = parse_resource_exhausted(error_text)
+    verdict = {
+        "requested_bytes": parsed.get("requested_bytes"),
+        "limit_bytes": parsed.get("limit_bytes"),
+        "largest_buffer": None,
+        "live_bytes": None,
+        "watermark_bytes": (monitor.watermark_bytes
+                            if monitor is not None else None),
+        "error": error_text[:500],
+        "memrec": None,
+    }
+    try:
+        snap = hbm.memory_snapshot(top_k=1)
+        verdict["live_bytes"] = snap["live_bytes"]
+        if snap["top"]:
+            verdict["largest_buffer"] = snap["top"][0]
+    except Exception:  # noqa: BLE001 — the backend may be down; the
+        # monitor's last snapshot is the fallback attribution
+        if monitor is not None and monitor.last:
+            verdict["live_bytes"] = monitor.last.get("live_bytes")
+            top = monitor.last.get("top") or []
+            verdict["largest_buffer"] = top[0] if top else None
+    try:
+        verdict["memrec"] = dump_memrec(
+            error, monitor=monitor, registry=registry,
+            directory=directory, step=step)
+    except Exception:  # noqa: BLE001 — verdict without artifact is
+        # still a verdict
+        verdict["memrec"] = None
+    return verdict

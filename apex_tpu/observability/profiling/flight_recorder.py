@@ -44,9 +44,24 @@ from typing import Callable, Optional
 
 from apex_tpu.observability.profiling.spans import SpanTracer, get_tracer
 
-__all__ = ["FlightRecorder", "DEFAULT_STALL_FACTOR"]
+__all__ = ["FlightRecorder", "DEFAULT_STALL_FACTOR", "thread_stacks"]
 
 DEFAULT_STALL_FACTOR = 3.0
+
+
+def thread_stacks() -> dict:
+    """Every thread's Python stack, keyed by thread id — the shared
+    post-mortem ingredient of flight records and the memory tier's
+    ``memrec_*.json`` OOM artifacts (ISSUE 15)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for tid, frame in sys._current_frames().items():
+        stacks[str(tid)] = {
+            "thread": names.get(tid, f"thread-{tid}"),
+            "stack": [line.rstrip("\n") for line in
+                      traceback.format_stack(frame)],
+        }
+    return stacks
 
 # process-wide dump serial: two recorders (or two dumps of one) in the
 # same second share a timestamp AND a pid — the serial is what keeps
@@ -56,6 +71,17 @@ _DUMP_SEQ = itertools.count()
 
 def _default_dir() -> str:
     return os.environ.get("APEX_TPU_FLIGHT_DIR", os.getcwd())
+
+
+def _memory_section():
+    """The memory tier's flight block, degraded to None on any
+    failure (the import is lazy so a trimmed install without the
+    memory package still dumps)."""
+    try:
+        from apex_tpu.observability.memory import hbm
+        return hbm.flight_section()
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return None
 
 
 class FlightRecorder:
@@ -268,15 +294,7 @@ class FlightRecorder:
     # ----------------------------------------------------------- dump
 
     def _thread_stacks(self) -> dict:
-        names = {t.ident: t.name for t in threading.enumerate()}
-        stacks = {}
-        for tid, frame in sys._current_frames().items():
-            stacks[str(tid)] = {
-                "thread": names.get(tid, f"thread-{tid}"),
-                "stack": [line.rstrip("\n") for line in
-                          traceback.format_stack(frame)],
-            }
-        return stacks
+        return thread_stacks()
 
     def dump(self, reason: str = "manual",
              kind: str = "manual") -> Optional[str]:
@@ -328,6 +346,12 @@ class FlightRecorder:
             "thread_names": {str(k): v
                              for k, v in tracer.thread_names().items()},
             "thread_stacks": self._thread_stacks(),
+            # ISSUE 15: a stall dump and an OOM memrec tell one
+            # coherent story — current live bytes, watermark and the
+            # top buffers ride every flight record (None when no
+            # backend is up or the read fails; the section must never
+            # take down the dump)
+            "memory": _memory_section(),
             "events": (reg.events()[-self.max_events:]
                        if self.max_events > 0 else []),
             "counters": {

@@ -65,6 +65,14 @@ class RecompileListener:
         self.compiles_by_fn = collections.Counter()
         self.totals = collections.Counter()      # event name -> count
         self.seconds = collections.defaultdict(float)
+        # compile observers (ISSUE 15): callbacks cb(kind, name) fired
+        # on "compile" (a per-function jax_log_compiles record — name
+        # known, executable not yet built) and "backend_compile" (the
+        # monitoring duration event AFTER the executable exists — the
+        # moment the memory tier sweeps live_executables for its
+        # per-executable memory_analysis view)
+        self._observers: list = []
+        self.observer_errors = 0
 
     # ---- feed: jax.monitoring duration events
 
@@ -76,6 +84,8 @@ class RecompileListener:
             self.seconds[name] += secs
         if self.registry is not None and name == _EV_COMPILE:
             self.registry.histogram("jax/backend_compile_secs").observe(secs)
+        if name == _EV_COMPILE:
+            self._notify("backend_compile", None)
 
     # ---- feed: jax_log_compiles records
 
@@ -84,6 +94,31 @@ class RecompileListener:
             self.compiles_by_fn[fn_name] += 1
         if self.registry is not None:
             self.registry.counter("jax/compiles", fn=fn_name).inc()
+        self._notify("compile", fn_name)
+
+    # ---- compile observers (ISSUE 15)
+
+    def add_observer(self, cb) -> None:
+        """Register ``cb(kind, name)`` to fire on compile activity
+        (``kind`` in {"compile", "backend_compile"}); idempotent."""
+        with self._lock:
+            if cb not in self._observers:
+                self._observers.append(cb)
+
+    def remove_observer(self, cb) -> None:
+        with self._lock:
+            if cb in self._observers:
+                self._observers.remove(cb)
+
+    def _notify(self, kind: str, name) -> None:
+        with self._lock:
+            observers = list(self._observers)
+        for cb in observers:
+            try:
+                cb(kind, name)
+            except Exception:  # noqa: BLE001 — an observer must never
+                # break the compile (or the logging filter) it rides
+                self.observer_errors += 1
 
     # ---- read side
 

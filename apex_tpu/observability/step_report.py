@@ -63,14 +63,17 @@ def transformer_step_flops(n_params: int, n_layers: int, hidden: int,
 # drift silently from its consumers. ``numerics`` is the ISSUE 9 block:
 # the latest decimated stats-pass summary
 # (``numerics.StatsCollector.last`` — finite flag, non-finite paths,
-# top-k amax tensors, stats-pass cost). ``process_index`` /
-# ``process_count`` are the ISSUE 12 fleet stamp (0 / 1 for a solo
-# process), so a merged fleet view can attribute every step record to
-# its rank; ``run_id`` rides as an extra field only when set.
+# top-k amax tensors, stats-pass cost). ``memory`` is the ISSUE 15
+# block: the latest decimated live-HBM snapshot
+# (``memory.MemoryMonitor.last`` — live bytes, watermark, top-k
+# buffers, snapshot cost). ``process_index`` / ``process_count`` are
+# the ISSUE 12 fleet stamp (0 / 1 for a solo process), so a merged
+# fleet view can attribute every step record to its rank; ``run_id``
+# rides as an extra field only when set.
 STEP_RECORD_FIELDS = (
     "reporter", "step", "step_time_ms", "loss", "loss_scale",
     "overflow_count", "grad_norm", "tokens_per_sec", "tflops_per_sec",
-    "mfu", "numerics", "process_index", "process_count",
+    "mfu", "numerics", "memory", "process_index", "process_count",
 )
 
 
@@ -122,7 +125,8 @@ class StepReporter:
         self.records: list = []
 
     def step(self, step_time_s: float, *, loss=None, scaler_state=None,
-             grad_norm=None, numerics=None, **extra) -> dict:
+             grad_norm=None, numerics=None, memory=None,
+             **extra) -> dict:
         """Record one step; returns the record's ``fields`` dict.
 
         ``scaler_state``: an ``amp.scaler.LossScaleState`` (or anything
@@ -132,6 +136,9 @@ class StepReporter:
         (``numerics.StatsCollector.last``) — attach it every step; the
         collector only refreshes it on its decimated cadence, so the
         record says which stats window it was inside.
+        ``memory``: the latest live-HBM snapshot dict
+        (``memory.MemoryMonitor.last``) — same decimated-cadence
+        contract as ``numerics``.
         """
         from apex_tpu.observability.fleet.identity import (
             process_identity,
@@ -154,6 +161,7 @@ class StepReporter:
             "tflops_per_sec": None,
             "mfu": None,
             "numerics": dict(numerics) if numerics else None,
+            "memory": dict(memory) if memory else None,
             "process_index": ident.process_index,
             "process_count": ident.process_count,
         }

@@ -295,7 +295,17 @@ def device_hbm_bytes(kind: "str | None" = None) -> int:
     string; default: the current backend's first device, or the
     conservative 16 GiB planning figure off-TPU). The
     ``APEX_TPU_HBM_BYTES`` env var overrides everything — the knob the
-    hbm-budget analysis check documents in docs/runtime.md."""
+    hbm-budget analysis check documents in docs/runtime.md.
+
+    ISSUE 15 satellite: when no ``kind`` is asked for and the live
+    device is a real TPU whose PJRT allocator reports a
+    ``bytes_limit``, that measured limit wins over the static
+    per-generation table — the hbm-budget check and the planner's
+    pruning then use what the attached chip actually has (which the
+    table can only approximate: a slice of HBM is held back for system
+    use). Precedence: env override > live ``bytes_limit`` > static
+    table. A malformed live value is a loud error, not a silent
+    fallback — a bad limit would mis-prune every candidate layout."""
     env = os.environ.get("APEX_TPU_HBM_BYTES")
     if env:
         try:
@@ -308,12 +318,41 @@ def device_hbm_bytes(kind: "str | None" = None) -> int:
         dev = jax.devices()[0]
         if dev.platform != "tpu":
             return _HBM_BYTES_DEFAULT
+        limit = _live_hbm_limit(dev)
+        if limit is not None:
+            return limit
         kind = dev.device_kind
     kind = kind.lower()
     for key, nbytes in _HBM_BYTES:
         if key in kind:
             return nbytes
     return _HBM_BYTES_DEFAULT
+
+
+def _live_hbm_limit(dev) -> "int | None":
+    """``dev.memory_stats()["bytes_limit"]`` as a validated int, or
+    None when the backend doesn't report one (stats are an optional
+    PJRT surface). Malformed values raise — see device_hbm_bytes."""
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — optional PJRT surface
+        return None
+    if not stats or "bytes_limit" not in stats:
+        return None
+    limit = stats["bytes_limit"]
+    try:
+        limit = int(limit)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"device.memory_stats()['bytes_limit'] is not an integer "
+            f"byte count: {limit!r} — refusing to guess an HBM budget "
+            f"(set APEX_TPU_HBM_BYTES to override)")
+    if limit <= 0:
+        raise ValueError(
+            f"device.memory_stats()['bytes_limit'] is non-positive "
+            f"({limit}) — refusing to use it as the HBM budget "
+            f"(set APEX_TPU_HBM_BYTES to override)")
+    return limit
 
 
 def out_struct(shape, dtype, *like):

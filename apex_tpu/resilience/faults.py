@@ -14,7 +14,14 @@ kinds a preemptible TPU fleet actually produces:
                    wedged collective / tunnel lease): the loop sleeps
                    ``stall_s`` inside the step, which is what the
                    observability flight recorder's watchdog exists to
-                   catch (docs/profiling.md).
+                   catch (docs/profiling.md);
+- ``oom``          a step that dies RESOURCE_EXHAUSTED (an allocation
+                   the device cannot satisfy): raises
+                   :class:`InjectedOom`, whose message is shaped like
+                   the real XLA string so the memory tier's OOM
+                   forensics (parse + ``memrec_*.json`` + the
+                   ``TrainAborted.report["memory"]`` verdict) are
+                   chaos-testable on CPU (docs/observability.md).
 
 Faults fire at fixed steps (``kind@7``) or at seeded per-step draws
 (``kind~0.05``); both are fully deterministic in (seed, kind, step), so
@@ -40,12 +47,12 @@ from typing import Optional
 
 __all__ = [
     "KINDS", "FaultInjected", "TornWrite", "DiskFull",
-    "TransientStepError", "FaultPlan", "corrupt_tree",
+    "TransientStepError", "InjectedOom", "FaultPlan", "corrupt_tree",
     "inject_checkpoint_failures",
 ]
 
 KINDS = ("preempt", "ckpt_torn", "ckpt_enospc", "step_exc", "nan_grads",
-         "stall")
+         "stall", "oom")
 
 
 class FaultInjected(Exception):
@@ -67,6 +74,26 @@ class DiskFull(FaultInjected, OSError):
 
 class TransientStepError(FaultInjected):
     """A transient train-step failure (retryable by design)."""
+
+
+#: the simulated allocation an injected OOM asks for (1 GiB — big
+#: enough to be unmistakably an allocation, stable for chaos asserts).
+INJECTED_OOM_BYTES = 1 << 30
+
+
+class InjectedOom(FaultInjected, RuntimeError):
+    """A simulated RESOURCE_EXHAUSTED step death. The message mirrors
+    the real XLA string so ``observability.memory.oom``'s classifier
+    AND parser see it exactly like the production failure."""
+
+    def __init__(self, step: int,
+                 requested_bytes: int = INJECTED_OOM_BYTES):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to "
+            f"allocate {int(requested_bytes)} bytes. "
+            f"(injected oom fault at step {step})")
+        self.step = step
+        self.requested_bytes = int(requested_bytes)
 
 
 class FaultPlan:

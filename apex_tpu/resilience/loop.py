@@ -42,6 +42,16 @@ verdict rides every ``rollback`` event and the
 ``nan_grads``/``corrupt_tree`` chaos fault — or the real thing — is
 fully attributable from the abort artifact alone.
 
+ISSUE 15: a step that dies RESOURCE_EXHAUSTED-shaped (the ``oom``
+chaos fault, or the real thing) additionally runs the memory tier's
+OOM forensics — a ``memrec_*.json`` post-mortem lands next to the
+checkpoints and the compact verdict (requested bytes, largest live
+buffer, watermark) rides every ``rollback`` event and the
+:class:`TrainAborted` report's ``memory`` block
+(:func:`apex_tpu.observability.memory.oom_forensics`;
+``memory_forensics=False`` opts out, ``memory_monitor=`` pins the
+watermark source).
+
 ISSUE 12: pass ``desync_detector=`` (an
 :class:`apex_tpu.observability.fleet.DesyncDetector`) and return the
 step's gathered fingerprint matrix
@@ -152,6 +162,12 @@ class ResilientTrainLoop:
         module docstring). Post-mortem-path only — costs nothing on
         healthy steps; disable for step functions whose replay side
         effects are unacceptable.
+    memory_monitor: an
+        :class:`apex_tpu.observability.MemoryMonitor` whose watermark
+        feeds the OOM verdict (default: the process's active monitor);
+        ``memory_forensics=False`` disables the OOM post-mortem path
+        entirely. Like the NaN probe, this costs nothing on healthy
+        steps.
     auto_resume: restore from ``directory`` on :meth:`run` entry.
     exit_on_preempt: call ``sys.exit(EXIT_PREEMPTED)`` instead of
         raising :class:`Preempted` (process-boundary behavior for real
@@ -169,7 +185,8 @@ class ResilientTrainLoop:
                  exit_on_preempt: bool = False, on_resume=None,
                  registry=None, stall_s: float = 2.0,
                  flight_recorder=None, numerics_provenance: bool = True,
-                 desync_detector=None):
+                 desync_detector=None, memory_monitor=None,
+                 memory_forensics: bool = True):
         self.step_fn = step_fn
         self.directory = directory
         self.save_every = save_every
@@ -188,6 +205,8 @@ class ResilientTrainLoop:
         self.flight_recorder = flight_recorder
         self.numerics_provenance = numerics_provenance
         self.desync_detector = desync_detector
+        self.memory_monitor = memory_monitor
+        self.memory_forensics = memory_forensics
         self.manager = (ckpt.CheckpointManager(
             directory, max_to_keep=max_to_keep, async_save=async_save)
             if directory else None)
@@ -356,6 +375,15 @@ class ResilientTrainLoop:
                                     kind="step_exc").inc()
                         raise faults_mod.TransientStepError(
                             f"injected transient failure at step {_step}")
+                    if plan is not None and plan.should_fire("oom",
+                                                             _step):
+                        # a RESOURCE_EXHAUSTED-shaped death (ISSUE 15):
+                        # the generic failure rung below classifies it
+                        # and runs the memory forensics, exactly like
+                        # the real thing
+                        reg.counter("resilience/faults_injected",
+                                    kind="oom").inc()
+                        raise faults_mod.InjectedOom(_step)
                     if plan is not None and plan.should_fire("stall",
                                                              _step):
                         # a hung step, not a failed one: the step
@@ -391,8 +419,10 @@ class ResilientTrainLoop:
             except Exception as e:  # noqa: BLE001 — ladder rung 2
                 last_error = e
                 recovery_target = max(recovery_target, step)
+                memory = self._probe_memory(e, step)
                 state, step, rollbacks = self._rollback(
-                    fallback_state, fallback_step, rollbacks, step, e)
+                    fallback_state, fallback_step, rollbacks, step, e,
+                    memory=memory)
                 continue
 
             if plan is not None and plan.should_fire("nan_grads", step):
@@ -500,6 +530,44 @@ class ResilientTrainLoop:
         reg.event("numerics_provenance", step=step, **prov)
         return prov
 
+    def _probe_memory(self, error, step: int):
+        """ISSUE 15: OOM forensics for a RESOURCE_EXHAUSTED-shaped step
+        death — dump a ``memrec_*.json`` post-mortem and return the
+        compact verdict (requested bytes, largest live buffer,
+        watermark). None for non-OOM failures; never raises — the
+        forensics are diagnostics and must not mask the step error."""
+        if not self.memory_forensics:
+            return None
+        # classification FIRST, outside the forensics guard: if the
+        # memory tier itself cannot import or classify, a non-OOM step
+        # death must stay a non-OOM step death — a mislabeled
+        # TrainAborted would send the oncall to the wrong subsystem
+        try:
+            from apex_tpu.observability.memory import (
+                is_oom_error,
+                oom_forensics,
+            )
+        except Exception:  # noqa: BLE001 — trimmed install: no
+            # memory tier, no verdict
+            return None
+        try:
+            if not is_oom_error(error):
+                return None
+        except Exception:  # noqa: BLE001 — cannot classify ⇒ not OOM
+            return None
+        try:
+            verdict = oom_forensics(
+                error, monitor=self.memory_monitor,
+                registry=self._registry, directory=self.directory,
+                step=step)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            verdict = {"error": f"memory forensics failed: {e!r:.200}"}
+        reg = self._reg()
+        reg.counter("memory/oom_probes").inc()
+        reg.event("memory_verdict", step=step, **{
+            k: v for k, v in verdict.items() if k != "error"})
+        return verdict
+
     # ---------------------------------------------------- fleet desync
 
     def _check_desync(self, metrics, step: int):
@@ -526,13 +594,13 @@ class ResilientTrainLoop:
 
     def _rollback(self, fallback_state, fallback_step: int,
                   rollbacks: int, step: int, error, numerics=None,
-                  fleet=None):
+                  fleet=None, memory=None):
         """Rung 2: restore the newest valid checkpoint (or the run's
         starting state) and hand back the replay position. Rung 3:
         past ``max_rollbacks``, abort with the structured report
         (``numerics`` = the probe verdict, ``fleet`` = the desync
-        verdict — both attached to the rollback event and the abort
-        report)."""
+        verdict, ``memory`` = the OOM forensics verdict — all attached
+        to the rollback event and the abort report)."""
         reg = self._reg()
         rollbacks += 1
         reg.counter("resilience/rollbacks").inc()
@@ -547,6 +615,11 @@ class ResilientTrainLoop:
                 k: fleet.get(k) for k in
                 ("rank", "tensor_path", "first_divergent_step",
                  "max_delta")}
+        if memory is not None:
+            event_fields["memory"] = {
+                k: memory.get(k) for k in
+                ("requested_bytes", "largest_buffer",
+                 "watermark_bytes", "memrec")}
         reg.event("rollback", **event_fields)
         if rollbacks > self.max_rollbacks:
             report = {
@@ -566,6 +639,8 @@ class ResilientTrainLoop:
                 report["numerics"] = numerics
             if fleet is not None:
                 report["fleet"] = fleet
+            if memory is not None:
+                report["memory"] = memory
             reg.event("train_aborted", **report)
             raise TrainAborted(report)
         if self.manager is not None:

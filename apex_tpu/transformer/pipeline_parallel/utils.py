@@ -246,11 +246,15 @@ def report_memory(name: str) -> str:
     """ref pipeline_parallel/utils.py report_memory — print device memory
     stats. CUDA's allocated/cached split maps onto the PJRT
     ``memory_stats`` of the local device: bytes in use, peak, and limit
-    (absent on backends that don't report, e.g. the CPU mesh)."""
+    (absent on backends that don't report, e.g. the CPU mesh). Read
+    through the memory observability tier (ISSUE 15) — the raw PJRT
+    surface belongs to apex_tpu.observability.memory."""
     import jax
 
+    from apex_tpu.observability.memory import device_memory_stats
+
     dev = jax.local_devices()[0]
-    stats = dev.memory_stats() or {}
+    stats = device_memory_stats(dev)
     giga = 1024.0 ** 3
     parts = [f"[{name}] memory on {dev.platform}:{dev.id}"]
     for key, label in (("bytes_in_use", "in use"),

@@ -308,6 +308,12 @@ def main():
         # the resilience ladder has to act
         collector = obs.StatsCollector("llama_train", every=8)
         health = obs.HealthMonitor("llama_train")
+        # memory tier (ISSUE 15): a decimated live-HBM snapshot (one
+        # host-side walk of the live buffers every 8 steps) rides the
+        # step record's memory block; the monitor's watermark + top-k
+        # buffers feed the OOM forensics verdict the resilience loop
+        # attaches when a step dies RESOURCE_EXHAUSTED
+        memmon = obs.MemoryMonitor("llama_train", every=8)
         key = jax.random.PRNGKey(1)
         stats = {"first": None, "last": None}
 
@@ -340,7 +346,9 @@ def main():
                 dt = time.perf_counter() - t0
             collector.observe({"stage": new_stage, "io": new_io}, it)
             health.observe(it, loss=loss)
+            memmon.observe(it)
             rec = reporter.step(dt, loss=loss, numerics=collector.last,
+                                memory=memmon.last,
                                 **phases.last_fields())
             if stats["first"] is None:
                 stats["first"] = loss
@@ -391,6 +399,7 @@ def main():
             fault_plan=(resilience.FaultPlan.parse(fault_spec)
                         if fault_spec else None),
             watcher=watcher, auto_resume=args.resume,
+            memory_monitor=memmon,  # OOM forensics read its watermark
             check_state_every=0,  # loss is the health signal; skip the
             # per-step full-state device fetch on the 3D-sharded tree
             exit_on_preempt=True,  # the scheduler-facing contract:

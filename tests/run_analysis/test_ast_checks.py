@@ -1030,3 +1030,127 @@ def dump(directory):
         [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
         root=repo, checks=(_RANK,)) if f.check == _RANK]
     assert not found, "\n".join(f.render() for f in found)
+
+
+# --------------------------------------- raw-memory-introspection
+
+_MEMINTRO = "raw-memory-introspection"
+
+
+def test_memory_introspection_live_arrays_in_loop_flagged():
+    """Seeded regression 1 (ISSUE 15): the ad-hoc live-bytes poll — a
+    jax.live_arrays() sweep inside the step loop, the memory analog of
+    the per-tensor isnan pull."""
+    src = """
+import jax
+
+def train(step_fn, state, n):
+    for it in range(n):
+        state, _ = step_fn(state, it)
+        used = sum(a.nbytes for a in jax.live_arrays())
+"""
+    found = _by_check(lint_source(src, "apex_tpu/train.py",
+                                  abspath="/r/apex_tpu/train.py"),
+                      _MEMINTRO)
+    assert len(found) == 1 and found[0].line == 7
+    assert "observability.memory" in found[0].message
+
+
+def test_memory_introspection_stats_and_profile_flagged():
+    """Seeded regression 2: a direct .memory_stats() read (subscripted
+    device base — no resolvable dotted chain) and a
+    jax.profiler.device_memory_profile() call, each its own finding.
+    from-imports resolve through the module's import map."""
+    src = """
+import jax
+
+def report():
+    stats = jax.devices()[0].memory_stats()
+    prof = jax.profiler.device_memory_profile()
+"""
+    found = _by_check(lint_source(src, "examples/report.py",
+                                  abspath="/r/examples/report.py"),
+                      _MEMINTRO)
+    assert sorted(f.line for f in found) == [5, 6]
+    # .live_executables() on a stashed client: attribute-matched too
+    # (its receiver breaks the dotted chain exactly like memory_stats)
+    src_exec = """
+import jax
+
+def sweep():
+    client = jax.devices()[0].client
+    return client.live_executables()
+"""
+    assert _by_check(lint_source(src_exec, "apex_tpu/runtime/s.py",
+                                 abspath="/r/apex_tpu/runtime/s.py"),
+                     _MEMINTRO)
+    src2 = """
+from jax import live_arrays
+
+def f():
+    return live_arrays()
+"""
+    assert _by_check(lint_source(src2, "examples/f.py",
+                                 abspath="/r/examples/f.py"),
+                     _MEMINTRO)
+
+
+def test_memory_introspection_clean_and_exempt_cases():
+    # a LOCAL helper named live_arrays is not jax's; monitor-routed
+    # reads are the sanctioned shape
+    clean = """
+from apex_tpu.observability.memory import MemoryMonitor, memory_snapshot
+
+def live_arrays():
+    return []
+
+def train(n):
+    mon = MemoryMonitor("t", every=8)
+    for it in range(n):
+        mon.observe(it)
+        xs = live_arrays()
+"""
+    assert not _by_check(lint_source(clean, "apex_tpu/train.py",
+                                     abspath="/r/apex_tpu/train.py"),
+                         _MEMINTRO)
+    flagged = """
+import jax
+
+def walk():
+    stats = jax.devices()[0].memory_stats()
+    return jax.live_arrays()
+"""
+    # the memory package + pallas_config ARE the sanctioned owners
+    assert not _by_check(lint_source(
+        flagged, "apex_tpu/observability/memory/hbm.py",
+        abspath="/r/apex_tpu/observability/memory/hbm.py"), _MEMINTRO)
+    assert not _by_check(lint_source(
+        flagged, "apex_tpu/ops/pallas_config.py",
+        abspath="/r/apex_tpu/ops/pallas_config.py"), _MEMINTRO)
+    # driver code (tools/, bench.py) is out of scope like the other
+    # step-loop checks
+    assert not _by_check(lint_source(flagged, "tools/probe.py",
+                                     abspath="/r/tools/probe.py"),
+                         _MEMINTRO)
+
+
+def test_memory_introspection_suppressible_and_repo_clean():
+    src = """
+import jax
+
+def f():
+    return jax.live_arrays()  # apex-lint: disable=raw-memory-introspection
+"""
+    assert not _by_check(lint_source(src, "apex_tpu/a.py",
+                                     abspath="/r/apex_tpu/a.py"),
+                         _MEMINTRO)
+    import os
+
+    from apex_tpu.analysis.ast_checks import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = [f for f in lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "examples")],
+        root=repo, checks=(_MEMINTRO,)) if f.check == _MEMINTRO]
+    assert not found, "\n".join(f.render() for f in found)

@@ -320,3 +320,50 @@ def test_fp8_family_table_renders(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "amp/fp8_* family" in proc.stdout
     assert "fp8_speedup" in proc.stdout
+
+
+# ------------------------------------------------- memory gates (ISSUE 15)
+
+def _memory_records(watermark=2_000_000, ratio=0.53):
+    return (
+        {"type": "gauge", "name": "memory/watermark_bytes",
+         "labels": {"source": "bench"}, "value": watermark},
+        {"type": "gauge", "name": "memory/hbm_calibration_ratio",
+         "labels": {"target": "moe_dispatch"}, "value": ratio},
+    )
+
+
+def test_compare_watermark_growth_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=_memory_records(watermark=2_000_000))
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=_memory_records(watermark=2_600_000))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION memory/watermark_bytes" in proc.stdout
+    assert "live set grew" in proc.stdout
+    # a looser threshold lets the same growth pass
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.5").returncode == 0
+
+
+def test_compare_calibration_drift_fails_both_directions(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_memory_records(ratio=0.53))
+    up = _dump(tmp_path / "up.jsonl", extra=_memory_records(ratio=0.70))
+    down = _dump(tmp_path / "down.jsonl", extra=_memory_records(ratio=0.40))
+    for cur in (up, down):
+        proc = _run(cur, "--compare", base)
+        assert proc.returncode == 1, proc.stdout
+        assert "REGRESSION memory/hbm_calibration_ratio" in proc.stdout
+        assert "cost model" in proc.stdout
+
+
+def test_compare_stable_memory_passes_and_new_is_info(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_memory_records())
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=_memory_records(watermark=2_050_000, ratio=0.54))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout
+    # metrics only in current are info, never failed on
+    plain = _dump(tmp_path / "plain.jsonl")
+    assert _run(cur, "--compare", plain).returncode == 0

@@ -1,0 +1,120 @@
+"""ISSUE 15 chaos suite: the injected ``oom`` fault kind through
+ResilientTrainLoop — rollback events and the TrainAborted report carry
+the memory verdict (largest buffer + requested bytes), and a
+``memrec_*.json`` post-mortem lands next to the checkpoints."""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.observability import MetricRegistry, memory
+from apex_tpu.observability.memory import hbm
+from apex_tpu.resilience import (
+    FaultPlan,
+    ResilientTrainLoop,
+    TrainAborted,
+)
+from apex_tpu.resilience.faults import INJECTED_OOM_BYTES, InjectedOom
+
+
+@pytest.fixture
+def registry():
+    return MetricRegistry()
+
+
+@pytest.fixture
+def fresh_active_monitor():
+    prev = hbm.set_active_monitor(None)
+    yield
+    hbm.set_active_monitor(prev)
+
+
+def _step_fn(state, step):
+    w = state["w"] * 0.99
+    return {"w": w}, {"loss": float((w * w).mean())}
+
+
+def test_oom_fault_kind_parses_and_roundtrips():
+    plan = FaultPlan.parse("seed=2,oom@3+5")
+    assert plan.spec() == "seed=2,oom@3+5"
+    assert plan.scheduled("oom", 3) and not plan.scheduled("oom", 4)
+
+
+def test_injected_oom_is_oom_shaped():
+    exc = InjectedOom(7)
+    assert memory.is_oom_error(exc)
+    parsed = memory.parse_resource_exhausted(str(exc))
+    assert parsed["requested_bytes"] == INJECTED_OOM_BYTES
+
+
+def test_single_oom_rolls_back_and_recovers(tmp_path, registry,
+                                            fresh_active_monitor):
+    """One OOM at step 2: the fault is spent once per process, so the
+    replay succeeds — the run completes, and the rollback event
+    carries the memory verdict."""
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path), save_every=2,
+        fault_plan=FaultPlan.parse("oom@2"), registry=registry)
+    final = loop.run({"w": jnp.ones((16, 16))}, 6)
+    assert final["w"].shape == (16, 16)
+    rollbacks = [e for e in registry.events() if e["name"] == "rollback"]
+    assert len(rollbacks) == 1
+    mem = rollbacks[0]["fields"]["memory"]
+    assert mem["requested_bytes"] == INJECTED_OOM_BYTES
+    assert mem["memrec"] and os.path.exists(mem["memrec"])
+    assert registry.counter("resilience/faults_injected",
+                            kind="oom").value == 1
+
+
+def test_repeated_oom_aborts_with_memory_verdict(
+        tmp_path, registry, fresh_active_monitor):
+    """The acceptance path: a chaos-injected OOM storm exhausts the
+    rollback budget and TrainAborted.report["memory"] names the
+    largest live buffer and the requested bytes, with the memrec
+    artifact on disk."""
+    big = jnp.ones((64, 64), jnp.float32)  # the nameable largest buffer
+    monitor = memory.MemoryMonitor("chaos", every=1, registry=registry)
+    monitor.observe(0)
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path), save_every=2,
+        fault_plan=FaultPlan.parse("oom@2+3+4"), max_rollbacks=1,
+        memory_monitor=monitor, registry=registry)
+    with pytest.raises(TrainAborted) as exc_info:
+        loop.run({"w": jnp.ones((16, 16))}, 8)
+    report = exc_info.value.report
+    mem = report["memory"]
+    assert mem["requested_bytes"] == INJECTED_OOM_BYTES
+    assert mem["largest_buffer"]["nbytes"] >= big.nbytes
+    assert mem["watermark_bytes"] == monitor.watermark_bytes
+    assert mem["memrec"] and os.path.exists(mem["memrec"])
+    payload = json.load(open(mem["memrec"]))
+    assert payload["kind"] == "apex_tpu.memory_record"
+    assert payload["oom"]["requested_bytes"] == INJECTED_OOM_BYTES
+    # one memrec per OOM attempt, all next to the checkpoints
+    recs = glob.glob(os.path.join(str(tmp_path), "memrec_*.json"))
+    assert len(recs) == 2
+    del big
+
+
+def test_non_oom_failures_carry_no_memory_verdict(tmp_path, registry):
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path), save_every=2,
+        fault_plan=FaultPlan.parse("step_exc@2"), registry=registry)
+    loop.run({"w": jnp.ones((8, 8))}, 5)
+    rollbacks = [e for e in registry.events() if e["name"] == "rollback"]
+    assert rollbacks and all(
+        "memory" not in e.get("fields", {}) for e in rollbacks)
+
+
+def test_memory_forensics_opt_out(tmp_path, registry):
+    loop = ResilientTrainLoop(
+        _step_fn, directory=str(tmp_path),
+        fault_plan=FaultPlan.parse("oom@1"), memory_forensics=False,
+        registry=registry)
+    loop.run({"w": jnp.ones((8, 8))}, 4)
+    assert not glob.glob(os.path.join(str(tmp_path), "memrec_*.json"))
+    rollbacks = [e for e in registry.events() if e["name"] == "rollback"]
+    assert rollbacks and "memory" not in rollbacks[0]["fields"]

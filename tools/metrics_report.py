@@ -43,8 +43,18 @@ straggler/desync counts, and the grad-sync barrier-wait timers;
 ``--compare`` additionally gates a ``fleet/step_time_skew`` gauge
 growing by more than ``--compare-threshold`` skew points — one rank
 falling behind the fleet is a regression regardless of absolute step
-time. Unknown ``schema_version`` values in analysis reports fail
-loudly rather than mis-summarizing.
+time. The ``memory/*`` family (ISSUE 15) gets the live-HBM table
+(per-source live/watermark bytes, snapshot cost + derived cadence,
+the per-target measured-vs-modeled HBM calibration ratios, the
+largest compiled executables), and ``--compare`` additionally gates
+two memory regressions: a ``memory/watermark_bytes`` gauge growing
+past ``--compare-threshold`` (the same workload keeps more bytes
+alive — the next OOM on a smaller chip), and a
+``memory/hbm_calibration_ratio{target=}`` gauge drifting past
+``--compare-threshold`` in either direction (the sharding cost model
+and XLA's allocator started disagreeing — every planner pruning
+decision inherits the error). Unknown ``schema_version`` values in
+analysis reports fail loudly rather than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -388,6 +398,144 @@ def summarize_numerics(path, fam):
               f"generic summary below)")
 
 
+def render_memory_family(path):
+    """The ``memory/*`` family from a metrics JSONL dump (None when the
+    file carries none): per-source live bytes / watermark / snapshot
+    cost + cadence, the per-target HBM calibration ratios, and the
+    per-fn compiled totals (ISSUE 15)."""
+    sources: dict = {}
+    calibration: dict = {}
+    compiled: dict = {}
+    events = 0
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        if rec.get("type") == "event" and (
+                name.startswith("memory") or name.startswith("memrec")):
+            events += 1
+            continue
+        if not name.startswith("memory/"):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = name[len("memory/"):]
+        if key.startswith("hbm_") and "target" in labels:
+            row = calibration.setdefault(labels["target"], {})
+            row[key] = rec.get("value")
+            continue
+        if key.startswith("compiled_") and "fn" in labels:
+            row = compiled.setdefault(labels["fn"], {})
+            if rec.get("type") == "counter":
+                row[key] = row.get(key, 0) + (rec.get("value") or 0)
+            else:
+                row[key] = rec.get("value")
+            continue
+        source = labels.get("source", "?")
+        row = sources.setdefault(source, {})
+        if rec.get("type") == "counter":
+            row[key] = row.get(key, 0) + (rec.get("value") or 0)
+        elif rec.get("type") == "gauge":
+            row[key] = rec.get("value")
+        elif rec.get("type") in ("histogram", "timer") and \
+                isinstance(rec.get("p50"), (int, float)):
+            row[key + "_p50"] = rec["p50"]
+    if not sources and not calibration and not compiled and not events:
+        return None
+    return {"sources": sources, "calibration": calibration,
+            "compiled": compiled, "events": events}
+
+
+def summarize_memory(path, fam):
+    print(f"{path}: memory/* family")
+    if fam["sources"]:
+        width = max(len(s) for s in fam["sources"])
+        print(f"  {'source':{width}s}  {'live':>10s}  {'watermark':>10s}"
+              f"  {'snap ms':>8s}  {'interval':>8s}")
+        for source, row in sorted(fam["sources"].items()):
+            def b(key):
+                v = row.get(key)
+                return _fmt_bytes(int(v)) if isinstance(
+                    v, (int, float)) else "-"
+            if isinstance(row.get("snapshot_ms"), (int, float)):
+                ms_s = f"{row['snapshot_ms']:.3f}"
+            elif isinstance(row.get("snapshot_pass_p50"), (int, float)):
+                ms_s = f"{row['snapshot_pass_p50'] * 1e3:.3f}"
+            else:
+                ms_s = "-"
+            interval = row.get("snapshot_interval")
+            int_s = str(int(interval)) if isinstance(
+                interval, (int, float)) else "-"
+            print(f"  {source:{width}s}  {b('live_bytes'):>10s}  "
+                  f"{b('watermark_bytes'):>10s}  {ms_s:>8s}  "
+                  f"{int_s:>8s}")
+    if fam["calibration"]:
+        print("  HBM calibration (measured XLA / modeled estimator):")
+        for target, row in sorted(fam["calibration"].items()):
+            ratio = row.get("hbm_calibration_ratio")
+            ratio_s = f"{ratio:.3f}x" if isinstance(
+                ratio, (int, float)) else "-"
+            modeled = row.get("hbm_modeled_bytes")
+            measured = row.get("hbm_measured_bytes")
+            mm = ""
+            if isinstance(modeled, (int, float)) and isinstance(
+                    measured, (int, float)):
+                mm = (f"  (modeled {_fmt_bytes(int(modeled))} vs "
+                      f"measured {_fmt_bytes(int(measured))})")
+            print(f"    {target:36s} {ratio_s:>8s}{mm}")
+    if fam["compiled"]:
+        biggest = sorted(fam["compiled"].items(),
+                         key=lambda kv: -(kv[1].get(
+                             "compiled_total_bytes") or 0))[:5]
+        print("  largest compiled executables:")
+        for fn, row in biggest:
+            total = row.get("compiled_total_bytes")
+            total_s = _fmt_bytes(int(total)) if isinstance(
+                total, (int, float)) else "-"
+            print(f"    {fn:36s} {total_s:>10s}")
+    if fam["events"]:
+        print(f"  ({fam['events']} memory event(s) — see the generic "
+              f"summary below)")
+
+
+def _memory_watermark_gauges(records):
+    """{labels-qualified name: value} for memory/watermark_bytes
+    gauges."""
+    out = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                rec.get("name") != "memory/watermark_bytes" or \
+                not isinstance(rec.get("value"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "memory/watermark_bytes" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["value"])
+    return out
+
+
+def _calibration_ratio_gauges(records):
+    """{labels-qualified name: value} for the per-target
+    memory/hbm_calibration_ratio gauges."""
+    out = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                rec.get("name") != "memory/hbm_calibration_ratio" or \
+                not isinstance(rec.get("value"), (int, float)):
+            continue
+        labels = rec.get("labels", {}) or {}
+        key = "memory/hbm_calibration_ratio" + (
+            "{" + ",".join(f"{k}={v}" for k, v in
+                           sorted(labels.items())) + "}"
+            if labels else "")
+        out[key] = float(rec["value"])
+    return out
+
+
 def _numerics_finite_gauges(records):
     """{labels-qualified name: value} for numerics/finite gauges."""
     out = {}
@@ -678,7 +826,12 @@ def compare_metrics(current_path, base_path, threshold=0.10):
     - DDP comms (ISSUE 11): a ``ddp/comms_bytes`` gauge growing past
       ``threshold`` (the sync layout moves more bytes), or
       ``ddp/overlap_efficiency`` dropping past ``threshold`` (the
-      bucket schedule stopped overlapping).
+      bucket schedule stopped overlapping);
+    - memory (ISSUE 15): a ``memory/watermark_bytes`` gauge growing
+      past ``threshold`` (the live set grew), or a
+      ``memory/hbm_calibration_ratio`` gauge drifting past
+      ``threshold`` in either direction (the HBM cost model stopped
+      tracking XLA).
 
     Metrics present in only one dump are reported as info, never
     failed on: a shorter run is not a regression.
@@ -807,6 +960,47 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"(-{(1 - c / b) * 100:.1f}% > {threshold * 100:.0f}%)")
         else:
             infos.append(f"{name}: speedup {b:.3f}x -> {c:.3f}x ok")
+
+    cur_wm, base_wm = _memory_watermark_gauges(cur), \
+        _memory_watermark_gauges(base)
+    for name in sorted(base_wm):
+        if name not in cur_wm:
+            infos.append(f"{name}: only in base "
+                         f"({base_wm[name]:.0f} B)")
+            continue
+        b, c = base_wm[name], cur_wm[name]
+        # the live-set high-watermark growing past threshold means the
+        # same workload now keeps more bytes alive — an HBM regression
+        # that on a smaller chip IS the next OOM, regardless of speed
+        if b > 0 and c > b * (1.0 + threshold):
+            regressions.append(
+                f"{name}: watermark {b:.0f} -> {c:.0f} B "
+                f"(+{(c / b - 1) * 100:.1f}% > {threshold * 100:.0f}% "
+                f"— the live set grew)")
+        else:
+            infos.append(f"{name}: {b:.0f} -> {c:.0f} B ok")
+
+    cur_cal, base_cal = _calibration_ratio_gauges(cur), \
+        _calibration_ratio_gauges(base)
+    for name in sorted(base_cal):
+        if name not in cur_cal:
+            infos.append(f"{name}: only in base "
+                         f"({base_cal[name]:.3f}x)")
+            continue
+        b, c = base_cal[name], cur_cal[name]
+        # the measured/modeled HBM ratio is not expected to be 1.0 but
+        # IS expected to be stable: drift in EITHER direction past
+        # threshold means the cost model and XLA's buffer assignment
+        # started disagreeing in a new way — every planner pruning
+        # decision inherits that error (ISSUE 15)
+        if b > 0 and abs(c - b) > b * threshold:
+            regressions.append(
+                f"{name}: calibration ratio {b:.3f}x -> {c:.3f}x "
+                f"(drifted {abs(c / b - 1) * 100:.1f}% > "
+                f"{threshold * 100:.0f}% — the HBM cost model no "
+                f"longer tracks what XLA allocates)")
+        else:
+            infos.append(f"{name}: ratio {b:.3f}x -> {c:.3f}x ok")
 
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
@@ -958,6 +1152,14 @@ if __name__ == "__main__":
                                       "numerics_family": num}))
                 else:
                     summarize_numerics(arg, num)
+            mem = render_memory_family(arg) if os.path.isfile(arg) \
+                else None
+            if mem is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "memory_family": mem}))
+                else:
+                    summarize_memory(arg, mem)
             ddp = render_ddp_family(arg) if os.path.isfile(arg) \
                 else None
             if ddp is not None:

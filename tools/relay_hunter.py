@@ -207,6 +207,18 @@ def main():
                      os.path.join(REPO, "TUNING_CACHE.json")],
                     timeout=3600, log_path=BENCH_LOG, header="tune")
                 log_probe(event="tune", rc=rc_t)
+                # real-TPU memory ground truth (ISSUE 15): the live
+                # bytes_limit, a live-buffer snapshot, and the
+                # measured-vs-modeled HBM calibration ratios computed
+                # against TPU XLA's memory_analysis — the sharding
+                # cost model's first on-silicon anchor (failure is
+                # non-fatal)
+                rc_m, _ = run_child(
+                    [sys.executable, "-m", "apex_tpu.observability",
+                     "memory", "--out",
+                     os.path.join(REPO, "TPU_MEMORY_r05.json")],
+                    timeout=1200, log_path=BENCH_LOG, header="memory")
+                log_probe(event="memory_snapshot", rc=rc_m)
                 # bonus evidence while the window is open: an xplane
                 # trace of the flagship step (failure is non-fatal)
                 rc_p, _ = run_child(
