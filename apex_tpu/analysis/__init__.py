@@ -37,6 +37,10 @@ from apex_tpu.analysis.ast_checks import (
     lint_paths,
     lint_source,
 )
+from apex_tpu.analysis.concurrency_checks import (
+    CONCURRENCY_CHECKS,
+    run_concurrency_findings,
+)
 from apex_tpu.analysis.findings import (
     Finding,
     load_baseline,
@@ -72,13 +76,15 @@ from apex_tpu.analysis.targets import (
 )
 
 __all__ = [
-    "AST_CHECKS", "Finding", "JAXPR_CHECKS", "PLAN_MODELS",
+    "AST_CHECKS", "CONCURRENCY_CHECKS", "Finding", "JAXPR_CHECKS",
+    "PLAN_MODELS",
     "PRECISION_CHECKS", "Plan", "PlanError",
     "SHARDING_CHECKS", "SPMD_CHECKS", "TARGETS", "analyze_fn",
     "analyze_precision",
     "analyze_sharding", "analyze_sharding_jaxpr", "analyze_spmd",
     "lint_paths", "lint_source", "load_baseline",
-    "new_findings", "plan", "run_precision_findings",
+    "new_findings", "plan", "run_concurrency_findings",
+    "run_precision_findings",
     "run_sharding_findings", "run_spmd_findings", "run_targets",
     "save_baseline",
 ]

@@ -24,6 +24,7 @@ import sys
 import time
 
 from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
+from apex_tpu.analysis.concurrency_checks import CONCURRENCY_CHECKS
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
 from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
@@ -34,7 +35,8 @@ DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 # Engines the per-target wall time rolls up into (the lint summary's
 # gate-latency line — the unified-interpreter speedup and any future
 # regression show up here, per ISSUE 8 satellite).
-ENGINE_NAMES = ("ast", "jaxpr", "dataflow", "sharding", "spmd")
+ENGINE_NAMES = ("ast", "concurrency", "jaxpr", "dataflow", "sharding",
+                "spmd")
 
 # Total-wall-time budget for one gate run (ISSUE 14 satellite): the
 # engine stack keeps growing, and tier-1 runs the gate every round — a
@@ -58,7 +60,8 @@ def _default_paths(root):
 
 
 def known_checks():
-    return (set(ast_checks.AST_CHECKS) | set(JAXPR_CHECKS)
+    return (set(ast_checks.AST_CHECKS) | set(CONCURRENCY_CHECKS)
+            | set(JAXPR_CHECKS)
             | set(PRECISION_CHECKS) | set(SHARDING_CHECKS)
             | set(SPMD_CHECKS) | set(targets.TARGET_CHECKS))
 
@@ -121,15 +124,16 @@ def parse_allow(entries):
     return allow
 
 
-def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
-        allow=None, engine_seconds=None):
+def run(paths=None, root=None, ast=True, jaxpr=True, concurrency=True,
+        checks=None, allow=None, engine_seconds=None):
     """Programmatic entry: returns (findings, target_errors).
 
     ``allow``: {target: {check ids}} per-target grandfather, merged over
     the ``@target(allow=...)`` declarations. ``engine_seconds``: an
     optional dict that receives per-engine wall time (keys
     :data:`ENGINE_NAMES`) — the gate-latency breakdown the lint summary
-    prints.
+    prints. The concurrency engine shares the AST engine's path list,
+    so ``--changed-only`` narrowing applies to both.
     """
     if checks:
         unknown = set(checks) - known_checks()
@@ -161,6 +165,18 @@ def run(paths=None, root=None, ast=True, jaxpr=True, checks=None,
             if engine_seconds is not None:
                 engine_seconds["ast"] = (
                     engine_seconds.get("ast", 0.0)
+                    + time.perf_counter() - t0)  # apex-lint: disable=raw-clock
+    if concurrency:
+        from apex_tpu.analysis import concurrency_checks
+        conc_ids = (set(checks) & set(CONCURRENCY_CHECKS)
+                    if checks else None)
+        if conc_ids is None or conc_ids:
+            t0 = time.perf_counter()  # apex-lint: disable=raw-clock
+            all_findings += concurrency_checks.lint_paths(
+                use, root=root, checks=conc_ids)
+            if engine_seconds is not None:
+                engine_seconds["concurrency"] = (
+                    engine_seconds.get("concurrency", 0.0)
                     + time.perf_counter() - t0)  # apex-lint: disable=raw-clock
     if jaxpr:
         if checks is None or set(checks) & set(targets.TRACING_CHECKS):
@@ -209,6 +225,10 @@ def main(argv=None):
                          "(default: cwd)")
     ap.add_argument("--no-ast", dest="ast", action="store_false")
     ap.add_argument("--no-jaxpr", dest="jaxpr", action="store_false")
+    ap.add_argument("--no-concurrency", dest="concurrency",
+                    action="store_false",
+                    help="skip the host-concurrency engine (it shares "
+                         "the AST engine's path list)")
     ap.add_argument("--checks", default=None,
                     help="comma-separated check ids to run")
     ap.add_argument("--allow", action="append", default=[],
@@ -235,6 +255,8 @@ def main(argv=None):
     if args.list_checks:
         for cid in ast_checks.AST_CHECKS:
             print(f"{cid:32s} [ast]")
+        for cid in CONCURRENCY_CHECKS:
+            print(f"{cid:32s} [concurrency]")
         for cid in JAXPR_CHECKS:
             print(f"{cid:32s} [jaxpr]")
         for cid in PRECISION_CHECKS:
@@ -260,7 +282,8 @@ def main(argv=None):
         if args.diff:
             diff_keys, diff_fps = load_diff_report(args.diff)
         found, errors = run(paths=args.paths or None, root=args.root,
-                            ast=args.ast, jaxpr=args.jaxpr, checks=checks,
+                            ast=args.ast, jaxpr=args.jaxpr,
+                            concurrency=args.concurrency, checks=checks,
                             allow=allow, engine_seconds=engine_seconds)
     except (OSError, ValueError) as e:
         print(str(e), file=sys.stderr)

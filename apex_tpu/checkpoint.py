@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import zlib
 from typing import Any, Optional
 
@@ -290,6 +291,11 @@ class AsyncCheckpointWriter:
         ocp = _ocp()
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         self._pending = None  # (tmp, final, step, overwrite)
+        # save/wait/close all fence-and-commit through _pending; two
+        # threads interleaving (a trainer saving while an eval thread
+        # waits) would double-commit one write or drop another's
+        # commit entirely. RLock: save()'s fence re-enters wait().
+        self._lock = threading.RLock()
 
     @property
     def in_flight_tmp(self) -> Optional[str]:
@@ -304,30 +310,38 @@ class AsyncCheckpointWriter:
         final = os.path.abspath(path)
         _check_overwrite(final, overwrite)
         tmp = final + TMP_SUFFIX
-        # fence + finalize the PREVIOUS write before issuing a new one —
-        # keeps the single-write-in-flight contract and commits in order
-        self.wait()
-        if os.path.isdir(tmp):
-            shutil.rmtree(tmp, ignore_errors=True)
-        _fault_point("pre_write", step, tmp)
-        self._ckptr.save(tmp, state, force=True)
-        self._pending = (tmp, final, step, overwrite)
+        with self._lock:
+            # fence + finalize the PREVIOUS write before issuing a new
+            # one — keeps the single-write-in-flight contract and
+            # commits in order. Holding the lock across the stale-tmp
+            # sweep and the async submit IS the point here: this lock
+            # exists to serialize whole save/wait transactions, not to
+            # guard a hot path.
+            self.wait()
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp, ignore_errors=True)  # apex-lint: disable=blocking-call-under-lock
+            _fault_point("pre_write", step, tmp)
+            self._ckptr.save(tmp, state, force=True)
+            self._pending = (tmp, final, step, overwrite)
         return final
 
     def wait(self):
         """Block until the in-flight write (if any) is durable AND
         committed (marker + rename)."""
-        self._ckptr.wait_until_finished()
-        if self._pending is not None:
-            tmp, final, step, overwrite = self._pending
-            # clear first: a failed commit leaves a torn .tmp behind (as
-            # a real crash would) rather than wedging every later save
-            self._pending = None
-            _commit(tmp, final, step, overwrite)
+        with self._lock:
+            self._ckptr.wait_until_finished()
+            if self._pending is not None:
+                tmp, final, step, overwrite = self._pending
+                # clear first: a failed commit leaves a torn .tmp
+                # behind (as a real crash would) rather than wedging
+                # every later save
+                self._pending = None
+                _commit(tmp, final, step, overwrite)
 
     def close(self):
-        self.wait()
-        self._ckptr.close()
+        with self._lock:
+            self.wait()
+            self._ckptr.close()
 
 
 class CheckpointManager:

@@ -242,10 +242,16 @@ class FlightRecorder:
                 continue
             elapsed = time.monotonic() - started
             if elapsed > limit:
-                self._dumped_step = step
                 reason = (f"step {step} stalled: {elapsed:.3f}s "
                           f"> threshold {limit:.3f}s")
-                self._stall_reason = reason
+                # same lock step_started() holds to clear _dumped_step:
+                # an unlocked write here races the step thread re-arming
+                # a replayed step. dump() stays OUTSIDE the lock — it
+                # opens files and takes this lock again for its state
+                # snapshot.
+                with self._lock:
+                    self._dumped_step = step
+                    self._stall_reason = reason
                 self.dump(reason=reason, kind="stall")
 
     def install(self) -> "FlightRecorder":

@@ -118,7 +118,10 @@ class RecompileListener:
                 cb(kind, name)
             except Exception:  # noqa: BLE001 — an observer must never
                 # break the compile (or the logging filter) it rides
-                self.observer_errors += 1
+                with self._lock:  # += is a read-modify-write; compile
+                    # records land from jax's logging + monitoring
+                    # hooks on whatever thread compiled
+                    self.observer_errors += 1
 
     # ---- read side
 

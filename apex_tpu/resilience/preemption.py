@@ -72,12 +72,22 @@ class PreemptionWatcher:
         self._reason: Optional[str] = None
         self._installed: dict = {}
         self._registry = registry
+        # Written by the signal handler (a plain attribute store is the
+        # only async-signal-safe primitive here) and folded into trip()
+        # by check() on the polling thread: trip() takes this watcher's
+        # lock AND the registry's, and a handler runs ON TOP of
+        # whatever frame the interrupted thread holds — tripping inline
+        # would deadlock exactly the run it exists to save.
+        self._pending_signal: Optional[int] = None
 
     # ------------------------------------------------------------ state
 
     @property
     def preempted(self) -> bool:
-        return self._event.is_set()
+        # a delivered-but-not-yet-serviced signal counts: the flag must
+        # never read False between the handler firing and the next
+        # check() folding it in
+        return self._event.is_set() or self._pending_signal is not None
 
     @property
     def reason(self) -> Optional[str]:
@@ -99,6 +109,15 @@ class PreemptionWatcher:
 
     def check(self) -> bool:
         """Poll sensors and return the (possibly just-tripped) flag."""
+        pending = self._pending_signal
+        if pending is not None:
+            # service the handler's flag here, on the polling thread,
+            # where taking trip()'s locks is safe; a second signal
+            # landing between the read and the clear re-reports the
+            # same preemption, which trip() dedups
+            self._pending_signal = None
+            self.trip(f"signal {signal.Signals(pending).name}")
+            return True
         if self._event.is_set():
             return True
         for sense in self.sensors:
@@ -123,7 +142,12 @@ class PreemptionWatcher:
     # ---------------------------------------------------------- signals
 
     def _handler(self, signum, frame):
-        self.trip(f"signal {signal.Signals(signum).name}")
+        # async-signal-safe: record the signal and return — trip()
+        # acquires this watcher's Lock and the registry's, and this
+        # frame may be interrupting a holder of either (the
+        # lock-in-signal-handler lint polices the pattern); check()
+        # folds the flag in from the polling thread
+        self._pending_signal = signum
 
     def install(self) -> "PreemptionWatcher":
         """Register signal handlers (previous handlers are saved and

@@ -12,6 +12,7 @@ Pure-numpy fallbacks keep everything working when the .so is absent.
 
 from __future__ import annotations
 
+import atexit
 import ctypes
 import os
 import threading
@@ -20,14 +21,30 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 _LIB = None
+# _load() is lazy and may SPAWN A BUILD (make -C csrc): two threads
+# hitting the first call unlocked would race duplicate makes and one
+# could CDLL a half-written .so. Double-checked: the fast path stays
+# lock-free (module attribute read is atomic), only first-load
+# serializes.
+_LOAD_LOCK = threading.Lock()
 _FILL_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p,
                             ctypes.c_int64, ctypes.c_void_p)
 
 
 def _load():
-    global _LIB
     if _LIB is not None:
         return _LIB or None  # False = cached failure -> numpy fallback
+    with _LOAD_LOCK:
+        # blocking (make + CDLL) under the lock IS the point: this lock
+        # exists solely to serialize the one-time build, there is no
+        # hot path contending on it
+        return _load_locked()  # apex-lint: disable=blocking-call-under-lock
+
+
+def _load_locked():
+    global _LIB
+    if _LIB is not None:
+        return _LIB or None
     pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # installed layout first (setup.py drops the lib inside the package),
     # then the source checkout's csrc/
@@ -190,6 +207,39 @@ class HostRuntime:
     available = staticmethod(runtime_available)
 
 
+# Live native prefetch rings: handle -> (lib, keep-alive callback).
+# apex_prefetch_destroy stops + JOINS the C++ workers before freeing
+# the slot buffers, so destroying through this registry is the one
+# safe teardown. The atexit sweep covers iterators that were abandoned
+# without being GC'd: without it, C++ worker threads could still be
+# calling the Python fill callback while the interpreter tears itself
+# down — a write into freed interpreter state.
+_RINGS_LOCK = threading.Lock()
+_ACTIVE_RINGS: dict = {}
+
+
+def _register_ring(handle, lib, cb) -> None:
+    with _RINGS_LOCK:
+        _ACTIVE_RINGS[handle] = (lib, cb)
+
+
+def _destroy_ring(handle) -> None:
+    """Idempotent stop+join+free of one ring (no-op if already gone)."""
+    with _RINGS_LOCK:
+        entry = _ACTIVE_RINGS.pop(handle, None)
+    if entry is not None:
+        lib, _cb = entry
+        # ctypes releases the GIL for the call, so workers blocked on
+        # the GIL for an in-flight fill can finish before the join
+        lib.apex_prefetch_destroy(handle)
+
+
+@atexit.register
+def _shutdown_rings() -> None:
+    for handle in list(_ACTIVE_RINGS):
+        _destroy_ring(handle)
+
+
 class PrefetchLoader:
     """Threaded prefetch over a Python ``fill(batch_idx, out_array)``
     callback, backed by the C++ ring (falls back to a Python thread pool).
@@ -197,6 +247,13 @@ class PrefetchLoader:
     Iterating yields numpy arrays of shape ``batch_shape``/dtype in batch
     order while up to ``n_slots`` future batches fill in the background —
     the input-pipeline overlap the reference gets from DataLoader workers.
+
+    Shutdown contract (both backends): closing or abandoning the
+    iterator stops and JOINS the fill workers before their buffers can
+    be freed; a fill callback still running at interpreter exit is
+    joined by the atexit sweep. A worker never wedges on a full queue
+    after the consumer walks away, and a fill exception surfaces as
+    ``RuntimeError`` on the consuming thread instead of hanging it.
     """
 
     def __init__(self, fill: Callable[[int, np.ndarray], None],
@@ -236,6 +293,7 @@ class PrefetchLoader:
         ring = lib.apex_prefetch_create(self.n_slots, self.nbytes,
                                         self.total, self.n_workers, cb,
                                         None)
+        _register_ring(ring, lib, cb)
         try:
             out = np.empty(self.nbytes, np.uint8)
             for _ in range(self.total):
@@ -248,7 +306,10 @@ class PrefetchLoader:
                 yield out[:self.nbytes].view(self.dtype).reshape(
                     self.shape).copy()
         finally:
-            lib.apex_prefetch_destroy(ring)
+            # stop + join workers BEFORE the callback can be released:
+            # a fill in flight completes into still-owned slot memory,
+            # then the workers exit, then cb may die
+            _destroy_ring(ring)
             del cb
 
     def _iter_python(self):
@@ -256,23 +317,56 @@ class PrefetchLoader:
 
         q: "queue.Queue" = queue.Queue(maxsize=self.n_slots)
         stop = threading.Event()
+        error = object()  # sentinel: fill raised on the worker thread
+
+        def put(item) -> bool:
+            # bounded put that can never wedge the worker: a consumer
+            # that abandoned the iterator stops draining, and a plain
+            # q.put would block this thread forever — stop.set() alone
+            # cannot unblock a blocked put
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
-            for b in range(self.total):
-                if stop.is_set():
-                    return
-                arr = np.empty(self.shape, self.dtype)
-                self.fill(b, arr)
-                q.put((b, arr))
-            q.put((None, None))
+            try:
+                for b in range(self.total):
+                    if stop.is_set():
+                        return
+                    arr = np.empty(self.shape, self.dtype)
+                    self.fill(b, arr)
+                    if not put((b, arr)):
+                        return
+                put((None, None))
+            except BaseException as e:  # noqa: BLE001 — a dead
+                # producer must surface on the consumer, which would
+                # otherwise block on q.get() forever
+                put((error, e))
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(target=worker, daemon=True,
+                             name="apex-prefetch-fill")
         t.start()
         try:
             while True:
                 b, arr = q.get()
+                if b is error:
+                    raise RuntimeError(
+                        "prefetch fill callback failed") from arr
                 if b is None:
                     return
                 yield arr
         finally:
             stop.set()
+            # drain so a put-blocked worker observes stop promptly,
+            # then join — the iterator owns the thread's lifetime; a
+            # missed join here is a thread leaked per abandoned epoch
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=10.0)

@@ -1311,6 +1311,19 @@ def worker():
     except Exception as e:  # same contract as the precision hook
         extras["spmd_findings_error"] = repr(e)[:120]
 
+    # host-concurrency verdict (ISSUE 16): the race/signal/callback
+    # checks over the threaded host runtime — per-check counts land in
+    # the analysis/concurrency_findings{check=} metric family and the
+    # JSON line, so a perf number always ships with its thread-safety
+    # lint status
+    try:
+        from apex_tpu.analysis import run_concurrency_findings
+
+        cfindings = run_concurrency_findings(registry=reg)
+        extras["concurrency_findings"] = len(cfindings)
+    except Exception as e:  # same contract as the precision hook
+        extras["concurrency_findings_error"] = repr(e)[:120]
+
     # fp8-vs-bf16 matmul race (ISSUE 13): the O4 tier's perf evidence —
     # CPU emulation here, real MXU numbers on the next relay window
     try:

@@ -195,6 +195,11 @@ int64_t apex_prefetch_next(void* ring, void* out, int64_t out_bytes) {
   int64_t want = r->next_consume;
   int64_t slot = -1;
   for (;;) {
+    // a ring being destroyed must unblock its consumer: destroy sets
+    // stop under mu and notifies cv_ready, so a consumer parked here
+    // wakes, sees stop, and reports exhaustion instead of sleeping
+    // through the join forever
+    if (r->stop) return -2;
     bool pending = false;
     for (size_t s = 0; s < r->slots.size(); ++s) {
       if (r->slot_batch[s] == want) {

@@ -1,0 +1,569 @@
+"""Host-concurrency engine unit tests (ISSUE 16): seeded regression
+snippets per check — each positive snippet is a minimized version of a
+real hazard class from the threaded host runtime (the recompile
+observer-error counter, the flight-recorder watchdog, the preemption
+SIGTERM handler, the checkpoint writer) — plus the idiomatic clean
+shape for each, suppression syntax, path scoping, and the
+observability hook."""
+
+import os
+import re
+
+import pytest
+
+from apex_tpu.analysis import CONCURRENCY_CHECKS
+from apex_tpu.analysis.concurrency_checks import (
+    lint_source,
+    run_concurrency_findings,
+)
+from apex_tpu.observability.registry import MetricRegistry
+
+LIB = "apex_tpu/fake.py"  # a relpath the engine's scope governs
+
+
+def _lint(src, checks=None, relpath=LIB):
+    return lint_source(src, relpath, checks)
+
+
+def _by_check(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# ------------------------------------- unlocked-shared-mutation
+
+def test_inconsistent_lockset_flagged():
+    """The flight_recorder._watch bug class: one method writes the
+    attribute under the lock, another writes it bare."""
+    src = """
+import threading
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dumped_step = -1
+
+    def step_started(self, step):
+        with self._lock:
+            self._dumped_step = step
+
+    def watch(self, step):
+        self._dumped_step = step
+"""
+    found = _by_check(_lint(src), "unlocked-shared-mutation")
+    assert len(found) == 1
+    assert found[0].symbol == "Recorder.watch"
+    assert "step_started" in found[0].message
+    assert "inconsistent lockset" in found[0].message
+
+
+def test_unlocked_aug_increment_flagged():
+    """The recompile.observer_errors bug class: += outside the class
+    lock loses updates under contention."""
+    src = """
+import threading
+
+class Listener:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.observer_errors = 0
+
+    def notify(self):
+        self.observer_errors += 1
+"""
+    found = _by_check(_lint(src), "unlocked-shared-mutation")
+    assert len(found) == 1
+    assert found[0].symbol == "Listener.notify"
+    assert "read-modify-write" in found[0].message
+
+
+def test_container_mutation_lockset_flagged():
+    """self.X.append() counts as a write of X for the lockset rule."""
+    src = """
+import threading
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def push(self, x):
+        with self._lock:
+            self._buf.append(x)
+
+    def drop_all(self):
+        self._buf.clear()
+"""
+    found = _by_check(_lint(src), "unlocked-shared-mutation")
+    assert len(found) == 1 and found[0].symbol == "Ring.drop_all"
+
+
+def test_init_writes_and_consistent_lockset_clean():
+    """__init__ is publication; every-write-under-lock is the fixed
+    shape — neither may fire."""
+    src = """
+import threading
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dumped_step = -1
+
+    def step_started(self, step):
+        with self._lock:
+            self._dumped_step = step
+
+    def watch(self, step):
+        with self._lock:
+            self._dumped_step = step
+"""
+    assert not _lint(src)
+
+
+def test_plain_class_aug_clean():
+    """A class with no locks, threads, or signal entries is not
+    concurrent — += stays unflagged (most of the codebase)."""
+    src = """
+class Accum:
+    def __init__(self):
+        self.total = 0
+
+    def add(self, x):
+        self.total += x
+"""
+    assert not _lint(src)
+
+
+# --------------------------------------- lock-in-signal-handler
+
+def test_signal_handler_direct_lock_flagged():
+    src = """
+import signal
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        with self._lock:
+            self._fired = True
+"""
+    found = _by_check(_lint(src), "lock-in-signal-handler")
+    assert len(found) == 1
+    assert found[0].symbol == "Watcher._handler"
+    assert "deadlock" in found[0].message
+
+
+def test_signal_handler_transitive_lock_flagged():
+    """The preemption._handler -> trip() bug class: the acquisition is
+    one call away, and the via path is named in the message."""
+    src = """
+import signal
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        self.trip()
+
+    def trip(self):
+        with self._lock:
+            self._fired = True
+"""
+    found = _by_check(_lint(src), "lock-in-signal-handler")
+    assert len(found) == 1
+    assert "_handler -> trip" in found[0].message
+
+
+def test_signal_handler_rlock_and_flag_clean():
+    """RLock is reentrant; the sanctioned pattern (plain-attribute flag
+    serviced elsewhere) has no acquisition at all."""
+    src = """
+import signal
+import threading
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        with self._lock:
+            self._fired = True
+
+class Deferred:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = None
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        self._pending = signum
+
+    def check(self):
+        with self._lock:
+            return self._pending
+"""
+    assert not _by_check(_lint(src), "lock-in-signal-handler")
+
+
+# -------------------------------------- blocking-call-under-lock
+
+def test_blocking_call_direct_flagged():
+    src = """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+    found = _by_check(_lint(src), "blocking-call-under-lock")
+    assert len(found) == 1
+    assert found[0].symbol == "Poller.wait"
+    assert "time.sleep" in found[0].message
+
+
+def test_blocking_call_transitive_flagged():
+    """The lock is held across a call that reaches file I/O."""
+    src = """
+import threading
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def save(self):
+        with self._lock:
+            self._write()
+
+    def _write(self):
+        with open("/tmp/x", "w") as f:
+            f.write("x")
+"""
+    found = _by_check(_lint(src), "blocking-call-under-lock")
+    assert len(found) == 1
+    assert found[0].symbol == "Dumper.save"
+    assert "_write" in found[0].message and "open()" in found[0].message
+
+
+def test_blocking_under_module_lock_flagged():
+    """Module-level locks define held regions too."""
+    src = """
+import shutil
+import threading
+
+_IO_LOCK = threading.Lock()
+
+def purge(path):
+    with _IO_LOCK:
+        shutil.rmtree(path)
+"""
+    found = _by_check(_lint(src), "blocking-call-under-lock")
+    assert len(found) == 1 and found[0].symbol == "purge"
+
+
+def test_snapshot_then_write_outside_clean():
+    """The fixed shape: copy state under the lock, do I/O outside."""
+    src = """
+import json
+import threading
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def save(self, path):
+        with self._lock:
+            rows = list(self._rows)
+        with open(path, "w") as f:
+            json.dump(rows, f)
+"""
+    assert not _by_check(_lint(src), "blocking-call-under-lock")
+
+
+# --------------------------------------------- callback-reentry
+
+def test_callback_loop_under_lock_flagged():
+    src = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._observers = []
+
+    def notify(self, event):
+        with self._lock:
+            for cb in self._observers:
+                cb(event)
+"""
+    found = _by_check(_lint(src), "callback-reentry")
+    assert len(found) == 1
+    assert found[0].symbol == "Registry.notify"
+    assert "_observers" in found[0].message
+
+
+def test_callback_copied_alias_still_under_lock_flagged():
+    """Copying the list but invoking INSIDE the locked region is still
+    reentry — the copy only helps once the invoke moves outside."""
+    src = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._observers = []
+
+    def notify(self, event):
+        with self._lock:
+            cbs = list(self._observers)
+            for cb in cbs:
+                cb(event)
+"""
+    found = _by_check(_lint(src), "callback-reentry")
+    assert len(found) == 1
+
+
+def test_callback_subscript_under_lock_flagged():
+    src = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers = {}
+
+    def fire(self, key, event):
+        with self._lock:
+            self._handlers[key](event)
+"""
+    assert len(_by_check(_lint(src), "callback-reentry")) == 1
+
+
+def test_copy_then_invoke_outside_clean():
+    """The RecompileListener._notify shape."""
+    src = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._observers = []
+
+    def notify(self, event):
+        with self._lock:
+            cbs = list(self._observers)
+        for cb in cbs:
+            cb(event)
+"""
+    assert not _by_check(_lint(src), "callback-reentry")
+
+
+# -------------------------------------------- fork-unsafe-state
+
+def test_import_time_thread_flagged():
+    src = """
+import threading
+
+def _poll():
+    pass
+
+_T = threading.Thread(target=_poll, daemon=True)
+_T.start()
+"""
+    found = _by_check(_lint(src), "fork-unsafe-state")
+    assert len(found) == 1
+    assert found[0].symbol == "<module>"
+    assert "import time" in found[0].message
+
+
+def test_fork_in_threaded_module_flagged():
+    src = """
+import os
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spawn(self):
+        return os.fork()
+"""
+    found = _by_check(_lint(src), "fork-unsafe-state")
+    assert len(found) == 1
+    assert found[0].symbol == "Pool.spawn"
+
+
+def test_main_guard_thread_and_threadless_fork_clean():
+    """Threads behind the __main__ guard run at script entry, not at
+    (re-)import; os.fork in a module with no threads or locks has no
+    state to corrupt."""
+    src = """
+import threading
+
+def _poll():
+    pass
+
+if __name__ == "__main__":
+    threading.Thread(target=_poll, daemon=True).start()
+"""
+    assert not _lint(src)
+    src2 = """
+import os
+
+def spawn():
+    return os.fork()
+"""
+    assert not _lint(src2)
+
+
+def test_module_lock_alone_clean():
+    """Module-level locks are reinitialized fresh per spawned child —
+    they do not make a module fork-hostile by themselves."""
+    src = """
+import threading
+
+_LOCK = threading.Lock()
+
+def bump(state):
+    with _LOCK:
+        state["n"] = state.get("n", 0) + 1
+"""
+    assert not _lint(src)
+
+
+# ------------------------------------------- shared infrastructure
+
+def test_suppression_comment_honored():
+    src = """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.5)  # apex-lint: disable=blocking-call-under-lock
+"""
+    assert not _lint(src)
+
+
+def test_path_scoping_exempts_driver_code():
+    """tools/ and bench.py are driver plumbing, outside the engine's
+    ground — the same hazardous source yields nothing there."""
+    src = """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+    assert _lint(src, relpath=LIB)
+    assert not _lint(src, relpath="tools/fake.py")
+    assert not _lint(src, relpath="bench.py")
+
+
+def test_unknown_check_rejected_loudly():
+    with pytest.raises(ValueError, match="unknown concurrency check"):
+        _lint("x = 1", checks=("not-a-check",))
+
+
+def test_checks_narrowing():
+    src = """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def bump(self):
+        self.n += 1
+"""
+    only_blocking = _lint(src, checks=("blocking-call-under-lock",))
+    assert {f.check for f in only_blocking} == {"blocking-call-under-lock"}
+    only_mut = _lint(src, checks=("unlocked-shared-mutation",))
+    assert {f.check for f in only_mut} == {"unlocked-shared-mutation"}
+
+
+def test_syntax_error_returns_nothing():
+    """The AST engine owns syntax-error reporting; this engine must not
+    double-report or crash."""
+    assert _lint("def broken(:\n") == []
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.mark.parametrize("relpath", [
+    "apex_tpu/runtime/host.py",    # _load(): make+CDLL under the
+    #                                one-time build lock is the point
+    "apex_tpu/checkpoint.py",      # AsyncCheckpointWriter.save(): the
+    #                                lock serializes whole transactions
+])
+def test_repo_suppressions_are_pinned(relpath):
+    """The justified in-repo blocking-call-under-lock suppressions stay
+    honest: today the engine reports nothing (the disable comment is
+    present and placed right), and stripping the comments makes it
+    fire (the suppression is load-bearing, not stale)."""
+    with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+        src = f.read()
+    check = "blocking-call-under-lock"
+    assert not _by_check(lint_source(src, relpath), check)
+    stripped = re.sub(r"\s*# apex-lint: disable=[\w,-]+", "", src)
+    assert _by_check(lint_source(stripped, relpath), check), relpath
+
+
+def test_run_concurrency_findings_publishes_counters(tmp_path):
+    """The bench.py observability hook: per-check counter family +
+    total gauge, seeded with one known-bad file."""
+    pkg = tmp_path / "apex_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def wait(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n")
+    reg = MetricRegistry()
+    findings = run_concurrency_findings(
+        registry=reg, paths=[str(pkg)], root=str(tmp_path))
+    assert len(findings) == 1
+    recs = reg.to_records()
+    by_check = {
+        (r.get("labels") or {}).get("check"): r["value"]
+        for r in recs
+        if r.get("name") == "analysis/concurrency_findings"}
+    assert set(by_check) == set(CONCURRENCY_CHECKS)
+    assert by_check["blocking-call-under-lock"] == 1
+    assert all(v == 0 for c, v in by_check.items()
+               if c != "blocking-call-under-lock")
+    totals = [r["value"] for r in recs
+              if r.get("name") == "analysis/concurrency_findings_total"]
+    assert totals == [1.0]

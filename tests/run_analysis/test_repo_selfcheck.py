@@ -99,7 +99,9 @@ def test_cli_list_checks():
                 "rng-in-jit", "mutable-default",
                 "kernel-auto-provenance", "lowprec-accum",
                 "master-weights", "unsafe-exp", "cast-churn",
-                "loss-scale-bypass"):
+                "loss-scale-bypass", "unlocked-shared-mutation",
+                "lock-in-signal-handler", "blocking-call-under-lock",
+                "callback-reentry", "fork-unsafe-state"):
         assert cid in proc.stdout, cid
 
 

@@ -163,3 +163,52 @@ def test_gc_survives_orbax_tmp_dirs(tmp_path):
     for s in (1, 2):
         m.save(s, {"x": jnp.arange(4.0)})
     assert m.latest_step() == 2
+
+
+def test_async_writer_concurrent_save_and_wait_threads(tmp_path):
+    """Regression (ISSUE 16): save/wait/close serialize through the
+    writer's RLock — a trainer thread saving while another thread
+    fences must keep the single-write-in-flight contract, commit every
+    step exactly once, and leave no torn .tmp dirs."""
+    import os as _os
+    import threading
+
+    from apex_tpu.checkpoint import (
+        AsyncCheckpointWriter,
+        latest_valid_step,
+        restore_checkpoint,
+    )
+
+    w = AsyncCheckpointWriter()
+    steps = (1, 2, 3, 4)
+    errors = []
+    stop = threading.Event()
+
+    def fencer():
+        # an eval thread draining the in-flight write in a loop,
+        # interleaving with the trainer's save() fences
+        try:
+            while not stop.is_set():
+                w.wait()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    ft = threading.Thread(target=fencer, daemon=True)
+    ft.start()
+    try:
+        for s in steps:
+            w.save(str(tmp_path), {"x": jnp.full((4,), float(s))},
+                   step=s)
+    finally:
+        stop.set()
+        ft.join(timeout=30)
+    assert not ft.is_alive() and not errors
+    w.close()
+
+    assert latest_valid_step(str(tmp_path)) == steps[-1]
+    assert not [d for d in _os.listdir(tmp_path) if d.endswith(".tmp")]
+    for s in steps:
+        got = restore_checkpoint(str(tmp_path),
+                                 target={"x": jnp.zeros((4,))}, step=s)
+        np.testing.assert_array_equal(np.asarray(got["x"]),
+                                      np.full((4,), float(s)))

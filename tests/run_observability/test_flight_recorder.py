@@ -334,3 +334,53 @@ def test_flight_record_exports_via_trace_cli(tmp_path, tracer):
     payload = json.loads(out.read_text())
     assert "pp/forward" in {e["name"] for e in payload["traceEvents"]
                             if e["ph"] == "B"}
+
+
+def test_stall_dedup_consistent_with_concurrent_rearm(tmp_path, tracer):
+    """Regression (unlocked-shared-mutation): the watchdog wrote
+    _dumped_step lock-free while step_started() clears it under the
+    lock — an inconsistent lockset that could lose the re-arm of a
+    replayed step. Hammering step_started from the step thread while
+    the watchdog is mid-stall must neither deadlock (dump runs OUTSIDE
+    the lock, which dump() re-takes) nor wedge the dedup state: after
+    quiescing, a fresh stall on a new attempt still dumps."""
+    import threading
+
+    reg = MetricRegistry()
+    rec = _recorder(tmp_path, tracer, reg)
+    stop = threading.Event()
+
+    def rearm():
+        # the trainer side: rapid replayed attempts of the same index,
+        # racing the watchdog's polls over the shared dedup state (each
+        # re-arm also resets the stall clock, so no dump fires yet)
+        while not stop.is_set():
+            rec.step_started(3)
+            time.sleep(0.01)
+
+    with rec:
+        t = threading.Thread(target=rearm, daemon=True)
+        t.start()
+        time.sleep(0.4)  # several watchdog polls race the re-arms
+        stop.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert not rec.dumps  # every attempt re-armed before stalling
+
+        # the state the lock guards came out coherent: the LAST attempt
+        # is still armed and its stall dumps
+        deadline = time.monotonic() + 5
+        while not rec.dumps and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.dumps, "stall never dumped after concurrent re-arms"
+        rec.step_finished(record=False)
+
+        # and a brand-new attempt re-arms detection and dumps again
+        seen = len(rec.dumps)
+        rec.step_started(4)
+        deadline = time.monotonic() + 5
+        while len(rec.dumps) == seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        rec.step_finished(record=False)
+    assert len(rec.dumps) > seen
+    assert rec.stalled  # _stall_reason set under the same lock

@@ -367,3 +367,63 @@ def test_compare_stable_memory_passes_and_new_is_info(tmp_path):
     # metrics only in current are info, never failed on
     plain = _dump(tmp_path / "plain.jsonl")
     assert _run(cur, "--compare", plain).returncode == 0
+
+
+# ------------------- host-concurrency finding counters (ISSUE 16)
+
+def _conc(check, value):
+    return {"type": "counter", "name": "analysis/concurrency_findings",
+            "labels": {"check": check}, "value": value}
+
+
+def test_compare_concurrency_growth_fails_binary(tmp_path):
+    """Any check counter growing above base fails, with NO threshold:
+    one new confirmed race in the host runtime is a regression
+    regardless of the wall clock."""
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=[_conc("unlocked-shared-mutation", 0)])
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_conc("unlocked-shared-mutation", 1)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION concurrency unlocked-shared-mutation" \
+        in proc.stdout
+    # a huge threshold changes nothing — the gate is binary
+    assert _run(cur, "--compare", base, "--compare-threshold",
+                "10.0").returncode == 1
+
+
+def test_compare_new_nonzero_check_id_fails(tmp_path):
+    """A check id absent from base going nonzero is a regression (a
+    NEW hazard class appeared, not churn in an old one)."""
+    base = _dump(tmp_path / "base.jsonl")
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_conc("lock-in-signal-handler", 1)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION concurrency lock-in-signal-handler" in proc.stdout
+
+
+def test_compare_concurrency_steady_or_fixed_passes(tmp_path):
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=[_conc("callback-reentry", 2),
+                        _conc("fork-unsafe-state", 0)])
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_conc("callback-reentry", 1),   # fixed one
+                       _conc("fork-unsafe-state", 0)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 regression(s)" in proc.stdout
+
+
+def test_concurrency_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl",
+                 extra=[_conc("blocking-call-under-lock", 3),
+                        {"type": "gauge",
+                         "name": "analysis/concurrency_findings_total",
+                         "value": 3.0}])
+    proc = _run(path)
+    assert proc.returncode == 0
+    assert "analysis/concurrency_* family" in proc.stdout
+    assert "blocking-call-under-lock 3" in proc.stdout
+    assert "findings: 3" in proc.stdout

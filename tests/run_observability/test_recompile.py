@@ -110,3 +110,52 @@ def test_install_is_idempotent_and_uninstall_restores():
     assert recompile_mod.current() is None
     assert jax.config.jax_log_compiles == prev_flag
     uninstall_recompile_listener()  # second uninstall is a no-op
+
+
+def test_observer_error_counter_exact_under_contention():
+    """Regression (unlocked-shared-mutation): ``observer_errors += 1``
+    ran outside the listener's lock — concurrent compile notifications
+    (jax's logging + monitoring hooks fire on whatever thread compiled)
+    lost increments. The count must be exact."""
+    import threading
+
+    lst = recompile_mod.RecompileListener(registry=MetricRegistry())
+
+    def bad_observer(kind, name):
+        raise RuntimeError("observer blew up")
+
+    lst.add_observer(bad_observer)
+    n_threads, n_iters = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait(timeout=30)
+        for i in range(n_iters):
+            lst._notify("compile", f"fn{i}")
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert lst.observer_errors == n_threads * n_iters
+
+
+def test_observer_may_deregister_itself_during_notify():
+    """The copy-then-invoke-outside-the-lock shape (the clean
+    callback-reentry pattern): an observer re-entering
+    remove_observer from inside the notification must not deadlock."""
+    lst = recompile_mod.RecompileListener(registry=MetricRegistry())
+    seen = []
+
+    def once(kind, name):
+        seen.append((kind, name))
+        lst.remove_observer(once)
+
+    lst.add_observer(once)
+    lst._notify("compile", "fn_a")
+    lst._notify("compile", "fn_b")  # already removed: no second fire
+    assert seen == [("compile", "fn_a")]
+    assert lst.observer_errors == 0

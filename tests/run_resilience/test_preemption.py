@@ -71,3 +71,40 @@ def test_signal_handler_installs_trips_and_restores():
         assert w.check()
         assert "SIGUSR1" in w.reason
     assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+def test_signal_while_lock_held_does_not_deadlock():
+    """Regression (lock-in-signal-handler): the handler used to call
+    trip(), which acquires the watcher's Lock — a signal landing while
+    this thread holds that lock deadlocked the process. The handler
+    must now only record the signal; tripping happens in check()."""
+    reg = MetricRegistry()
+    with PreemptionWatcher(signals=(signal.SIGUSR1,),
+                           registry=reg) as w:
+        with w._lock:
+            # the handler runs synchronously on this frame, ON TOP of
+            # the held lock — with the old inline trip() this statement
+            # never returned
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert w.preempted  # visible before any lock is taken
+            assert w.reason is None  # ...but not yet serviced
+        assert w.check()
+        assert "SIGUSR1" in w.reason
+        assert reg.counter("resilience/preemptions").value == 1
+
+
+def test_preempted_visible_between_signal_and_check():
+    """The flag must never read False in the window between signal
+    delivery and the next poll servicing it."""
+    reg = MetricRegistry()
+    with PreemptionWatcher(signals=(signal.SIGUSR1,),
+                           registry=reg) as w:
+        assert not w.preempted
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert w.preempted
+        assert w.check() and w.preempted
+        # serviced exactly once; a duplicate signal re-reports the same
+        # preemption, which trip() dedups
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert w.check()
+        assert reg.counter("resilience/preemptions").value == 1

@@ -1,14 +1,15 @@
 #!/usr/bin/env bash
-# apex_tpu static-analysis gate: both apex_tpu.analysis engines over the
+# apex_tpu static-analysis gate: every apex_tpu.analysis engine over the
 # canonical target set, failing on any finding not grandfathered in
 # tests/run_analysis/baseline.json.
 #
 #   bash tools/lint.sh                 # the tier-1 gate (run by
 #                                      # tests/run_analysis/test_repo_selfcheck.py)
-#   bash tools/lint.sh --changed-only  # AST engine over files changed vs
-#                                      # the merge base only (LINT_BASE,
-#                                      # default main); jaxpr/dataflow
-#                                      # targets still run in full
+#   bash tools/lint.sh --changed-only  # AST + concurrency engines over
+#                                      # files changed vs the merge base
+#                                      # only (LINT_BASE, default main);
+#                                      # jaxpr/dataflow targets still
+#                                      # run in full
 #   bash tools/lint.sh --write-baseline tests/run_analysis/baseline.json
 #
 # Extra args are forwarded to `python -m apex_tpu.analysis` (which
@@ -29,11 +30,13 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 if [[ "${1:-}" == "--changed-only" ]]; then
     shift
-    # Narrow the AST engine to python files changed since the merge base
-    # (working tree + index + committed-vs-base; deleted files drop out
-    # via the existence filter). The jaxpr + dataflow/sharding targets
-    # are NOT narrowed: they trace whole entry points, so an edit
-    # anywhere in a traced module can move their verdicts.
+    # Narrow the path-driven engines (AST + host-concurrency — both
+    # consume the same explicit path list) to python files changed
+    # since the merge base (working tree + index + committed-vs-base;
+    # deleted files drop out via the existence filter). The jaxpr +
+    # dataflow/sharding targets are NOT narrowed: they trace whole
+    # entry points, so an edit anywhere in a traced module can move
+    # their verdicts.
     #
     # LINT_DIFF_REPORT: path to a stored `--json` dump from the merge
     # base (generate once per base rev: `python -m apex_tpu.analysis
@@ -61,11 +64,12 @@ if [[ "${1:-}" == "--changed-only" ]]; then
         [[ -n "$f" && -e "$f" ]] && ast_paths+=("$f")
     done <<< "$changed"
     if [[ ${#ast_paths[@]} -eq 0 ]]; then
-        # nothing changed under the linted paths: skip the AST engine
-        # entirely (an empty explicit path list would be rejected as a
-        # typo by the CLI's loud-failure rule)
+        # nothing changed under the linted paths: skip both path-driven
+        # engines entirely (an empty explicit path list would be
+        # rejected as a typo by the CLI's loud-failure rule)
         exec python -m apex_tpu.analysis \
-            --baseline tests/run_analysis/baseline.json --no-ast \
+            --baseline tests/run_analysis/baseline.json \
+            --no-ast --no-concurrency \
             ${diff_args[@]+"${diff_args[@]}"} "$@"
     fi
     exec python -m apex_tpu.analysis \

@@ -53,8 +53,14 @@ alive — the next OOM on a smaller chip), and a
 ``memory/hbm_calibration_ratio{target=}`` gauge drifting past
 ``--compare-threshold`` in either direction (the sharding cost model
 and XLA's allocator started disagreeing — every planner pruning
-decision inherits the error). Unknown ``schema_version`` values in
-analysis reports fail loudly rather than mis-summarizing.
+decision inherits the error). The
+``analysis/concurrency_findings{check=}`` family (ISSUE 16 — the
+host-concurrency engine's per-check race/signal/callback verdict)
+gets a per-check table, and ``--compare`` gates any check counter
+growing above its base value or a new check id going nonzero —
+binary, no threshold: one new confirmed race in the host runtime is
+a regression regardless of speed. Unknown ``schema_version`` values
+in analysis reports fail loudly rather than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -166,6 +172,54 @@ def summarize_sharding(path, fam):
             print(f"  {t:{width}s}  "
                   f"{_fmt_bytes(vals.get('comms_bytes', 0)):>12s}  "
                   f"{_fmt_bytes(vals.get('peak_hbm_bytes', 0)):>12s}")
+
+
+def render_concurrency_family(path):
+    """Per-check table of the ``analysis/concurrency_findings{check=}``
+    counter family (ISSUE 16 — the host-concurrency engine's verdict a
+    bench run ships with) from a metrics JSONL dump; None when the file
+    carries none. Later records win, matching the registry's cumulative
+    counter dumps."""
+    checks = {}
+    total = None
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        labels = rec.get("labels", {}) or {}
+        if name == "analysis/concurrency_findings_total":
+            total = rec.get("value")
+        elif name == "analysis/concurrency_findings":
+            checks[labels.get("check", "?")] = rec.get("value")
+    if total is None and not checks:
+        return None
+    return {"checks": checks, "findings_total": total}
+
+
+def summarize_concurrency(path, fam):
+    print(f"{path}: analysis/concurrency_* family")
+    if fam["findings_total"] is not None:
+        print(f"  findings: {int(fam['findings_total'])}")
+    for check, n in sorted(fam["checks"].items()):
+        print(f"    {check:24s} {n}")
+
+
+def _concurrency_check_counts(records):
+    """{check id: count} from ``analysis/concurrency_findings``
+    counters; later records win (cumulative counter dumps)."""
+    counts = {}
+    for rec in records:
+        if rec.get("name") != "analysis/concurrency_findings":
+            continue
+        labels = rec.get("labels", {}) or {}
+        try:
+            counts[labels.get("check", "?")] = float(rec.get("value"))
+        except (TypeError, ValueError):
+            continue
+    return counts
 
 
 def render_tuning_family(path):
@@ -831,7 +885,11 @@ def compare_metrics(current_path, base_path, threshold=0.10):
       past ``threshold`` (the live set grew), or a
       ``memory/hbm_calibration_ratio`` gauge drifting past
       ``threshold`` in either direction (the HBM cost model stopped
-      tracking XLA).
+      tracking XLA);
+    - host concurrency (ISSUE 16): any
+      ``analysis/concurrency_findings{check=}`` counter growing above
+      its base value, or a check id absent/zero in base going nonzero
+      — binary, no threshold.
 
     Metrics present in only one dump are reported as info, never
     failed on: a shorter run is not a regression.
@@ -1002,6 +1060,28 @@ def compare_metrics(current_path, base_path, threshold=0.10):
         else:
             infos.append(f"{name}: ratio {b:.3f}x -> {c:.3f}x ok")
 
+    cur_conc, base_conc = _concurrency_check_counts(cur), \
+        _concurrency_check_counts(base)
+    if cur_conc or base_conc:
+        for check in sorted(set(cur_conc) | set(base_conc)):
+            b = base_conc.get(check, 0.0)
+            c = cur_conc.get(check)
+            if c is None:
+                infos.append(f"concurrency {check}: only in base "
+                             f"({b:.0f})")
+                continue
+            # binary, no threshold: one new confirmed race / signal /
+            # callback hazard in the host runtime is a regression
+            # regardless of what the wall clock did (ISSUE 16)
+            if c > b:
+                regressions.append(
+                    f"concurrency {check}: findings {b:.0f} -> {c:.0f} "
+                    f"(new host-concurrency hazard — see "
+                    f"docs/analysis.md#host-concurrency-checks)")
+            else:
+                infos.append(f"concurrency {check}: {b:.0f} -> "
+                             f"{c:.0f} ok")
+
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
         if kernel not in cur_race:
@@ -1121,6 +1201,14 @@ if __name__ == "__main__":
                                       "sharding_family": fam}))
                 else:
                     summarize_sharding(arg, fam)
+            conc = render_concurrency_family(arg) \
+                if os.path.isfile(arg) else None
+            if conc is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "concurrency_family": conc}))
+                else:
+                    summarize_concurrency(arg, conc)
             pl = render_plan_family(arg) if os.path.isfile(arg) \
                 else None
             if pl is not None:
