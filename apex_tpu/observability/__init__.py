@@ -32,10 +32,19 @@ The single layer the whole stack reports through:
   top-k buffers), per-executable compiled memory stats off the
   recompile listener, measured-vs-modeled HBM calibration of the
   sharding cost model, and OOM forensics (``memrec_*.json``);
+- :mod:`~apex_tpu.observability.goodput` — the run ledger + goodput
+  accounting tier (ISSUE 17): every artifact family normalized into
+  one ordered, rank-aware timeline, wall-clock classified into causes
+  (productive step / init / compile / data wait / checkpoint / stall /
+  preempt drain / restart / rollback replay), and the ``goodput/*``
+  gauge family (ratio, lost-seconds-by-cause, badput top-3, fleet
+  min); event names are pinned by the
+  :mod:`~apex_tpu.observability.events` catalog;
 - ``python -m apex_tpu.observability report <metrics.jsonl>`` — the
   summary CLI (also ``tools/metrics_report.py``); ``... trace <run>``
   exports a span dump or xplane capture as Perfetto JSON;
-  ``... fleet <shards>`` joins per-rank shards into one fleet view.
+  ``... fleet <shards>`` joins per-rank shards into one fleet view;
+  ``... goodput <run>`` renders the run-ledger accounting table.
 
 The modules themselves import jax lazily and never force backend init —
 but importing them through the ``apex_tpu`` package still runs the
@@ -98,6 +107,18 @@ from apex_tpu.observability.fleet import (  # noqa: F401
     process_identity,
     rank_path,
 )
+from apex_tpu.observability import goodput  # noqa: F401
+from apex_tpu.observability.goodput import (  # noqa: F401
+    RunLedger,
+    ledger_from_records,
+)
+from apex_tpu.observability.goodput import (  # noqa: F401
+    account as account_goodput,
+)
+from apex_tpu.observability.events import (  # noqa: F401
+    EVENT_CATALOG,
+    GOODPUT_CRITICAL,
+)
 from apex_tpu.observability.scope import annotate, scope  # noqa: F401
 from apex_tpu.observability.step_report import (  # noqa: F401
     STEP_RECORD_FIELDS,
@@ -122,4 +143,6 @@ __all__ = [
     "install_compiled_capture", "calibrate_targets",
     "fleet", "DesyncDetector", "StragglerDetector", "merge_fleet",
     "merge_flight_records", "process_identity", "rank_path",
+    "goodput", "RunLedger", "ledger_from_records", "account_goodput",
+    "EVENT_CATALOG", "GOODPUT_CRITICAL",
 ]

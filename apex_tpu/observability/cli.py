@@ -37,8 +37,25 @@ snapshot as JSON — on a real TPU relay window this is the cost
 model's on-silicon ground truth (``tools/relay_hunter.py`` runs it
 per clean window as ``TPU_MEMORY_r0X.json``).
 
+``goodput <run>`` (ISSUE 17) builds the unified run ledger and prints
+the goodput accounting table: ``run`` is a metrics JSONL (any
+``.rank{i}`` shard names its whole family), a directory of run
+artifacts (every ``*.jsonl`` plus ``flightrec_*``/``memrec_*``/
+``fleetrec_*`` post-mortems), or a previously saved run-ledger JSON
+(re-accounted without re-ingesting). Options:
+
+- ``--wall S`` — the run's real wall-clock seconds; bounds the
+  ``unknown`` bucket (events carry no wall timestamps, so idle gaps
+  are invisible without it);
+- ``--json`` — the accounting object as JSON;
+- ``--out LEDGER.json`` — persist the (byte-stable) ledger;
+- ``--trace OUT.json`` — Perfetto export, one track per cause;
+- ``--records DIR`` / ``--ckpt DIR`` — fold in a post-mortem
+  directory / the checkpoint manifest's committed steps.
+
 Exit codes: 0 ok, 1 no records found (memory: no calibration ratio
-landed), 2 bad usage / unreadable file.
+landed; goodput: nothing ledger-relevant), 2 bad usage / unreadable
+file.
 """
 
 from __future__ import annotations
@@ -334,6 +351,59 @@ def memory_main(args) -> int:
     return 0 if ratios else 1
 
 
+def goodput_main(args) -> int:
+    import glob as glob_mod
+
+    from apex_tpu.observability import goodput as goodput_mod
+    from apex_tpu.observability.fleet.identity import rank_of_path
+
+    run = args.run
+    try:
+        if os.path.isdir(run):
+            ledger = goodput_mod.RunLedger()
+            for path in sorted(glob_mod.glob(os.path.join(run,
+                                                          "*.jsonl"))):
+                ledger.ingest_records(read_jsonl(path),
+                                      rank=rank_of_path(path),
+                                      where=path)
+            ledger.ingest_record_dir(run)
+        elif run.endswith(".jsonl"):
+            ledger = goodput_mod.RunLedger()
+            ledger.ingest_metrics(run)
+        else:
+            ledger = goodput_mod.RunLedger.load(run)
+        if args.records:
+            ledger.ingest_record_dir(args.records)
+        if args.ckpt:
+            ledger.ingest_checkpoints(args.ckpt)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not ledger.intervals:
+        print("no goodput-relevant records found", file=sys.stderr)
+        return 1
+    accounting, segments = goodput_mod.classify(ledger,
+                                                wall_s=args.wall)
+    try:
+        if args.out:
+            ledger.save(args.out)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if args.trace:
+            with open(args.trace, "w") as f:
+                json.dump({"traceEvents":
+                           goodput_mod.to_trace_events(segments),
+                           "displayTimeUnit": "ms"}, f)
+            print(f"wrote {args.trace}", file=sys.stderr)
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(accounting, indent=2, sort_keys=True))
+    else:
+        print(goodput_mod.render(accounting))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apex_tpu.observability",
@@ -385,6 +455,28 @@ def main(argv=None) -> int:
                          "(default: the calibration set)")
     mp.add_argument("--top-k", type=int, default=5,
                     help="how many largest buffers the snapshot keeps")
+    gp = sub.add_parser(
+        "goodput", help="run ledger + goodput accounting (ISSUE 17)")
+    gp.add_argument("run",
+                    help="metrics .jsonl (any .rank shard names its "
+                         "family), a run-artifact directory, or a "
+                         "saved run-ledger .json")
+    gp.add_argument("--json", action="store_true",
+                    help="emit the accounting object as JSON")
+    gp.add_argument("--wall", type=float, default=None,
+                    help="run wall-clock seconds — bounds the unknown "
+                         "bucket (default: sum of attributed time)")
+    gp.add_argument("--out", default="",
+                    help="persist the run ledger JSON here")
+    gp.add_argument("--trace", default="",
+                    help="Perfetto export (one track per cause) to "
+                         "this path")
+    gp.add_argument("--records", default="",
+                    help="directory of flightrec_*/memrec_*/fleetrec_* "
+                         "post-mortems to fold into the ledger")
+    gp.add_argument("--ckpt", default="",
+                    help="checkpoint directory — record its committed "
+                         "steps in the ledger")
     args = ap.parse_args(argv)
     if args.cmd == "trace":
         return trace_main(args)
@@ -392,6 +484,8 @@ def main(argv=None) -> int:
         return fleet_main(args)
     if args.cmd == "memory":
         return memory_main(args)
+    if args.cmd == "goodput":
+        return goodput_main(args)
 
     records = []
     for path in args.paths:

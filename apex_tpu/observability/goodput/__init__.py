@@ -1,0 +1,39 @@
+"""Goodput accounting + unified run ledger (ISSUE 17).
+
+:mod:`.ledger` normalizes every artifact family a run produces into
+one ordered, rank-aware timeline; :mod:`.accounting` classifies the
+wall-clock into causes and reduces it to the goodput ratio and
+lost-seconds-by-cause. ``python -m apex_tpu.observability goodput``
+is the CLI face; ``bench.py`` publishes the ``goodput/*`` gauge family
+on every run and ``tools/metrics_report.py --compare`` gates ratio
+drops.
+"""
+
+from .ledger import (
+    INTERVAL_KINDS,
+    LEDGER_KIND,
+    LEDGER_SCHEMA_VERSION,
+    RunLedger,
+    ledger_from_records,
+)
+from .accounting import (
+    ACCOUNTING_KIND,
+    ACCOUNTING_SCHEMA_VERSION,
+    CAUSES,
+    FAULT_CAUSES,
+    MIN_STEP_HISTORY,
+    STALL_FACTOR,
+    account,
+    classify,
+    publish,
+    render,
+    to_trace_events,
+)
+
+__all__ = [
+    "INTERVAL_KINDS", "LEDGER_KIND", "LEDGER_SCHEMA_VERSION",
+    "RunLedger", "ledger_from_records",
+    "ACCOUNTING_KIND", "ACCOUNTING_SCHEMA_VERSION", "CAUSES",
+    "FAULT_CAUSES", "MIN_STEP_HISTORY", "STALL_FACTOR",
+    "account", "classify", "publish", "render", "to_trace_events",
+]

@@ -230,14 +230,29 @@ class ResilientTrainLoop:
 
     def _save(self, state, step: int) -> Optional[str]:
         """Periodic save; a failure (after retries) degrades to a
-        counter + event — training continues on the last good save."""
+        counter + event — training continues on the last good save.
+
+        ISSUE 17: the save is timed through the registry Timer (the
+        corrected-sync clock — resilience code never reads a raw
+        clock) and the elapsed host seconds ride the event as
+        ``duration_s``, the run ledger's ``ckpt_save`` interval. With
+        ``async_save`` this is the host-blocking enqueue time, which
+        is exactly the wall time the training loop lost."""
+        reg = self._reg()
+        timer = reg.timer("resilience/ckpt_save_s")
+        timer.start()
         try:
-            return self._call(self.manager.save, step, {"state": state})
+            path = self._call(self.manager.save, step, {"state": state})
         except Exception as e:  # noqa: BLE001 — degradation rung 0
-            reg = self._reg()
+            duration = timer.stop()
             reg.counter("resilience/checkpoint_failures").inc()
-            reg.event("checkpoint_failed", step=step, error=repr(e)[:200])
+            reg.event("checkpoint_failed", step=step, error=repr(e)[:200],
+                      duration_s=round(duration, 6))
             return None
+        duration = timer.stop()
+        reg.event("checkpoint_saved", step=step,
+                  duration_s=round(duration, 6))
+        return path
 
     def _emergency_save(self, state, step: int) -> Optional[str]:
         """Synchronous, retry-wrapped save issued on preemption — the
@@ -252,31 +267,39 @@ class ResilientTrainLoop:
             # the thing that is broken; the sync save below still counts
             reg.event("emergency_flush_failed", step=step,
                       error=repr(e)[:200])
+        timer = reg.timer("resilience/emergency_save_s")
+        timer.start()
         try:
             path = self._call(ckpt.save_checkpoint, self.directory,
                               {"state": state}, step=step)
+            timer.stop()
             reg.counter("resilience/emergency_saves").inc()
             return path
         except Exception as e:  # noqa: BLE001
+            duration = timer.stop()
             reg.counter("resilience/checkpoint_failures").inc()
             reg.event("emergency_save_failed", step=step,
-                      error=repr(e)[:200])
+                      error=repr(e)[:200], duration_s=round(duration, 6))
             return None
 
     def _resume(self, state):
         """(state, start_step): restore the newest valid checkpoint,
         walking back to older valid steps when a restore itself fails."""
         reg = self._reg()
+        gc_timer = reg.timer("resilience/ckpt_gc_s")
+        gc_timer.start()
         removed = ckpt.gc_partial_checkpoints(
             self.directory,
             keep=() if self.manager is None
             else ((self.manager._writer.in_flight_tmp,)
                   if self.manager._writer is not None
                   and self.manager._writer.in_flight_tmp else ()))
+        gc_s = gc_timer.stop()
         if removed:
             reg.counter("resilience/gc_partial").inc(len(removed))
             reg.event("gc_partial_checkpoints",
-                      removed=[p.rsplit("/", 1)[-1] for p in removed])
+                      removed=[p.rsplit("/", 1)[-1] for p in removed],
+                      duration_s=round(gc_s, 6))
         candidates = list(reversed(ckpt.valid_steps(
             self.directory, deep=self.deep_validate_resume)))
         if not candidates:
@@ -288,17 +311,23 @@ class ResilientTrainLoop:
             if legacy is not None:
                 candidates = [legacy]
         for step in candidates:
+            restore_timer = reg.timer("resilience/ckpt_restore_s")
+            restore_timer.start()
             try:
                 restored = ckpt.restore_checkpoint(
                     self.directory, target={"state": state}, step=step)
             except Exception as e:  # noqa: BLE001 — fall back to the
                 # previous valid step rather than dying on a bad restore
+                duration = restore_timer.stop()
                 reg.counter("resilience/restore_failures").inc()
                 reg.event("restore_failed", step=step,
-                          error=repr(e)[:200])
+                          error=repr(e)[:200],
+                          duration_s=round(duration, 6))
                 continue
+            duration = restore_timer.stop()
             reg.counter("resilience/resumes").inc()
-            reg.event("resumed", step=step)
+            reg.event("resumed", step=step,
+                      duration_s=round(duration, 6))
             self.resumed_from = step
             if self.on_resume is not None:
                 self.on_resume(step)
@@ -349,10 +378,21 @@ class ResilientTrainLoop:
 
     def _run(self, state, num_steps: int):
         reg = self._reg()
+        # ISSUE 17: the startup interval (gc + restore + template
+        # setup) is an attempt boundary the run ledger needs — a cold
+        # attempt's startup is `init`, a resumed attempt's is
+        # `restart`. Timed via the registry Timer like every other
+        # phase here (no raw clocks in resilience code).
+        startup_timer = reg.timer("resilience/startup_s")
+        startup_timer.start()
         self.resumed_from = None
         start = 0
         if self.manager is not None and self.auto_resume:
             state, start = self._resume(state)
+        reg.event("attempt_start", start_step=start,
+                  num_steps=num_steps,
+                  resumed=self.resumed_from is not None,
+                  startup_s=round(startup_timer.stop(), 6))
         fallback_state, fallback_step = state, start
         plan = self.fault_plan
         step, rollbacks = start, 0
@@ -411,12 +451,22 @@ class ResilientTrainLoop:
                     recorder.step_finished()
                 return result
 
+            # ISSUE 17: every completed step attempt leaves a
+            # `step_done` event with its host wall seconds — the run
+            # ledger's `productive_step` / `rollback_replay` interval
+            # source (a step index completing twice is a replay). The
+            # timer wraps the whole retried call, so a retry storm's
+            # wall time is honestly attributed to the step it served.
+            step_timer = reg.timer("resilience/step_s")
+            step_timer.start()
             try:
                 new_state, metrics = self._call(attempt)
             except (Preempted, TrainAborted, KeyboardInterrupt,
                     SystemExit):
+                step_timer.cancel()
                 raise
             except Exception as e:  # noqa: BLE001 — ladder rung 2
+                step_timer.cancel()
                 last_error = e
                 recovery_target = max(recovery_target, step)
                 memory = self._probe_memory(e, step)
@@ -424,6 +474,8 @@ class ResilientTrainLoop:
                     fallback_state, fallback_step, rollbacks, step, e,
                     memory=memory)
                 continue
+            reg.event("step_done", step=step,
+                      duration_s=round(step_timer.stop(), 6))
 
             if plan is not None and plan.should_fire("nan_grads", step):
                 reg.counter("resilience/faults_injected",
@@ -481,9 +533,15 @@ class ResilientTrainLoop:
             if tripped:
                 reason = (self.watcher.reason or "preempted"
                           if self.watcher is not None else "fault-plan")
+                # the drain interval (flush + emergency save) is what
+                # the preemption actually cost before the process
+                # dies — the ledger's `preempt_drain` cause (ISSUE 17)
+                drain_timer = reg.timer("resilience/preempt_drain_s")
+                drain_timer.start()
                 path = self._emergency_save(state, step)
                 reg.event("preempt_exit", step=step, reason=reason,
-                          checkpoint=bool(path))
+                          checkpoint=bool(path),
+                          duration_s=round(drain_timer.stop(), 6))
                 if self.exit_on_preempt:
                     sys.exit(EXIT_PREEMPTED)
                 raise Preempted(step, path, reason)
@@ -497,14 +555,19 @@ class ResilientTrainLoop:
             step += 1
 
         if self.manager is not None:
+            drain_timer = reg.timer("resilience/ckpt_save_s")
+            drain_timer.start()
             try:
                 self.manager.wait_until_finished()
+                drain_timer.stop()
             except Exception as e:  # noqa: BLE001 — the final async
                 # commit failing must not cost the trained state; the
                 # last committed checkpoint stands (degradation rung 0)
+                duration = drain_timer.stop()
                 reg.counter("resilience/checkpoint_failures").inc()
                 reg.event("checkpoint_failed", step=num_steps - 1,
-                          error=repr(e)[:200])
+                          error=repr(e)[:200],
+                          duration_s=round(duration, 6))
         return state
 
     # ------------------------------------------------------- provenance
@@ -645,15 +708,26 @@ class ResilientTrainLoop:
             raise TrainAborted(report)
         if self.manager is not None:
             for s in reversed(ckpt.valid_steps(self.directory)):
+                restore_timer = reg.timer("resilience/ckpt_restore_s")
+                restore_timer.start()
                 try:
                     restored = ckpt.restore_checkpoint(
                         self.directory, target={"state": fallback_state},
                         step=s)
                 except Exception as e:  # noqa: BLE001
+                    duration = restore_timer.stop()
                     reg.counter("resilience/restore_failures").inc()
                     reg.event("restore_failed", step=s,
-                              error=repr(e)[:200])
+                              error=repr(e)[:200],
+                              duration_s=round(duration, 6))
                     continue
+                duration = restore_timer.stop()
+                # a rollback restore is a `resumed`-shaped interval for
+                # the ledger: same name, same duration contract, plus
+                # the rollback marker so accounting can tell the two
+                # apart (in-process rollback vs process restart)
+                reg.event("resumed", step=s, rollback=True,
+                          duration_s=round(duration, 6))
                 return restored["state"], s + 1, rollbacks
         return fallback_state, fallback_step, rollbacks
 

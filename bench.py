@@ -1402,6 +1402,27 @@ def worker():
                 reg.event("retrace_budget_exceeded", retraces=retraces,
                           budget=budget_n,
                           by_fn=snap["retraces_by_fn"])
+        # goodput accounting (ISSUE 17): ledger this worker's own event
+        # stream and publish the goodput/* gauge family BEFORE the dump
+        # so it rides the metrics JSONL into metrics_report's compare
+        # gate; the JSON line carries the summary object
+        try:
+            from apex_tpu.observability import goodput as goodput_mod
+
+            ledger = goodput_mod.ledger_from_records(reg.to_records())
+            acc = goodput_mod.account(
+                ledger, wall_s=time.perf_counter() - t_worker)
+            goodput_mod.publish(acc, reg)
+            extras["goodput"] = {
+                "ratio": acc["goodput_ratio"],
+                "fleet_ratio": acc["fleet_goodput"],
+                "wall_s": acc["wall_s"],
+                "productive_s": acc["productive_s"],
+                "badput_top": acc["badput_top"],
+                "steps": acc["steps"],
+            }
+        except Exception as e:  # telemetry must not cost the JSON line
+            extras["goodput_error"] = repr(e)[:120]
         try:
             reg.dump(_metrics_path())
             # dump() rank-suffixes the shared path for fleet members

@@ -33,6 +33,7 @@ import numpy as np
 
 
 def main():
+    t_main0 = time.perf_counter()
     p = argparse.ArgumentParser()
     p.add_argument("--pp", type=int, default=2)
     p.add_argument("--dp", type=int, default=2)
@@ -426,7 +427,22 @@ def main():
               f"({'decreased' if stats['last'] < stats['first'] else 'NOT decreased'})")
 
     if os.environ.get("APEX_TPU_METRICS"):
-        obs.get_registry().dump(os.environ["APEX_TPU_METRICS"])
+        reg = obs.get_registry()
+        # goodput accounting (ISSUE 17): publish the goodput/* gauge
+        # family before the dump so the run's JSONL carries its own
+        # accounting (re-derivable offline:
+        # `python -m apex_tpu.observability goodput <dump>`)
+        try:
+            ledger = obs.ledger_from_records(reg.to_records())
+            acc = obs.account_goodput(
+                ledger, wall_s=time.perf_counter() - t_main0)
+            obs.goodput.publish(acc, reg)
+            print(f"goodput {acc['goodput_ratio']:.4f} "
+                  f"(productive {acc['productive_s']:.2f}s of "
+                  f"{acc['wall_s']:.2f}s wall)")
+        except Exception as e:  # telemetry must not cost the run
+            print(f"goodput accounting failed: {e!r}")
+        reg.dump(os.environ["APEX_TPU_METRICS"])
         print(f"metrics -> {os.environ['APEX_TPU_METRICS']}")
 
 

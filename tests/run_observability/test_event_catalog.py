@@ -1,0 +1,167 @@
+"""Event-schema consistency (ISSUE 17 satellite): every
+``reg.event(name, ...)`` site in the tree emits a name registered in
+``observability/events.EVENT_CATALOG``, and the goodput-critical
+events carry their pinned required fields — statically (AST scan of
+the literal emit sites) and at runtime (a faulted loop run's actual
+records). The run ledger parses the event stream by name, so an
+uncatalogued rename would silently drop intervals from the goodput
+accounting."""
+
+import ast
+import os
+
+import pytest
+
+from apex_tpu.observability import MetricRegistry
+from apex_tpu.observability.events import (
+    DYNAMIC_EVENT_SITES,
+    EVENT_CATALOG,
+    GOODPUT_CRITICAL,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: everything lint.sh lints is also catalog-checked
+_SCAN = ("apex_tpu", "examples", "bench.py")
+
+
+def _python_files():
+    for target in _SCAN:
+        path = os.path.join(_ROOT, target)
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, _, names in os.walk(path):
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def _event_calls():
+    """(relpath, lineno, name_node, keywords) for every ``*.event(...)``
+    method call in the scanned tree."""
+    for path in _python_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        rel = os.path.relpath(path, _ROOT)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "event" and node.args):
+                continue
+            yield rel, node.lineno, node.args[0], node.keywords
+
+
+def test_every_literal_event_name_is_catalogued():
+    uncatalogued = []
+    for rel, lineno, name_node, _ in _event_calls():
+        if isinstance(name_node, ast.Constant) \
+                and isinstance(name_node.value, str):
+            if name_node.value not in EVENT_CATALOG:
+                uncatalogued.append(f"{rel}:{lineno}: "
+                                    f"{name_node.value!r}")
+    assert not uncatalogued, (
+        "event names missing from observability/events.EVENT_CATALOG "
+        "(the run ledger parses events by name — register them):\n"
+        + "\n".join(uncatalogued))
+
+
+def test_dynamic_event_sites_are_declared_and_catalogued():
+    """A computed event name is only allowed at a site declared in
+    DYNAMIC_EVENT_SITES, and every name such a site can emit must
+    still be catalogued."""
+    undeclared = []
+    for rel, lineno, name_node, _ in _event_calls():
+        if isinstance(name_node, ast.Constant):
+            continue
+        if rel not in DYNAMIC_EVENT_SITES:
+            undeclared.append(f"{rel}:{lineno}")
+    assert not undeclared, (
+        "dynamic event-name call sites not declared in "
+        "DYNAMIC_EVENT_SITES:\n" + "\n".join(undeclared))
+    for site, names in DYNAMIC_EVENT_SITES.items():
+        missing = [n for n in names if n not in EVENT_CATALOG]
+        assert not missing, f"{site}: uncatalogued names {missing}"
+
+
+def test_goodput_critical_sites_pass_required_fields():
+    """Every literal emit site of a goodput-critical event passes its
+    pinned required fields as explicit keywords (sites that splat a
+    dict are covered by the runtime contract test below)."""
+    violations = []
+    for rel, lineno, name_node, keywords in _event_calls():
+        if not (isinstance(name_node, ast.Constant)
+                and name_node.value in GOODPUT_CRITICAL):
+            continue
+        if any(kw.arg is None for kw in keywords):  # **splat site
+            continue
+        passed = {kw.arg for kw in keywords}
+        missing = [f for f in EVENT_CATALOG[name_node.value]
+                   if f not in passed]
+        if missing:
+            violations.append(
+                f"{rel}:{lineno}: {name_node.value!r} missing "
+                f"required fields {missing}")
+    assert not violations, "\n".join(violations)
+
+
+def test_goodput_critical_names_are_catalogued_with_fields():
+    for name in GOODPUT_CRITICAL:
+        assert name in EVENT_CATALOG, name
+        assert EVENT_CATALOG[name], (
+            f"{name} is goodput-critical but pins no required fields")
+
+
+# ---------------------------------------------- runtime contract
+
+def _records_by_name(reg):
+    out = {}
+    for ev in reg.events():
+        out.setdefault(ev["name"], []).append(ev.get("fields") or {})
+    return out
+
+
+def _assert_fields(records_by_name, *names):
+    for name in names:
+        assert records_by_name.get(name), f"no {name!r} event emitted"
+        for fields in records_by_name[name]:
+            missing = [f for f in EVENT_CATALOG[name]
+                       if f not in fields]
+            assert not missing, (
+                f"{name!r} record missing required fields {missing}: "
+                f"{sorted(fields)}")
+
+
+def test_runtime_records_carry_required_fields(tmp_path):
+    """A preempted + crash-restarted chaos run's ACTUAL event records
+    carry every field the catalog pins — including the splat-emitted
+    ``rollback``/``train_aborted`` the AST check can't see."""
+    from apex_tpu.resilience import (
+        ResilientTrainLoop,
+        TrainAborted,
+        chaos_probe,
+    )
+
+    reg = MetricRegistry()
+    chaos_probe("seed=1,preempt@3", str(tmp_path / "chaos"), steps=8,
+                save_every=2, registry=reg)
+    by_name = _records_by_name(reg)
+    _assert_fields(by_name, "attempt_start", "step_done", "resumed",
+                   "preempt_exit", "checkpoint_saved", "preemption",
+                   "chaos_probe")
+
+    import jax.numpy as jnp
+
+    def step_fn(state, step):
+        return {"w": state["w"] + 1.0}, {"loss": 1.0}
+
+    reg2 = MetricRegistry()
+    loop = ResilientTrainLoop(
+        step_fn, directory=str(tmp_path / "abort"), save_every=2,
+        validate=lambda state, metrics, step: step < 3,
+        max_rollbacks=1, registry=reg2)
+    with pytest.raises(TrainAborted):
+        loop.run({"w": jnp.zeros((2,))}, 8)
+    by_name2 = _records_by_name(reg2)
+    _assert_fields(by_name2, "rollback", "train_aborted")

@@ -416,6 +416,74 @@ def test_compare_concurrency_steady_or_fixed_passes(tmp_path):
     assert "0 regression(s)" in proc.stdout
 
 
+# ----------------------------------------- goodput gates (ISSUE 17)
+
+def _goodput_recs(ratio=0.82, fleet=None, lost=()):
+    recs = [
+        {"type": "gauge", "name": "goodput/ratio", "value": ratio},
+        {"type": "gauge", "name": "goodput/fleet_ratio",
+         "value": fleet if fleet is not None else ratio},
+        {"type": "gauge", "name": "goodput/wall_s", "value": 100.0},
+        {"type": "gauge", "name": "goodput/productive_s",
+         "value": 100.0 * ratio},
+    ]
+    for cause, seconds in lost:
+        recs.append({"type": "gauge", "name": "goodput/lost_s",
+                     "labels": {"cause": cause}, "value": seconds})
+    return recs
+
+
+def test_compare_goodput_ratio_drop_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_goodput_recs(ratio=0.82))
+    cur = _dump(tmp_path / "cur.jsonl", extra=_goodput_recs(ratio=0.60))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION goodput/ratio" in proc.stdout
+    assert "badput" in proc.stdout
+    # a looser threshold (in ratio points) lets the same drop pass
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.3").returncode == 0
+
+
+def test_compare_goodput_wobble_or_gain_passes(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_goodput_recs(ratio=0.82))
+    wobble = _dump(tmp_path / "w.jsonl", extra=_goodput_recs(ratio=0.78))
+    assert _run(wobble, "--compare", base).returncode == 0
+    better = _dump(tmp_path / "b2.jsonl", extra=_goodput_recs(ratio=0.95))
+    assert _run(better, "--compare", base).returncode == 0
+
+
+def test_compare_goodput_fleet_min_gated_independently(tmp_path):
+    """The overall ratio holding steady must not mask one rank's
+    goodput collapsing — the fleet min is gated on its own."""
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=_goodput_recs(ratio=0.82, fleet=0.80))
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=_goodput_recs(ratio=0.82, fleet=0.50))
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION goodput/fleet_ratio" in proc.stdout
+
+
+def test_compare_goodput_only_in_base_is_info(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", extra=_goodput_recs())
+    cur = _dump(tmp_path / "cur.jsonl")
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "only in base" in proc.stdout
+
+
+def test_goodput_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=_goodput_recs(
+        ratio=0.7, lost=[("ckpt_save", 12.5), ("stall", 8.0)]))
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "goodput/* family" in proc.stdout
+    assert "goodput ratio 0.7000" in proc.stdout
+    assert "lost ckpt_save" in proc.stdout
+    assert "lost stall" in proc.stdout
+
+
 def test_concurrency_family_table_renders(tmp_path):
     path = _dump(tmp_path / "m.jsonl",
                  extra=[_conc("blocking-call-under-lock", 3),

@@ -19,6 +19,11 @@
 # when the summed engine wall time exceeds LINT_TIME_BUDGET_S (default
 # 180s; <= 0 disables) — the growing engine stack must not silently rot
 # tier-1 runtime. The per-engine breakdown is printed on every run.
+#
+# Goodput gate (ISSUE 17 satellite): after the analysis engines, the
+# full run also exercises `tools/metrics_report.py --compare` against
+# the pinned BENCH_BASELINE.jsonl (self-compare by default; set
+# BENCH_COMPARE_CURRENT to a fresh bench dump to gate a real run).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -77,6 +82,28 @@ if [[ "${1:-}" == "--changed-only" ]]; then
         ${diff_args[@]+"${diff_args[@]}"} "${ast_paths[@]}" "$@"
 fi
 
-exec python -m apex_tpu.analysis \
+rc=0
+python -m apex_tpu.analysis \
     --baseline tests/run_analysis/baseline.json \
-    apex_tpu examples tools bench.py "$@"
+    apex_tpu examples tools bench.py "$@" || rc=$?
+
+# Goodput regression gate (ISSUE 17 satellite): compare a bench metrics
+# dump against the pinned BENCH_BASELINE.jsonl. By default the baseline
+# is compared against itself — a deterministic arming check that proves
+# the gate parses the pinned dump and the goodput/* family is present
+# (a broken baseline or renamed gauge fails loudly here, not silently
+# in CI). Point BENCH_COMPARE_CURRENT at a fresh `python bench.py`
+# dump to gate a real run's goodput ratio against the baseline.
+if [[ -f BENCH_BASELINE.jsonl ]]; then
+    current="${BENCH_COMPARE_CURRENT:-BENCH_BASELINE.jsonl}"
+    if [[ ! -f "$current" ]]; then
+        echo "BENCH_COMPARE_CURRENT=$current does not exist" >&2
+        exit 2
+    fi
+    python tools/metrics_report.py "$current" \
+        --compare BENCH_BASELINE.jsonl || rc=$?
+else
+    echo "WARNING: BENCH_BASELINE.jsonl missing - goodput gate skipped" >&2
+fi
+
+exit "$rc"

@@ -59,8 +59,16 @@ host-concurrency engine's per-check race/signal/callback verdict)
 gets a per-check table, and ``--compare`` gates any check counter
 growing above its base value or a new check id going nonzero —
 binary, no threshold: one new confirmed race in the host runtime is
-a regression regardless of speed. Unknown ``schema_version`` values
-in analysis reports fail loudly rather than mis-summarizing.
+a regression regardless of speed. The ``goodput/*`` family (ISSUE 17
+— published by the run-ledger accounting, ``python -m
+apex_tpu.observability goodput``) gets the goodput table (ratio +
+fleet min, lost seconds by cause, badput top-3, per-rank ratios),
+and ``--compare`` gates a ``goodput/ratio`` or ``goodput/
+fleet_ratio`` gauge dropping by more than ``--compare-threshold``
+ratio points — the same workload spending more of its wall-clock on
+badput causes is a regression regardless of absolute speed. Unknown
+``schema_version`` values in analysis reports fail loudly rather
+than mis-summarizing.
 """
 
 from __future__ import annotations
@@ -813,6 +821,89 @@ def summarize_fleet(path, fam):
               f"summary below)")
 
 
+def render_goodput_family(path):
+    """The ``goodput/*`` family from a metrics JSONL dump (None when
+    the file carries none): the goodput ratio + fleet min the run-
+    ledger accounting published, lost seconds by cause, the badput
+    top-3 and per-rank ratios (ISSUE 17)."""
+    records = _read_records(path)
+    if records is None:
+        return None
+    ratio = fleet = wall = productive = replayed = None
+    lost: dict = {}
+    badput: dict = {}
+    rank_ratio: dict = {}
+    for rec in records:
+        if rec.get("type") != "gauge" or \
+                not isinstance(rec.get("name"), str) or \
+                not rec["name"].startswith("goodput/"):
+            continue
+        name = rec["name"]
+        labels = rec.get("labels", {}) or {}
+        value = rec.get("value")
+        if name == "goodput/ratio":
+            ratio = value
+        elif name == "goodput/fleet_ratio":
+            fleet = value
+        elif name == "goodput/wall_s":
+            wall = value
+        elif name == "goodput/productive_s":
+            productive = value
+        elif name == "goodput/steps_replayed":
+            replayed = value
+        elif name == "goodput/lost_s":
+            lost[labels.get("cause", "?")] = value
+        elif name == "goodput/badput_rank":
+            badput[labels.get("cause", "?")] = value
+        elif name == "goodput/rank_ratio":
+            rank_ratio[labels.get("rank", "?")] = value
+    if ratio is None and not lost:
+        return None
+    return {"ratio": ratio, "fleet_ratio": fleet, "wall_s": wall,
+            "productive_s": productive, "steps_replayed": replayed,
+            "lost_s": lost, "badput_rank": badput,
+            "rank_ratio": rank_ratio}
+
+
+def summarize_goodput(path, fam):
+    print(f"{path}: goodput/* family")
+    ratio = fam["ratio"]
+    ratio_s = f"{ratio:.4f}" if isinstance(ratio, (int, float)) else "-"
+    fleet = fam["fleet_ratio"]
+    fleet_s = f"{fleet:.4f}" if isinstance(fleet, (int, float)) else "-"
+    print(f"  goodput ratio {ratio_s}  (fleet min {fleet_s})")
+    if isinstance(fam["wall_s"], (int, float)):
+        prod = fam["productive_s"] or 0.0
+        print(f"  wall {fam['wall_s']:.3f} s, productive {prod:.3f} s")
+    if fam["steps_replayed"]:
+        print(f"  replayed steps: {fam['steps_replayed']:.0f}")
+    for cause, seconds in sorted(fam["lost_s"].items(),
+                                 key=lambda cs: -(cs[1] or 0)):
+        if not seconds:
+            continue
+        marker = "  <- badput top-3" if cause in fam["badput_rank"] \
+            else ""
+        print(f"    lost {cause:<16} {seconds:.3f} s{marker}")
+    if not any(fam["lost_s"].values()):
+        print("    no lost seconds attributed")
+    for rank, rr in sorted(fam["rank_ratio"].items()):
+        rr_s = f"{rr:.4f}" if isinstance(rr, (int, float)) else "-"
+        print(f"  rank {rank}: ratio {rr_s}")
+
+
+def _goodput_ratio_gauges(records):
+    """{name: value} for the unlabeled goodput ratio gauges the
+    accounting publishes (ratio + fleet min)."""
+    out = {}
+    for rec in records:
+        if rec.get("type") == "gauge" and rec.get("name") in (
+                "goodput/ratio", "goodput/fleet_ratio") \
+                and not (rec.get("labels") or {}) \
+                and isinstance(rec.get("value"), (int, float)):
+            out[rec["name"]] = float(rec["value"])
+    return out
+
+
 def _fleet_skew_gauges(records):
     """{labels-qualified name: value} for fleet/step_time_skew
     gauges."""
@@ -999,6 +1090,26 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"is falling behind the fleet)")
         else:
             infos.append(f"{name}: skew {b:+.1%} -> {c:+.1%} ok")
+
+    cur_gp, base_gp = _goodput_ratio_gauges(cur), \
+        _goodput_ratio_gauges(base)
+    for name in sorted(base_gp):
+        if name not in cur_gp:
+            infos.append(f"{name}: only in base ({base_gp[name]:.4f})")
+            continue
+        b, c = base_gp[name], cur_gp[name]
+        # the goodput ratio is already a fraction of wall-clock, so the
+        # gate is an absolute delta in ratio points (like the fleet-
+        # skew gate): the same workload spending threshold more of its
+        # wall on non-productive causes is a regression regardless of
+        # absolute speed (ISSUE 17)
+        if c < b - threshold:
+            regressions.append(
+                f"{name}: goodput {b:.4f} -> {c:.4f} "
+                f"(dropped past {threshold * 100:.0f} points — the run "
+                f"spends more wall-clock on badput causes)")
+        else:
+            infos.append(f"{name}: goodput {b:.4f} -> {c:.4f} ok")
 
     cur_fp8, base_fp8 = _fp8_speedup_gauges(cur), \
         _fp8_speedup_gauges(base)
@@ -1270,6 +1381,14 @@ if __name__ == "__main__":
                                       "fleet_family": flt}))
                 else:
                     summarize_fleet(arg, flt)
+            gp = render_goodput_family(arg) if os.path.isfile(arg) \
+                else None
+            if gp is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "goodput_family": gp}))
+                else:
+                    summarize_goodput(arg, gp)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
