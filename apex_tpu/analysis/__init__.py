@@ -21,7 +21,13 @@ Two engines, one CLI, one pytest gate:
   **rank-consistency engine** (:mod:`.spmd_checks`) proves the SPMD
   contracts over the same walk: no collective under rank-divergent
   control, no rank-distinct value stored where out_specs claim
-  replication, coordinated RNG, anchored host effects.
+  replication, coordinated RNG, anchored host effects. The
+  **checkpoint/state-flow engine** (:mod:`.state_checks`) closes the
+  resume loop: a step-carry fixpoint over the train-step jaxpr proves
+  every live state leaf reaches the checkpoint save tree, matches the
+  manifest's format-2 ``state_schema``, restores without dtype
+  narrowing, re-shards legally onto every elastic candidate mesh, and
+  is never read after being donated on the resume path.
 - **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
   examples/, tools/, bench.py) for host-sync anti-patterns — the
   ``block_until_ready``-as-timing bug that produced r5's impossible
@@ -67,11 +73,16 @@ from apex_tpu.analysis.spmd_checks import (
     SPMD_CHECKS,
     analyze_spmd,
 )
+from apex_tpu.analysis.state_checks import (
+    STATE_CHECKS,
+    analyze_state,
+)
 from apex_tpu.analysis.targets import (
     TARGETS,
     run_precision_findings,
     run_sharding_findings,
     run_spmd_findings,
+    run_state_findings,
     run_targets,
 )
 
@@ -79,12 +90,15 @@ __all__ = [
     "AST_CHECKS", "CONCURRENCY_CHECKS", "Finding", "JAXPR_CHECKS",
     "PLAN_MODELS",
     "PRECISION_CHECKS", "Plan", "PlanError",
-    "SHARDING_CHECKS", "SPMD_CHECKS", "TARGETS", "analyze_fn",
+    "SHARDING_CHECKS", "SPMD_CHECKS", "STATE_CHECKS", "TARGETS",
+    "analyze_fn",
     "analyze_precision",
     "analyze_sharding", "analyze_sharding_jaxpr", "analyze_spmd",
+    "analyze_state",
     "lint_paths", "lint_source", "load_baseline",
     "new_findings", "plan", "run_concurrency_findings",
     "run_precision_findings",
-    "run_sharding_findings", "run_spmd_findings", "run_targets",
+    "run_sharding_findings", "run_spmd_findings", "run_state_findings",
+    "run_targets",
     "save_baseline",
 ]

@@ -9,6 +9,8 @@
     python -m apex_tpu.analysis --diff base.json     # fail only on NEW
     python -m apex_tpu.analysis --allow my_target:master-weights
     python -m apex_tpu.analysis --list-checks
+    python -m apex_tpu.analysis --list-targets       # registered targets + engine
+    python -m apex_tpu.analysis --engines ast,state  # engine subset
     python -m apex_tpu.analysis plan --target llama  # auto-shard planner
 
 Exit codes: 0 clean (or all findings grandfathered), 1 new findings,
@@ -29,14 +31,20 @@ from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
 from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
 from apex_tpu.analysis.spmd_checks import SPMD_CHECKS
+from apex_tpu.analysis.state_checks import STATE_CHECKS
 
 DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 
 # Engines the per-target wall time rolls up into (the lint summary's
 # gate-latency line — the unified-interpreter speedup and any future
-# regression show up here, per ISSUE 8 satellite).
+# regression show up here, per ISSUE 8 satellite). Also the vocabulary
+# of --engines selection.
 ENGINE_NAMES = ("ast", "concurrency", "jaxpr", "dataflow", "sharding",
-                "spmd")
+                "spmd", "state")
+
+# The engines that run via the registered tracing targets (everything
+# in ENGINE_NAMES except the two path-driven ones).
+_TRACING_ENGINES = frozenset(ENGINE_NAMES) - {"ast", "concurrency"}
 
 # Total-wall-time budget for one gate run (ISSUE 14 satellite): the
 # engine stack keeps growing, and tier-1 runs the gate every round — a
@@ -63,7 +71,38 @@ def known_checks():
     return (set(ast_checks.AST_CHECKS) | set(CONCURRENCY_CHECKS)
             | set(JAXPR_CHECKS)
             | set(PRECISION_CHECKS) | set(SHARDING_CHECKS)
-            | set(SPMD_CHECKS) | set(targets.TARGET_CHECKS))
+            | set(SPMD_CHECKS) | set(STATE_CHECKS)
+            | set(targets.TARGET_CHECKS))
+
+
+def target_engine(target_name):
+    """Which ENGINE_NAMES bucket a registered target's wall time and
+    findings roll up into."""
+    return ("dataflow" if target_name in targets.PRECISION_TARGETS else
+            "sharding" if target_name in targets.SHARDING_TARGETS else
+            "spmd" if target_name in targets.SPMD_TARGETS else
+            "state" if target_name in targets.STATE_TARGETS else
+            "jaxpr")
+
+
+def parse_engines(spec):
+    """--engines value -> validated frozenset of engine names; loud on
+    typos and on an empty selection (either would silently run
+    nothing/everything forever)."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        spec = [e.strip() for e in spec.split(",") if e.strip()]
+    engines = frozenset(spec)
+    if not engines:
+        raise ValueError(
+            f"--engines selected no engine; valid: {list(ENGINE_NAMES)}")
+    unknown = engines - set(ENGINE_NAMES)
+    if unknown:
+        raise ValueError(
+            f"unknown engine(s) {sorted(unknown)}; valid: "
+            f"{list(ENGINE_NAMES)}")
+    return engines
 
 
 def load_diff_report(path):
@@ -125,7 +164,7 @@ def parse_allow(entries):
 
 
 def run(paths=None, root=None, ast=True, jaxpr=True, concurrency=True,
-        checks=None, allow=None, engine_seconds=None):
+        checks=None, allow=None, engine_seconds=None, engines=None):
     """Programmatic entry: returns (findings, target_errors).
 
     ``allow``: {target: {check ids}} per-target grandfather, merged over
@@ -133,8 +172,15 @@ def run(paths=None, root=None, ast=True, jaxpr=True, concurrency=True,
     optional dict that receives per-engine wall time (keys
     :data:`ENGINE_NAMES`) — the gate-latency breakdown the lint summary
     prints. The concurrency engine shares the AST engine's path list,
-    so ``--changed-only`` narrowing applies to both.
+    so ``--changed-only`` narrowing applies to both. ``engines``: an
+    iterable of :data:`ENGINE_NAMES` to restrict the run to (validated
+    loudly); composes with the ``--no-*`` flags (both must select an
+    engine) and with ``checks`` (intersection).
     """
+    engines = parse_engines(engines)
+    if engines is not None:
+        ast = ast and "ast" in engines
+        concurrency = concurrency and "concurrency" in engines
     if checks:
         unknown = set(checks) - known_checks()
         if unknown:
@@ -185,18 +231,18 @@ def run(paths=None, root=None, ast=True, jaxpr=True, concurrency=True,
             # only the (cheap, non-tracing) targets whose checks were
             # asked for — skips the kernel trace suite
             names = set(checks) & set(targets.TARGET_CHECKS)
+        if engines is not None:
+            tracing = engines & _TRACING_ENGINES
+            wanted = {t for t in targets.TARGETS
+                      if target_engine(t) in tracing}
+            names = wanted if names is None else set(names) & wanted
         if names is None or names:
             per_target = {} if engine_seconds is not None else None
             jf, errors = targets.run_targets(names, extra_allow=allow,
                                              timings=per_target)
             if per_target is not None:
                 for target_name, seconds in per_target.items():
-                    engine = ("dataflow" if target_name in
-                              targets.PRECISION_TARGETS else
-                              "sharding" if target_name in
-                              targets.SHARDING_TARGETS else
-                              "spmd" if target_name in
-                              targets.SPMD_TARGETS else "jaxpr")
+                    engine = target_engine(target_name)
                     engine_seconds[engine] = engine_seconds.get(
                         engine, 0.0) + seconds
             if checks:
@@ -231,6 +277,11 @@ def main(argv=None):
                          "the AST engine's path list)")
     ap.add_argument("--checks", default=None,
                     help="comma-separated check ids to run")
+    ap.add_argument("--engines", default=None,
+                    help=f"comma-separated engine subset to run "
+                         f"(valid: {','.join(ENGINE_NAMES)}); composes "
+                         f"with --checks and tools/lint.sh "
+                         f"--changed-only")
     ap.add_argument("--allow", action="append", default=[],
                     metavar="TARGET:CHECK",
                     help="drop findings of CHECK from jaxpr TARGET "
@@ -250,6 +301,9 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--list-targets", action="store_true",
+                    help="print the registered tracing targets and the "
+                         "engine each rolls up into, then exit")
     args = ap.parse_args(argv)
 
     if args.list_checks:
@@ -265,8 +319,15 @@ def main(argv=None):
             print(f"{cid:32s} [jaxpr/sharding]")
         for cid in SPMD_CHECKS:
             print(f"{cid:32s} [jaxpr/spmd]")
+        for cid in STATE_CHECKS:
+            print(f"{cid:32s} [jaxpr/state]")
         for cid in targets.TARGET_CHECKS:
             print(f"{cid:32s} [jaxpr]")
+        return 0
+
+    if args.list_targets:
+        for name in targets.TARGETS:
+            print(f"{name:36s} [{target_engine(name)}]")
         return 0
 
     checks = None
@@ -284,7 +345,8 @@ def main(argv=None):
         found, errors = run(paths=args.paths or None, root=args.root,
                             ast=args.ast, jaxpr=args.jaxpr,
                             concurrency=args.concurrency, checks=checks,
-                            allow=allow, engine_seconds=engine_seconds)
+                            allow=allow, engine_seconds=engine_seconds,
+                            engines=args.engines)
     except (OSError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -1,0 +1,758 @@
+"""Checkpoint/state-flow checks — static resume compatibility (ISSUE 18).
+
+Every training tier this repo grew (amp scaler state, fused-optimizer
+master state, fp8 amax rings, ZeRO-1 moment shards) rides one unproven
+assumption: that the state a train step carries round-trips through
+:mod:`apex_tpu.checkpoint` and can be re-laid-out on a different mesh.
+``_APEX_COMMIT.json`` is a file-level manifest (size + crc32): a field
+silently dropped from the save tree, a dtype-narrowed restore slot, or
+a ZeRO-1 bucket whose padding quantum does not divide the new dp count
+are all runtime-or-never discoveries. This engine makes them static
+errors, the way the spmd/concurrency engines did for rank desync and
+host races.
+
+The engine derives the *expected* state schema from code:
+
+- a **step-carry fixpoint** over the train-step jaxpr via the unified
+  interpreter (:mod:`.interp`) — :class:`StateFlowLattice` tracks, per
+  jaxpr ``Var``, the set of flat state-input leaves the value derives
+  from (``warm_carry_join`` runs scan/while bodies to their
+  steady-state, so a leaf read only through a carried loop still
+  registers). A state leaf whose value reaches ANY step output is
+  *step-carried*: its restored value determines the post-resume
+  trajectory, so it must round-trip through the checkpoint;
+- **joined with the registered state constructors** — the known state
+  NamedTuples (``LossScaleState``, ``Fp8ScalingState``,
+  ``AmaxHistoryState``, ``Zero1AdamState``, fused-optimizer flat/tree
+  state) tag each leaf with its constructor kind, so findings and the
+  manifest's ``state_schema`` block both name the field that drifted,
+  not just a flat index.
+
+Five checks (:data:`STATE_CHECKS`):
+
+- ``unsaved-train-state``  a step-carried leaf never reaches the
+  checkpoint save tree (the save fn's jaxpr is origin-traced the same
+  way) — silent state loss on resume. The chaos harness can only catch
+  this per-field at runtime; the fixpoint proves it for all fields.
+- ``ckpt-schema-drift``  the code-derived treedef/shape/dtype/spec
+  fingerprint disagrees with the manifest's ``state_schema`` block
+  (commit-marker format 2, :func:`apex_tpu.checkpoint.state_schema_of`)
+  — the checkpoint on disk is not the state the code expects to
+  restore. Format-1 manifests carry no schema and pass (back-compat).
+- ``dtype-narrowing-restore``  a saved dtype wider than the restore
+  slot (fp32 master state restored into a bf16 slot): orbax casts
+  silently and the master-weight discipline dies on resume.
+- ``reshard-illegal``  for each saved dim-0-sharded buffer and every
+  candidate mesh size the planner would propose on shrink/grow, prove
+  dim-0 divisibility AND shard-quantum compatibility (the ZeRO-1
+  bucket padding ``_pad_up(total, n)`` must be invariant under the new
+  shard count, or the saved flat buffer cannot be re-laid-out
+  bit-for-bit) — the static gate elastic re-mesh needs before it
+  exists (ROADMAP items 2–3).
+- ``restore-donation-hazard``  a restored buffer feeds a donated
+  argnum on the resume path and is read again (or returned) after the
+  donating call with no copy in between — use-after-donate that only
+  fires on real TPU, where donation actually invalidates the buffer.
+
+Entry point: :func:`analyze_state` (mirrors ``analyze_spmd``); the
+registered step/save/resume compositions live in :mod:`.targets`
+(``STATE_TARGETS``) and per-run counts land in the
+``analysis/state_findings{check=}`` metric family — zero-filled (every
+check id is emitted every run), so the binary ``--compare`` gate in
+``tools/metrics_report.py`` sees an explicit 0, not an absent series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from apex_tpu.analysis import interp
+from apex_tpu.analysis.findings import Finding
+
+STATE_CHECKS = (
+    "unsaved-train-state", "ckpt-schema-drift",
+    "dtype-narrowing-restore", "reshard-illegal",
+    "restore-donation-hazard",
+)
+
+
+# ------------------------------------------------------- origin lattice
+
+
+@dataclasses.dataclass(frozen=True)
+class OriginVal:
+    """One point of the state-flow lattice: the set of flat state-input
+    leaf indices this value derives from."""
+
+    origins: frozenset = frozenset()
+
+
+_EMPTY = OriginVal()
+
+
+def _join(ins):
+    present = [v for v in ins if v is not None]
+    if not present:
+        return _EMPTY
+    return OriginVal(origins=frozenset().union(
+        *(v.origins for v in present)))
+
+
+class StateFlowLattice(interp.Lattice):
+    """Origin provenance over the unified walk: which state leaves can
+    influence each value. Union-join everywhere (provenance is
+    contagious through every compute op); scan/while carries run the
+    warm fixpoint so a leaf read only on iteration >= 1 of a carried
+    loop still registers as live."""
+
+    name = "state"
+    warm_carry_join = True
+
+    def for_aval(self, aval):
+        return _EMPTY
+
+    def transfer(self, eqn, ins, out_avals, ctx):
+        if eqn.primitive.name == "optimization_barrier":
+            # elementwise over the tuple: each output mirrors its own
+            # operand (a chain token must not taint the bucket it
+            # orders — same rule as the rank lattice)
+            return tuple(
+                (ins[i] if i < len(ins) and ins[i] is not None
+                 else _EMPTY) for i in range(len(out_avals)))
+        base = _join(ins)
+        return tuple(base for _ in out_avals)
+
+    def join_branch(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return OriginVal(origins=a.origins | b.origins)
+
+    join_carry = join_branch
+
+
+STATE_LATTICE = StateFlowLattice()
+
+
+# ----------------------------------------------------- schema derivation
+
+
+#: Known state-constructor NamedTuples: leaves under one of these nodes
+#: are tagged ``Kind.field`` in the schema, so a drift finding names
+#: the constructor field, not a flat index. Import paths are lazy —
+#: a missing module just loses the tag, never the check.
+_CONSTRUCTOR_IMPORTS = (
+    ("apex_tpu.amp.scaler", "LossScaleState"),
+    ("apex_tpu.amp.scaler", "Fp8ScalingState"),
+    ("apex_tpu.observability.numerics.history", "AmaxHistoryState"),
+    ("apex_tpu.parallel.zero", "Zero1AdamState"),
+)
+
+
+#: (module, class) pairs whose lazy import failed: the schema loses the
+#: constructor tag but every check still runs — counted here so the
+#: degradation is inspectable, never silent.
+_MISSING_CONSTRUCTORS = set()
+
+
+def _constructor_classes():
+    import importlib
+
+    out = []
+    for mod, cls in _CONSTRUCTOR_IMPORTS:
+        try:
+            out.append(getattr(importlib.import_module(mod), cls))
+        except Exception:  # noqa: BLE001 — optional tags only
+            _MISSING_CONSTRUCTORS.add((mod, cls))
+    return tuple(out)
+
+
+def leaf_kinds(tree):
+    """Per-flat-leaf constructor tag (``"Zero1AdamState.mu"`` /
+    ``"LossScaleState.loss_scale"`` / None) for ``tree``, in
+    ``tree_leaves`` order — the registered-constructor join."""
+    import jax
+
+    classes = _constructor_classes()
+    kinds = []
+
+    def walk(node, tag):
+        if isinstance(node, classes):
+            for field, child in zip(type(node)._fields, node):
+                walk(child, f"{type(node).__name__}.{field}")
+            return
+        leaves_here = jax.tree_util.tree_structure(node).num_leaves
+        if leaves_here == 0:
+            return
+        children, _treedef = jax.tree_util.tree_flatten(
+            node, is_leaf=lambda x: x is not node and (
+                isinstance(x, classes)
+                or jax.tree_util.treedef_is_leaf(
+                    jax.tree_util.tree_structure(x))))
+        if len(children) == 1 and children[0] is node:
+            kinds.append(tag)
+            return
+        for child in children:
+            walk(child, tag)
+
+    walk(tree, None)
+    return tuple(kinds)
+
+
+@dataclasses.dataclass(frozen=True)
+class StateLeaf:
+    """One flat leaf of the derived schema."""
+
+    path: str            # jax.tree_util.keystr of the leaf
+    shape: tuple
+    dtype: str
+    spec: object         # encoded PartitionSpec dims, or None (unknown)
+    kind: object = None  # constructor tag ("Zero1AdamState.mu") or None
+    carried: bool = False  # the step-carry fixpoint says the step reads it
+
+
+@dataclasses.dataclass(frozen=True)
+class StateSchema:
+    """Code-derived expected state schema (treedef + typed leaves)."""
+
+    treedef: str
+    leaves: tuple
+
+    def to_manifest(self) -> dict:
+        """The commit-marker ``state_schema`` encoding this schema
+        expects on disk — same shape :func:`apex_tpu.checkpoint.
+        state_schema_of` writes, so drift compares real encodings."""
+        from apex_tpu.checkpoint import schema_fingerprint
+
+        body = {
+            "treedef": self.treedef,
+            "leaves": [
+                {"path": lf.path, "shape": list(lf.shape),
+                 "dtype": lf.dtype, "spec": lf.spec, "kind": lf.kind}
+                for lf in self.leaves],
+        }
+        body["fingerprint"] = schema_fingerprint(body)
+        return body
+
+
+def _flatten_with_paths(tree):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = tuple(jax.tree_util.keystr(kp) for kp, _ in flat)
+    leaves = tuple(leaf for _, leaf in flat)
+    return paths, leaves, treedef
+
+
+def _spec_leaves(specs, n, context):
+    """Flatten a PartitionSpec pytree to ``n`` encoded entries (None =
+    unknown); loud on a structure mismatch — a silently-misaligned
+    spec tree would attach the wrong axis to every leaf."""
+    if specs is None:
+        return (None,) * n
+    import jax
+    from jax.sharding import PartitionSpec
+
+    flat = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda s: s is None
+        or isinstance(s, PartitionSpec))[0]
+    if len(flat) != n:
+        raise ValueError(
+            f"{context}: specs pytree has {len(flat)} leaves, state "
+            f"has {n} — spec and state trees diverged")
+    from apex_tpu.checkpoint import encode_spec
+
+    return tuple(encode_spec(s) for s in flat)
+
+
+def derive_state_schema(step_fn, state, *args, specs=None, name=None,
+                        axis_sizes=None) -> StateSchema:
+    """Trace ``step_fn(state, *args)`` and derive the expected state
+    schema: per-leaf path/shape/dtype/spec/constructor-kind plus the
+    step-carry verdict (does the leaf's value influence any output —
+    the fixpoint over the jaxpr via :class:`StateFlowLattice`)."""
+    import jax
+
+    name = name or getattr(step_fn, "__name__", "step")
+    paths, leaves, treedef = _flatten_with_paths(state)
+    closed = jax.make_jaxpr(step_fn)(state, *args)
+
+    n_state = len(leaves)
+    in_vals = [OriginVal(origins=frozenset({j})) for j in range(n_state)]
+    in_vals += [None] * (len(closed.jaxpr.invars) - n_state)
+    (out_vals,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(STATE_LATTICE, in_vals)],
+        axis_sizes=axis_sizes or {})
+
+    live = frozenset().union(
+        *(v.origins for v in out_vals if v is not None)) \
+        if any(v is not None for v in out_vals) else frozenset()
+
+    spec_flat = _spec_leaves(specs, n_state, f"derive_state_schema "
+                                            f"({name})")
+    kinds = leaf_kinds(state)
+    schema_leaves = tuple(
+        StateLeaf(path=paths[j], shape=tuple(leaves[j].shape),
+                  dtype=_dtype_name(leaves[j]), spec=spec_flat[j],
+                  kind=kinds[j] if j < len(kinds) else None,
+                  carried=j in live)
+        for j in range(n_state))
+    return StateSchema(treedef=str(treedef), leaves=schema_leaves)
+
+
+def _dtype_name(leaf):
+    import numpy as np
+
+    dt = getattr(leaf, "dtype", None)
+    if dt is None:
+        dt = np.asarray(leaf).dtype
+    return np.dtype(dt).name
+
+
+def trace_save_coverage(save_tree_of, state):
+    """Origin-trace the save fn: which flat state leaves reach the
+    saved tree, and per saved slot, which state leaf it mirrors.
+
+    Returns ``(covered, saved_paths, saved_shapes, slot_origins)``:
+    ``covered`` is the frozenset of state-leaf indices present in the
+    save tree; ``slot_origins[i]`` is the origin set of saved flat
+    slot ``i`` (a singleton for plain restructuring saves)."""
+    import jax
+
+    closed, saved_shape = jax.make_jaxpr(
+        save_tree_of, return_shape=True)(state)
+    n_state = len(jax.tree_util.tree_leaves(state))
+    in_vals = [OriginVal(origins=frozenset({j})) for j in range(n_state)]
+    in_vals += [None] * (len(closed.jaxpr.invars) - n_state)
+    (out_vals,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(STATE_LATTICE, in_vals)],
+        axis_sizes={})
+    slot_origins = tuple(
+        (v.origins if v is not None else frozenset())
+        for v in out_vals)
+    covered = frozenset().union(*slot_origins) if slot_origins \
+        else frozenset()
+    saved_paths, saved_leaves, saved_treedef = _flatten_with_paths(
+        saved_shape)
+    return covered, saved_paths, saved_leaves, saved_treedef, \
+        slot_origins
+
+
+# ------------------------------------------------------------- findings
+
+
+class _Ctx:
+    def __init__(self, name, path, checks=frozenset(STATE_CHECKS)):
+        self.name = name
+        self.path = path
+        self.checks = frozenset(checks)
+        self.findings = []
+        self.seen = set()
+
+    def add(self, check, severity, message, dedup_key=None):
+        if check not in self.checks:
+            return
+        if dedup_key is not None:
+            key = (check,) + tuple(dedup_key)
+            if key in self.seen:
+                return
+            self.seen.add(key)
+        self.findings.append(Finding(
+            check, severity, self.path, 0, self.name, message))
+
+
+# -------------------------------------------------- per-check evaluators
+
+
+def _check_unsaved(ctx, schema, covered):
+    for j, lf in enumerate(schema.leaves):
+        if not lf.carried or j in covered:
+            continue
+        kind = f" ({lf.kind})" if lf.kind else ""
+        ctx.add(
+            "unsaved-train-state", "error",
+            f"state leaf {lf.path}{kind} is step-carried (its value "
+            f"flows into the next step's outputs) but never reaches "
+            f"the checkpoint save tree: on resume it silently "
+            f"re-initializes and the run is no longer the run that "
+            f"was saved — add the leaf to the save tree, or prove it "
+            f"derivable and drop it from the carry",
+            dedup_key=(lf.path,))
+
+
+def _manifest_leaves(manifest_schema):
+    out = {}
+    for lf in manifest_schema.get("leaves", ()):
+        out[lf.get("path", "?")] = lf
+    return out
+
+
+def _check_schema_drift(ctx, code_manifest, disk_manifest):
+    code_by = _manifest_leaves(code_manifest)
+    disk_by = _manifest_leaves(disk_manifest)
+    if code_manifest.get("treedef") != disk_manifest.get("treedef"):
+        ctx.add(
+            "ckpt-schema-drift", "error",
+            f"saved treedef does not match the code-derived save "
+            f"tree: manifest has {disk_manifest.get('treedef')!r}, "
+            f"code expects {code_manifest.get('treedef')!r} — the "
+            f"checkpoint on disk is not the state this step restores",
+            dedup_key=("treedef",))
+    for path in sorted(set(code_by) - set(disk_by)):
+        ctx.add(
+            "ckpt-schema-drift", "error",
+            f"save-tree leaf {path} is missing from the manifest's "
+            f"state_schema — the checkpoint predates (or dropped) "
+            f"this field and restore will not populate it",
+            dedup_key=("missing", path))
+    for path in sorted(set(disk_by) - set(code_by)):
+        ctx.add(
+            "ckpt-schema-drift", "warning",
+            f"manifest carries leaf {path} the code-derived save "
+            f"tree no longer has — stale state rides every restore "
+            f"(or the save tree silently shrank)",
+            dedup_key=("extra", path))
+    for path in sorted(set(code_by) & set(disk_by)):
+        want, got = code_by[path], disk_by[path]
+        for field in ("shape", "dtype", "spec"):
+            w = want.get(field)
+            g = got.get(field)
+            if field == "shape":
+                w, g = list(w or ()), list(g or ())
+            if w != g:
+                ctx.add(
+                    "ckpt-schema-drift", "error",
+                    f"leaf {path} {field} drifted: manifest has "
+                    f"{g!r}, code expects {w!r} — restore would "
+                    f"reinterpret the saved bytes",
+                    dedup_key=(path, field))
+
+
+_FLOAT_WIDTH = {
+    "float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "float8_e4m3fnuz": 1,
+    "float8_e5m2fnuz": 1, "float8_e4m3b11fnuz": 1,
+}
+
+
+def _check_dtype_narrowing(ctx, saved_manifest, template_paths,
+                           template_leaves):
+    slot_by_path = {p: lf for p, lf in zip(template_paths,
+                                           template_leaves)}
+    for lf in saved_manifest.get("leaves", ()):
+        path = lf.get("path", "?")
+        slot = slot_by_path.get(path)
+        if slot is None:
+            continue
+        saved_dt = str(lf.get("dtype"))
+        slot_dt = _dtype_name(slot)
+        sw = _FLOAT_WIDTH.get(saved_dt)
+        tw = _FLOAT_WIDTH.get(slot_dt)
+        if sw is None or tw is None or sw <= tw:
+            continue
+        kind = f" ({lf.get('kind')})" if lf.get("kind") else ""
+        ctx.add(
+            "dtype-narrowing-restore", "error",
+            f"leaf {path}{kind} was saved as {saved_dt} but the "
+            f"restore slot is {slot_dt}: orbax casts silently and "
+            f"the wide master copy is lost on resume — restore into "
+            f"a {saved_dt} slot (the master-weight discipline the "
+            f"precision engine enforces in-step applies across the "
+            f"checkpoint boundary too)",
+            dedup_key=(path,))
+
+
+def _pad_up(total, k):
+    return total + ((-total) % max(1, k))
+
+
+def _spec_dim0_axes(spec):
+    """Mesh axis names the encoded spec shards dim 0 over."""
+    if not spec:
+        return ()
+    dim0 = spec[0]
+    if dim0 is None:
+        return ()
+    if isinstance(dim0, (list, tuple)):
+        return tuple(str(a) for a in dim0)
+    return (str(dim0),)
+
+
+def _check_reshard(ctx, saved_manifest, layout, candidates):
+    candidates = tuple(int(n) for n in candidates)
+    axis = (layout or {}).get("axis")
+    for lf in saved_manifest.get("leaves", ()):
+        axes = _spec_dim0_axes(lf.get("spec"))
+        if not axes or (axis is not None and axis not in axes):
+            continue
+        shape = tuple(lf.get("shape") or ())
+        if not shape:
+            continue
+        for n in candidates:
+            if n > 0 and shape[0] % n == 0:
+                continue
+            ctx.add(
+                "reshard-illegal", "error",
+                f"leaf {lf.get('path', '?')} is saved dim-0-sharded "
+                f"over {'/'.join(axes)} with shape[0]={shape[0]}, "
+                f"which does not divide into {n} shards — the "
+                f"planner's candidate mesh ({'/'.join(axes)}={n}) "
+                f"cannot re-lay this buffer out; re-pad the saved "
+                f"buffer or drop {n} from the elastic candidate set",
+                dedup_key=(lf.get("path", "?"), n))
+    for k, bucket in enumerate((layout or {}).get("buckets", ())):
+        total = int(bucket.get("total", 0))
+        padded = int(bucket.get("padded", 0))
+        for n in candidates:
+            if n <= 0:
+                continue
+            if padded % n != 0:
+                ctx.add(
+                    "reshard-illegal", "error",
+                    f"ZeRO-1 bucket {k} ({bucket.get('dtype')}) has "
+                    f"padded length {padded}, not divisible by "
+                    f"candidate shard count {n} — the saved moment "
+                    f"shards cannot be re-scattered onto that mesh",
+                    dedup_key=("bucket-div", k, n))
+            elif _pad_up(total, n) != padded:
+                ctx.add(
+                    "reshard-illegal", "error",
+                    f"ZeRO-1 bucket {k} ({bucket.get('dtype')}) was "
+                    f"padded to {padded} for "
+                    f"{(layout or {}).get('num_shards')} shards, but "
+                    f"re-planning for {n} shards pads "
+                    f"{total} -> {_pad_up(total, n)}: the saved flat "
+                    f"buffer and the new plan disagree on the shard "
+                    f"quantum, so a restore onto that mesh "
+                    f"misaligns every leaf after the first pad — "
+                    f"only shard counts with _pad_up(total, n) == "
+                    f"{padded} are pure reshards",
+                    dedup_key=("bucket-quantum", k, n))
+
+
+def check_restore_donation(resume_fn, state, *resume_args, name=None,
+                           checks=None):
+    """Trace the resume path (``resume_fn(restored_state, *args)``)
+    and flag restored buffers that feed a donated argnum of an inner
+    jitted call and are then read again (or returned) — on real TPU
+    the donation invalidated the buffer, so the later read is
+    use-after-free the CPU backend never surfaces.
+
+    A copy (``jnp.copy`` / ``+ 0``) before the donating call creates a
+    fresh buffer and clears the hazard for the original; so does
+    simply not touching the restored reference after the call."""
+    import jax
+
+    name = name or getattr(resume_fn, "__name__", "resume")
+    ctx = _Ctx(name, f"<jaxpr:{name}>",
+               checks=_validate_checks(checks))
+    if "restore-donation-hazard" not in ctx.checks:
+        return ctx.findings
+    closed = jax.make_jaxpr(resume_fn)(state, *resume_args)
+    jaxpr = closed.jaxpr
+    n_state = len(jax.tree_util.tree_leaves(state))
+
+    # forward origin pass over the TOP-LEVEL eqns (donation happens at
+    # jit boundaries, which appear here as pjit eqns)
+    restored = {v for v in jaxpr.invars[:n_state]}
+    derives = dict.fromkeys(restored, True)
+    donated_at = []  # (position, eqn, donated restored vars)
+    for pos, eqn in enumerate(jaxpr.eqns):
+        flags = eqn.params.get("donated_invars")
+        if flags:
+            hit = [v for v, flag in zip(eqn.invars, flags)
+                   if flag and interp.is_var(v) and derives.get(v)]
+            if hit:
+                donated_at.append((pos, eqn, hit))
+        tainted = any(interp.is_var(v) and derives.get(v)
+                      for v in eqn.invars)
+        for v in eqn.outvars:
+            if interp.is_var(v):
+                derives[v] = tainted
+    out_vars = {v for v in jaxpr.outvars if interp.is_var(v)}
+    for pos, eqn, hit in donated_at:
+        later_reads = set()
+        for later in jaxpr.eqns[pos + 1:]:
+            later_reads.update(v for v in later.invars
+                               if interp.is_var(v))
+        for v in hit:
+            read_after = v in later_reads
+            returned = v in out_vars
+            if not read_after and not returned:
+                continue
+            how = "read again after the donating call" if read_after \
+                else "returned to the caller"
+            ctx.add(
+                "restore-donation-hazard", "error",
+                f"a restored buffer is donated into "
+                f"'{eqn.primitive.name}' (donate_argnums on the first "
+                f"post-resume step) and then {how}: on TPU the "
+                f"donation invalidated the buffer, so the resume path "
+                f"holds a dead reference (the ResilientTrainLoop "
+                f"fallback_state pattern) — jnp.copy the restored "
+                f"state before the donating step, or drop the stale "
+                f"reference",
+                dedup_key=(str(v), pos))
+    return ctx.findings
+
+
+# ----------------------------------------------------------------- entry
+
+
+def analyze_state(step_fn, state, *args, name=None, save_tree_of=None,
+                  restore_template=None, specs=None, manifest=None,
+                  reshard_layout=None, reshard_candidates=None,
+                  resume_fn=None, resume_args=None, checks=None,
+                  stats_out=None, axis_sizes=None):
+    """Run the checkpoint/state-flow checks over one train step.
+
+    ``step_fn(state, *args)``: the train step, state as argnum 0; its
+    outputs define liveness for the step-carry fixpoint.
+    ``save_tree_of``: state -> the pytree the checkpoint path actually
+    persists (default: identity — save everything).
+    ``restore_template``: the pytree restore populates (default: the
+    save tree itself — no narrowing). ``specs``: PartitionSpec pytree
+    matching ``state``. ``manifest``: a commit-marker ``state_schema``
+    dict, a full marker payload, or a checkpoint dir path — when None,
+    the drift check round-trips the code-derived schema through the
+    manifest encoding (the arming self-check). ``reshard_layout`` /
+    ``reshard_candidates``: the :meth:`Zero1FusedAdam.state_layout`
+    export and the candidate shard counts to prove (e.g.
+    :meth:`Zero1FusedAdam.elastic_candidates`). ``resume_fn`` /
+    ``resume_args``: the resume-path composition for the donation
+    check (skipped when absent). Returns a list of :class:`Finding`.
+    """
+    name = name or getattr(step_fn, "__name__", "step")
+    run = _validate_checks(checks)
+    ctx = _Ctx(name, f"<jaxpr:{name}>", checks=run)
+
+    schema = derive_state_schema(step_fn, state, *args, specs=specs,
+                                 name=name, axis_sizes=axis_sizes)
+    save_fn = save_tree_of if save_tree_of is not None \
+        else (lambda s: s)
+    covered, saved_paths, saved_leaves, _saved_treedef, slot_origins \
+        = trace_save_coverage(save_fn, state)
+
+    if "unsaved-train-state" in run:
+        _check_unsaved(ctx, schema, covered)
+
+    # schema of the SAVED tree (what the manifest describes): spec and
+    # kind carry over from the state leaf a slot mirrors (singleton
+    # origin — plain restructuring saves)
+    saved_schema_leaves = []
+    for i, (path, leaf) in enumerate(zip(saved_paths, saved_leaves)):
+        spec = kind = None
+        origins = slot_origins[i] if i < len(slot_origins) \
+            else frozenset()
+        if len(origins) == 1:
+            (j,) = origins
+            if j < len(schema.leaves):
+                spec = schema.leaves[j].spec
+                kind = schema.leaves[j].kind
+        saved_schema_leaves.append(StateLeaf(
+            path=path, shape=tuple(leaf.shape),
+            dtype=_dtype_name(leaf), spec=spec, kind=kind))
+    code_saved = StateSchema(treedef=str(_saved_treedef),
+                             leaves=tuple(saved_schema_leaves))
+    code_manifest = code_saved.to_manifest()
+
+    disk_manifest = _resolve_manifest(manifest)
+    if "ckpt-schema-drift" in run:
+        if disk_manifest is not None:
+            _check_schema_drift(ctx, code_manifest, disk_manifest)
+        else:
+            # arming round-trip: the encode/decode path itself is under
+            # test, so a broken encoder fails the clean targets loudly
+            _check_schema_drift(
+                ctx, code_manifest,
+                json.loads(json.dumps(code_manifest)))
+
+    if "dtype-narrowing-restore" in run:
+        saved_for_narrowing = disk_manifest if disk_manifest is not None \
+            else code_manifest
+        if restore_template is not None:
+            tpaths, tleaves, _ = _flatten_with_paths(restore_template)
+        else:
+            tpaths, tleaves = saved_paths, saved_leaves
+        _check_dtype_narrowing(ctx, saved_for_narrowing, tpaths,
+                               tleaves)
+
+    if "reshard-illegal" in run and reshard_candidates:
+        _check_reshard(ctx, code_manifest, reshard_layout,
+                       reshard_candidates)
+
+    if "restore-donation-hazard" in run and resume_fn is not None:
+        ctx.findings.extend(check_restore_donation(
+            resume_fn, state, *(resume_args or ()), name=name,
+            checks=("restore-donation-hazard",)))
+
+    if stats_out is not None:
+        stats_out.update({
+            "carried": sum(1 for lf in schema.leaves if lf.carried),
+            "saved_leaves": len(saved_leaves),
+            "reshard_candidates": len(tuple(reshard_candidates or ())),
+        })
+    return ctx.findings
+
+
+def _resolve_manifest(manifest):
+    """Normalize ``manifest`` to a ``state_schema`` dict (or None):
+    accepts the schema dict itself, a full commit-marker payload, or a
+    checkpoint step-dir path. A format-1 dir (no schema) resolves to
+    None — back-compat, not drift."""
+    if manifest is None:
+        return None
+    if isinstance(manifest, str):
+        from apex_tpu.checkpoint import manifest_state_schema
+
+        return manifest_state_schema(manifest)
+    if isinstance(manifest, dict):
+        if "leaves" in manifest:
+            return manifest
+        return manifest.get("state_schema")
+    raise TypeError(
+        f"manifest must be a dict or checkpoint dir path, got "
+        f"{type(manifest).__name__}")
+
+
+def _validate_checks(checks):
+    run = set(checks or STATE_CHECKS)
+    unknown = run - set(STATE_CHECKS)
+    if unknown:
+        raise ValueError(
+            f"unknown state check(s) {sorted(unknown)}; valid: "
+            f"{list(STATE_CHECKS)}")
+    return run
+
+
+def report_to_registry(results, registry=None):
+    """Publish state findings + per-target carry/save stats as the
+    ``analysis/state_*`` metric family.
+
+    ``results``: {target name: (findings list, stats dict)}. Counters:
+    ``analysis/state_findings{check=}`` — ZERO-FILLED: every check id
+    is emitted every run (an explicit 0, not an absent series), so the
+    binary ``--compare`` gate distinguishes "clean" from "never ran".
+    Gauges: ``analysis/state_findings_total``,
+    ``analysis/state_carried_leaves{target=}``,
+    ``analysis/state_saved_leaves{target=}``. Returns {check: count}.
+    """
+    from apex_tpu.observability import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    counts = {c: 0 for c in STATE_CHECKS}
+    for target, (findings, stats) in sorted(results.items()):
+        for f in findings:
+            if f.check in counts:
+                counts[f.check] += 1
+        if stats:
+            reg.gauge("analysis/state_carried_leaves",
+                      target=target).set(stats.get("carried", 0))
+            reg.gauge("analysis/state_saved_leaves",
+                      target=target).set(stats.get("saved_leaves", 0))
+    for check, n in counts.items():
+        reg.counter("analysis/state_findings", check=check).inc(n)
+    reg.gauge("analysis/state_findings_total").set(sum(counts.values()))
+    return counts

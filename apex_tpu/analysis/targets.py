@@ -21,6 +21,7 @@ from apex_tpu.analysis.sharding_checks import (
     analyze_sharding,
 )
 from apex_tpu.analysis.spmd_checks import SPMD_CHECKS, analyze_spmd
+from apex_tpu.analysis.state_checks import STATE_CHECKS, analyze_state
 
 TARGETS = {}
 
@@ -45,11 +46,16 @@ TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 # Check ids that require running the tracing targets (the CLI runs the
 # full target suite when any of these is requested).
 TRACING_CHECKS = (tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
-                  + tuple(SHARDING_CHECKS) + tuple(SPMD_CHECKS))
+                  + tuple(SHARDING_CHECKS) + tuple(SPMD_CHECKS)
+                  + tuple(STATE_CHECKS))
 
 # Per-target collective/host-effect counts from the last analyze_spmd
 # run of each spmd target (the analysis/spmd_* gauge family).
 SPMD_STATS = {}
+
+# Per-target carried/saved leaf counts from the last analyze_state run
+# of each state target (the analysis/state_* gauge family).
+STATE_STATS = {}
 
 
 def target(name, allow=()):
@@ -1499,6 +1505,233 @@ def run_spmd_findings(registry=None, names=None):
         results[name] = (
             [f for f in findings if f.symbol == name],
             dict(SPMD_STATS.get(name, {})),
+        )
+    _report(results, registry=registry)
+    stats = {name: s for name, (_, s) in results.items()}
+    return findings, errors, stats
+
+
+# ---- checkpoint/state-flow targets (ISSUE 18) ------------------------
+# The resume-compatibility surface: each target is a train step in
+# carry form (state as argnum 0, new state in the outputs) run through
+# analyze_state — the step-carry fixpoint, save-tree coverage, the
+# manifest schema round-trip, and (where state is dp-sharded) the
+# elastic-reshard proof. All at 0 findings: every seeded regression
+# lives in tests/run_analysis/test_state_checks.py.
+
+@target("state_llama_o4_step")
+def _state_llama_o4_step():
+    """The llama O4 train step in carry form: params + fused-adam tree
+    state + the fp8 delayed-scaling rings all round one step. The
+    fixpoint must see every fp8 ring column and the adam moments as
+    step-carried, and the identity save tree must cover them — drop
+    any field from the carry's save path and this target turns red."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.amp import Fp8DelayedScaler
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = llama.tiny(num_layers=1, num_heads=2, num_kv_heads=1,
+                     hidden_size=32, intermediate_size=64,
+                     vocab_size=128, max_seq_len=16)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tx = fused_adam(lr=1e-3)
+    fp8 = Fp8DelayedScaler(["lm_head"], history=4)
+    carry = (params, tx.init(params), fp8.init())
+    tokens = jnp.zeros((2, 16), jnp.int32)
+
+    def train_step(carry, tokens, targets):
+        params, opt_state, fp8_state = carry
+
+        def loss_fn(p):
+            logits = llama.forward(p, tokens, cfg, tp_axis=None,
+                                   cp_axis=None, ep_axis=None)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                lp, targets[..., None], axis=-1))
+
+        with fp8.step(fp8_state) as ctx:
+            loss, grads = ctx.value_and_grad(loss_fn)(params)
+        new_fp8 = fp8.update(fp8_state, ctx)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return (new_params, new_opt, new_fp8), loss
+
+    stats = STATE_STATS.setdefault("state_llama_o4_step", {})
+    return analyze_state(train_step, carry, tokens, tokens,
+                         name="state_llama_o4_step", stats_out=stats)
+
+
+@target("state_zero1_fused_adam_step")
+def _state_zero1_fused_adam_step():
+    """ZeRO-1 carry step + the elastic-reshard proof: the dp-sharded
+    mu/nu buckets must be step-carried, covered by the save tree,
+    schema-stable through the format-2 manifest encoding, AND legally
+    re-shardable onto every candidate the optimizer itself claims
+    (state_layout/elastic_candidates) — the machine check on zero.py's
+    pure-reshard contract."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.zero import Zero1FusedAdam
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.bfloat16),
+                  "b": jnp.zeros((256,), jnp.bfloat16)}
+        opt = Zero1FusedAdam(lr=1e-3, weight_decay=0.01, axis_name="dp",
+                             num_shards=dp, bucket_cap_mb=0.1)
+        state = opt.init(params)
+        grads_of = _ddp_grad_model()
+
+        def step(x, state, params):
+            return opt.step(grads_of(x), state, params)
+
+        state_specs = opt.state_specs(params)
+        param_specs = {"w": P(), "b": P()}
+        fn = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("dp"), state_specs, param_specs),
+            out_specs=(param_specs, state_specs),
+            check_vma=False)
+
+        def train_step(carry, x):
+            params, ostate = carry
+            new_params, new_ostate = fn(x, ostate, params)
+            return new_params, new_ostate
+
+        stats = STATE_STATS.setdefault("state_zero1_fused_adam_step", {})
+        return analyze_state(
+            train_step, (params, state),
+            jnp.zeros((8 * dp, 256), jnp.float32),
+            name="state_zero1_fused_adam_step",
+            specs=(param_specs, state_specs),
+            reshard_layout=opt.state_layout(params),
+            reshard_candidates=opt.elastic_candidates(params),
+            axis_sizes=sizes, stats_out=stats)
+    finally:
+        _release_mesh(owned)
+
+
+@target("state_ddp_overlap_step")
+def _state_ddp_overlap_step():
+    """Overlapped-DDP amp step: flat-adam state plus the LossScaleState
+    counters round the carry through scaled_update's lax.cond skip —
+    the fixpoint must prove both cond branches keep the opt state
+    live, and every scaler counter saved."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.amp import LossScaler, scaled_update
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.float32),
+                  "b": jnp.zeros((256,), jnp.float32)}
+        tx = fused_adam(lr=1e-3, flat=True)
+        scaler = LossScaler()
+        carry = (params, tx.init(params), scaler.init())
+        grads_of = _ddp_grad_model()
+
+        def inner(x, params, opt_state, sstate):
+            grads = sync_gradients_overlapped(
+                grads_of(x), axis_name="dp", bucket_cap_mb=0.1)
+            updates, new_opt, new_sstate, _ovf = scaled_update(
+                tx, scaler, grads, opt_state, params, sstate,
+                overflow_reduce_axes=("dp",))
+            new_params = jax.tree_util.tree_map(
+                jnp.add, params, updates)
+            return new_params, new_opt, new_sstate
+
+        fn = jax.shard_map(
+            inner, mesh=mesh, in_specs=(P("dp"), P(), P(), P()),
+            out_specs=(P(), P(), P()), check_vma=False)
+
+        def train_step(carry, x):
+            params, opt_state, sstate = carry
+            return fn(x, params, opt_state, sstate)
+
+        stats = STATE_STATS.setdefault("state_ddp_overlap_step", {})
+        return analyze_state(
+            train_step, carry,
+            jnp.zeros((8 * dp, 256), jnp.float32),
+            name="state_ddp_overlap_step", axis_sizes=sizes,
+            stats_out=stats)
+    finally:
+        _release_mesh(owned)
+
+
+@target("state_resilient_resume_path")
+def _state_resilient_resume_path():
+    """The ResilientTrainLoop resume composition: restore → first step
+    with the restored reference retained as fallback_state
+    (loop.resume_path mirrors run()'s real shape). The loop's step
+    contract forbids donation, and this target is what enforces it —
+    jit the step with donate_argnums=(0,) and restore-donation-hazard
+    fires on the held fallback reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.resilience.loop import resume_path
+
+    key = jax.random.PRNGKey(0)
+    state = {"w": jnp.ones((16, 16), jnp.float32)}
+
+    @jax.jit  # NON-donating: the loop's documented step contract
+    def step_fn(state, step):
+        g = jax.random.normal(jax.random.fold_in(key, step), (16, 16))
+        w = state["w"] - 0.01 * (g + 0.1 * state["w"])
+        return {"w": w}, {"loss": jnp.mean(w * w)}
+
+    stats = STATE_STATS.setdefault("state_resilient_resume_path", {})
+    return analyze_state(
+        step_fn, state, jnp.int32(0),
+        name="state_resilient_resume_path",
+        save_tree_of=lambda s: {"state": s},  # the loop's save shape
+        resume_fn=resume_path(step_fn), resume_args=(jnp.int32(0),),
+        stats_out=stats)
+
+
+STATE_TARGETS = (
+    "state_llama_o4_step", "state_zero1_fused_adam_step",
+    "state_ddp_overlap_step", "state_resilient_resume_path",
+)
+
+
+def run_state_findings(registry=None, names=None):
+    """Run only the checkpoint/state-flow targets and publish finding
+    counts (zero-filled over every check id) + per-target carried/saved
+    leaf counts to the observability registry (``analysis/state_*``
+    family) — the hook bench.py reports through. Returns
+    (findings, errors, stats)."""
+    from apex_tpu.analysis.state_checks import (
+        STATE_CHECKS as _ST,
+        report_to_registry as _report,
+    )
+
+    wanted = tuple(names) if names is not None else STATE_TARGETS
+    unknown = set(wanted) - set(TARGETS)
+    if unknown:
+        raise ValueError(
+            f"unknown state target(s) {sorted(unknown)}; valid: "
+            f"{sorted(STATE_TARGETS)}")
+    findings, errors = run_targets(set(wanted))
+    findings = [f for f in findings if f.check in _ST]
+    results = {}
+    for name in wanted:
+        if name in errors:
+            continue
+        results[name] = (
+            [f for f in findings if f.symbol == name],
+            dict(STATE_STATS.get(name, {})),
         )
     _report(results, registry=registry)
     stats = {name: s for name, (_, s) in results.items()}

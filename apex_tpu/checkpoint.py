@@ -21,6 +21,17 @@ a ``.tmp`` dir, which :func:`latest_valid_step` ignores and
 torn write. :mod:`apex_tpu.resilience` injects simulated write failures
 through the module-level ``_FAULT_HOOK`` so the failure paths are
 testable on CPU.
+
+Manifest format 2 (ISSUE 18): saves additionally record a *semantic*
+``state_schema`` block — treedef, per-leaf path/shape/dtype/
+PartitionSpec, and a fingerprint over the lot
+(:func:`state_schema_of`) — so the static state-flow engine
+(:mod:`apex_tpu.analysis.state_checks`) can prove, without opening the
+arrays, that the code about to restore this checkpoint agrees with
+what was saved (``ckpt-schema-drift``). Format-1 markers (no schema)
+remain fully valid: :func:`validate_step_dir`, restore and GC never
+look at the block, and format-2 markers are read fine by format-1-era
+code because validation only consumes ``files``.
 """
 
 from __future__ import annotations
@@ -91,11 +102,104 @@ def build_manifest(dirpath: str) -> dict:
     return {"files": files}
 
 
-def write_commit_marker(dirpath: str, step: Optional[int] = None) -> str:
+def encode_spec(spec) -> Optional[list]:
+    """JSON-native encoding of a PartitionSpec: one entry per dim,
+    each ``None`` (replicated), an axis name, or a list of axis names.
+    ``None`` in = ``None`` out (spec unknown, not replicated-everywhere
+    — the state engine treats unknown as unshardable-on-dim-0)."""
+    if spec is None:
+        return None
+    out = []
+    for dim in tuple(spec):
+        if dim is None:
+            out.append(None)
+        elif isinstance(dim, (tuple, list)):
+            out.append([str(a) for a in dim])
+        else:
+            out.append(str(dim))
+    return out
+
+
+def schema_fingerprint(body: dict) -> str:
+    """sha1 over the canonical JSON of the schema's treedef + leaves —
+    one string two manifests (or a manifest and the code-derived
+    schema) can compare without walking the leaf list."""
+    import hashlib
+
+    canon = json.dumps({"treedef": body.get("treedef"),
+                        "leaves": body.get("leaves")},
+                       sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(canon.encode()).hexdigest()
+
+
+def state_schema_of(state: Any, specs: Optional[Any] = None) -> dict:
+    """Semantic schema of a state pytree, as stored in the format-2
+    commit marker: ``{"treedef", "leaves": [{path, shape, dtype, spec,
+    kind}], "fingerprint"}``.
+
+    ``specs``: optional PartitionSpec pytree matching ``state``; when
+    absent each leaf's own ``.sharding.spec`` is used where available.
+    ``kind`` tags leaves of the registered state constructors
+    (``Zero1AdamState.mu`` etc.) via the state engine's constructor
+    registry — best-effort, None when the analysis package is absent.
+    """
+    import numpy as np
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    spec_flat = None
+    if specs is not None:
+        from jax.sharding import PartitionSpec
+
+        spec_flat = jax.tree_util.tree_flatten(
+            specs, is_leaf=lambda s: s is None
+            or isinstance(s, PartitionSpec))[0]
+        if len(spec_flat) != len(flat):
+            raise ValueError(
+                f"state_schema_of: specs pytree has {len(spec_flat)} "
+                f"leaves, state has {len(flat)} — the trees diverged")
+    try:
+        from apex_tpu.analysis.state_checks import leaf_kinds
+
+        kinds = leaf_kinds(state)
+    except Exception:  # noqa: BLE001 — tags are optional decoration
+        kinds = (None,) * len(flat)
+    leaves = []
+    for i, (kp, leaf) in enumerate(flat):
+        if spec_flat is not None:
+            spec = encode_spec(spec_flat[i])
+        else:
+            try:
+                spec = encode_spec(leaf.sharding.spec)
+            except Exception:  # noqa: BLE001 — host arrays, scalars
+                spec = None
+        dt = getattr(leaf, "dtype", None)
+        dtype = np.dtype(dt if dt is not None
+                         else np.asarray(leaf).dtype).name
+        leaves.append({
+            "path": jax.tree_util.keystr(kp),
+            "shape": [int(d) for d in getattr(leaf, "shape", ())],
+            "dtype": dtype,
+            "spec": spec,
+            "kind": kinds[i] if i < len(kinds) else None,
+        })
+    body = {"treedef": str(treedef), "leaves": leaves}
+    body["fingerprint"] = schema_fingerprint(body)
+    return body
+
+
+def write_commit_marker(dirpath: str, step: Optional[int] = None,
+                        state_schema: Optional[dict] = None) -> str:
     """Write the manifest/commit marker into ``dirpath`` (atomically
     within the dir: marker.part + rename). The marker is the LAST write
-    of a checkpoint — its presence asserts every listed file landed."""
+    of a checkpoint — its presence asserts every listed file landed.
+
+    ``state_schema`` (a :func:`state_schema_of` dict) upgrades the
+    marker to format 2; without it the format-1 payload is written
+    byte-compatible with every earlier release."""
     payload = {"format": 1, "step": step, **build_manifest(dirpath)}
+    if state_schema is not None:
+        payload["format"] = 2
+        payload["state_schema"] = state_schema
     marker = os.path.join(dirpath, COMMIT_MARKER)
     part = marker + ".part"
     with open(part, "w") as f:
@@ -104,6 +208,30 @@ def write_commit_marker(dirpath: str, step: Optional[int] = None) -> str:
         os.fsync(f.fileno())
     os.replace(part, marker)
     return marker
+
+
+def read_manifest(dirpath: str) -> Optional[dict]:
+    """The commit-marker payload of ``dirpath``, or None when the dir
+    has no (parseable) marker. Format-agnostic: returns whatever the
+    marker holds."""
+    marker = os.path.join(dirpath, COMMIT_MARKER)
+    try:
+        with open(marker) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def manifest_state_schema(dirpath: str) -> Optional[dict]:
+    """The ``state_schema`` block of a step dir's commit marker, or
+    None for format-1 (pre-schema) checkpoints and unmarked dirs — the
+    state engine treats None as "nothing to compare", never as drift."""
+    payload = read_manifest(dirpath)
+    if payload is None:
+        return None
+    schema = payload.get("state_schema")
+    return schema if isinstance(schema, dict) else None
 
 
 def validate_step_dir(dirpath: str, deep: bool = False) -> bool:
@@ -214,10 +342,11 @@ def _check_overwrite(final: str, overwrite: bool) -> None:
             f"checkpoint already exists at {final} and overwrite=False")
 
 
-def _commit(tmp: str, final: str, step, overwrite: bool) -> str:
+def _commit(tmp: str, final: str, step, overwrite: bool,
+            state_schema: Optional[dict] = None) -> str:
     """Marker + rename: the atomic tail of every save path."""
     _fault_point("pre_commit", step, tmp)
-    write_commit_marker(tmp, step=step)
+    write_commit_marker(tmp, step=step, state_schema=state_schema)
     if os.path.isdir(final):
         _check_overwrite(final, overwrite)  # lost the entry-check race
         shutil.rmtree(final)
@@ -243,9 +372,20 @@ def save_checkpoint(path: str, state: Any, step: Optional[int] = None,
     if os.path.isdir(tmp):  # stale torn write from a previous crash
         shutil.rmtree(tmp, ignore_errors=True)
     _fault_point("pre_write", step, tmp)
+    schema = _schema_or_none(state)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(tmp, state, force=True)
-    return _commit(tmp, final, step, overwrite)
+    return _commit(tmp, final, step, overwrite, state_schema=schema)
+
+
+def _schema_or_none(state: Any) -> Optional[dict]:
+    """Best-effort format-2 schema: a state tree the encoder cannot
+    describe (exotic leaves) degrades the marker to format 1 rather
+    than failing the save — durability beats observability here."""
+    try:
+        return state_schema_of(state)
+    except Exception:  # noqa: BLE001 — schema is advisory metadata
+        return None
 
 
 def restore_checkpoint(path: str, target: Optional[Any] = None,
@@ -290,7 +430,7 @@ class AsyncCheckpointWriter:
     def __init__(self):
         ocp = _ocp()
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        self._pending = None  # (tmp, final, step, overwrite)
+        self._pending = None  # (tmp, final, step, overwrite, schema)
         # save/wait/close all fence-and-commit through _pending; two
         # threads interleaving (a trainer saving while an eval thread
         # waits) would double-commit one write or drop another's
@@ -321,8 +461,12 @@ class AsyncCheckpointWriter:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)  # apex-lint: disable=blocking-call-under-lock
             _fault_point("pre_write", step, tmp)
+            # schema is derived from the live tree BEFORE the async
+            # write snapshots it — the marker must describe what was
+            # handed to the writer, not whatever the tree mutated into
+            schema = _schema_or_none(state)
             self._ckptr.save(tmp, state, force=True)
-            self._pending = (tmp, final, step, overwrite)
+            self._pending = (tmp, final, step, overwrite, schema)
         return final
 
     def wait(self):
@@ -331,12 +475,13 @@ class AsyncCheckpointWriter:
         with self._lock:
             self._ckptr.wait_until_finished()
             if self._pending is not None:
-                tmp, final, step, overwrite = self._pending
+                tmp, final, step, overwrite, schema = self._pending
                 # clear first: a failed commit leaves a torn .tmp
                 # behind (as a real crash would) rather than wedging
                 # every later save
                 self._pending = None
-                _commit(tmp, final, step, overwrite)
+                _commit(tmp, final, step, overwrite,
+                        state_schema=schema)
 
     def close(self):
         with self._lock:

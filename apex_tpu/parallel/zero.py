@@ -221,6 +221,47 @@ class Zero1FusedAdam:
 
     # ------------------------------------------------------- utilities
 
+    def state_layout(self, params) -> dict:
+        """The shard layout the checkpoint actually persists — what the
+        state engine's ``reshard-illegal`` check consumes: the dp axis,
+        the shard count the buffers were padded for, and per bucket the
+        ``{dtype, total, padded}`` triple that decides whether a new
+        shard count is a pure reshard (``padded % n == 0`` AND
+        re-planning at ``n`` reproduces the same padding)."""
+        plan = self.plan_for(params)
+        return {
+            "axis": self.axis_name,
+            "num_shards": self.num_shards,
+            "buckets": [{"dtype": b.dtype, "total": int(b.total),
+                         "padded": int(b.padded)}
+                        for b in plan.buckets],
+        }
+
+    def elastic_candidates(self, params, max_shards: Optional[int] = None
+                           ) -> tuple:
+        """Shard counts a saved state can be re-laid-out onto without
+        repacking: every ``n`` (1..max_shards, default 2x the current
+        count) for which EVERY bucket keeps its flat layout —
+        ``padded % n == 0`` and ``_pad_up(total, n) == padded``, i.e.
+        re-planning at ``n`` pads each bucket to the same length the
+        saved buffers already have. Always includes the current
+        ``num_shards``. The claim is machine-checked: the state
+        engine's ``reshard-illegal`` proof runs over exactly this set
+        in the registered ZeRO-1 target."""
+        from apex_tpu.parallel.overlap import _pad_up
+
+        plan = self.plan_for(params)
+        limit = max_shards if max_shards is not None \
+            else 2 * self.num_shards
+        out = []
+        for n in range(1, max(limit, self.num_shards) + 1):
+            ok = all(b.padded % n == 0
+                     and _pad_up(b.total, n) == b.padded
+                     for b in plan.buckets)
+            if ok or n == self.num_shards:
+                out.append(n)
+        return tuple(out)
+
     def comms_bytes(self, params) -> int:
         """Per-device grad-sync bytes of one step (the shared price —
         see :func:`~apex_tpu.parallel.overlap.grad_sync_comms_bytes`)."""

@@ -74,7 +74,7 @@ from apex_tpu.resilience import faults as faults_mod
 from apex_tpu.resilience.preemption import EXIT_PREEMPTED
 
 __all__ = ["Preempted", "TrainAborted", "ResilientTrainLoop",
-           "chaos_probe"]
+           "chaos_probe", "resume_path"]
 
 
 class Preempted(RuntimeError):
@@ -730,6 +730,43 @@ class ResilientTrainLoop:
                           duration_s=round(duration, 6))
                 return restored["state"], s + 1, rollbacks
         return fallback_state, fallback_step, rollbacks
+
+
+# -------------------------------------------------------- resume path
+
+def resume_path(step_fn: Callable, *, holds_fallback: bool = True
+                ) -> Callable:
+    """The loop's post-restore composition as one traceable function —
+    the ``state_resilient_resume_path`` target of the state engine's
+    ``restore-donation-hazard`` check.
+
+    ``run()`` keeps the restored pytree alive past the first step in
+    two ways: ``fallback_state`` (held for ``_rollback``) and the
+    emergency-save path. A ``step_fn`` compiled with
+    ``donate_argnums=(0,)`` therefore donates buffers the loop still
+    references — fine on CPU, use-after-free on TPU where donation
+    actually invalidates the buffer. The returned function mirrors
+    that shape: ``resume(restored, step) -> (new_state, metrics[,
+    restored])``, returning the retained restored reference when
+    ``holds_fallback`` (the loop's real behavior). Static proof, not a
+    runtime check: trace it with
+    :func:`apex_tpu.analysis.state_checks.check_restore_donation` — a
+    non-donating ``step_fn`` (the loop's documented contract) is
+    clean; a donating one flags the held reference.
+    """
+
+    if holds_fallback:
+        def resume(restored, step):
+            # fallback_state = restored — the reference _rollback and
+            # the emergency save still need after step_fn runs
+            fallback_state = restored
+            new_state, metrics = step_fn(restored, step)
+            return new_state, metrics, fallback_state
+    else:
+        def resume(restored, step):
+            return step_fn(restored, step)
+    resume.__name__ = f"resume_path({getattr(step_fn, '__name__', 'step')})"
+    return resume
 
 
 # --------------------------------------------------------------- probe

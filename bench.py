@@ -1324,6 +1324,27 @@ def worker():
     except Exception as e:  # same contract as the precision hook
         extras["concurrency_findings_error"] = repr(e)[:120]
 
+    # checkpoint/state-flow verdict (ISSUE 18): the resume-compatibility
+    # checks over the carry-form train steps — the zero-filled
+    # analysis/state_findings{check=} counter family lands in the JSON
+    # line (every check id explicit, even at 0, so the report's binary
+    # --compare gate can tell "clean" from "never ran") alongside the
+    # per-target carried/saved leaf gauges
+    try:
+        from apex_tpu.analysis import run_state_findings
+
+        stfindings, sterrors, ststats = run_state_findings(registry=reg)
+        extras["state_findings"] = len(stfindings)
+        extras["state_targets"] = {
+            name: {"carried": int(s.get("carried", 0)),
+                   "saved_leaves": int(s.get("saved_leaves", 0))}
+            for name, s in sorted(ststats.items())}
+        if sterrors:
+            extras["state_target_errors"] = dict(sorted(
+                sterrors.items()))
+    except Exception as e:  # same contract as the precision hook
+        extras["state_findings_error"] = repr(e)[:120]
+
     # fp8-vs-bf16 matmul race (ISSUE 13): the O4 tier's perf evidence —
     # CPU emulation here, real MXU numbers on the next relay window
     try:

@@ -1,0 +1,236 @@
+"""Commit-marker format 1 <-> format 2 compatibility (ISSUE 18).
+
+Format 2 adds the semantic ``state_schema`` block (treedef + per-leaf
+path/shape/dtype/spec/kind + fingerprint) to ``_APEX_COMMIT.json``.
+Both directions must keep working: a format-1 checkpoint (pre-schema)
+validates, restores, and GCs under the current code; a format-2
+checkpoint validates under format-1-era semantics (the validator reads
+only the ``files`` manifest) — and the schema the saver writes is
+bit-identical to what the state engine derives from code, so the
+drift check compares real encodings.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.checkpoint import (
+    COMMIT_MARKER,
+    encode_spec,
+    gc_partial_checkpoints,
+    latest_valid_step,
+    manifest_state_schema,
+    read_manifest,
+    restore_checkpoint,
+    save_checkpoint,
+    schema_fingerprint,
+    state_schema_of,
+    validate_step_dir,
+    write_commit_marker,
+)
+
+_STATE = {"w": jnp.arange(6.0, dtype=jnp.float32).reshape(2, 3),
+          "count": jnp.asarray(3, jnp.int32)}
+
+
+def _write_format1_checkpoint(root, step=1):
+    """A pre-schema checkpoint: real orbax payload, then a marker
+    written WITHOUT a schema — byte-compatible with every release
+    before format 2."""
+    save_checkpoint(str(root), _STATE, step=step)
+    d = os.path.join(str(root), f"step_{step:08d}")
+    marker = os.path.join(d, COMMIT_MARKER)
+    os.remove(marker)
+    write_commit_marker(d, step=step)  # no state_schema -> format 1
+    return d
+
+
+# ------------------------------------------------- format 1 under today
+
+
+def test_format1_dir_still_validates_restores_and_gcs(tmp_path):
+    d = _write_format1_checkpoint(tmp_path, step=1)
+    payload = read_manifest(d)
+    assert payload["format"] == 1
+    assert "state_schema" not in payload
+    assert validate_step_dir(d, deep=True)
+    assert latest_valid_step(str(tmp_path)) == 1
+    got = restore_checkpoint(str(tmp_path), target=_STATE, step=1)
+    np.testing.assert_array_equal(np.asarray(got["w"]),
+                                  np.asarray(_STATE["w"]))
+    # GC sees it as committed, not a torn leftover
+    assert gc_partial_checkpoints(str(tmp_path)) == []
+    assert os.path.isdir(d)
+
+
+def test_format1_schema_lookup_returns_none(tmp_path):
+    d = _write_format1_checkpoint(tmp_path, step=2)
+    assert manifest_state_schema(d) is None
+
+
+def test_format1_manifest_passes_state_engine_backcompat(tmp_path):
+    """The engine's drift check treats a schemaless dir as nothing to
+    compare — a fleet of old checkpoints never turns red on upgrade."""
+    from apex_tpu.analysis.state_checks import analyze_state
+
+    d = _write_format1_checkpoint(tmp_path, step=3)
+
+    def step(s, g):
+        return {"w": s["w"] - g, "count": s["count"] + 1}
+
+    assert analyze_state(step, _STATE, jnp.ones((2, 3)),
+                         name="fmt1_roundtrip", manifest=d) == []
+
+
+# ------------------------------------------------- format 2 both ways
+
+
+def test_save_checkpoint_writes_format2_schema(tmp_path):
+    save_checkpoint(str(tmp_path), _STATE, step=5)
+    d = os.path.join(str(tmp_path), "step_00000005")
+    payload = read_manifest(d)
+    assert payload["format"] == 2
+    schema = payload["state_schema"]
+    assert schema == manifest_state_schema(d)
+    assert schema["fingerprint"] == schema_fingerprint(schema)
+    by_path = {lf["path"]: lf for lf in schema["leaves"]}
+    assert by_path["['w']"]["shape"] == [2, 3]
+    assert by_path["['w']"]["dtype"] == "float32"
+    assert by_path["['count']"]["dtype"] == "int32"
+
+
+def test_format2_dir_validates_under_format1_semantics(tmp_path):
+    """A format-1-era reader checks only the ``files`` manifest — the
+    schema block must ride along without breaking that contract."""
+    save_checkpoint(str(tmp_path), _STATE, step=6)
+    d = os.path.join(str(tmp_path), "step_00000006")
+    payload = read_manifest(d)
+    # the format-1 subset is intact and sufficient on its own
+    files = payload["files"]
+    assert files and all(
+        os.path.getsize(os.path.join(d, rel)) == meta["size"]
+        for rel, meta in files.items())
+    assert validate_step_dir(d, deep=True)
+    got = restore_checkpoint(str(tmp_path), target=_STATE, step=6)
+    np.testing.assert_array_equal(np.asarray(got["count"]),
+                                  np.asarray(_STATE["count"]))
+
+
+def test_format2_schema_matches_engine_derivation(tmp_path):
+    """The design invariant the drift check rests on: the saver's
+    encoding (checkpoint.state_schema_of) and the engine's code-derived
+    encoding agree to the fingerprint."""
+    from apex_tpu.analysis.state_checks import derive_state_schema
+
+    save_checkpoint(str(tmp_path), _STATE, step=7)
+    disk = manifest_state_schema(
+        os.path.join(str(tmp_path), "step_00000007"))
+
+    def step(s, g):
+        return {"w": s["w"] - g, "count": s["count"] + 1}
+
+    code = derive_state_schema(step, _STATE,
+                               jnp.ones((2, 3))).to_manifest()
+    assert code["treedef"] == disk["treedef"]
+    assert code["leaves"] == disk["leaves"]
+    assert code["fingerprint"] == disk["fingerprint"]
+
+
+def test_format2_drift_detected_after_state_evolves(tmp_path):
+    """Round-trip the other direction: a format-2 checkpoint written
+    for YESTERDAY'S state turns red when the code's state grows a
+    field — exactly the upgrade hazard the block exists to catch."""
+    from apex_tpu.analysis.state_checks import analyze_state
+
+    save_checkpoint(str(tmp_path), _STATE, step=8)
+    d = os.path.join(str(tmp_path), "step_00000008")
+    new_state = dict(_STATE, ring=jnp.zeros((4,), jnp.float32))
+
+    def step(s, g):
+        return {"w": s["w"] - g, "count": s["count"] + 1,
+                "ring": s["ring"]}
+
+    found = analyze_state(step, new_state, jnp.ones((2, 3)),
+                          name="evolved", manifest=d,
+                          checks=("ckpt-schema-drift",))
+    assert found
+    assert any("ring" in f.message for f in found)
+
+
+def test_async_writer_commits_format2(tmp_path):
+    from apex_tpu.checkpoint import AsyncCheckpointWriter
+
+    w = AsyncCheckpointWriter()
+    w.save(str(tmp_path), _STATE, step=9)
+    w.wait()
+    w.close()
+    d = os.path.join(str(tmp_path), "step_00000009")
+    schema = manifest_state_schema(d)
+    assert schema is not None
+    assert schema["fingerprint"] == state_schema_of(
+        _STATE)["fingerprint"]
+
+
+# ----------------------------------------------- schema encoding units
+
+
+def test_fingerprint_stable_and_sensitive():
+    a = state_schema_of(_STATE)
+    b = state_schema_of(jax.tree_util.tree_map(jnp.copy, _STATE))
+    assert a["fingerprint"] == b["fingerprint"]
+    narrowed = state_schema_of(
+        {"w": _STATE["w"].astype(jnp.bfloat16), "count": _STATE["count"]})
+    assert narrowed["fingerprint"] != a["fingerprint"]
+
+
+def test_schema_is_json_native():
+    schema = state_schema_of(_STATE)
+    assert json.loads(json.dumps(schema)) == schema
+
+
+def test_encode_spec_forms():
+    from jax.sharding import PartitionSpec as P
+
+    assert encode_spec(None) is None
+    assert encode_spec(P()) == []
+    assert encode_spec(P("dp", None)) == ["dp", None]
+    assert encode_spec(P(("dp", "tp"), None)) == [["dp", "tp"], None]
+
+
+def test_state_schema_of_specs_mismatch_loud():
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="diverged"):
+        state_schema_of(_STATE, specs={"w": P()})
+
+
+def test_state_schema_of_explicit_specs_encoded():
+    from jax.sharding import PartitionSpec as P
+
+    schema = state_schema_of(_STATE,
+                             specs={"w": P("dp"), "count": P()})
+    by_path = {lf["path"]: lf for lf in schema["leaves"]}
+    assert by_path["['w']"]["spec"] == ["dp"]
+    assert by_path["['count']"]["spec"] == []
+
+
+def test_schema_failure_never_blocks_save(tmp_path, monkeypatch):
+    """Durability beats observability: a broken schema derivation
+    degrades to a format-1 marker, never a failed save."""
+    import apex_tpu.checkpoint as ckpt
+
+    monkeypatch.setattr(
+        ckpt, "state_schema_of",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    save_checkpoint(str(tmp_path), _STATE, step=10)
+    d = os.path.join(str(tmp_path), "step_00000010")
+    payload = read_manifest(d)
+    assert payload["format"] == 1
+    assert validate_step_dir(d)
+    got = restore_checkpoint(str(tmp_path), target=_STATE, step=10)
+    np.testing.assert_array_equal(np.asarray(got["w"]),
+                                  np.asarray(_STATE["w"]))

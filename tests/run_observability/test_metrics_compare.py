@@ -484,6 +484,86 @@ def test_goodput_family_table_renders(tmp_path):
     assert "lost stall" in proc.stdout
 
 
+# ------------- checkpoint/state-flow finding counters (ISSUE 18)
+
+def _state(check, value):
+    return {"type": "counter", "name": "analysis/state_findings",
+            "labels": {"check": check}, "value": value}
+
+
+def test_compare_state_growth_fails_binary(tmp_path):
+    """Any state check counter growing above base fails, with NO
+    threshold: one new unsaved-state/drift finding is a regression
+    regardless of the wall clock."""
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=[_state("unsaved-train-state", 0)])
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_state("unsaved-train-state", 1)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION state unsaved-train-state" in proc.stdout
+    # a huge threshold changes nothing — the gate is binary
+    assert _run(cur, "--compare", base, "--compare-threshold",
+                "10.0").returncode == 1
+
+
+def test_compare_state_new_nonzero_check_id_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl")
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_state("reshard-illegal", 2)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION state reshard-illegal" in proc.stdout
+
+
+def test_compare_state_steady_or_fixed_passes(tmp_path):
+    """The zero-filled family in steady state (explicit 0s both sides)
+    and a fixed finding both pass; a check only in base is info."""
+    zeros = [_state(c, 0) for c in
+             ("unsaved-train-state", "ckpt-schema-drift",
+              "dtype-narrowing-restore", "reshard-illegal",
+              "restore-donation-hazard")]
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=zeros + [_state("extinct-check", 1)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=zeros)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 regression(s)" in proc.stdout
+    assert "only in base" in proc.stdout
+
+
+def test_state_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=[
+        _state("unsaved-train-state", 1),
+        _state("reshard-illegal", 0),
+        {"type": "gauge", "name": "analysis/state_findings_total",
+         "value": 1.0},
+        {"type": "gauge", "name": "analysis/state_carried_leaves",
+         "labels": {"target": "state_llama_o4_step"}, "value": 44},
+        {"type": "gauge", "name": "analysis/state_saved_leaves",
+         "labels": {"target": "state_llama_o4_step"}, "value": 44},
+    ])
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis/state_* family" in proc.stdout
+    assert "unsaved-train-state" in proc.stdout
+    assert "state_llama_o4_step" in proc.stdout
+    assert "carried 44" in proc.stdout
+    # --json prints one compact line per family present in the dump
+    proc_json = _run(path, "--json")
+    fam = None
+    for line in proc_json.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "state_family" in rec:
+            fam = rec["state_family"]
+    assert fam is not None
+    assert fam["checks"]["unsaved-train-state"] == 1
+    assert fam["targets"]["state_llama_o4_step"]["carried"] == 44
+
+
 def test_concurrency_family_table_renders(tmp_path):
     path = _dump(tmp_path / "m.jsonl",
                  extra=[_conc("blocking-call-under-lock", 3),

@@ -13,7 +13,14 @@
 #   bash tools/lint.sh --write-baseline tests/run_analysis/baseline.json
 #
 # Extra args are forwarded to `python -m apex_tpu.analysis` (which
-# ignores --baseline when --write-baseline is given).
+# ignores --baseline when --write-baseline is given). That includes the
+# ISSUE 18 ergonomics flags: `--engines ast,state` narrows the run to an
+# explicit engine subset (composes with --changed-only, since the
+# forwarded args reach both exec paths) and `--list-targets` prints the
+# registered jaxpr/dataflow/sharding/spmd/state targets with their
+# owning engine. The checkpoint/state-flow engine (ISSUE 18) runs its
+# four resume-path targets here like any other tracing engine and gets
+# its own line in the per-engine wall-time breakdown.
 #
 # Wall-time budget (ISSUE 14 satellite): the CLI fails (exit 2, LOUD)
 # when the summed engine wall time exceeds LINT_TIME_BUDGET_S (default

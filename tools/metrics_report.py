@@ -59,7 +59,14 @@ host-concurrency engine's per-check race/signal/callback verdict)
 gets a per-check table, and ``--compare`` gates any check counter
 growing above its base value or a new check id going nonzero —
 binary, no threshold: one new confirmed race in the host runtime is
-a regression regardless of speed. The ``goodput/*`` family (ISSUE 17
+a regression regardless of speed. The
+``analysis/state_findings{check=}`` family (ISSUE 18 — the
+checkpoint/state-flow engine's resume-compatibility verdict,
+zero-filled so every check id is explicit every run) gets the same
+treatment: a per-check table plus per-target carried/saved leaf
+gauges, and a binary ``--compare`` gate — one new unsaved-state /
+schema-drift / illegal-reshard / donation finding is a regression
+regardless of speed. The ``goodput/*`` family (ISSUE 17
 — published by the run-ledger accounting, ``python -m
 apex_tpu.observability goodput``) gets the goodput table (ratio +
 fleet min, lost seconds by cause, badput top-3, per-rank ratios),
@@ -221,6 +228,69 @@ def _concurrency_check_counts(records):
     counts = {}
     for rec in records:
         if rec.get("name") != "analysis/concurrency_findings":
+            continue
+        labels = rec.get("labels", {}) or {}
+        try:
+            counts[labels.get("check", "?")] = float(rec.get("value"))
+        except (TypeError, ValueError):
+            continue
+    return counts
+
+
+def render_state_family(path):
+    """Per-check table of the ``analysis/state_findings{check=}``
+    counter family (ISSUE 18 — the checkpoint/state-flow engine's
+    resume-compatibility verdict a bench run ships with) from a metrics
+    JSONL dump; None when the file carries none. The family is
+    zero-filled by the engine (every check id present every run), so a
+    missing family means the engine never ran, not that it was clean.
+    Later records win, matching the registry's cumulative counter
+    dumps."""
+    checks = {}
+    total = None
+    targets: dict = {}
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        labels = rec.get("labels", {}) or {}
+        if name == "analysis/state_findings_total":
+            total = rec.get("value")
+        elif name == "analysis/state_findings":
+            checks[labels.get("check", "?")] = rec.get("value")
+        elif name == "analysis/state_carried_leaves":
+            targets.setdefault(labels.get("target", "?"), {})[
+                "carried"] = rec.get("value")
+        elif name == "analysis/state_saved_leaves":
+            targets.setdefault(labels.get("target", "?"), {})[
+                "saved"] = rec.get("value")
+    if total is None and not checks:
+        return None
+    return {"checks": checks, "findings_total": total,
+            "targets": targets}
+
+
+def summarize_state(path, fam):
+    print(f"{path}: analysis/state_* family")
+    if fam["findings_total"] is not None:
+        print(f"  findings: {int(fam['findings_total'])}")
+    for check, n in sorted(fam["checks"].items()):
+        print(f"    {check:26s} {n}")
+    for tgt, row in sorted(fam.get("targets", {}).items()):
+        carried = row.get("carried")
+        saved = row.get("saved")
+        print(f"    {tgt:32s} carried {carried}  saved {saved}")
+
+
+def _state_check_counts(records):
+    """{check id: count} from ``analysis/state_findings`` counters;
+    later records win (cumulative counter dumps)."""
+    counts = {}
+    for rec in records:
+        if rec.get("name") != "analysis/state_findings":
             continue
         labels = rec.get("labels", {}) or {}
         try:
@@ -1193,6 +1263,29 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 infos.append(f"concurrency {check}: {b:.0f} -> "
                              f"{c:.0f} ok")
 
+    cur_state, base_state = _state_check_counts(cur), \
+        _state_check_counts(base)
+    if cur_state or base_state:
+        for check in sorted(set(cur_state) | set(base_state)):
+            b = base_state.get(check, 0.0)
+            c = cur_state.get(check)
+            if c is None:
+                infos.append(f"state {check}: only in base ({b:.0f})")
+                continue
+            # binary, no threshold: one new resume-compatibility hole
+            # (state loss, schema drift, illegal reshard, donation
+            # hazard) is a regression regardless of what the wall
+            # clock did (ISSUE 18). The engine zero-fills the family,
+            # so c and b are explicit 0s on clean runs — a check id
+            # going nonzero always trips here.
+            if c > b:
+                regressions.append(
+                    f"state {check}: findings {b:.0f} -> {c:.0f} "
+                    f"(new checkpoint/state-flow hazard — see "
+                    f"docs/analysis.md#state-flow-checks)")
+            else:
+                infos.append(f"state {check}: {b:.0f} -> {c:.0f} ok")
+
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
         if kernel not in cur_race:
@@ -1320,6 +1413,14 @@ if __name__ == "__main__":
                                       "concurrency_family": conc}))
                 else:
                     summarize_concurrency(arg, conc)
+            st = render_state_family(arg) \
+                if os.path.isfile(arg) else None
+            if st is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "state_family": st}))
+                else:
+                    summarize_state(arg, st)
             pl = render_plan_family(arg) if os.path.isfile(arg) \
                 else None
             if pl is not None:
