@@ -27,7 +27,13 @@ Two engines, one CLI, one pytest gate:
   every live state leaf reaches the checkpoint save tree, matches the
   manifest's format-2 ``state_schema``, restores without dtype
   narrowing, re-shards legally onto every elastic candidate mesh, and
-  is never read after being donated on the resume path.
+  is never read after being donated on the resume path. The
+  **memory-liveness engine** (:mod:`.memory_checks`) rides the same
+  walk with a live-interval lattice — every value gets a birth/death
+  step, donation credit, and peak-composition record — powering
+  missed-donation, remat-opportunity (roofline-priced), peak-spike,
+  live-range-upcast, and offload-candidate, plus the calibrated HBM
+  priors (``hbm_priors.json``) the planner prunes on.
 - **AST engine** (:mod:`.ast_checks`): lint driver code (apex_tpu,
   examples/, tools/, bench.py) for host-sync anti-patterns — the
   ``block_until_ready``-as-timing bug that produced r5's impossible
@@ -54,6 +60,13 @@ from apex_tpu.analysis.findings import (
     save_baseline,
 )
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS, analyze_fn
+from apex_tpu.analysis.memory_checks import (
+    MEMORY_CHECKS,
+    analyze_memory,
+    analyze_memory_jaxpr,
+    load_hbm_priors,
+    prior_for,
+)
 from apex_tpu.analysis.precision_checks import (
     PRECISION_CHECKS,
     analyze_precision,
@@ -79,6 +92,7 @@ from apex_tpu.analysis.state_checks import (
 )
 from apex_tpu.analysis.targets import (
     TARGETS,
+    run_memory_findings,
     run_precision_findings,
     run_sharding_findings,
     run_spmd_findings,
@@ -88,15 +102,18 @@ from apex_tpu.analysis.targets import (
 
 __all__ = [
     "AST_CHECKS", "CONCURRENCY_CHECKS", "Finding", "JAXPR_CHECKS",
+    "MEMORY_CHECKS",
     "PLAN_MODELS",
     "PRECISION_CHECKS", "Plan", "PlanError",
     "SHARDING_CHECKS", "SPMD_CHECKS", "STATE_CHECKS", "TARGETS",
     "analyze_fn",
+    "analyze_memory", "analyze_memory_jaxpr",
     "analyze_precision",
     "analyze_sharding", "analyze_sharding_jaxpr", "analyze_spmd",
     "analyze_state",
-    "lint_paths", "lint_source", "load_baseline",
-    "new_findings", "plan", "run_concurrency_findings",
+    "lint_paths", "lint_source", "load_baseline", "load_hbm_priors",
+    "new_findings", "plan", "prior_for", "run_concurrency_findings",
+    "run_memory_findings",
     "run_precision_findings",
     "run_sharding_findings", "run_spmd_findings", "run_state_findings",
     "run_targets",

@@ -28,6 +28,7 @@ import time
 from apex_tpu.analysis import ast_checks, findings as findings_mod, targets
 from apex_tpu.analysis.concurrency_checks import CONCURRENCY_CHECKS
 from apex_tpu.analysis.jaxpr_checks import JAXPR_CHECKS
+from apex_tpu.analysis.memory_checks import MEMORY_CHECKS
 from apex_tpu.analysis.precision_checks import PRECISION_CHECKS
 from apex_tpu.analysis.sharding_checks import SHARDING_CHECKS
 from apex_tpu.analysis.spmd_checks import SPMD_CHECKS
@@ -40,7 +41,7 @@ DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 # regression show up here, per ISSUE 8 satellite). Also the vocabulary
 # of --engines selection.
 ENGINE_NAMES = ("ast", "concurrency", "jaxpr", "dataflow", "sharding",
-                "spmd", "state")
+                "spmd", "state", "memory")
 
 # The engines that run via the registered tracing targets (everything
 # in ENGINE_NAMES except the two path-driven ones).
@@ -72,7 +73,7 @@ def known_checks():
             | set(JAXPR_CHECKS)
             | set(PRECISION_CHECKS) | set(SHARDING_CHECKS)
             | set(SPMD_CHECKS) | set(STATE_CHECKS)
-            | set(targets.TARGET_CHECKS))
+            | set(MEMORY_CHECKS) | set(targets.TARGET_CHECKS))
 
 
 def target_engine(target_name):
@@ -82,6 +83,7 @@ def target_engine(target_name):
             "sharding" if target_name in targets.SHARDING_TARGETS else
             "spmd" if target_name in targets.SPMD_TARGETS else
             "state" if target_name in targets.STATE_TARGETS else
+            "memory" if target_name in targets.MEMORY_TARGETS else
             "jaxpr")
 
 
@@ -251,6 +253,72 @@ def run(paths=None, root=None, ast=True, jaxpr=True, concurrency=True,
     return all_findings, errors
 
 
+def sarif_report(findings, root=None) -> dict:
+    """Findings -> a SARIF 2.1.0 ``run`` document (ISSUE 19 satellite):
+    one reporting rule per known check id (stable, sorted — present
+    even at 0 results so viewers can enumerate the rule set), one
+    result per finding. Deterministic on purpose: no clocks, sorted
+    rule table, insertion order of results follows the CLI's sorted
+    finding order — re-exporting the same run yields a byte-identical
+    file. Snippet fingerprints (:func:`findings.finding_fingerprint`)
+    land in ``partialFingerprints`` so SARIF consumers get the same
+    rename-survival the ``--diff`` gate uses; jaxpr findings (line 0,
+    ``<jaxpr:target>`` paths) carry a logical location instead of a
+    physical one — there is no file region to point at."""
+    rule_ids = sorted(known_checks())
+    rule_index = {cid: i for i, cid in enumerate(rule_ids)}
+    lines_cache: dict = {}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.check,
+            "ruleIndex": rule_index.get(f.check, -1),
+            "level": f.severity if f.severity in ("error", "warning")
+            else "warning",
+            "message": {"text": f.message},
+        }
+        if f.line > 0:
+            result["locations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": f.line},
+                },
+            }]
+        else:
+            result["locations"] = [{
+                "logicalLocations": [{
+                    "name": f.symbol,
+                    "fullyQualifiedName": f"{f.path}:{f.symbol}",
+                }],
+            }]
+        fp = findings_mod.finding_fingerprint(f, root=root,
+                                              lines_cache=lines_cache)
+        if fp:
+            result["partialFingerprints"] = {
+                "apexTpuFingerprint/v1": fp}
+        results.append(result)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "apex_tpu.analysis",
+                "informationUri":
+                    "https://github.com/apex-tpu/apex-tpu",
+                "rules": [{"id": cid} for cid in rule_ids],
+            }},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path, findings, root=None):
+    with open(path, "w") as f:
+        f.write(json.dumps(sarif_report(findings, root=root),
+                           indent=2, sort_keys=True) + "\n")
+
+
 def main(argv=None):
     if argv is None:
         argv = sys.argv[1:]
@@ -300,6 +368,11 @@ def main(argv=None):
                     help="write current findings as the baseline and exit")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ap.add_argument("--sarif", default=None, metavar="OUT.json",
+                    help="also write the (post-baseline) findings as a "
+                         "SARIF 2.1.0 report — one rule per check id, "
+                         "snippet fingerprints as partialFingerprints; "
+                         "byte-stable across identical runs")
     ap.add_argument("--list-checks", action="store_true")
     ap.add_argument("--list-targets", action="store_true",
                     help="print the registered tracing targets and the "
@@ -321,6 +394,8 @@ def main(argv=None):
             print(f"{cid:32s} [jaxpr/spmd]")
         for cid in STATE_CHECKS:
             print(f"{cid:32s} [jaxpr/state]")
+        for cid in MEMORY_CHECKS:
+            print(f"{cid:32s} [jaxpr/memory]")
         for cid in targets.TARGET_CHECKS:
             print(f"{cid:32s} [jaxpr]")
         return 0
@@ -379,6 +454,10 @@ def main(argv=None):
         fresh = findings_mod.new_findings_with_fingerprints(
             found, base_keys, diff_fps, root=args.root)
         grandfathered = len(found) - len(fresh)
+
+    if args.sarif:
+        write_sarif(args.sarif, fresh, root=args.root)
+        print(f"sarif -> {args.sarif}", file=sys.stderr)
 
     timing = "  ".join(
         f"{name} {engine_seconds.get(name, 0.0):.1f}s"

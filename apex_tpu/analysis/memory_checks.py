@@ -1,0 +1,664 @@
+"""Memory-liveness checks — static donation/remat/offload findings
+plus the calibrated HBM priors (ISSUE 19).
+
+PR 14's calibration loop measured the PR 4 HBM cost model off by up to
+3.43x per target, and the paper's Apex blueprint wins exactly because
+memory discipline (master weights, flat buffers, donation) is enforced
+by construction. This engine makes HBM waste a *static* finding: it
+rides the unified interpreter (:mod:`.interp`) with a
+:class:`LiveIntervalLattice` for value provenance, and consumes the
+SAME liveness record (:func:`~.sharding_flow.compute_liveness`) the
+HBM estimator prices from — birth/death interval, donation credit, and
+the peak-composition record per value — so the estimator and the
+checks can never disagree on what is live when.
+
+Five checks (:data:`MEMORY_CHECKS`):
+
+- ``missed-donation``    an input buffer dies inside the jaxpr (last
+  read, never returned) and an output of matching shape/dtype exists
+  to alias into, but the call site passes no ``donate_argnums`` slot
+  for it — free HBM, bytes named.
+- ``remat-opportunity``  an intermediate held live across the modeled
+  peak whose roofline recompute cost (producer FLOPs over the planning
+  peak) is cheaper than spilling its bytes through HBM — suggests
+  ``jax.checkpoint`` at the named site.
+- ``peak-spike``         the transient peak exceeds the steady
+  end-of-step watermark by a factor; the message names the ops whose
+  values compose the spike.
+- ``live-range-upcast``  a widening cast (e.g. bf16 -> fp32) born long
+  before its first real consumer — cast later and the wide live range
+  shrinks to the narrow one.
+- ``offload-candidate``  a step-carried state leaf never read between
+  step start and its own update — legal to park in host RAM between
+  steps (the storage-tier item ROADMAP 3 asks for). Requires the
+  caller to name the state args (``state_argnums``): without that
+  signal the engine cannot know which inputs are step-carried.
+
+The calibration-prior half: the committed ``analysis/hbm_priors.json``
+(schema-versioned; :func:`load_hbm_priors` is loud on drift) distills
+the bench ``memory_calibration`` captures into per-target
+measured/modeled ratios, consumed by
+``estimate_hbm_and_comms(priors=...)`` and the planner's
+``pruned:hbm`` decisions (``tools/refresh_priors.py`` regenerates it
+from the newest capture).
+
+Entry point: :func:`analyze_memory` (mirrors ``analyze_sharding``);
+the registered targets live in :mod:`.targets` (``MEMORY_TARGETS``)
+and per-run counts land in the ``analysis/memory_findings{check=}``
+family — zero-filled, so the binary ``--compare`` gate in
+``tools/metrics_report.py`` sees an explicit 0, not an absent series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+from apex_tpu.analysis import interp
+from apex_tpu.analysis.findings import Finding
+from apex_tpu.analysis.sharding_flow import (
+    ShardVal, compute_liveness, normalize_spec, prior_ratio_of,
+)
+
+MEMORY_CHECKS = (
+    "missed-donation", "remat-opportunity", "peak-spike",
+    "live-range-upcast", "offload-candidate",
+)
+
+#: Tunable floors/factors; override per call via ``thresholds=``.
+#: Defaults are set so a well-disciplined step (donated state, fused
+#: update, no held activations) is clean — see the registered
+#: MEMORY_TARGETS contract in tests/run_analysis/test_memory_checks.py.
+DEFAULT_THRESHOLDS = {
+    "min_donation_bytes": 1 << 16,   # ignore sub-64KiB inputs
+    "min_remat_bytes": 1 << 20,      # peak contribution worth holding
+    "remat_min_steps": 16,           # tiny programs have no fwd/bwd
+    "remat_span_frac": 0.35,         # live across >= 35% of the step
+    "spike_factor": 3.0,             # peak > 3x steady watermark
+    "min_spike_bytes": 1 << 20,      # and at least 1MiB above it
+    "upcast_min_gap": 8,             # steps between cast and first use
+    "upcast_gap_frac": 0.25,         # ... and >= 25% of the program
+    "min_upcast_bytes": 1 << 16,     # wide bytes worth shrinking
+    # first read in the last quarter: host offload pays a PCIe
+    # round-trip, so state merely idle for half a step (an Adam moment
+    # read mid-update) is not a candidate — only tail-read state is
+    "offload_frac": 0.75,
+    "offload_min_steps": 16,
+    "min_offload_bytes": 1 << 16,
+}
+
+
+# ----------------------------------------------------- interval lattice
+
+
+@dataclasses.dataclass(frozen=True)
+class MemVal:
+    """One point of the live-interval lattice: which flat input leaves
+    this value derives from (``origins`` — ties an update output back
+    to the state leaf it rewrites), and the narrow dtype it was widened
+    from when the value is (a preserve-chain of) an upcast."""
+
+    origins: frozenset = frozenset()
+    upcast_from: object = None
+
+    def with_upcast(self, mark):
+        if mark == self.upcast_from:
+            return self
+        return dataclasses.replace(self, upcast_from=mark)
+
+
+_EMPTY = MemVal()
+
+# Ops that keep the widened bytes without consuming them: the upcast
+# marker flows through (a reshaped fp32 upcast is still "the upcast").
+_UPCAST_PRESERVE = frozenset({
+    "reshape", "broadcast_in_dim", "squeeze", "expand_dims",
+    "transpose", "copy", "stop_gradient",
+})
+
+
+def _join_mem(ins):
+    present = [v for v in ins if v is not None]
+    if not present:
+        return _EMPTY
+    origins = frozenset().union(*(v.origins for v in present))
+    ups = {v.upcast_from for v in present}
+    return MemVal(origins=origins,
+                  upcast_from=ups.pop() if len(ups) == 1 else None)
+
+
+def _itemsize(aval) -> int:
+    import numpy as np
+
+    try:
+        return np.dtype(str(getattr(aval, "dtype", "float32"))).itemsize
+    except TypeError:
+        return getattr(getattr(aval, "dtype", None), "itemsize", 0) or 0
+
+
+class LiveIntervalLattice(interp.Lattice):
+    """Provenance over the unified walk: input-leaf origins (union-join
+    — contagious through every compute op, ``warm_carry_join`` so a
+    leaf read only through a carried loop still registers) plus the
+    upcast marker the live-range-upcast check chases through preserve
+    chains. The *intervals* themselves come from the shared
+    :func:`~.sharding_flow.compute_liveness` walk; this lattice carries
+    what the linearized view cannot see — which concrete input each
+    value derives from across call/scan/shard_map boundaries."""
+
+    name = "memory"
+    warm_carry_join = True
+
+    def for_aval(self, aval):
+        return _EMPTY
+
+    def transfer(self, eqn, ins, out_avals, ctx):
+        prim = eqn.primitive.name
+        if prim == "optimization_barrier":
+            # elementwise over the tuple: a chain token must not taint
+            # the bucket it orders (same rule as the state lattice)
+            return tuple(
+                (ins[i] if i < len(ins) and ins[i] is not None
+                 else _EMPTY) for i in range(len(out_avals)))
+        base = _join_mem(ins)
+        if prim == "convert_element_type":
+            src_aval = eqn.invars[0].aval if eqn.invars else None
+            widened = (src_aval is not None and out_avals
+                       and _itemsize(out_avals[0]) > _itemsize(src_aval))
+            mark = str(getattr(src_aval, "dtype", "")) if widened \
+                else None
+            return tuple(base.with_upcast(mark) for _ in out_avals)
+        if prim in _UPCAST_PRESERVE:
+            return tuple(base for _ in out_avals)
+        return tuple(base.with_upcast(None) for _ in out_avals)
+
+    def join_branch(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return _join_mem((a, b))
+
+    join_carry = join_branch
+
+
+MEMORY_LATTICE = LiveIntervalLattice()
+
+
+# -------------------------------------------------------------- priors
+
+PRIORS_SCHEMA_VERSION = 1
+
+HBM_PRIORS_PATH = os.path.join(os.path.dirname(__file__),
+                               "hbm_priors.json")
+
+
+def load_hbm_priors(path=None) -> dict:
+    """Load and validate the committed calibration priors. LOUD on
+    schema drift or malformed ratios: a priors file the loader cannot
+    vouch for must never silently price planner pruning. Returns the
+    full document (``priors`` maps target -> row with ``ratio``)."""
+    path = path or HBM_PRIORS_PATH
+    with open(path) as f:
+        data = json.load(f)
+    ver = data.get("schema_version")
+    if ver != PRIORS_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: hbm_priors schema_version {ver!r} != expected "
+            f"{PRIORS_SCHEMA_VERSION} — regenerate with "
+            f"tools/refresh_priors.py (or teach this loader the new "
+            f"schema); refusing to price HBM on a drifted prior file")
+    priors = data.get("priors")
+    if not isinstance(priors, dict) or not priors:
+        raise ValueError(
+            f"{path}: 'priors' must be a non-empty "
+            f"{{target: {{'ratio': ...}}}} map, got {priors!r}")
+    for name, row in priors.items():
+        try:
+            prior_ratio_of(row)
+        except ValueError as e:
+            raise ValueError(f"{path}: prior for {name!r}: {e}") from e
+    if "default_ratio" in data:
+        prior_ratio_of(data["default_ratio"])
+    return data
+
+
+def prior_for(name, priors=None, default=False):
+    """The calibration ratio for target ``name``, or None when no
+    capture exists (callers annotate that loudly as ``prior:none``).
+    ``priors``: a loaded priors document (default: the committed
+    file). ``default=True`` falls back to the document's
+    ``default_ratio`` instead of None."""
+    data = priors if priors is not None else load_hbm_priors()
+    row = (data.get("priors") or {}).get(name)
+    if row is not None:
+        return prior_ratio_of(row)
+    if default and "default_ratio" in data:
+        return prior_ratio_of(data["default_ratio"])
+    return None
+
+
+# ------------------------------------------------------------- findings
+
+
+class _Ctx:
+    def __init__(self, name, path, checks=frozenset(MEMORY_CHECKS)):
+        self.name = name
+        self.path = path
+        self.checks = frozenset(checks)
+        self.findings = []
+        self.seen = set()
+
+    def add(self, check, severity, message, dedup_key=None):
+        if check not in self.checks:
+            return
+        if dedup_key is not None:
+            key = (check,) + tuple(dedup_key)
+            if key in self.seen:
+                return
+            self.seen.add(key)
+        self.findings.append(Finding(
+            check, severity, self.path, 0, self.name, message))
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _aval_desc(aval) -> str:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = getattr(aval, "dtype", "?")
+    return f"{dtype}[{','.join(str(d) for d in shape)}]"
+
+
+def _eqn_flops(eqn) -> int:
+    """Roofline FLOP floor for recomputing one equation: dot_general
+    counts 2*out*K; everything else one op per output element (the
+    conservative elementwise floor)."""
+    out_elems = sum(
+        math.prod(tuple(getattr(v.aval, "shape", ()) or ()) or (1,))
+        for v in eqn.outvars)
+    if eqn.primitive.name == "dot_general":
+        ((lc, _rc), _) = eqn.params["dimension_numbers"]
+        lhs_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+        k = math.prod([lhs_shape[d] for d in lc
+                       if d < len(lhs_shape)] or [1])
+        return 2 * out_elems * k
+    return out_elems
+
+
+# -------------------------------------------------- per-check evaluators
+
+
+def _check_missed_donation(ctx, live, donated, leaf_label, th):
+    out_avals = {}
+    for v in live.out_vars:
+        key = (tuple(getattr(v.aval, "shape", ()) or ()),
+               str(getattr(v.aval, "dtype", "?")))
+        out_avals[key] = out_avals.get(key, 0) + 1
+    for i, cv in enumerate(live.invar_canon):
+        if i in donated or cv in live.out_vars:
+            continue
+        last = live.last_use.get(cv)
+        if last is None:
+            continue  # never read: dead weight, not a donation miss
+        nbytes = live.var_bytes(cv)
+        if nbytes < th["min_donation_bytes"]:
+            continue
+        key = (tuple(getattr(cv.aval, "shape", ()) or ()),
+               str(getattr(cv.aval, "dtype", "?")))
+        if not out_avals.get(key):
+            continue  # nothing to alias the donated buffer into
+        ctx.add(
+            "missed-donation", "warning",
+            f"{leaf_label(i)} ({_aval_desc(cv.aval)}, "
+            f"{_fmt_bytes(nbytes)}/device) is read for the last time "
+            f"at step {last}/{live.n_steps} and never returned, but "
+            f"the call site passes no donate_argnums slot for it: the "
+            f"caller-owned buffer pins {_fmt_bytes(nbytes)} of HBM for "
+            f"the whole step while an output of matching shape/dtype "
+            f"exists to alias into — donate it and the bytes are free "
+            f"from step {last + 1} on",
+            dedup_key=(i,))
+
+
+def _check_remat(ctx, live, th):
+    if live.n_steps < th["remat_min_steps"]:
+        return
+    from apex_tpu.analysis.planner import (
+        hbm_bandwidth, planning_peak_flops,
+    )
+
+    hbm_bw = hbm_bandwidth()
+    peak_fl = planning_peak_flops()
+    for cv, nbytes in live.live_at_peak():
+        prod = live.producer.get(cv)
+        if prod is None or cv in live.out_vars:
+            continue  # inputs / outputs cannot be remat'd away
+        if nbytes < th["min_remat_bytes"]:
+            continue
+        span = live.deaths[cv] - live.births[cv]
+        if span < th["remat_span_frac"] * live.n_steps:
+            continue
+        idx, eqn = prod
+        recompute_s = _eqn_flops(eqn) / peak_fl
+        spill_s = 2 * nbytes / hbm_bw  # write it out + read it back
+        if recompute_s >= spill_s:
+            continue
+        ctx.add(
+            "remat-opportunity", "warning",
+            f"value {_aval_desc(cv.aval)} ({_fmt_bytes(nbytes)}/device,"
+            f" born at step {idx} by '{eqn.primitive.name}') stays "
+            f"live across the modeled peak (step {live.peak_step}) for "
+            f"{span} of {live.n_steps} steps; recomputing it costs "
+            f"~{recompute_s * 1e6:.1f}us at the planning roofline vs "
+            f"~{spill_s * 1e6:.1f}us of HBM traffic to hold it — wrap "
+            f"the producing region in jax.checkpoint and the peak "
+            f"drops by {_fmt_bytes(nbytes)}",
+            dedup_key=(str(cv),))
+
+
+def _check_peak_spike(ctx, live, th):
+    steady = live.steady_bytes()
+    peak = live.peak_hbm_bytes
+    if steady <= 0 or peak <= th["spike_factor"] * steady:
+        return
+    if peak - steady < th["min_spike_bytes"]:
+        return
+    transients = [(cv, nb) for cv, nb in live.live_at_peak()
+                  if live.deaths[cv] <= live.n_steps]
+    top = []
+    for cv, nb in transients[:3]:
+        prod = live.producer.get(cv)
+        prim = prod[1].primitive.name if prod else "input"
+        top.append(f"'{prim}' {_aval_desc(cv.aval)} ({_fmt_bytes(nb)})")
+    ctx.add(
+        "peak-spike", "warning",
+        f"transient peak {_fmt_bytes(peak)} at step {live.peak_step} "
+        f"is {peak / steady:.1f}x the steady end-of-step watermark "
+        f"({_fmt_bytes(steady)}) — the spike is composed of "
+        f"{', '.join(top) if top else 'short-lived intermediates'}; "
+        f"stagger or fuse those ops and the per-device HBM budget "
+        f"follows the watermark, not the spike",
+        dedup_key=("peak", live.peak_step))
+
+
+def _check_upcast(ctx, live, th):
+    # chase widening casts through preserve chains in the SAME
+    # linearized world the intervals live in: the "first real use" of
+    # an upcast is the first non-preserve consumer of its chain
+    tracked = {}  # canonical var -> (birth idx, origin cv, narrow bytes)
+    first_real = {}  # origin cv -> first non-preserve consuming step
+    for idx, (eqn, reads) in enumerate(live.steps):
+        prim = eqn.primitive.name
+        hit = [tracked[r] for r in reads
+               if r is not None and r in tracked]
+        for rec in hit:
+            if prim not in _UPCAST_PRESERVE:
+                origin = rec[1]
+                if origin not in first_real:
+                    first_real[origin] = idx
+        if prim == "convert_element_type" and eqn.invars:
+            src, out = eqn.invars[0].aval, eqn.outvars[0].aval
+            if _itemsize(out) > _itemsize(src):
+                cv = live.canon(eqn.outvars[0])
+                narrow = live.var_bytes(live.canon(eqn.invars[0])) \
+                    if interp.is_var(eqn.invars[0]) else 0
+                tracked[cv] = (idx, cv, narrow)
+                continue
+        if prim in _UPCAST_PRESERVE and hit and len(eqn.outvars) == 1:
+            tracked[live.canon(eqn.outvars[0])] = hit[0]
+    for origin, (birth, _cv, narrow) in sorted(
+            ((o, t) for o, t in tracked.items() if o == t[1]),
+            key=lambda p: p[1][0]):
+        used = first_real.get(origin)
+        if used is None:
+            continue  # never really consumed
+        gap = used - birth
+        if gap < th["upcast_min_gap"] or \
+                gap < th["upcast_gap_frac"] * live.n_steps:
+            continue
+        wide = live.var_bytes(origin)
+        if wide < th["min_upcast_bytes"]:
+            continue
+        ctx.add(
+            "live-range-upcast", "warning",
+            f"value {_aval_desc(origin.aval)} is widened at step "
+            f"{birth} but first consumed at step {used} "
+            f"({gap} of {live.n_steps} steps later): the wide copy "
+            f"({_fmt_bytes(wide)}/device) is live the whole gap where "
+            f"the narrow one ({_fmt_bytes(narrow)}) would do — move "
+            f"the cast next to its consumer and "
+            f"{_fmt_bytes(wide - narrow)} of live range disappears",
+            dedup_key=(str(origin),))
+
+
+def _check_offload(ctx, live, state_leaves, leaf_label, out_origins,
+                   th):
+    if live.n_steps < th["offload_min_steps"]:
+        return
+    for i in sorted(state_leaves):
+        if i >= len(live.invar_canon):
+            continue
+        cv = live.invar_canon[i]
+        first = live.first_use.get(cv)
+        if first is None:
+            continue
+        if first < th["offload_frac"] * live.n_steps:
+            continue
+        nbytes = live.var_bytes(cv)
+        if nbytes < th["min_offload_bytes"]:
+            continue
+        # its own update must exist: an output deriving from this leaf
+        # with the same shape/dtype (the rewritten state slot)
+        key = (tuple(getattr(cv.aval, "shape", ()) or ()),
+               str(getattr(cv.aval, "dtype", "?")))
+        updated = any(
+            i in origins and
+            (tuple(getattr(ov.aval, "shape", ()) or ()),
+             str(getattr(ov.aval, "dtype", "?"))) == key
+            for ov, origins in out_origins)
+        if not updated:
+            continue
+        ctx.add(
+            "offload-candidate", "warning",
+            f"state leaf {leaf_label(i)} ({_aval_desc(cv.aval)}, "
+            f"{_fmt_bytes(nbytes)}/device) is step-carried but not "
+            f"read until step {first}/{live.n_steps} — its own update "
+            f"at the tail of the step: between steps the buffer is "
+            f"dead weight in HBM, legal to park in host RAM and "
+            f"prefetch before the update (device->host offload, the "
+            f"storage tier ROADMAP item 3 names)",
+            dedup_key=(i,))
+
+
+# ----------------------------------------------------------------- entry
+
+
+def analyze_memory_jaxpr(closed, *, name, donated=frozenset(),
+                         state_leaves=frozenset(), in_vals=None,
+                         axis_sizes=None, checks=None, leaf_label=None,
+                         stats_out=None, priors=None, thresholds=None):
+    """Run the memory-liveness checks over a traced ``ClosedJaxpr``.
+
+    ``donated``: flat invar indices with a donate_argnums slot.
+    ``state_leaves``: flat invar indices that are step-carried state
+    (the offload check's scope — empty disables it, there is no way to
+    know which inputs persist across steps without the caller saying
+    so). ``leaf_label``: flat index -> human label for messages.
+    ``priors``: calibration ratio for this program (see
+    :func:`prior_for`); threads into ``stats_out`` as
+    ``calibrated_peak_hbm_bytes``. Returns a list of
+    :class:`~.findings.Finding`."""
+    run = _validate_checks(checks)
+    th = dict(DEFAULT_THRESHOLDS)
+    for k, v in (thresholds or {}).items():
+        if k not in DEFAULT_THRESHOLDS:
+            raise ValueError(
+                f"unknown memory threshold {k!r}; valid: "
+                f"{sorted(DEFAULT_THRESHOLDS)}")
+        th[k] = v
+    ctx = _Ctx(name, f"<jaxpr:{name}>", checks=run)
+    label = leaf_label or (lambda j: f"input #{j}")
+
+    live = compute_liveness(closed, list(in_vals or []),
+                            donated=frozenset(donated),
+                            axis_sizes=axis_sizes)
+
+    # provenance ride-along: one unified-interpreter pass ties every
+    # output back to the input leaves it derives from (across
+    # call/scan/shard_map boundaries the linearized walk keeps opaque)
+    n_in = len(closed.jaxpr.invars)
+    mem_in = [MemVal(origins=frozenset({j})) for j in range(n_in)]
+    (mem_outs,) = interp.interpret_lattices(
+        closed, [interp.LatticeRun(MEMORY_LATTICE, mem_in)],
+        axis_sizes=axis_sizes or {})
+    out_origins = tuple(
+        (ov, mem_outs[k].origins if k < len(mem_outs)
+         and mem_outs[k] is not None else frozenset())
+        for k, ov in enumerate(closed.jaxpr.outvars)
+        if interp.is_var(ov))
+
+    if "missed-donation" in run:
+        _check_missed_donation(ctx, live, frozenset(donated), label, th)
+    if "remat-opportunity" in run:
+        _check_remat(ctx, live, th)
+    if "peak-spike" in run:
+        _check_peak_spike(ctx, live, th)
+    if "live-range-upcast" in run:
+        _check_upcast(ctx, live, th)
+    if "offload-candidate" in run:
+        _check_offload(ctx, live, frozenset(state_leaves), label,
+                       out_origins, th)
+
+    if stats_out is not None:
+        stats_out.update({
+            "peak_hbm_bytes": live.peak_hbm_bytes,
+            "peak_step": live.peak_step,
+            "n_steps": live.n_steps,
+            "n_values": len(live.births),
+            "donated": len(frozenset(donated)),
+            "steady_bytes": live.steady_bytes(),
+        })
+        if priors is not None:
+            ratio = prior_ratio_of(priors)
+            stats_out["prior_ratio"] = ratio
+            stats_out["calibrated_peak_hbm_bytes"] = int(
+                round(live.peak_hbm_bytes * ratio))
+    return ctx.findings
+
+
+def analyze_memory(fn, *example_args, name=None, donate_argnums=(),
+                   state_argnums=(), in_specs=None, axis_sizes=None,
+                   checks=None, stats_out=None, priors=None,
+                   thresholds=None):
+    """Trace ``fn(*example_args)`` and run the memory-liveness checks.
+
+    ``donate_argnums``: the argnums the REAL call site donates — the
+    missed-donation check flags dead non-donated inputs relative to
+    exactly this set. ``state_argnums``: argnums holding step-carried
+    state (optimizer moments, scaler state); scopes the
+    offload-candidate check. ``in_specs``: optional PartitionSpec
+    pytree per arg (sharded byte pricing, as in ``analyze_sharding``).
+    Returns a list of :class:`~.findings.Finding`."""
+    import jax
+
+    name = name or getattr(fn, "__name__", "fn")
+
+    flat_ranges = []
+    labels = []
+    start = 0
+    for a, arg in enumerate(example_args):
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        flat_ranges.append((start, start + len(flat)))
+        for kp, _leaf in flat:
+            suffix = jax.tree_util.keystr(kp)
+            labels.append(f"arg {a}{suffix}" if suffix else f"arg {a}")
+        start += len(flat)
+
+    def leaf_range(argnums, what):
+        out = set()
+        for a in argnums:
+            if not 0 <= a < len(flat_ranges):
+                raise ValueError(
+                    f"{what} {a} out of range for "
+                    f"{len(flat_ranges)} args")
+            out.update(range(*flat_ranges[a]))
+        return frozenset(out)
+
+    donated = leaf_range(donate_argnums, "donate_argnums")
+    state_leaves = leaf_range(state_argnums, "state_argnums")
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+
+    in_vals = None
+    if in_specs is not None:
+        from jax.sharding import PartitionSpec
+
+        flat_specs = jax.tree_util.tree_flatten(
+            in_specs, is_leaf=lambda s: s is None
+            or isinstance(s, PartitionSpec))[0]
+        if len(flat_specs) != len(closed.jaxpr.invars):
+            raise ValueError(
+                f"analyze_memory({name}): in_specs has "
+                f"{len(flat_specs)} leaves, the traced program has "
+                f"{len(closed.jaxpr.invars)} inputs")
+        in_vals = [
+            None if spec is None else ShardVal(spec=normalize_spec(
+                spec, len(getattr(var.aval, 'shape', ()) or ())))
+            for spec, var in zip(flat_specs, closed.jaxpr.invars)]
+
+    def leaf_label(j):
+        return labels[j] if j < len(labels) else f"input #{j}"
+
+    return analyze_memory_jaxpr(
+        closed, name=name, donated=donated, state_leaves=state_leaves,
+        in_vals=in_vals, axis_sizes=axis_sizes, checks=checks,
+        leaf_label=leaf_label, stats_out=stats_out, priors=priors,
+        thresholds=thresholds)
+
+
+def _validate_checks(checks):
+    run = set(checks or MEMORY_CHECKS)
+    unknown = run - set(MEMORY_CHECKS)
+    if unknown:
+        raise ValueError(
+            f"unknown memory check(s) {sorted(unknown)}; valid: "
+            f"{list(MEMORY_CHECKS)}")
+    return run
+
+
+def report_to_registry(results, registry=None):
+    """Publish memory findings + per-target peak stats as the
+    ``analysis/memory_*`` metric family.
+
+    ``results``: {target name: (findings list, stats dict)}. Counters:
+    ``analysis/memory_findings{check=}`` — ZERO-FILLED: every check id
+    is emitted every run (an explicit 0, not an absent series), so the
+    binary ``--compare`` gate distinguishes "clean" from "never ran".
+    Gauges: ``analysis/memory_findings_total``,
+    ``analysis/memory_peak_hbm_bytes{target=}``. Returns
+    {check: count}."""
+    from apex_tpu.observability import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    counts = {c: 0 for c in MEMORY_CHECKS}
+    for target, (findings, stats) in sorted(results.items()):
+        for f in findings:
+            if f.check in counts:
+                counts[f.check] += 1
+        if stats:
+            reg.gauge("analysis/memory_peak_hbm_bytes",
+                      target=target).set(stats.get("peak_hbm_bytes", 0))
+    for check, n in counts.items():
+        reg.counter("analysis/memory_findings", check=check).inc(n)
+    reg.gauge("analysis/memory_findings_total").set(
+        sum(counts.values()))
+    return counts

@@ -573,6 +573,7 @@ class Candidate:
     layout: str
     comms_bytes: int = 0
     peak_hbm_bytes: int = 0
+    calibrated_hbm_bytes: int = 0  # modeled x calibration prior
     modeled_step_ms: float = 0.0
     status: str = "ok"        # ok | chosen | pruned:hbm | rejected:checks
     detail: str = ""
@@ -589,6 +590,7 @@ class Candidate:
         return {"candidate": self.key, "mesh": self.mesh,
                 "layout": self.layout, "comms_bytes": self.comms_bytes,
                 "peak_hbm_bytes": self.peak_hbm_bytes,
+                "calibrated_hbm_bytes": self.calibrated_hbm_bytes,
                 "modeled_step_ms": self.modeled_step_ms,
                 "status": self.status, "detail": self.detail}
 
@@ -609,6 +611,7 @@ class Plan:
     predicted: dict
     candidates: list
     model_kw: dict
+    hbm_prior: str = "none"  # calibration prior label the pruning used
 
     @property
     def chosen_key(self) -> str:
@@ -623,6 +626,7 @@ class Plan:
             "devices": self.devices,
             "device_kind": self.device_kind,
             "hbm_budget_bytes": self.hbm_budget_bytes,
+            "hbm_prior": self.hbm_prior,
             "mesh": self.mesh,
             "layout": self.layout,
             "chosen": self.chosen_key,
@@ -721,9 +725,29 @@ def _in_vals_for(closed, flat_specs):
     return vals
 
 
+def _resolve_hbm_prior(hbm_prior):
+    """(ratio or None, label) from the ``hbm_prior`` argument: None
+    keeps modeled bytes uncorrected; a number is used verbatim; a
+    string names a calibrated target whose measured/modeled ratio the
+    committed analysis/hbm_priors.json carries (ISSUE 19) — unknown
+    names resolve to None so the table can say ``prior:none`` loudly
+    instead of silently inventing a correction."""
+    if hbm_prior is None:
+        return None, "none"
+    if isinstance(hbm_prior, str):
+        from apex_tpu.analysis.memory_checks import prior_for
+        ratio = prior_for(hbm_prior)
+        if ratio is None:
+            return None, f"none ({hbm_prior}: no capture)"
+        return ratio, f"{ratio:g} ({hbm_prior})"
+    from apex_tpu.analysis.sharding_flow import prior_ratio_of
+    ratio = prior_ratio_of(hbm_prior)
+    return ratio, f"{ratio:g}"
+
+
 def plan(model="llama", devices=None, device_kind=None,
          hbm_budget_bytes=None, registry=None, verify=True,
-         min_mesh=None, **model_kw) -> Plan:
+         min_mesh=None, hbm_prior=None, **model_kw) -> Plan:
     """Search the mesh/layout space for ``model`` over ``devices`` and
     return the verified :class:`Plan` (see module docstring for the
     pipeline). Raises :class:`PlanError` when nothing survives.
@@ -731,7 +755,16 @@ def plan(model="llama", devices=None, device_kind=None,
     ``min_mesh``: {axis: minimum size} executability floor from the
     consumer — e.g. a step whose collectives require a bound tp axis
     passes ``{"tp": 2}`` so the search never emits a mesh its runtime
-    cannot execute."""
+    cannot execute.
+
+    ``hbm_prior``: calibration correction for the HBM pruning gate —
+    a measured/modeled ratio (number), the name of a calibrated target
+    in analysis/hbm_priors.json (string), or None to prune on raw
+    modeled bytes. With a prior, candidates are pruned on
+    ``modeled x prior`` (the planner's best estimate of what the
+    compiler will actually allocate — the fused-Adam master-weight
+    target runs 3.4x its modeled peak), and the ranked table carries
+    the calibrated column."""
     from apex_tpu.analysis.sharding_checks import analyze_sharding_jaxpr
     from apex_tpu.analysis.sharding_flow import estimate_hbm_and_comms
 
@@ -750,6 +783,8 @@ def plan(model="llama", devices=None, device_kind=None,
     if hbm_budget_bytes is None:
         from apex_tpu.ops.pallas_config import device_hbm_bytes
         hbm_budget_bytes = device_hbm_bytes(device_kind)
+
+    prior_ratio, prior_label = _resolve_hbm_prior(hbm_prior)
 
     mdl = PLAN_MODELS[model](**(model_kw or {}))
     candidates = _enumerate(mdl, devices, min_mesh=min_mesh)
@@ -778,15 +813,28 @@ def plan(model="llama", devices=None, device_kind=None,
             traced.closed, in_vals, donated=traced.donated,
             axis_sizes={"dp": cand.dp, "tp": cand.tp})
         cand.peak_hbm_bytes = stats["peak_hbm_bytes"]
+        cand.calibrated_hbm_bytes = (
+            int(round(cand.peak_hbm_bytes * prior_ratio))
+            if prior_ratio is not None else cand.peak_hbm_bytes)
         cand.comms_bytes = _candidate_comms(mdl, traced, cand, stats)
         cand.modeled_step_ms = round(
             _modeled_step_s(mdl, traced, cand, device_kind, stats) * 1e3,
             6)
-        if cand.peak_hbm_bytes > hbm_budget_bytes:
+        # the pruning gate prices calibrated bytes: with no prior that
+        # IS the modeled peak (back-compat); with one, a candidate the
+        # raw model calls feasible can still be pruned (and vice versa)
+        if cand.calibrated_hbm_bytes > hbm_budget_bytes:
             cand.status = "pruned:hbm"
-            cand.detail = (
-                f"peak HBM {cand.peak_hbm_bytes} B exceeds the "
-                f"{hbm_budget_bytes} B per-device budget")
+            if prior_ratio is not None:
+                cand.detail = (
+                    f"calibrated HBM {cand.calibrated_hbm_bytes} B "
+                    f"(modeled {cand.peak_hbm_bytes} B x prior "
+                    f"{prior_ratio:g}) exceeds the {hbm_budget_bytes} B "
+                    f"per-device budget")
+            else:
+                cand.detail = (
+                    f"peak HBM {cand.peak_hbm_bytes} B exceeds the "
+                    f"{hbm_budget_bytes} B per-device budget")
         evaluated.append((cand, traced, in_vals))
 
     # deterministic ranking: modeled time, then comms, then peak HBM,
@@ -831,6 +879,7 @@ def plan(model="llama", devices=None, device_kind=None,
             "step_ms": chosen.modeled_step_ms,
             "comms_bytes": chosen.comms_bytes,
             "peak_hbm_bytes": chosen.peak_hbm_bytes,
+            "calibrated_hbm_bytes": chosen.calibrated_hbm_bytes,
             # which dp grad-sync layout the comms term priced
             # (docs/parallel.md "Overlapped buckets & ZeRO-1")
             "grad_sync": getattr(mdl, "grad_sync", "allreduce"),
@@ -839,6 +888,7 @@ def plan(model="llama", devices=None, device_kind=None,
         },
         candidates=ranked,
         model_kw={k: model_kw[k] for k in sorted(model_kw)},
+        hbm_prior=prior_label,
     )
     publish_to_registry(result, registry=registry)
     return result
@@ -872,6 +922,8 @@ def publish_to_registry(result: Plan, registry=None):
             cand.comms_bytes)
         reg.gauge("analysis/plan_peak_hbm_bytes", **labels).set(
             cand.peak_hbm_bytes)
+        reg.gauge("analysis/plan_calibrated_hbm_bytes", **labels).set(
+            cand.calibrated_hbm_bytes)
         reg.gauge("analysis/plan_chosen", **labels).set(
             1 if cand.status == "chosen" else 0)
     reg.event("plan", model=result.model, devices=result.devices,
@@ -882,19 +934,28 @@ def publish_to_registry(result: Plan, registry=None):
 def render_table(result: Plan) -> str:
     from apex_tpu.analysis.sharding_checks import _fmt_bytes
 
+    has_prior = not result.hbm_prior.startswith("none")
     lines = [
         f"auto-shard plan: {result.model} over {result.devices} "
         f"device(s) ({result.device_kind}), HBM budget "
-        f"{_fmt_bytes(result.hbm_budget_bytes)}",
+        f"{_fmt_bytes(result.hbm_budget_bytes)}, "
+        f"HBM prior {result.hbm_prior}",
         f"{'rank':>4s}  {'candidate':28s}  {'modeled':>12s}  "
-        f"{'comms/step':>12s}  {'peak HBM':>10s}  status",
+        f"{'comms/step':>12s}  {'peak HBM':>10s}  {'cal HBM':>10s}  "
+        f"status",
     ]
     for rank, cand in enumerate(result.candidates, 1):
+        # the calibrated column is modeled x prior (what pruning
+        # priced); with no capture it says so loudly instead of
+        # repeating the modeled number as if it were calibrated
+        cal = (_fmt_bytes(cand.calibrated_hbm_bytes) if has_prior
+               else "prior:none")
         lines.append(
             f"{rank:>4d}  {cand.key:28s}  "
             f"{cand.modeled_step_ms:>9.3f} ms  "
             f"{_fmt_bytes(cand.comms_bytes):>12s}  "
-            f"{_fmt_bytes(cand.peak_hbm_bytes):>10s}  {cand.status}")
+            f"{_fmt_bytes(cand.peak_hbm_bytes):>10s}  "
+            f"{cal:>10s}  {cand.status}")
     mesh = result.mesh
     verified = result.predicted["findings"]
     lines.append(
@@ -923,6 +984,13 @@ def main(argv=None):
                     help="device generation for the cost tables "
                          "(default: detected; 'cpu' uses v5e priors)")
     ap.add_argument("--hbm-budget-bytes", type=int, default=None)
+    ap.add_argument("--hbm-prior", default=None,
+                    help="calibration prior for the HBM pruning gate: "
+                         "a measured/modeled ratio (e.g. 3.43) or the "
+                         "name of a calibrated target in "
+                         "analysis/hbm_priors.json (e.g. "
+                         "fused_adam_master_sharded_step); default "
+                         "prunes on raw modeled bytes")
     ap.add_argument("--set", action="append", default=[],
                     metavar="KEY=INT",
                     help="model_kw override, e.g. --set layers=16")
@@ -954,11 +1022,19 @@ def main(argv=None):
     if args.grad_sync is not None:
         model_kw["grad_sync"] = args.grad_sync
 
+    hbm_prior = args.hbm_prior
+    if hbm_prior is not None:
+        try:
+            hbm_prior = float(hbm_prior)
+        except ValueError:
+            pass  # a target name — resolved against hbm_priors.json
+
     try:
         result = plan(model=args.model, devices=args.devices,
                       device_kind=args.device_kind,
                       hbm_budget_bytes=args.hbm_budget_bytes,
-                      verify=args.verify, **model_kw)
+                      verify=args.verify, hbm_prior=hbm_prior,
+                      **model_kw)
     except (ValueError, TypeError) as e:
         print(str(e), file=sys.stderr)
         return 2

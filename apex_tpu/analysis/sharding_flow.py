@@ -57,6 +57,7 @@ __all__ = [
     "ShardingLattice", "SHARDING_LATTICE",
     "shard_val_for_aval", "spec_from_partition_spec", "local_bytes",
     "collective_bytes", "estimate_hbm_and_comms", "normalize_spec",
+    "Liveness", "compute_liveness", "prior_ratio_of",
 ]
 
 # Call-like primitives whose bodies run in the caller's value world.
@@ -657,15 +658,84 @@ def _linearized(jaxpr):
     return hit
 
 
-def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
-                           axis_sizes=None):
-    """Liveness walk over the linearized program.
+@dataclasses.dataclass
+class Liveness:
+    """Per-value live-interval record of one linearized walk — the ONE
+    truth under both :func:`estimate_hbm_and_comms` and the
+    memory-liveness checks (:mod:`.memory_checks`, ISSUE 19). Every
+    field is in the canonical (caller-world) var namespace of
+    :func:`_linearize`; steps index into the linearized program.
 
-    ``donated``: flat invar indices whose buffers die at their last
-    read (jit donation); everything else is caller-owned for the whole
-    step. Returns ``{"peak_hbm_bytes", "input_bytes", "output_bytes",
-    "comms_bytes", "peak_step"}`` — all per-device estimates under the
-    propagated shardings.
+    ``births[cv]``/``deaths[cv]``: the half-open live interval (a var
+    is live at step ``s`` iff ``births[cv] <= s < deaths[cv]``).
+    Donation credit shows up as an early death: a donated invar that is
+    not returned dies at ``last_use + 1`` instead of surviving the
+    whole step."""
+
+    ctx: MeshCtx
+    env: dict
+    steps: list
+    vals: dict
+    births: dict
+    deaths: dict
+    first_use: dict
+    last_use: dict
+    producer: dict          # canonical var -> (step idx, eqn)
+    out_vars: frozenset
+    donated_vars: frozenset
+    invar_canon: tuple      # canonical var per flat invar index
+    n_steps: int
+    peak_hbm_bytes: int
+    peak_step: int
+    comms_bytes: int
+    input_bytes: int
+    output_bytes: int
+
+    def canon(self, v):
+        while v in self.env:
+            v = self.env[v]
+        return v
+
+    def var_bytes(self, cv) -> int:
+        return local_bytes(cv.aval, self.vals.get(cv), self.ctx)
+
+    def live_at(self, step):
+        """Canonical vars live at ``step`` (birth <= step < death)."""
+        return [cv for cv, b in self.births.items()
+                if b <= step < self.deaths[cv]]
+
+    def live_at_peak(self):
+        """The peak-composition record: ``(cv, bytes)`` pairs live at
+        the modeled peak, largest first."""
+        pairs = [(cv, self.var_bytes(cv))
+                 for cv in self.live_at(self.peak_step)]
+        pairs.sort(key=lambda p: (-p[1], str(p[0])))
+        return pairs
+
+    def steady_bytes(self) -> int:
+        """Bytes still live when the step returns (outputs plus every
+        caller-owned input/const) — the post-peak watermark the
+        peak-spike check compares the transient peak against."""
+        return sum(self.var_bytes(cv) for cv, d in self.deaths.items()
+                   if d > self.n_steps)
+
+    def donation_credit(self):
+        """Per flat invar index: True when the input's buffer was
+        donated AND actually credited (it dies before the step ends)."""
+        out = {}
+        for i, cv in enumerate(self.invar_canon):
+            out[i] = cv in self.donated_vars and \
+                self.deaths.get(cv, self.n_steps + 1) <= self.n_steps
+        return out
+
+
+def compute_liveness(closed, in_vals, donated=frozenset(),
+                     axis_sizes=None) -> Liveness:
+    """The liveness walk over the linearized program: propagate
+    ShardVals, account comms, and assign every canonical var its
+    birth/death interval with donation credit. Both the HBM estimator
+    and the memory-liveness engine consume this record, so the two can
+    never disagree on what is live when.
     """
     if axis_sizes is None:
         axis_sizes = live_mesh_axis_sizes()
@@ -750,14 +820,16 @@ def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
             vals[var] = val
 
     # liveness: birth/death step per canonical var
+    first_use: dict = {}
     last_use: dict = {}
     for idx, (eqn, reads) in enumerate(steps):
         for r in reads:
             if r is not None:
+                first_use.setdefault(r, idx)
                 last_use[r] = idx
-    out_vars = {canon(v) for v in jaxpr.outvars if _is_var(v)}
-    donated_vars = {canon(jaxpr.invars[i]) for i in donated
-                    if i < len(jaxpr.invars)}
+    out_vars = frozenset(canon(v) for v in jaxpr.outvars if _is_var(v))
+    donated_vars = frozenset(canon(jaxpr.invars[i]) for i in donated
+                             if i < len(jaxpr.invars))
     n_steps = len(steps)
 
     def var_bytes(v):
@@ -765,6 +837,7 @@ def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
 
     births: dict = {}
     deaths: dict = {}
+    producer: dict = {}
     for i, var in enumerate(jaxpr.invars):
         cv = canon(var)
         births[cv] = 0
@@ -782,6 +855,7 @@ def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
             if cv in births:
                 continue
             births[cv] = idx
+            producer[cv] = (idx, eqn)
             if cv in out_vars:
                 deaths[cv] = n_steps + 1
             else:
@@ -811,13 +885,68 @@ def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
             comms += collective_bytes(
                 "psum", var_bytes(canon(v)),
                 [ctx.size(a) for a in val.pending])
-    return {
-        "peak_hbm_bytes": int(peak),
-        "input_bytes": int(input_bytes),
-        "output_bytes": int(output_bytes),
-        "comms_bytes": int(comms),
-        "peak_step": int(peak_step),
+    return Liveness(
+        ctx=ctx, env=env, steps=steps, vals=vals, births=births,
+        deaths=deaths, first_use=first_use, last_use=last_use,
+        producer=producer, out_vars=out_vars,
+        donated_vars=donated_vars,
+        invar_canon=tuple(canon(v) for v in jaxpr.invars),
+        n_steps=n_steps, peak_hbm_bytes=int(peak),
+        peak_step=int(peak_step), comms_bytes=int(comms),
+        input_bytes=int(input_bytes), output_bytes=int(output_bytes))
+
+
+def prior_ratio_of(priors):
+    """Normalize a prior to a positive finite float ratio. Accepts a
+    bare number or a priors-file row (``{"ratio": ...}``); loud on
+    anything else — a drifted priors file must never silently price
+    the planner's pruning."""
+    ratio = priors.get("ratio") if isinstance(priors, dict) else priors
+    try:
+        ratio = float(ratio)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"HBM prior must be a number or a {{'ratio': ...}} row, "
+            f"got {priors!r}")
+    if not math.isfinite(ratio) or ratio <= 0:
+        raise ValueError(
+            f"HBM prior ratio must be positive and finite, got "
+            f"{ratio!r} (from {priors!r})")
+    return ratio
+
+
+def estimate_hbm_and_comms(closed, in_vals, donated=frozenset(),
+                           axis_sizes=None, priors=None):
+    """Liveness walk over the linearized program (a thin view over
+    :func:`compute_liveness` — the memory-liveness engine shares the
+    same record).
+
+    ``donated``: flat invar indices whose buffers die at their last
+    read (jit donation); everything else is caller-owned for the whole
+    step. ``priors``: an optional measured/modeled calibration ratio
+    (a number, or an ``analysis/hbm_priors.json`` row) — when given,
+    the result additionally carries ``prior_ratio`` and
+    ``calibrated_peak_hbm_bytes`` (modeled peak x prior), the bytes
+    calibrated consumers (planner pruning, hbm-budget) should price
+    on. Returns ``{"peak_hbm_bytes", "input_bytes", "output_bytes",
+    "comms_bytes", "peak_step"}`` — all per-device estimates under the
+    propagated shardings.
+    """
+    live = compute_liveness(closed, in_vals, donated=donated,
+                            axis_sizes=axis_sizes)
+    out = {
+        "peak_hbm_bytes": live.peak_hbm_bytes,
+        "input_bytes": live.input_bytes,
+        "output_bytes": live.output_bytes,
+        "comms_bytes": live.comms_bytes,
+        "peak_step": live.peak_step,
     }
+    if priors is not None:
+        ratio = prior_ratio_of(priors)
+        out["prior_ratio"] = ratio
+        out["calibrated_peak_hbm_bytes"] = int(
+            round(live.peak_hbm_bytes * ratio))
+    return out
 
 
 def _jaxpr_comms(jaxpr, ctx: MeshCtx, mult: int) -> int:

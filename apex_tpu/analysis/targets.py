@@ -20,6 +20,7 @@ from apex_tpu.analysis.sharding_checks import (
     SHARDING_CHECKS,
     analyze_sharding,
 )
+from apex_tpu.analysis.memory_checks import MEMORY_CHECKS, analyze_memory
 from apex_tpu.analysis.spmd_checks import SPMD_CHECKS, analyze_spmd
 from apex_tpu.analysis.state_checks import STATE_CHECKS, analyze_state
 
@@ -47,7 +48,7 @@ TARGET_CHECKS = ("kernel-auto-provenance", "step-record-schema")
 # full target suite when any of these is requested).
 TRACING_CHECKS = (tuple(JAXPR_CHECKS) + tuple(PRECISION_CHECKS)
                   + tuple(SHARDING_CHECKS) + tuple(SPMD_CHECKS)
-                  + tuple(STATE_CHECKS))
+                  + tuple(STATE_CHECKS) + tuple(MEMORY_CHECKS))
 
 # Per-target collective/host-effect counts from the last analyze_spmd
 # run of each spmd target (the analysis/spmd_* gauge family).
@@ -56,6 +57,10 @@ SPMD_STATS = {}
 # Per-target carried/saved leaf counts from the last analyze_state run
 # of each state target (the analysis/state_* gauge family).
 STATE_STATS = {}
+
+# Per-target peak/steady liveness numbers from the last analyze_memory
+# run of each memory target (the analysis/memory_* gauge family).
+MEMORY_STATS = {}
 
 
 def target(name, allow=()):
@@ -1732,6 +1737,242 @@ def run_state_findings(registry=None, names=None):
         results[name] = (
             [f for f in findings if f.symbol == name],
             dict(STATE_STATS.get(name, {})),
+        )
+    _report(results, registry=registry)
+    stats = {name: s for name, (_, s) in results.items()}
+    return findings, errors, stats
+
+
+@target("memory_llama_o4_step")
+def _memory_llama_o4_step():
+    """The llama O4 train step through the live-interval lattice: the
+    carry is donated (the run loop's real calling convention), so every
+    param/moment/fp8-ring buffer earns its donation credit and the
+    peak is the transient working set — hold an activation across the
+    backward or drop a donation and this target turns red."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.amp import Fp8DelayedScaler
+    from apex_tpu.models import llama
+    from apex_tpu.optimizers import fused_adam
+
+    cfg = llama.tiny(num_layers=1, num_heads=2, num_kv_heads=1,
+                     hidden_size=32, intermediate_size=64,
+                     vocab_size=128, max_seq_len=16)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tx = fused_adam(lr=1e-3)
+    fp8 = Fp8DelayedScaler(["lm_head"], history=4)
+    carry = (params, tx.init(params), fp8.init())
+    tokens = jnp.zeros((2, 16), jnp.int32)
+
+    def train_step(carry, tokens, targets):
+        params, opt_state, fp8_state = carry
+
+        def loss_fn(p):
+            logits = llama.forward(p, tokens, cfg, tp_axis=None,
+                                   cp_axis=None, ep_axis=None)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                lp, targets[..., None], axis=-1))
+
+        with fp8.step(fp8_state) as ctx:
+            loss, grads = ctx.value_and_grad(loss_fn)(params)
+        new_fp8 = fp8.update(fp8_state, ctx)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return (new_params, new_opt, new_fp8), loss
+
+    stats = MEMORY_STATS.setdefault("memory_llama_o4_step", {})
+    return analyze_memory(train_step, carry, tokens, tokens,
+                          name="memory_llama_o4_step",
+                          donate_argnums=(0,), state_argnums=(0,),
+                          stats_out=stats)
+
+
+@target("memory_zero1_fused_adam_step")
+def _memory_zero1_fused_adam_step():
+    """ZeRO-1 carry step under the liveness walk: the dp-sharded mu/nu
+    buckets and params are donated carry, so the interval lattice must
+    see their updates land in-place-shaped and charge only the
+    reduce-scatter transients against the peak."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel.zero import Zero1FusedAdam
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.bfloat16),
+                  "b": jnp.zeros((256,), jnp.bfloat16)}
+        opt = Zero1FusedAdam(lr=1e-3, weight_decay=0.01, axis_name="dp",
+                             num_shards=dp, bucket_cap_mb=0.1)
+        state = opt.init(params)
+        grads_of = _ddp_grad_model()
+
+        def step(x, state, params):
+            return opt.step(grads_of(x), state, params)
+
+        state_specs = opt.state_specs(params)
+        param_specs = {"w": P(), "b": P()}
+        fn = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("dp"), state_specs, param_specs),
+            out_specs=(param_specs, state_specs),
+            check_vma=False)
+
+        def train_step(carry, x):
+            params, ostate = carry
+            new_params, new_ostate = fn(x, ostate, params)
+            return new_params, new_ostate
+
+        stats = MEMORY_STATS.setdefault("memory_zero1_fused_adam_step",
+                                        {})
+        return analyze_memory(
+            train_step, (params, state),
+            jnp.zeros((8 * dp, 256), jnp.float32),
+            name="memory_zero1_fused_adam_step",
+            donate_argnums=(0,), state_argnums=(0,),
+            axis_sizes=sizes, stats_out=stats)
+    finally:
+        _release_mesh(owned)
+
+
+@target("memory_ddp_overlap_step")
+def _memory_ddp_overlap_step():
+    """Overlapped-DDP amp step through the interval lattice: bucketed
+    grad allreduce + scaled_update's cond must not hold the full grad
+    tree and the bucket slabs live at once past the spike gate, and
+    the donated carry (params, flat-adam state, scaler counters)
+    collects its credit."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.amp import LossScaler, scaled_update
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel.overlap import sync_gradients_overlapped
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        params = {"w": jnp.zeros((256, 256), jnp.float32),
+                  "b": jnp.zeros((256,), jnp.float32)}
+        tx = fused_adam(lr=1e-3, flat=True)
+        scaler = LossScaler()
+        carry = (params, tx.init(params), scaler.init())
+        grads_of = _ddp_grad_model()
+
+        def inner(x, params, opt_state, sstate):
+            grads = sync_gradients_overlapped(
+                grads_of(x), axis_name="dp", bucket_cap_mb=0.1)
+            updates, new_opt, new_sstate, _ovf = scaled_update(
+                tx, scaler, grads, opt_state, params, sstate,
+                overflow_reduce_axes=("dp",))
+            new_params = jax.tree_util.tree_map(
+                jnp.add, params, updates)
+            return new_params, new_opt, new_sstate
+
+        fn = jax.shard_map(
+            inner, mesh=mesh, in_specs=(P("dp"), P(), P(), P()),
+            out_specs=(P(), P(), P()), check_vma=False)
+
+        def train_step(carry, x):
+            params, opt_state, sstate = carry
+            return fn(x, params, opt_state, sstate)
+
+        stats = MEMORY_STATS.setdefault("memory_ddp_overlap_step", {})
+        return analyze_memory(
+            train_step, carry,
+            jnp.zeros((8 * dp, 256), jnp.float32),
+            name="memory_ddp_overlap_step",
+            donate_argnums=(0,), state_argnums=(0,),
+            axis_sizes=sizes, stats_out=stats)
+    finally:
+        _release_mesh(owned)
+
+
+@target("memory_fused_adam_master_sharded")
+def _memory_fused_adam_master_sharded():
+    """The calibration loop's 3.4x outlier (fused Adam over tp-sharded
+    fp32 masters) under the liveness walk, fully donated: grads, state
+    AND masters die into their updates, so every slab earns donation
+    credit and the modeled peak is the number hbm_priors.json's ratio
+    corrects. The grads slot is donated here where the sharding twin
+    (fused_adam_master_sharded_step) historically was not — exactly
+    the missed-donation pattern the check exists to catch."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.optimizers import fused_adam
+
+    mesh, sizes, owned = _owned_mesh(
+        tensor_model_parallel_size_=_tp_size())
+    try:
+        master = {"w": jnp.zeros((256, 1024), jnp.float32),
+                  "b": jnp.zeros((1024,), jnp.float32)}
+        tx = fused_adam(lr=1e-3, weight_decay=0.01, flat=False)
+        state = tx.init(master)
+        grads = jax.tree_util.tree_map(jnp.ones_like, master)
+
+        def step(grads, state, master):
+            updates, new_state = tx.update(grads, state, master)
+            return optax.apply_updates(master, updates), new_state
+
+        wspec = {"w": P(None, "tp"), "b": P("tp")}
+        state_spec = jax.tree_util.tree_map(
+            lambda s: (wspec["w"] if getattr(s, "ndim", 0) == 2 else
+                       wspec["b"] if getattr(s, "ndim", 0) == 1 else P()),
+            state, is_leaf=lambda s: hasattr(s, "shape"))
+        with jax.sharding.set_mesh(mesh):
+            stats = MEMORY_STATS.setdefault(
+                "memory_fused_adam_master_sharded", {})
+            return analyze_memory(
+                step, grads, state, master,
+                in_specs=[wspec, state_spec, wspec],
+                donate_argnums=(0, 1, 2), state_argnums=(1,),
+                axis_sizes=sizes, stats_out=stats,
+                name="memory_fused_adam_master_sharded")
+    finally:
+        _release_mesh(owned)
+
+
+MEMORY_TARGETS = (
+    "memory_llama_o4_step", "memory_zero1_fused_adam_step",
+    "memory_ddp_overlap_step", "memory_fused_adam_master_sharded",
+)
+
+
+def run_memory_findings(registry=None, names=None):
+    """Run only the memory-liveness targets and publish finding counts
+    (zero-filled over every check id) + per-target peak/steady bytes to
+    the observability registry (``analysis/memory_findings*`` +
+    ``analysis/memory_peak_hbm_bytes`` family) — the hook bench.py
+    reports through. Returns (findings, errors, stats)."""
+    from apex_tpu.analysis.memory_checks import (
+        MEMORY_CHECKS as _MC,
+        report_to_registry as _report,
+    )
+
+    wanted = tuple(names) if names is not None else MEMORY_TARGETS
+    unknown = set(wanted) - set(TARGETS)
+    if unknown:
+        raise ValueError(
+            f"unknown memory target(s) {sorted(unknown)}; valid: "
+            f"{sorted(MEMORY_TARGETS)}")
+    findings, errors = run_targets(set(wanted))
+    findings = [f for f in findings if f.check in _MC]
+    results = {}
+    for name in wanted:
+        if name in errors:
+            continue
+        results[name] = (
+            [f for f in findings if f.symbol == name],
+            dict(MEMORY_STATS.get(name, {})),
         )
     _report(results, registry=registry)
     stats = {name: s for name, (_, s) in results.items()}

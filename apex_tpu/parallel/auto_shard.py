@@ -122,6 +122,10 @@ def load_plan(path: str) -> Plan:
         pp=c["mesh"]["pp"], dp=c["mesh"]["dp"], tp=c["mesh"]["tp"],
         layout=c["layout"], comms_bytes=c["comms_bytes"],
         peak_hbm_bytes=c["peak_hbm_bytes"],
+        # pre-ISSUE-19 plans carry no calibrated column: no prior means
+        # calibrated == modeled, exactly what the planner would emit
+        calibrated_hbm_bytes=c.get("calibrated_hbm_bytes",
+                                   c["peak_hbm_bytes"]),
         modeled_step_ms=c["modeled_step_ms"], status=c["status"],
         detail=c.get("detail", "")) for c in data.get("candidates", ())]
     return Plan(
@@ -130,4 +134,5 @@ def load_plan(path: str) -> Plan:
         hbm_budget_bytes=data["hbm_budget_bytes"], mesh=data["mesh"],
         layout=data["layout"], specs=data["specs"],
         predicted=data["predicted"], candidates=candidates,
-        model_kw=data.get("model_kw", {}))
+        model_kw=data.get("model_kw", {}),
+        hbm_prior=data.get("hbm_prior", "none"))

@@ -1345,6 +1345,26 @@ def worker():
     except Exception as e:  # same contract as the precision hook
         extras["state_findings_error"] = repr(e)[:120]
 
+    # memory-liveness verdict (ISSUE 19): the live-interval checks over
+    # the donated-carry train steps — the zero-filled
+    # analysis/memory_findings{check=} counter family lands in the JSON
+    # line (every check id explicit, even at 0) alongside the
+    # per-target modeled peak-HBM gauges the calibration priors correct
+    try:
+        from apex_tpu.analysis import run_memory_findings
+
+        mfindings, merrors, mstats = run_memory_findings(registry=reg)
+        extras["memory_findings"] = len(mfindings)
+        extras["memory_targets"] = {
+            name: {"peak_hbm_bytes": int(s.get("peak_hbm_bytes", 0)),
+                   "steady_bytes": int(s.get("steady_bytes", 0))}
+            for name, s in sorted(mstats.items())}
+        if merrors:
+            extras["memory_target_errors"] = dict(sorted(
+                merrors.items()))
+    except Exception as e:  # same contract as the precision hook
+        extras["memory_findings_error"] = repr(e)[:120]
+
     # fp8-vs-bf16 matmul race (ISSUE 13): the O4 tier's perf evidence —
     # CPU emulation here, real MXU numbers on the next relay window
     try:

@@ -575,3 +575,78 @@ def test_concurrency_family_table_renders(tmp_path):
     assert "analysis/concurrency_* family" in proc.stdout
     assert "blocking-call-under-lock 3" in proc.stdout
     assert "findings: 3" in proc.stdout
+
+
+def _memf(check, value):
+    return {"type": "counter", "name": "analysis/memory_findings",
+            "labels": {"check": check}, "value": value}
+
+
+def test_compare_memory_growth_fails_binary(tmp_path):
+    """Any memory check counter growing above base fails, with NO
+    threshold: one new missed-donation/peak-spike finding is a
+    regression regardless of the wall clock (ISSUE 19)."""
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=[_memf("missed-donation", 0)])
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_memf("missed-donation", 1)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION memory missed-donation" in proc.stdout
+    # a huge threshold changes nothing — the gate is binary
+    assert _run(cur, "--compare", base, "--compare-threshold",
+                "10.0").returncode == 1
+
+
+def test_compare_memory_new_nonzero_check_id_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl")
+    cur = _dump(tmp_path / "cur.jsonl",
+                extra=[_memf("peak-spike", 2)])
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION memory peak-spike" in proc.stdout
+
+
+def test_compare_memory_steady_or_fixed_passes(tmp_path):
+    """The zero-filled family in steady state (explicit 0s both sides)
+    and a fixed finding both pass; a check only in base is info."""
+    zeros = [_memf(c, 0) for c in
+             ("missed-donation", "remat-opportunity", "peak-spike",
+              "live-range-upcast", "offload-candidate")]
+    base = _dump(tmp_path / "base.jsonl",
+                 extra=zeros + [_memf("extinct-check", 1)])
+    cur = _dump(tmp_path / "cur.jsonl", extra=zeros)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 regression(s)" in proc.stdout
+    assert "only in base" in proc.stdout
+
+
+def test_memory_findings_family_table_renders(tmp_path):
+    path = _dump(tmp_path / "m.jsonl", extra=[
+        _memf("missed-donation", 1),
+        _memf("offload-candidate", 0),
+        {"type": "gauge", "name": "analysis/memory_findings_total",
+         "value": 1.0},
+        {"type": "gauge", "name": "analysis/memory_peak_hbm_bytes",
+         "labels": {"target": "memory_llama_o4_step"},
+         "value": 313196},
+    ])
+    proc = _run(path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis/memory_* family" in proc.stdout
+    assert "missed-donation" in proc.stdout
+    assert "modeled peak 313196 B" in proc.stdout
+    # --json prints one compact line per family present in the dump
+    proc_json = _run(path, "--json")
+    fam = None
+    for line in proc_json.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "memory_findings_family" in rec:
+            fam = rec["memory_findings_family"]
+    assert fam is not None
+    assert fam["checks"]["missed-donation"] == 1
+    assert fam["targets"]["memory_llama_o4_step"]["peak"] == 313196

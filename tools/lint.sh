@@ -17,10 +17,13 @@
 # ISSUE 18 ergonomics flags: `--engines ast,state` narrows the run to an
 # explicit engine subset (composes with --changed-only, since the
 # forwarded args reach both exec paths) and `--list-targets` prints the
-# registered jaxpr/dataflow/sharding/spmd/state targets with their
-# owning engine. The checkpoint/state-flow engine (ISSUE 18) runs its
-# four resume-path targets here like any other tracing engine and gets
-# its own line in the per-engine wall-time breakdown.
+# registered jaxpr/dataflow/sharding/spmd/state/memory targets with
+# their owning engine. The checkpoint/state-flow engine (ISSUE 18) runs
+# its four resume-path targets here like any other tracing engine and
+# gets its own line in the per-engine wall-time breakdown; the
+# memory-liveness engine (ISSUE 19, `--engines memory`) does the same
+# with its four donated-carry targets, which the gate holds at 0
+# findings.
 #
 # Wall-time budget (ISSUE 14 satellite): the CLI fails (exit 2, LOUD)
 # when the summed engine wall time exceeds LINT_TIME_BUDGET_S (default

@@ -66,7 +66,12 @@ zero-filled so every check id is explicit every run) gets the same
 treatment: a per-check table plus per-target carried/saved leaf
 gauges, and a binary ``--compare`` gate — one new unsaved-state /
 schema-drift / illegal-reshard / donation finding is a regression
-regardless of speed. The ``goodput/*`` family (ISSUE 17
+regardless of speed. The ``analysis/memory_findings{check=}`` family
+(ISSUE 19 — the memory-liveness engine's verdict, zero-filled the
+same way) gets the identical treatment: a per-check table plus
+per-target modeled-peak gauges, and a binary ``--compare`` gate —
+one new missed-donation / peak-spike / held-upcast finding is a
+regression regardless of speed. The ``goodput/*`` family (ISSUE 17
 — published by the run-ledger accounting, ``python -m
 apex_tpu.observability goodput``) gets the goodput table (ratio +
 fleet min, lost seconds by cause, badput top-3, per-rank ratios),
@@ -291,6 +296,66 @@ def _state_check_counts(records):
     counts = {}
     for rec in records:
         if rec.get("name") != "analysis/state_findings":
+            continue
+        labels = rec.get("labels", {}) or {}
+        try:
+            counts[labels.get("check", "?")] = float(rec.get("value"))
+        except (TypeError, ValueError):
+            continue
+    return counts
+
+
+def render_memory_findings_family(path):
+    """Per-check table of the ``analysis/memory_findings{check=}``
+    counter family (ISSUE 19 — the memory-liveness engine's verdict a
+    bench run ships with) from a metrics JSONL dump; None when the file
+    carries none. Distinct from :func:`render_memory_family`, which
+    reads the live ``memory/*`` HBM gauges — this family is the static
+    engine's zero-filled finding counters plus the per-target modeled
+    peaks the calibration priors correct. Later records win, matching
+    the registry's cumulative counter dumps."""
+    checks = {}
+    total = None
+    targets: dict = {}
+    records = _read_records(path)
+    if records is None:
+        return None
+    for rec in records:
+        name = rec.get("name", "")
+        if not isinstance(name, str):
+            continue
+        labels = rec.get("labels", {}) or {}
+        if name == "analysis/memory_findings_total":
+            total = rec.get("value")
+        elif name == "analysis/memory_findings":
+            checks[labels.get("check", "?")] = rec.get("value")
+        elif name == "analysis/memory_peak_hbm_bytes":
+            targets.setdefault(labels.get("target", "?"), {})[
+                "peak"] = rec.get("value")
+    if total is None and not checks:
+        return None
+    return {"checks": checks, "findings_total": total,
+            "targets": targets}
+
+
+def summarize_memory_findings(path, fam):
+    print(f"{path}: analysis/memory_* family")
+    if fam["findings_total"] is not None:
+        print(f"  findings: {int(fam['findings_total'])}")
+    for check, n in sorted(fam["checks"].items()):
+        print(f"    {check:26s} {n}")
+    for tgt, row in sorted(fam.get("targets", {}).items()):
+        peak = row.get("peak")
+        if peak is not None:
+            print(f"    {tgt:32s} modeled peak {int(peak)} B")
+
+
+def _memory_finding_counts(records):
+    """{check id: count} from ``analysis/memory_findings`` counters;
+    later records win (cumulative counter dumps)."""
+    counts = {}
+    for rec in records:
+        if rec.get("name") != "analysis/memory_findings":
             continue
         labels = rec.get("labels", {}) or {}
         try:
@@ -1286,6 +1351,28 @@ def compare_metrics(current_path, base_path, threshold=0.10):
             else:
                 infos.append(f"state {check}: {b:.0f} -> {c:.0f} ok")
 
+    cur_mem, base_mem = _memory_finding_counts(cur), \
+        _memory_finding_counts(base)
+    if cur_mem or base_mem:
+        for check in sorted(set(cur_mem) | set(base_mem)):
+            b = base_mem.get(check, 0.0)
+            c = cur_mem.get(check)
+            if c is None:
+                infos.append(f"memory {check}: only in base ({b:.0f})")
+                continue
+            # binary, no threshold: one new liveness hazard (dropped
+            # donation, peak spike, held upcast) is a regression
+            # regardless of what the wall clock did (ISSUE 19). The
+            # engine zero-fills the family, so c and b are explicit 0s
+            # on clean runs — a check id going nonzero always trips.
+            if c > b:
+                regressions.append(
+                    f"memory {check}: findings {b:.0f} -> {c:.0f} "
+                    f"(new memory-liveness hazard — see "
+                    f"docs/analysis.md#memory-liveness-checks)")
+            else:
+                infos.append(f"memory {check}: {b:.0f} -> {c:.0f} ok")
+
     cur_race, base_race = _race_wins(cur), _race_wins(base)
     for kernel in sorted(base_race):
         if kernel not in cur_race:
@@ -1421,6 +1508,14 @@ if __name__ == "__main__":
                                       "state_family": st}))
                 else:
                     summarize_state(arg, st)
+            memf = render_memory_findings_family(arg) \
+                if os.path.isfile(arg) else None
+            if memf is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "memory_findings_family": memf}))
+                else:
+                    summarize_memory_findings(arg, memf)
             pl = render_plan_family(arg) if os.path.isfile(arg) \
                 else None
             if pl is not None:

@@ -219,6 +219,16 @@ def main():
                      os.path.join(REPO, "TPU_MEMORY_r05.json")],
                     timeout=1200, log_path=BENCH_LOG, header="memory")
                 log_probe(event="memory_snapshot", rc=rc_m)
+                # refresh the committed HBM calibration priors from
+                # the live window (ISSUE 19): on-silicon ratios
+                # replace the CPU-backend ones the planner otherwise
+                # prices pruning on (failure is non-fatal)
+                rc_pr, _ = run_child(
+                    [sys.executable, "tools/refresh_priors.py",
+                     "--live"],
+                    timeout=1200, log_path=BENCH_LOG,
+                    header="refresh_priors")
+                log_probe(event="refresh_priors", rc=rc_pr)
                 # bonus evidence while the window is open: an xplane
                 # trace of the flagship step (failure is non-fatal)
                 rc_p, _ = run_child(
