@@ -150,10 +150,13 @@ _SYNC_ALLOWLIST = {os.path.join("apex_tpu", "runtime", "timing.py")}
 # sanctioned clocks (timing.py implements the corrected sync, the
 # observability layer's Timer/StepReporter are built on it;
 # resilience/ reads wall time for retry backoff/deadlines — host-side
-# scheduling, not device phase timing).
+# scheduling, not device phase timing; serving/ stamps request
+# lifecycle times (latency/ttft) and paces loadgen arrivals — same
+# host-side scheduling class as resilience/).
 _RAW_CLOCK_ALLOW_FILES = {"apex_tpu/runtime/timing.py"}
 _RAW_CLOCK_ALLOW_PREFIXES = ("apex_tpu/observability/",
-                             "apex_tpu/resilience/")
+                             "apex_tpu/resilience/",
+                             "apex_tpu/serving/")
 
 
 def _apex_tail(path: str):

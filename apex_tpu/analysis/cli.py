@@ -41,7 +41,7 @@ DEFAULT_PATHS = ("apex_tpu", "examples", "tools", "bench.py")
 # regression show up here, per ISSUE 8 satellite). Also the vocabulary
 # of --engines selection.
 ENGINE_NAMES = ("ast", "concurrency", "jaxpr", "dataflow", "sharding",
-                "spmd", "state", "memory")
+                "spmd", "state", "memory", "serving")
 
 # The engines that run via the registered tracing targets (everything
 # in ENGINE_NAMES except the two path-driven ones).
@@ -79,7 +79,11 @@ def known_checks():
 def target_engine(target_name):
     """Which ENGINE_NAMES bucket a registered target's wall time and
     findings roll up into."""
-    return ("dataflow" if target_name in targets.PRECISION_TARGETS else
+    # serving first: its targets also live in the spmd/state/memory
+    # family tuples (their checks are those families') but their wall
+    # time gets the dedicated serving bucket
+    return ("serving" if target_name in targets.SERVING_TARGETS else
+            "dataflow" if target_name in targets.PRECISION_TARGETS else
             "sharding" if target_name in targets.SHARDING_TARGETS else
             "spmd" if target_name in targets.SPMD_TARGETS else
             "state" if target_name in targets.STATE_TARGETS else

@@ -1387,7 +1387,7 @@ SPMD_TARGETS = (
     "spmd_ddp_sync_gradients", "spmd_ddp_overlap_bucket_step",
     "spmd_fleet_probe_grad_sync", "spmd_zero1_fused_adam_step",
     "spmd_pp_1f1b_microbatch_step", "spmd_llama_o4_step",
-    "spmd_simple_distributed",
+    "spmd_simple_distributed", "spmd_serving_decode_step",
 )
 
 
@@ -1708,6 +1708,7 @@ def _state_resilient_resume_path():
 STATE_TARGETS = (
     "state_llama_o4_step", "state_zero1_fused_adam_step",
     "state_ddp_overlap_step", "state_resilient_resume_path",
+    "state_serving_decode_step",
 )
 
 
@@ -1944,6 +1945,7 @@ def _memory_fused_adam_master_sharded():
 MEMORY_TARGETS = (
     "memory_llama_o4_step", "memory_zero1_fused_adam_step",
     "memory_ddp_overlap_step", "memory_fused_adam_master_sharded",
+    "memory_serving_decode_step",
 )
 
 
@@ -1977,3 +1979,140 @@ def run_memory_findings(registry=None, names=None):
     _report(results, registry=registry)
     stats = {name: s for name, (_, s) in results.items()}
     return findings, errors, stats
+
+
+# ------------------------------------------------------- serving targets
+#
+# The serving decode step (apex_tpu/serving/scheduler.py) as analysis
+# targets: the same static-shape step the engine jits, proven through
+# the state fixpoint (carried tokens/pages/positions), the memory
+# liveness walk (donated page buffers), and — for fleet serving — the
+# SPMD audit of the dp-replicated variant. They live in the state/
+# memory/spmd family tuples (their checks ARE those families') but
+# roll their wall time into the dedicated "serving" engine bucket
+# (cli.target_engine checks SERVING_TARGETS first).
+
+
+def _serving_decode_fixture():
+    """Tiny-llama decode-step fixture shared by the serving targets:
+    (cfg, params, decode_fn, carry, tables, active) with 2 slots over
+    8 pages of 4 tokens (+ trash page), both rows mid-sequence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models import llama
+    from apex_tpu.serving.scheduler import build_decode_step
+
+    cfg = llama.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    page_size, num_pages, batch, maxp = 4, 8, 2, 4
+    decode = build_decode_step(cfg, page_size)
+    shape = (cfg.num_layers, num_pages + 1, page_size,
+             cfg.num_kv_heads, cfg.head_dim)
+    carry = (jnp.zeros((batch,), jnp.int32),
+             jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+             jnp.full((batch,), 5, jnp.int32))
+    tables = jnp.asarray(
+        np.arange(batch * maxp).reshape(batch, maxp), jnp.int32)
+    active = jnp.ones((batch,), bool)
+
+    def serve_step(carry, params, tables, active):
+        tokens, k_pages, v_pages, pos = carry
+        nxt, k_pages, v_pages = decode(params, {}, k_pages, v_pages,
+                                       tokens, tables, pos, active)
+        return nxt, k_pages, v_pages, pos + 1
+
+    return cfg, params, serve_step, carry, tables, active
+
+
+@target("state_serving_decode_step")
+def _state_serving_decode_step():
+    """The serving decode step through the state fixpoint: tokens,
+    both page buffers and the position vector are the carry a
+    continuous-batching server threads forever — every one must flow
+    step-to-step (a dropped page buffer would silently serve from a
+    stale cache)."""
+    _cfg, params, serve_step, carry, tables, active = \
+        _serving_decode_fixture()
+    stats = STATE_STATS.setdefault("state_serving_decode_step", {})
+    return analyze_state(serve_step, carry, params, tables, active,
+                         name="state_serving_decode_step",
+                         stats_out=stats)
+
+
+@target("memory_serving_decode_step")
+def _memory_serving_decode_step():
+    """The serving decode step through the liveness walk with the
+    carry donated — the engine's jit donates both page buffers every
+    step, so the lattice must see the scatter updates land
+    in-place-shaped and charge only the per-step activations (not a
+    second cache) against the peak."""
+    _cfg, params, serve_step, carry, tables, active = \
+        _serving_decode_fixture()
+    stats = MEMORY_STATS.setdefault("memory_serving_decode_step", {})
+    return analyze_memory(serve_step, carry, params, tables, active,
+                          name="memory_serving_decode_step",
+                          donate_argnums=(0,), state_argnums=(0,),
+                          stats_out=stats)
+
+
+@target("spmd_serving_decode_step")
+def _spmd_serving_decode_step():
+    """Fleet serving: dp-replicated decode shards the slot arrays and
+    page buffers over 'dp' (replica-private caches), params
+    replicated. There are NO collectives by design — each replica
+    serves its own requests — and the SPMD audit is what keeps that
+    true (an accidental cross-replica reduction would both corrupt
+    tokens and serialize the fleet)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.models import llama
+    from apex_tpu.serving.scheduler import build_decode_step
+
+    mesh, sizes, owned = _owned_mesh()
+    try:
+        dp = sizes.get("dp", 1)
+        cfg = llama.tiny()
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        page_size, num_pages, batch, maxp = 4, 8, 2, 4
+        decode = build_decode_step(cfg, page_size)
+
+        def local_step(params, k_pages, v_pages, tokens, tables, pos,
+                       active):
+            return decode(params, {}, k_pages, v_pages, tokens,
+                          tables, pos, active)
+
+        shape = (cfg.num_layers, dp * (num_pages + 1), page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        k_pages = jnp.zeros(shape, cfg.dtype)
+        v_pages = jnp.zeros(shape, cfg.dtype)
+        tokens = jnp.zeros((dp * batch,), jnp.int32)
+        tables = jnp.asarray(
+            np.tile(np.arange(batch * maxp).reshape(batch, maxp),
+                    (dp, 1)), jnp.int32)
+        pos = jnp.full((dp * batch,), 5, jnp.int32)
+        active = jnp.ones((dp * batch,), bool)
+        fn = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(), P(None, "dp"), P(None, "dp"), P("dp"),
+                      P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), P(None, "dp"), P(None, "dp")),
+            check_vma=False)
+        return _analyze_spmd_target(
+            "spmd_serving_decode_step", fn, params, k_pages, v_pages,
+            tokens, tables, pos, active, axis_sizes=sizes)
+    finally:
+        _release_mesh(owned)
+
+
+# The dedicated wall-time bucket (cli.ENGINE_NAMES "serving"): checked
+# FIRST by cli.target_engine, so these names bucket here even though
+# they also belong to the state/memory/spmd family tuples above.
+SERVING_TARGETS = (
+    "state_serving_decode_step", "memory_serving_decode_step",
+    "spmd_serving_decode_step",
+)

@@ -50,18 +50,28 @@ def _layer_qkv(x, lp, cfg, positions):
 
 
 def _decode_attention(q, k_cache, v_cache, pos):
-    """q [b, 1, nq, d] vs cache [b, max_len, nkv, d], valid idx <= pos."""
+    """q [b, 1, nq, d] vs cache [b, max_len, nkv, d], valid idx <= pos.
+
+    GQA contracts grouped: q reshapes to [b, nkv, rep, d] (query head
+    n = kv * rep + r) and both einsums run against the nkv-head cache
+    directly, so the rep× cache copy a ``jnp.repeat`` to nq heads would
+    materialize every decode step never exists. ``pos`` is a scalar for
+    the batch-uniform generate()/gpt2 loops, or any shape broadcastable
+    against [b, nq, max_len] (e.g. [b, 1, 1] per-row positions for the
+    serving scheduler's packed batches).
+    """
     b, _, nq, d = q.shape
     nkv = k_cache.shape[2]
     rep = nq // nkv
-    k = jnp.repeat(k_cache, rep, axis=2)          # [b, T, nq, d]
-    v = jnp.repeat(v_cache, rep, axis=2)
-    scores = jnp.einsum("bqnd,btnd->bnt", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * (d ** -0.5)
+    qg = q.astype(jnp.float32).reshape(b, nkv, rep, d)
+    scores = jnp.einsum("bkrd,btkd->bkrt", qg,
+                        k_cache.astype(jnp.float32)) * (d ** -0.5)
+    scores = scores.reshape(b, nq, -1)            # [b, nq, T]
     idx = jnp.arange(k_cache.shape[1])
     scores = jnp.where(idx[None, None, :] <= pos, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum("bnt,btnd->bnd", probs, v.astype(jnp.float32))
+    o = jnp.einsum("bkrt,btkd->bkrd", probs.reshape(b, nkv, rep, -1),
+                   v_cache.astype(jnp.float32))
     return o.reshape(b, 1, nq * d)
 
 

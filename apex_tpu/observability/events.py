@@ -84,6 +84,10 @@ EVENT_CATALOG = {
     "train_aborted": ("step", "rollbacks", "reason"),
     "resilience_give_up": ("scope", "attempts"),
     "chaos_probe": ("completed", "restarts", "steps", "plan"),
+    # serving (ISSUE 20): the engine's drain record — queue + in-flight
+    # counts at the moment the preemption contract fired
+    "serving_drain": ("reason", "iteration", "inflight", "queued",
+                      "dump_dir"),
 }
 
 #: the events whose required fields the run ledger's interval

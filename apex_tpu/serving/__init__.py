@@ -1,0 +1,50 @@
+"""apex_tpu.serving — continuous-batching TPU inference runtime.
+
+Paged KV cache (budget from the calibrated memory tier), a
+prefill/decode scheduler with one static decode shape, request
+telemetry on the metric registry, and the PR 5 drain/resume contract
+for preempted servers. See docs/serving.md.
+"""
+
+from apex_tpu.serving.engine import ServerMetrics, ServingEngine
+from apex_tpu.serving.kv_cache import (
+    PageAllocator,
+    PageBudget,
+    PagedKVCache,
+    derive_page_budget,
+    page_hbm_bytes,
+)
+from apex_tpu.serving.loadgen import (
+    TraceRequest,
+    make_trace,
+    run_closed_loop,
+    run_sequential,
+)
+from apex_tpu.serving.scheduler import (
+    ContinuousBatchScheduler,
+    Request,
+    build_decode_step,
+    build_prefill,
+    fp8_weight_scales,
+    pages_per_request,
+)
+
+__all__ = [
+    "ContinuousBatchScheduler",
+    "PageAllocator",
+    "PageBudget",
+    "PagedKVCache",
+    "Request",
+    "ServerMetrics",
+    "ServingEngine",
+    "TraceRequest",
+    "build_decode_step",
+    "build_prefill",
+    "derive_page_budget",
+    "fp8_weight_scales",
+    "make_trace",
+    "page_hbm_bytes",
+    "pages_per_request",
+    "run_closed_loop",
+    "run_sequential",
+]

@@ -1,0 +1,156 @@
+"""Seeded synthetic traffic + the closed-loop CPU bench driver.
+
+:func:`make_trace` draws a deterministic request trace — Poisson
+arrivals (exponential inter-arrival gaps at ``arrival_rate_hz``) with
+prompt/output lengths sampled from small categorical distributions —
+so every bench run and every chaos test replays the identical
+workload for a given seed.
+
+:func:`run_closed_loop` drives a :class:`ServingEngine` over a trace
+(wall-clock arrivals, or all-at-once for deterministic tests) and
+returns the report the bench emits: p50/p99 request latency, ttft
+p50/p99, tokens/s, mean batch occupancy. :func:`run_sequential` is
+the honest baseline — one-request-at-a-time ``generate()`` on the
+same trace, paying its real per-shape compile and no-batching costs —
+that continuous batching must beat on tokens/s.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "TraceRequest",
+    "make_trace",
+    "run_closed_loop",
+    "run_sequential",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceRequest:
+    rid: int
+    arrival_s: float
+    prompt: np.ndarray
+    max_new_tokens: int
+
+
+def make_trace(*, seed: int = 0, num_requests: int = 8,
+               arrival_rate_hz: float = 50.0,
+               prompt_lens: Sequence[int] = (4, 8, 12, 24),
+               output_lens: Sequence[int] = (4, 8, 16),
+               vocab_size: int = 256) -> List[TraceRequest]:
+    """A deterministic Poisson trace (same seed → same trace, token
+    for token)."""
+    if num_requests < 1 or arrival_rate_hz <= 0:
+        raise ValueError("need num_requests >= 1 and a positive "
+                         "arrival rate")
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    trace = []
+    for rid in range(num_requests):
+        t += float(rng.exponential(1.0 / arrival_rate_hz))
+        p = int(rng.choice(list(prompt_lens)))
+        max_new = int(rng.choice(list(output_lens)))
+        prompt = rng.randint(0, vocab_size, size=p).astype(np.int32)
+        trace.append(TraceRequest(rid=rid, arrival_s=t, prompt=prompt,
+                                  max_new_tokens=max_new))
+    return trace
+
+
+def _percentile(values, q: float) -> float:
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+def summarize(engine, wall_s: float) -> dict:
+    """The serving report from an engine's completed requests — the
+    shape bench.py emits verbatim as its ``serving`` object."""
+    reqs = engine.completed
+    lats = [(r.finish_s - r.submit_s) * 1e3 for r in reqs
+            if r.finish_s is not None and r.submit_s is not None]
+    ttfts = [(r.first_token_s - r.submit_s) * 1e3 for r in reqs
+             if r.first_token_s is not None and r.submit_s is not None]
+    tokens = sum(len(r.tokens) for r in reqs)
+    report = {
+        "requests": len(reqs),
+        "tokens": tokens,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_s": round(tokens / wall_s, 2) if wall_s > 0 else 0.0,
+        "mean_occupancy": round(engine.mean_occupancy(), 4),
+        "decode_steps": engine.scheduler.decode_steps,
+        "prefills": engine.scheduler.prefill_count,
+        "decode_retraces": engine.scheduler.decode_retraces(),
+    }
+    if lats:
+        report["latency_p50_ms"] = round(_percentile(lats, 50), 3)
+        report["latency_p99_ms"] = round(_percentile(lats, 99), 3)
+    if ttfts:
+        report["ttft_p50_ms"] = round(_percentile(ttfts, 50), 3)
+        report["ttft_p99_ms"] = round(_percentile(ttfts, 99), 3)
+    return report
+
+
+def run_closed_loop(engine, trace: List[TraceRequest], *,
+                    use_wall_clock: bool = True,
+                    publish: bool = True) -> dict:
+    """Drive ``engine`` over ``trace`` to completion and report.
+
+    ``use_wall_clock=True`` injects each request when real time passes
+    its arrival offset (the bench's arrival dynamics);
+    ``use_wall_clock=False`` submits everything up front — fully
+    deterministic scheduling for tests. ``publish`` mirrors the report
+    as ``serving/*`` gauges on the engine's registry.
+    """
+    pending = collections.deque(
+        sorted(trace, key=lambda t: (t.arrival_s, t.rid)))
+    start = time.monotonic()
+    while pending or engine.pending:
+        now = time.monotonic() - start
+        while pending and (not use_wall_clock
+                           or pending[0].arrival_s <= now):
+            tr = pending.popleft()
+            engine.submit(tr.prompt, tr.max_new_tokens, rid=tr.rid,
+                          arrival_s=tr.arrival_s)
+        if engine.pending:
+            engine.step()
+        elif pending:
+            # idle until the next arrival — nothing to decode
+            time.sleep(max(0.0, min(
+                0.01, pending[0].arrival_s - (time.monotonic() - start))))
+    wall = time.monotonic() - start
+    report = summarize(engine, wall)
+    if publish:
+        engine.metrics.publish_summary(report)
+    return report
+
+
+def run_sequential(params, cfg, trace: List[TraceRequest]) -> dict:
+    """The no-batching baseline: each request runs alone through
+    ``models.generate.generate`` (greedy), paying the real
+    per-(prompt_len, max_new) compile and serialization costs a
+    server without continuous batching would pay."""
+    from apex_tpu.models.generate import generate
+
+    start = time.monotonic()
+    tokens = 0
+    results = {}
+    for tr in trace:
+        import jax.numpy as jnp
+        out = generate(params, jnp.asarray(tr.prompt)[None, :], cfg,
+                       tr.max_new_tokens)
+        out = np.asarray(out)  # block: the request is done when read
+        results[tr.rid] = [int(t) for t in out[0, len(tr.prompt):]]
+        tokens += tr.max_new_tokens
+    wall = time.monotonic() - start
+    return {
+        "requests": len(trace),
+        "tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2) if wall > 0 else 0.0,
+        "results": results,
+    }

@@ -1,0 +1,494 @@
+"""Continuous-batching scheduler: prefill/decode split over paged KV.
+
+The serving loop has exactly two compiled shapes:
+
+- **prefill** — one full-sequence flash-attention pass per admitted
+  request, bucketed to page-size multiples of prompt length (one jit
+  per bucket, named ``_serving_prefill_s<S>`` so the recompile listener
+  attributes them separately from the decode step);
+- **decode** — ONE static-shape jit step (``_decode_step``) over the
+  packed ``[max_batch]`` slot arrays and the donated page buffers. The
+  batch composition (which requests occupy which slots, who is active)
+  is data — block tables, positions and an active mask — never shape,
+  so steady-state decode retraces exactly zero times.
+
+Every decode op is per-slot independent (row-wise gemms, per-row
+attention over the row's own block table, per-row argmax), which is
+what makes a request's token stream bit-identical regardless of what
+else shares the batch — the property the preempt/resume chaos test
+pins down.
+
+Admission is FCFS: a request enters when a slot is free AND its whole
+page worst case (padded prompt + max_new_tokens) can be allocated, so
+an admitted request can never deadlock on pages mid-decode. Eviction
+(EOS or length cap) frees pages and refills from the queue.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models import generate as _gen
+from apex_tpu.models import llama as _llama
+from apex_tpu.serving.kv_cache import PagedKVCache
+from apex_tpu.transformer.functional.rope import apply_rotary_qk
+
+__all__ = [
+    "ContinuousBatchScheduler",
+    "Request",
+    "build_decode_step",
+    "build_prefill",
+    "fp8_weight_scales",
+    "pages_per_request",
+]
+
+_E4M3_MAX = 448.0
+WEIGHT_MODES = ("native", "bf16", "fp8")
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request and its lifecycle timestamps (monotonic
+    seconds; ``arrival_s`` is the loadgen trace offset)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival_s: float = 0.0
+    submit_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    state: str = "queued"                 # queued -> active -> done
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+def pages_per_request(prompt_len: int, max_new_tokens: int,
+                      page_size: int) -> int:
+    """Worst-case pages one request holds: the padded prompt bucket
+    plus every decode write. Allocated whole at admission so decode
+    can never stall on pages."""
+    bucket = max(1, math.ceil(prompt_len / page_size)) * page_size
+    return math.ceil((bucket + max_new_tokens) / page_size)
+
+
+def fp8_weight_scales(params) -> Dict[str, jax.Array]:
+    """Static per-layer weight scales (E4M3 amax scaling) for every
+    dense layer kernel, stacked ``[L]`` to ride the decode scan's xs.
+    Serving weights are frozen, so one amax pass at engine build
+    replaces the training path's delayed-scaling ring."""
+    out = {}
+    for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+        w = params["layers"][name].astype(jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+        out[name] = _E4M3_MAX / jnp.maximum(amax, 1e-12)
+    return out
+
+
+def _make_mm(weight_mode: str):
+    """The bf16-or-fp8 routing hook: every layer gemm goes through
+    here. ``native`` is a plain matmul in the activation dtype (the
+    exact op generate.py uses, so tokens match the reference decoder);
+    ``fp8`` routes through :func:`~apex_tpu.ops.precision.matmul_fp8`
+    with the static weight scales."""
+    if weight_mode == "fp8":
+        from apex_tpu.ops.precision import matmul_fp8
+
+        def mm(x, w, scale):
+            return matmul_fp8(x, w, jnp.float32(1.0),
+                              scale).astype(x.dtype)
+    else:
+        def mm(x, w, scale):
+            del scale
+            return jnp.matmul(x, w.astype(x.dtype))
+    return mm
+
+
+def _normalize_weight_mode(weight_mode: str) -> str:
+    if weight_mode not in WEIGHT_MODES:
+        raise ValueError(f"weight_mode must be one of {WEIGHT_MODES}, "
+                         f"got {weight_mode!r}")
+    return "fp8" if weight_mode == "fp8" else "native"
+
+
+def build_decode_step(cfg, page_size: int, weight_mode: str = "native"):
+    """The ONE jit-compiled decode step (jit + donation is the
+    caller's: ``jax.jit(step, donate_argnums=(2, 3))``).
+
+    ``(params, scales, k_pages, v_pages, tokens, tables, pos, active)
+    -> (next_tokens, k_pages, v_pages)`` — all batch inputs are packed
+    ``[max_batch]`` slot arrays; ``tables`` is ``[max_batch,
+    max_pages]`` of page indices (trash-padded). Inactive slots write
+    their k/v to the trash page and pass their token through, so the
+    step is total over any batch composition with zero control flow.
+    Greedy (argmax) by design — the bit-reproducibility contract.
+    """
+    if cfg.moe:
+        raise NotImplementedError(
+            "serving decode is dense-only; MoE routing needs a paged "
+            "expert-gather step (llama dense configs only for now)")
+    mode = _normalize_weight_mode(weight_mode)
+    mm = _make_mm(mode)
+    d = cfg.head_dim
+
+    def _layer(x, lp, sc, kp, vp, tables, pos, page_idx, off):
+        b = x.shape[0]
+        h = _llama._rmsnorm(x, lp["attn_norm"], cfg.rms_eps)
+        q = mm(h, lp["wq"], sc.get("wq")).reshape(b, 1, cfg.num_heads, d)
+        k = mm(h, lp["wk"], sc.get("wk")).reshape(
+            b, 1, cfg.num_kv_heads, d)
+        v = mm(h, lp["wv"], sc.get("wv")).reshape(
+            b, 1, cfg.num_kv_heads, d)
+        q, k = apply_rotary_qk(q, k, positions=pos[:, None],
+                               base=cfg.rope_theta)
+        kp = kp.at[page_idx, off].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page_idx, off].set(v[:, 0].astype(vp.dtype))
+        kg = kp[tables].reshape(b, -1, cfg.num_kv_heads, d)
+        vg = vp[tables].reshape(b, -1, cfg.num_kv_heads, d)
+        o = _gen._decode_attention(q, kg, vg,
+                                   pos[:, None, None]).astype(x.dtype)
+        x = x + mm(o, lp["wo"], sc.get("wo"))
+        hm = _llama._rmsnorm(x, lp["mlp_norm"], cfg.rms_eps)
+        g = mm(hm, lp["wg"], sc.get("wg"))
+        u = mm(hm, lp["wu"], sc.get("wu"))
+        return x + mm(jax.nn.silu(g) * u, lp["wd"], sc.get("wd")), kp, vp
+
+    def _decode_step(params, scales, k_pages, v_pages, tokens, tables,
+                     pos, active):
+        x = _llama.embed(params, tokens[:, None], cfg, tp_axis=None)
+        trash = k_pages.shape[1] - 1
+        page_idx = jnp.take_along_axis(
+            tables, (pos // page_size)[:, None], axis=1)[:, 0]
+        page_idx = jnp.where(active, page_idx, trash)
+        off = pos % page_size
+
+        def body(h, layer):
+            lp, sc, kp, vp = layer
+            h, kp, vp = _layer(h, lp, sc, kp, vp, tables, pos,
+                               page_idx, off)
+            return h, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            body, x, (params["layers"], scales, k_pages, v_pages))
+        logits = _gen._logits(params, x, cfg)[:, 0]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jnp.where(active, nxt, tokens), k_pages, v_pages
+
+    return _decode_step
+
+
+def build_prefill(cfg, bucket_len: int, weight_mode: str = "native"):
+    """Jit'd full-sequence prefill for ONE prompt padded to
+    ``bucket_len``: ``(params, scales, prompt [1, S], true_len) ->
+    (first_token [1], ks [L, S, nkv, d], vs [L, S, nkv, d])``.
+
+    Causal flash attention means the pad suffix never contaminates
+    real positions; the pad k/v land in the request's pages but decode
+    overwrites index ``p + t`` before ever unmasking it. The jit is
+    named per bucket so prefill compiles never count against the
+    decode step's zero-retrace guard.
+    """
+    if cfg.moe:
+        raise NotImplementedError("serving prefill is dense-only")
+    mode = _normalize_weight_mode(weight_mode)
+    mm = _make_mm(mode)
+    d = cfg.head_dim
+
+    def prefill(params, scales, prompt, true_len):
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        b, s = prompt.shape
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = _llama.embed(params, prompt, cfg, tp_axis=None)
+
+        def body(h, layer):
+            lp, sc = layer
+            hh = _llama._rmsnorm(h, lp["attn_norm"], cfg.rms_eps)
+            q = mm(hh, lp["wq"], sc.get("wq")).reshape(
+                b, s, cfg.num_heads, d)
+            k = mm(hh, lp["wk"], sc.get("wk")).reshape(
+                b, s, cfg.num_kv_heads, d)
+            v = mm(hh, lp["wv"], sc.get("wv")).reshape(
+                b, s, cfg.num_kv_heads, d)
+            q, k = apply_rotary_qk(q, k, positions=positions,
+                                   base=cfg.rope_theta)
+            o = flash_attention(q, k, v, causal=True, scale=d ** -0.5)
+            h = h + mm(o.reshape(b, s, -1), lp["wo"], sc.get("wo"))
+            hm = _llama._rmsnorm(h, lp["mlp_norm"], cfg.rms_eps)
+            g = mm(hm, lp["wg"], sc.get("wg"))
+            u = mm(hm, lp["wu"], sc.get("wu"))
+            h = h + mm(jax.nn.silu(g) * u, lp["wd"], sc.get("wd"))
+            return h, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["layers"], scales))
+        x_last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1,
+                                              axis=1)
+        logits = _gen._logits(params, x_last, cfg)[:, 0]
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (first, ks[:, 0].astype(cfg.dtype),
+                vs[:, 0].astype(cfg.dtype))
+
+    prefill.__name__ = f"_serving_prefill_s{bucket_len}"
+    prefill.__qualname__ = prefill.__name__
+    return jax.jit(prefill)
+
+
+class ContinuousBatchScheduler:
+    """Queue + slots + paged cache behind the two compiled shapes.
+
+    Host mirrors (numpy) of the slot arrays are the source of truth;
+    each decode step re-wraps them as device arrays (same shapes every
+    step — data changes, shapes never do).
+    """
+
+    def __init__(self, params, cfg, *, num_pages: int,
+                 page_size: int = 8, max_batch: int = 4,
+                 max_prompt_len: int = 64, max_new_cap: int = 32,
+                 weight_mode: str = "native",
+                 eos_id: Optional[int] = None):
+        if cfg.moe:
+            raise NotImplementedError("serving is dense-only")
+        if max_batch < 1 or page_size < 1:
+            raise ValueError("max_batch and page_size must be >= 1")
+        self.params = params
+        self.cfg = cfg
+        self.page_size = int(page_size)
+        self.max_batch = int(max_batch)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_new_cap = int(max_new_cap)
+        self.eos_id = eos_id
+        self.weight_mode = _normalize_weight_mode(weight_mode)
+        self.max_pages_per_req = pages_per_request(
+            max_prompt_len, max_new_cap, page_size)
+        if num_pages < self.max_pages_per_req:
+            raise ValueError(
+                f"num_pages={num_pages} cannot hold even one "
+                f"worst-case request ({self.max_pages_per_req} pages "
+                f"for prompt {max_prompt_len} + {max_new_cap} new)")
+        self.cache = PagedKVCache(cfg, num_pages, page_size)
+        self.queue: "collections.deque[Request]" = collections.deque()
+        self.slots: List[Optional[Request]] = [None] * self.max_batch
+        trash = self.cache.trash_page
+        self._tokens = np.zeros(self.max_batch, np.int32)
+        self._pos = np.zeros(self.max_batch, np.int32)
+        self._tables = np.full(
+            (self.max_batch, self.max_pages_per_req), trash, np.int32)
+        self._active = np.zeros(self.max_batch, bool)
+        self._scales = (fp8_weight_scales(params)
+                        if self.weight_mode == "fp8" else {})
+        self._decode = jax.jit(
+            build_decode_step(cfg, self.page_size, self.weight_mode),
+            donate_argnums=(2, 3))
+        self._prefills: Dict[int, object] = {}
+        self.decode_steps = 0
+        self.prefill_count = 0
+        # compile count of "_decode_step" right after OUR first compile
+        # — the zero-retrace guard's baseline (delta, so other engines'
+        # earlier compiles of the same-named step don't count here)
+        self._decode_compiles0: Optional[int] = None
+
+    # --------------------------------------------------------- queries
+
+    def occupancy(self) -> float:
+        return float(np.count_nonzero(self._active)) / self.max_batch
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(
+            r is not None for r in self.slots)
+
+    def num_active(self) -> int:
+        return int(np.count_nonzero(self._active))
+
+    def decode_retraces(self) -> int:
+        """Recompiles of ``_decode_step`` after this scheduler's own
+        first compile — steady-state must report 0."""
+        if self._decode_compiles0 is None:
+            return 0
+        from apex_tpu.observability import recompile
+        listener = recompile.install()
+        return max(0, listener.compiles("_decode_step")
+                   - self._decode_compiles0)
+
+    # ------------------------------------------------------- admission
+
+    def submit(self, req: Request) -> None:
+        p = len(req.prompt)
+        if not 1 <= p <= self.max_prompt_len:
+            raise ValueError(f"prompt length {p} outside "
+                             f"[1, {self.max_prompt_len}]")
+        if not 1 <= req.max_new_tokens <= self.max_new_cap:
+            raise ValueError(
+                f"max_new_tokens {req.max_new_tokens} outside "
+                f"[1, {self.max_new_cap}]")
+        self.queue.append(req)
+
+    def pages_needed(self, req: Request) -> int:
+        return pages_per_request(len(req.prompt), req.max_new_tokens,
+                                 self.page_size)
+
+    def try_admit(self) -> Tuple[List[Request], List[Request]]:
+        """Admit FCFS while a slot is free and the head request's
+        worst-case pages fit; returns ``(admitted, finished)`` —
+        finished covers single-token (or instant-EOS) requests that
+        complete inside their own prefill."""
+        admitted, finished = [], []
+        while self.queue and None in self.slots:
+            if not self.cache.alloc.can_alloc(
+                    self.pages_needed(self.queue[0])):
+                break
+            req = self.queue.popleft()
+            if self._admit(req):
+                admitted.append(req)
+            else:
+                admitted.append(req)
+                finished.append(req)
+        return admitted, finished
+
+    def _bucket(self, p: int) -> int:
+        return max(1, math.ceil(p / self.page_size)) * self.page_size
+
+    def _prefill_for(self, bucket_len: int):
+        fn = self._prefills.get(bucket_len)
+        if fn is None:
+            fn = build_prefill(self.cfg, bucket_len, self.weight_mode)
+            self._prefills[bucket_len] = fn
+        return fn
+
+    def _admit(self, req: Request) -> bool:
+        """Prefill + slot placement; returns False when the request
+        finished at its first token (no slot taken)."""
+        p = len(req.prompt)
+        s_pad = self._bucket(p)
+        pages = self.cache.alloc.alloc(self.pages_needed(req), req.rid)
+        prompt = np.zeros((1, s_pad), np.int32)
+        prompt[0, :p] = req.prompt
+        first, ks, vs = self._prefill_for(s_pad)(
+            self.params, self._scales, jnp.asarray(prompt),
+            np.int32(p))
+        self.prefill_count += 1
+        self.cache.write_prompt(pages[:s_pad // self.page_size], ks, vs)
+        t0 = int(np.asarray(first)[0])
+        req.tokens = [t0]
+        req.first_token_s = time.monotonic()
+        if self._is_finished(req, t0):
+            self._retire(req)
+            return False
+        slot = self.slots.index(None)
+        self.slots[slot] = req
+        req.state = "active"
+        self._tokens[slot] = t0
+        self._pos[slot] = p
+        row = np.full(self.max_pages_per_req, self.cache.trash_page,
+                      np.int32)
+        row[:len(pages)] = pages
+        self._tables[slot] = row
+        self._active[slot] = True
+        return True
+
+    # ---------------------------------------------------------- decode
+
+    def step_decode(self) -> List[Request]:
+        """One packed decode step; returns requests finished by it."""
+        if not self._active.any():
+            return []
+        nxt, self.cache.k_pages, self.cache.v_pages = self._decode(
+            self.params, self._scales,
+            self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(self._tokens), jnp.asarray(self._tables),
+            jnp.asarray(self._pos), jnp.asarray(self._active))
+        self.decode_steps += 1
+        if self._decode_compiles0 is None:
+            from apex_tpu.observability import recompile
+            self._decode_compiles0 = recompile.install().compiles(
+                "_decode_step")
+        nxt = np.asarray(nxt)
+        finished = []
+        for slot, req in enumerate(self.slots):
+            if req is None or not self._active[slot]:
+                continue
+            t = int(nxt[slot])
+            req.tokens.append(t)
+            self._tokens[slot] = t
+            self._pos[slot] += 1
+            if self._is_finished(req, t):
+                self._free_slot(slot)
+                self._retire(req)
+                finished.append(req)
+        return finished
+
+    def _is_finished(self, req: Request, token: int) -> bool:
+        return (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_id is not None and token == self.eos_id))
+
+    def _retire(self, req: Request) -> None:
+        req.state = "done"
+        req.finish_s = time.monotonic()
+        self.cache.alloc.free_owner(req.rid)
+
+    def _free_slot(self, slot: int) -> None:
+        self.slots[slot] = None
+        self._active[slot] = False
+        self._tables[slot] = self.cache.trash_page
+        self._tokens[slot] = 0
+        self._pos[slot] = 0
+
+    # --------------------------------------------------- dump / resume
+
+    def _req_record(self, req: Request) -> dict:
+        return {"rid": req.rid,
+                "prompt": [int(t) for t in req.prompt],
+                "max_new_tokens": int(req.max_new_tokens),
+                "arrival_s": float(req.arrival_s)}
+
+    def export_requests(self):
+        """Emergency-dump payload: (queued records, inflight records,
+        {name: numpy} page arrays). Inflight k/v pages are gathered so
+        resume restores them by scatter — re-prefilling would re-run
+        float math and forfeit bit-identical resumption."""
+        queued = [self._req_record(r) for r in self.queue]
+        inflight, arrays = [], {}
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            pages = self.cache.alloc.pages_of(req.rid)
+            k, v = self.cache.gather_pages(pages)
+            arrays[f"k_{req.rid}"] = k
+            arrays[f"v_{req.rid}"] = v
+            rec = self._req_record(req)
+            rec.update(pos=int(self._pos[slot]),
+                       tokens=[int(t) for t in req.tokens],
+                       npages=len(pages))
+            inflight.append(rec)
+        return queued, inflight, arrays
+
+    def import_request(self, rec: dict, k, v) -> Request:
+        """Rebuild one in-flight request from a dump record + its
+        gathered pages (resume path)."""
+        req = Request(rid=rec["rid"],
+                      prompt=np.asarray(rec["prompt"], np.int32),
+                      max_new_tokens=rec["max_new_tokens"],
+                      arrival_s=rec.get("arrival_s", 0.0),
+                      submit_s=time.monotonic())
+        slot = self.slots.index(None)
+        pages = self.cache.alloc.alloc(rec["npages"], req.rid)
+        self.cache.restore_pages(pages, k, v)
+        req.tokens = list(rec["tokens"])
+        req.state = "active"
+        req.first_token_s = time.monotonic()
+        self.slots[slot] = req
+        self._tokens[slot] = req.tokens[-1]
+        self._pos[slot] = rec["pos"]
+        row = np.full(self.max_pages_per_req, self.cache.trash_page,
+                      np.int32)
+        row[:len(pages)] = pages
+        self._tables[slot] = row
+        self._active[slot] = True
+        return req

@@ -885,6 +885,53 @@ def bench_allreduce(extras):
           f"{line['overlap_efficiency']}", file=sys.stderr)
 
 
+def bench_serving(extras):
+    """Continuous-batching inference closed loop (ISSUE 20): a seeded
+    Poisson trace through apex_tpu.serving.ServingEngine on the tiny
+    llama, against the one-request-at-a-time ``generate()`` baseline
+    on the SAME trace. Emits the ``serving`` JSON object (p50/p99
+    request latency, ttft, tokens/s, mean batch occupancy, retrace
+    count) and mirrors it as ``serving/*`` gauges, so
+    tools/metrics_report.py renders the family and the --compare gate
+    watches p99-latency growth and tokens/s drops between runs."""
+    import jax
+
+    from apex_tpu.models import llama
+    from apex_tpu.serving import (
+        ServingEngine,
+        make_trace,
+        run_closed_loop,
+        run_sequential,
+    )
+
+    cfg = llama.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    trace = make_trace(seed=0, num_requests=8, arrival_rate_hz=200.0,
+                       prompt_lens=(4, 8, 12), output_lens=(4, 8, 16),
+                       vocab_size=cfg.vocab_size)
+    engine = ServingEngine(params, cfg, page_size=8, max_batch=4,
+                           num_pages=64, max_prompt_len=16,
+                           max_new_cap=16)
+    report = run_closed_loop(engine, trace)
+    if report["decode_retraces"]:
+        # steady-state decode retracing means the static-shape contract
+        # broke — surfaced loudly, never silently averaged into tok/s
+        report["retrace_warning"] = (
+            f"{report['decode_retraces']} decode retrace(s) — the "
+            f"decode step should compile exactly once")
+    seq = run_sequential(params, cfg, trace)
+    report["sequential_tokens_per_s"] = seq["tokens_per_s"]
+    if seq["tokens_per_s"]:
+        report["speedup_vs_sequential"] = round(
+            report["tokens_per_s"] / seq["tokens_per_s"], 3)
+    extras["serving"] = report
+    print(f"serving: {report['requests']} reqs "
+          f"{report['tokens_per_s']} tok/s "
+          f"(sequential {seq['tokens_per_s']} tok/s)  "
+          f"p99 {report.get('latency_p99_ms', '-')} ms  "
+          f"occ {report['mean_occupancy']}", file=sys.stderr)
+
+
 def bench_fp8(cpu_mode, extras):
     """fp8-vs-bf16 llama matmul race (ISSUE 13): the lm_head-shaped
     gemm through ops.precision.matmul_fp8 (scale-in, E4M3 cast, fp32
@@ -1402,6 +1449,13 @@ def worker():
             bench_allreduce(extras)
         except Exception as e:  # noqa: BLE001 — never cost the JSON line
             extras["bench_allreduce_error"] = repr(e)[:200]
+        # the serving closed loop (ISSUE 20) is CPU-sized by design —
+        # tiny llama, 8 requests — so it always lands its JSON object
+        # + serving/* gauges, even on the fallback path
+        try:
+            bench_serving(extras)
+        except Exception as e:  # noqa: BLE001 — never cost the JSON line
+            extras["bench_serving_error"] = repr(e)[:200]
 
     def finalize_metrics():
         """Fold recompile counts into extras and (re)write the metrics
@@ -1514,7 +1568,7 @@ def worker():
         only = {s.strip() for s in os.environ.get("BENCH_ONLY", "").split(",")
                 if s.strip()}
         secondary = (bench_llama, bench_resnet, bench_kernels, bench_bert,
-                     bench_gpt2, bench_allreduce)
+                     bench_gpt2, bench_allreduce, bench_serving)
         if only:
             names = {fn.__name__.removeprefix("bench_") for fn in secondary}
             unknown = only - names

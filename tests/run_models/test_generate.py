@@ -77,6 +77,59 @@ def test_moe_top1_switch_decode_runs():
                                   preds[:, 5:-1])
 
 
+def test_decode_attention_gqa_matches_repeat_reference():
+    """The grouped-einsum GQA decode attention (ISSUE 20 satellite)
+    must be BIT-identical to the materialized jnp.repeat reference it
+    replaced — same fp32 contractions over d and T, only the rep×
+    cache copy removed — for scalar pos and for the serving
+    scheduler's per-row [b, 1, 1] pos."""
+    key = jax.random.PRNGKey(0)
+    b, T, nkv, rep, d = 3, 16, 2, 3, 8
+    nq = nkv * rep
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, nq, d), jnp.float32)
+    k_cache = jax.random.normal(kk, (b, T, nkv, d), jnp.float32)
+    v_cache = jax.random.normal(kv, (b, T, nkv, d), jnp.float32)
+
+    def reference(q, k_cache, v_cache, pos):
+        k = jnp.repeat(k_cache, rep, axis=2)      # [b, T, nq, d]
+        v = jnp.repeat(v_cache, rep, axis=2)
+        scores = jnp.einsum("bqnd,btnd->bnt", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * (d ** -0.5)
+        idx = jnp.arange(T)
+        scores = jnp.where(idx[None, None, :] <= pos, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bnt,btnd->bnd", probs, v.astype(jnp.float32))
+        return o.reshape(b, 1, nq * d)
+
+    for pos in (0, 9, T - 1):
+        want = np.asarray(reference(q, k_cache, v_cache, pos))
+        got = np.asarray(gen._decode_attention(q, k_cache, v_cache, pos))
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"grouped GQA attention diverged from "
+                               f"the repeat reference at pos={pos}")
+
+    # per-row positions (serving packed batch): each row must equal the
+    # scalar-pos result for its own position
+    rows = np.array([2, 9, 15])
+    got = np.asarray(gen._decode_attention(
+        q, k_cache, v_cache, jnp.asarray(rows)[:, None, None]))
+    for i, p in enumerate(rows):
+        want_i = np.asarray(reference(q, k_cache, v_cache, int(p)))[i]
+        np.testing.assert_array_equal(
+            got[i], want_i,
+            err_msg=f"per-row pos diverged for row {i} (pos {p})")
+
+    # bf16 caches exercise the astype path generate() actually runs
+    got16 = np.asarray(gen._decode_attention(
+        q.astype(jnp.bfloat16), k_cache.astype(jnp.bfloat16),
+        v_cache.astype(jnp.bfloat16), 9))
+    want16 = np.asarray(reference(
+        q.astype(jnp.bfloat16), k_cache.astype(jnp.bfloat16),
+        v_cache.astype(jnp.bfloat16), 9))
+    np.testing.assert_array_equal(got16, want16)
+
+
 def test_temperature_sampling_runs():
     cfg = llama.tiny(num_layers=1)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)

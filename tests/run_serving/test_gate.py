@@ -1,0 +1,108 @@
+"""tools/metrics_report.py serving gates (ISSUE 20 satellite): the
+--compare gate fails p99-latency growth and tokens/s drops past
+threshold on the serving/* summary gauges, and the report renders
+the serving family table from a metrics dump."""
+
+import json
+import os
+import subprocess
+import sys
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "tools", "metrics_report.py")
+
+
+def _dump(path, p99=100.0, tps=50.0, extra=()):
+    records = [
+        {"type": "gauge", "name": "serving/latency_p99_ms",
+         "value": p99},
+        {"type": "gauge", "name": "serving/tokens_per_s", "value": tps},
+        {"type": "gauge", "name": "serving/latency_p50_ms",
+         "value": p99 / 2},
+        {"type": "gauge", "name": "serving/mean_occupancy",
+         "value": 0.8},
+        *extra,
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, _TOOL, *args],
+                          capture_output=True, text=True, timeout=240)
+
+
+def test_within_threshold_passes(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p99=100.0, tps=50.0)
+    cur = _dump(tmp_path / "cur.jsonl", p99=105.0, tps=48.0)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 regression(s)" in proc.stdout
+
+
+def test_p99_latency_growth_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p99=100.0, tps=50.0)
+    cur = _dump(tmp_path / "cur.jsonl", p99=140.0, tps=50.0)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION serving/latency_p99_ms" in proc.stdout
+    # a looser threshold lets the same diff pass
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.5").returncode == 0
+
+
+def test_tokens_per_s_drop_fails(tmp_path):
+    base = _dump(tmp_path / "base.jsonl", p99=100.0, tps=50.0)
+    cur = _dump(tmp_path / "cur.jsonl", p99=100.0, tps=35.0)
+    proc = _run(cur, "--compare", base)
+    assert proc.returncode == 1
+    assert "REGRESSION serving/tokens_per_s" in proc.stdout
+    assert _run(cur, "--compare", base,
+                "--compare-threshold", "0.5").returncode == 0
+
+
+def test_faster_and_leaner_passes(tmp_path):
+    """Improvement in both gated directions is never a regression."""
+    base = _dump(tmp_path / "base.jsonl", p99=100.0, tps=50.0)
+    cur = _dump(tmp_path / "cur.jsonl", p99=60.0, tps=80.0)
+    assert _run(cur, "--compare", base).returncode == 0
+
+
+def test_gauge_only_in_base_is_info_not_failure(tmp_path):
+    base = _dump(tmp_path / "base.jsonl")
+    cur = tmp_path / "cur.jsonl"
+    with open(cur, "w") as f:
+        f.write(json.dumps({"type": "gauge", "name": "other/x",
+                            "value": 1.0}) + "\n")
+    proc = _run(str(cur), "--compare", base)
+    assert proc.returncode == 0
+    assert "only in base" in proc.stdout
+
+
+def test_report_renders_serving_family(tmp_path):
+    dump = _dump(tmp_path / "run.jsonl", extra=[
+        {"type": "counter", "name": "serving/requests_completed",
+         "value": 8},
+        {"type": "counter", "name": "serving/tokens_generated",
+         "value": 56},
+        {"type": "histogram", "name": "serving/request_latency_ms",
+         "count": 8, "total": 800.0, "min": 50.0, "max": 200.0,
+         "mean": 100.0, "p50": 90.0, "p90": 150.0, "p99": 190.0},
+    ])
+    proc = _run(dump)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "serving/* family" in out
+    assert "completed 8" in out
+    assert "tokens generated 56" in out
+    assert "request_latency_ms" in out
+    # --json mode carries the family as a machine-readable object
+    jproc = _run(dump, "--json")
+    assert jproc.returncode == 0
+    fams = [json.loads(line) for line in jproc.stdout.splitlines()
+            if "serving_family" in line]
+    assert fams
+    assert fams[0]["serving_family"]["counters"][
+        "requests_completed"] == 8

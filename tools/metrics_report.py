@@ -1026,6 +1026,94 @@ def summarize_goodput(path, fam):
         print(f"  rank {rank}: ratio {rr_s}")
 
 
+def render_serving_family(path):
+    """The ``serving/*`` family from a metrics JSONL dump (None when
+    the file carries none): request/token counters, the closed-loop
+    summary gauges (latency + ttft percentiles, tokens/s, mean
+    occupancy), and the live occupancy / page-utilization gauges the
+    engine publishes every step (ISSUE 20)."""
+    records = _read_records(path)
+    if records is None:
+        return None
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for rec in records:
+        name = rec.get("name")
+        if not isinstance(name, str) or not name.startswith("serving/"):
+            continue
+        key = name[len("serving/"):]
+        if rec.get("type") == "counter" and \
+                isinstance(rec.get("value"), (int, float)):
+            counters[key] = counters.get(key, 0) + rec["value"]
+        elif rec.get("type") == "gauge" and \
+                isinstance(rec.get("value"), (int, float)):
+            gauges[key] = rec["value"]
+        elif rec.get("type") in ("histogram", "timer") and \
+                isinstance(rec.get("p50"), (int, float)):
+            hists[key] = {q: rec.get(q) for q in
+                          ("count", "p50", "p90", "p99", "max")}
+    if not counters and not gauges and not hists:
+        return None
+    return {"counters": counters, "gauges": gauges,
+            "histograms": hists}
+
+
+def summarize_serving(path, fam):
+    print(f"{path}: serving/* family")
+    c, g = fam["counters"], fam["gauges"]
+
+    def cv(key):
+        v = c.get(key)
+        return f"{v:.0f}" if isinstance(v, (int, float)) else "-"
+
+    print(f"  requests submitted {cv('requests_submitted')}  "
+          f"admitted {cv('requests_admitted')}  "
+          f"completed {cv('requests_completed')}  "
+          f"preempted {cv('requests_preempted')}")
+    if "tokens_generated" in c:
+        print(f"  tokens generated {cv('tokens_generated')}")
+    for key, label in (("tokens_per_s", "tokens/s"),
+                       ("mean_occupancy", "mean occupancy"),
+                       ("batch_occupancy", "batch occupancy"),
+                       ("page_utilization", "page utilization")):
+        if isinstance(g.get(key), (int, float)):
+            print(f"  {label:<18} {g[key]:.4g}")
+    for pair in (("latency_p50_ms", "latency_p99_ms"),
+                 ("ttft_p50_ms", "ttft_p99_ms")):
+        if any(isinstance(g.get(k), (int, float)) for k in pair):
+            name = pair[0].split("_p50")[0]
+            p50 = g.get(pair[0])
+            p99 = g.get(pair[1])
+            p50s = f"{p50:.3f}" if isinstance(p50, (int, float)) else "-"
+            p99s = f"{p99:.3f}" if isinstance(p99, (int, float)) else "-"
+            print(f"  {name:<8} p50 {p50s} ms  p99 {p99s} ms")
+    for key, h in sorted(fam["histograms"].items()):
+        cnt = h.get("count")
+        cnt_s = f"{cnt:.0f}" if isinstance(cnt, (int, float)) else "-"
+        p50 = h.get("p50")
+        p99 = h.get("p99")
+        p50s = f"{p50:.3f}" if isinstance(p50, (int, float)) else "-"
+        p99s = f"{p99:.3f}" if isinstance(p99, (int, float)) else "-"
+        print(f"    hist {key:<22} n={cnt_s} p50 {p50s} p99 {p99s}")
+
+
+def _serving_gauges(records):
+    """{name: value} for the unlabeled serving summary gauges the
+    closed-loop bench publishes — the two the --compare gate watches
+    (p99 latency, tokens/s) plus the rest for info lines."""
+    out = {}
+    watched = ("serving/latency_p99_ms", "serving/tokens_per_s",
+               "serving/latency_p50_ms", "serving/ttft_p99_ms",
+               "serving/mean_occupancy")
+    for rec in records:
+        if rec.get("type") == "gauge" and rec.get("name") in watched \
+                and not (rec.get("labels") or {}) \
+                and isinstance(rec.get("value"), (int, float)):
+            out[rec["name"]] = float(rec["value"])
+    return out
+
+
 def _goodput_ratio_gauges(records):
     """{name: value} for the unlabeled goodput ratio gauges the
     accounting publishes (ratio + fleet min)."""
@@ -1115,7 +1203,13 @@ def compare_metrics(current_path, base_path, threshold=0.10):
     - host concurrency (ISSUE 16): any
       ``analysis/concurrency_findings{check=}`` counter growing above
       its base value, or a check id absent/zero in base going nonzero
-      — binary, no threshold.
+      — binary, no threshold;
+    - serving (ISSUE 20): the ``serving/latency_p99_ms`` gauge growing
+      past ``threshold`` (request tail latency on the seeded trace),
+      or ``serving/tokens_per_s`` dropping past ``threshold``
+      (continuous-batching throughput) — the loadgen trace is
+      deterministic per seed, so the workload cannot explain either
+      move.
 
     Metrics present in only one dump are reported as info, never
     failed on: a shorter run is not a regression.
@@ -1245,6 +1339,34 @@ def compare_metrics(current_path, base_path, threshold=0.10):
                 f"spends more wall-clock on badput causes)")
         else:
             infos.append(f"{name}: goodput {b:.4f} -> {c:.4f} ok")
+
+    cur_srv, base_srv = _serving_gauges(cur), _serving_gauges(base)
+    for name in sorted(base_srv):
+        if name not in cur_srv:
+            infos.append(f"{name}: only in base ({base_srv[name]:.4g})")
+            continue
+        b, c = base_srv[name], cur_srv[name]
+        # the serving gates mirror the paper's inference-SLO framing
+        # (ISSUE 20): tail latency growing or throughput dropping past
+        # threshold on the SAME seeded trace means the scheduler or
+        # cache path regressed — the trace is deterministic, so the
+        # workload cannot explain the move
+        if name == "serving/latency_p99_ms" and b > 0 \
+                and c > b * (1.0 + threshold):
+            regressions.append(
+                f"{name}: p99 {b:.3f} -> {c:.3f} ms "
+                f"(+{(c / b - 1) * 100:.1f}% > {threshold * 100:.0f}% "
+                f"— request tail latency grew on the same trace)")
+        elif name == "serving/tokens_per_s" and b > 0 \
+                and c < b * (1.0 - threshold):
+            regressions.append(
+                f"{name}: {b:.2f} -> {c:.2f} tok/s "
+                f"(-{(1 - c / b) * 100:.1f}% > {threshold * 100:.0f}% "
+                f"— continuous-batching throughput dropped)")
+        else:
+            infos.append(f"{name}: {b:.4g} -> {c:.4g} ok")
+    for name in sorted(set(cur_srv) - set(base_srv)):
+        infos.append(f"{name}: new ({cur_srv[name]:.4g})")
 
     cur_fp8, base_fp8 = _fp8_speedup_gauges(cur), \
         _fp8_speedup_gauges(base)
@@ -1585,6 +1707,14 @@ if __name__ == "__main__":
                                       "goodput_family": gp}))
                 else:
                     summarize_goodput(arg, gp)
+            srv = render_serving_family(arg) if os.path.isfile(arg) \
+                else None
+            if srv is not None:
+                if json_mode:
+                    print(json.dumps({"path": arg,
+                                      "serving_family": srv}))
+                else:
+                    summarize_serving(arg, srv)
             passthrough.append(arg)
     remaining_files = [a for a in passthrough if os.path.isfile(a)]
     if handled_any and not remaining_files:
